@@ -1,0 +1,33 @@
+"""In-SPMD collective primitives (call these inside ``shard_map``/``pjit``).
+
+These are the TPU-native lowering of the reference's communication layer
+(``bluefog/torch/mpi_ops.py`` + ``bluefog/common/mpi_controller.cc``,
+upstream-relative): neighbor collectives become ``lax.ppermute`` matchings
+along the ICI mesh, dense collectives become ``lax.psum``/``all_gather``, and
+the weighted combination fuses into the surrounding XLA program instead of
+running on the host as in the reference (SURVEY.md §3.2 "HOT CPU" note).
+"""
+
+from bluefog_tpu.ops.collectives import (
+    allreduce,
+    allgather,
+    broadcast,
+    barrier,
+    neighbor_allreduce,
+    neighbor_allgather,
+    neighbor_allreduce_dynamic,
+    hierarchical_neighbor_allreduce,
+    pair_gossip,
+)
+from bluefog_tpu.ops.windows import (
+    WindowSpec,
+    WindowState,
+    win_create,
+    win_free,
+    win_put,
+    win_get,
+    win_accumulate,
+    win_update,
+    win_update_then_collect,
+    win_sync,
+)

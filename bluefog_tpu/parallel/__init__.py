@@ -1,0 +1,15 @@
+"""Mesh/context machinery and the array-level (host-side) API.
+
+``bluefog_tpu.parallel.context`` is the analog of the reference's
+``bluefog/common/basics.py`` + ``global_state.h`` (upstream-relative): the
+process-wide singleton holding the device mesh, current topology, compiled
+gossip schedules, and the window registry.
+
+``bluefog_tpu.parallel.api`` is the analog of ``bluefog/torch/mpi_ops.py``'s
+module-level functions, re-expressed for SPMD: tensors carry a leading
+``size``-sized rank axis sharded over the gossip mesh axis, and each call is a
+``shard_map`` around the in-SPMD primitive from ``bluefog_tpu.ops``.
+"""
+
+from bluefog_tpu.parallel.context import BluefogContext, get_context, init, shutdown
+from bluefog_tpu.parallel import api

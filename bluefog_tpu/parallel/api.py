@@ -1,0 +1,331 @@
+"""Array-level (host-side) op API — the ``bf.*`` surface of the reference.
+
+Reference parity (upstream-relative): module-level functions of
+``bluefog/torch/mpi_ops.py`` and the helpers of ``bluefog/torch/utility.py``.
+
+Representation: where the reference's process-per-rank model gives each rank a
+private ``tensor``, the SPMD model stacks all ranks' values into one global
+array with a leading ``size``-length *rank axis*, sharded over the gossip mesh
+axis (``P('bf')``).  ``x[r]`` is rank ``r``'s value.  Every function here
+wraps the corresponding in-SPMD primitive from ``bluefog_tpu.ops`` in a
+``shard_map`` over the context mesh; inside a user's own ``shard_map``-ed
+training step, call the ``bluefog_tpu.ops`` primitives directly instead.
+
+Because everything is jitted XLA, the reference's nonblocking/handle surface
+(``*_nonblocking``, ``poll``, ``synchronize`` — SURVEY.md §3.2) maps onto
+JAX's async dispatch: every call here *is* nonblocking (returns a future-like
+Array); ``jax.block_until_ready`` is the ``synchronize`` analog, and overlap
+with compute is handled by the XLA scheduler rather than a background thread.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from bluefog_tpu import ops as _ops
+from bluefog_tpu.ops.windows import WindowState
+from bluefog_tpu.parallel.context import get_context
+from bluefog_tpu.topology.graphs import Topology
+from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
+
+try:  # JAX >= 0.4.35
+    from jax import shard_map as _shard_map_mod  # type: ignore
+
+    shard_map = _shard_map_mod.shard_map if hasattr(_shard_map_mod, "shard_map") else _shard_map_mod
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = [
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "barrier",
+    "neighbor_allreduce",
+    "neighbor_allgather",
+    "hierarchical_neighbor_allreduce",
+    "win_create",
+    "win_free",
+    "win_put",
+    "win_get",
+    "win_accumulate",
+    "win_update",
+    "win_update_then_collect",
+    "broadcast_parameters",
+    "allreduce_parameters",
+    "broadcast_optimizer_state",
+    "rank_stack",
+    "rank_shard",
+]
+
+
+def _sched(topology) -> GossipSchedule:
+    if topology is None:
+        return get_context().schedule
+    if isinstance(topology, GossipSchedule):
+        return topology
+    return build_schedule(topology)
+
+
+def _smap(fn, n_in: int = 1, replicated_in: int = 0):
+    ctx = get_context()
+    ax = ctx.axis_name
+    in_specs = tuple([P(ax)] * n_in + [P()] * replicated_in)
+    return shard_map(
+        fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=P(ax), check_vma=False,
+    )
+
+
+def rank_stack(x, size: Optional[int] = None):
+    """Replicate a host value into the stacked per-rank representation:
+    ``out[r] = x`` for every rank (pytree-polymorphic)."""
+    n = size or get_context().size
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.broadcast_to(jnp.asarray(leaf)[None], (n,) + jnp.asarray(leaf).shape), x
+    )
+
+
+def rank_shard(x):
+    """Device-put a stacked array so the rank axis lies on the gossip mesh."""
+    ctx = get_context()
+    sharding = jax.sharding.NamedSharding(ctx.mesh, P(ctx.axis_name))
+    return jax.tree_util.tree_map(lambda leaf: jax.device_put(leaf, sharding), x)
+
+
+# ---------------------------------------------------------------------------
+# Collectives
+# ---------------------------------------------------------------------------
+
+
+def neighbor_allreduce(x, *, topology=None, self_weight=None, recv_weights=None):
+    """Stacked-array ``bf.neighbor_allreduce``: ``out[i] = W[i,i] x[i] +
+    sum_j W[i,j] x[j]`` with ``W`` from ``topology`` (default: context)."""
+    ctx = get_context()
+    sched = _sched(topology)
+
+    f = _smap(
+        lambda xs: _ops.neighbor_allreduce(
+            xs, sched, ctx.axis_name, self_weight=self_weight, recv_weights=recv_weights
+        )
+    )
+    return f(x)
+
+
+def neighbor_allgather(x, *, topology=None):
+    """Stacked ``bf.neighbor_allgather``: returns ``(slots, mask)``; see
+    :func:`bluefog_tpu.ops.collectives.neighbor_allgather` for the padding
+    deviation from the reference's ragged concatenation."""
+    ctx = get_context()
+    sched = _sched(topology)
+
+    def fn(xs):
+        slots, mask = _ops.neighbor_allgather(xs[0], sched, ctx.axis_name)
+        return slots[None], mask[None]
+
+    f = shard_map(
+        fn, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+        out_specs=(P(ctx.axis_name), P(ctx.axis_name)), check_vma=False,
+    )
+    return f(x)
+
+
+def allreduce(x, *, average: bool = True):
+    ctx = get_context()
+    return _smap(lambda xs: _ops.allreduce(xs, ctx.axis_name, average=average))(x)
+
+
+def allgather(x):
+    """Stacked allgather: every rank's row becomes the full stack — output
+    shape ``(size, size, ...)`` per the stacked-representation convention."""
+    ctx = get_context()
+    return _smap(lambda xs: _ops.allgather(xs, ctx.axis_name, axis=0, tiled=True)[None])(x)
+
+
+def broadcast(x, root_rank: int = 0):
+    ctx = get_context()
+    return _smap(lambda xs: _ops.broadcast(xs, root_rank, ctx.axis_name))(x)
+
+
+def barrier():
+    """Block the host until all in-flight device work completes."""
+    ctx = get_context()
+    out = _smap(lambda xs: xs + _ops.barrier(ctx.axis_name))(
+        jnp.zeros((ctx.size,), jnp.float32)
+    )
+    jax.block_until_ready(out)
+    return True
+
+
+def hierarchical_neighbor_allreduce(x, *, machine_topology=None, self_weight=None,
+                                    recv_weights=None):
+    """Stacked ``bf.hierarchical_neighbor_allreduce`` (intra-machine exact
+    average + machine-level gossip; requires ``init(local_size=...)``)."""
+    ctx = get_context()
+    msched = machine_topology
+    if msched is None:
+        if ctx.machine_schedule is None:
+            raise RuntimeError("no machine topology: init(local_size=...) first")
+        msched = ctx.machine_schedule
+    elif isinstance(msched, Topology):
+        msched = build_schedule(msched)
+    return _smap(
+        lambda xs: _ops.hierarchical_neighbor_allreduce(
+            xs, msched, ctx.axis_name, local_size=ctx.local_size,
+            self_weight=self_weight, recv_weights=recv_weights,
+        )
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# Window registry (one-sided ops)
+# ---------------------------------------------------------------------------
+
+
+def _win_smap(fn, state: WindowState, *extra):
+    """shard_map an op over a registered window state (+ stacked extras)."""
+    ctx = get_context()
+    n_extra = len(extra)
+    f = shard_map(
+        fn,
+        mesh=ctx.mesh,
+        in_specs=(P(ctx.axis_name),) * (1 + n_extra),
+        out_specs=P(ctx.axis_name),
+        check_vma=False,
+    )
+    return f(state, *extra)
+
+
+def win_create(x, name: str, *, topology=None, zero_init: bool = False) -> bool:
+    """Register window ``name`` over stacked tensor(-tree) ``x``
+    (reference ``bf.win_create``; collective there, pure allocation here)."""
+    ctx = get_context()
+    sched = _sched(topology)
+    if zero_init:
+        x = jax.tree_util.tree_map(lambda leaf: jnp.zeros_like(leaf), x)
+
+    def fn(xs):
+        return _ops.win_create(xs, sched, ctx.axis_name, name=name)
+
+    ctx.windows[name] = _win_smap_create(fn, x)
+    return True
+
+
+def _win_smap_create(fn, x):
+    ctx = get_context()
+    f = shard_map(
+        fn, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),), out_specs=P(ctx.axis_name),
+        check_vma=False,
+    )
+    return f(x)
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    """Drop one window (or all, matching the reference's ``win_free()``)."""
+    ctx = get_context()
+    if name is None:
+        ctx.windows.clear()
+    else:
+        ctx.windows.pop(name, None)
+    return True
+
+
+def _get_win(name: str) -> WindowState:
+    ctx = get_context()
+    if name not in ctx.windows:
+        raise KeyError(f"no window named {name!r}; call win_create first")
+    return ctx.windows[name]
+
+
+def win_put(x, name: str, *, dst_weight=1.0) -> bool:
+    ctx = get_context()
+    state = _get_win(name)
+    ctx.windows[name] = _win_smap(
+        lambda st, xs: _ops.win_put(st, xs, ctx.axis_name, dst_weight=dst_weight),
+        state, x,
+    )
+    return True
+
+
+def win_accumulate(x, name: str, *, dst_weight=1.0) -> bool:
+    ctx = get_context()
+    state = _get_win(name)
+    ctx.windows[name] = _win_smap(
+        lambda st, xs: _ops.win_accumulate(st, xs, ctx.axis_name, dst_weight=dst_weight),
+        state, x,
+    )
+    return True
+
+
+def win_get(name: str) -> bool:
+    ctx = get_context()
+    state = _get_win(name)
+    ctx.windows[name] = _win_smap(
+        lambda st: _ops.win_get(st, ctx.axis_name), state,
+    )
+    return True
+
+
+def win_update(name: str, *, self_weight=None, recv_weights=None):
+    """Returns the stacked averaged tensor and refreshes the window
+    (reference ``bf.win_update``)."""
+    ctx = get_context()
+    state = _get_win(name)
+    f = shard_map(
+        lambda st: _ops.win_update(
+            st, ctx.axis_name, self_weight=self_weight, recv_weights=recv_weights
+        ),
+        mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+        out_specs=(P(ctx.axis_name), P(ctx.axis_name)), check_vma=False,
+    )
+    out, new_state = f(state)
+    ctx.windows[name] = new_state
+    return out
+
+
+def win_update_then_collect(name: str):
+    ctx = get_context()
+    state = _get_win(name)
+    f = shard_map(
+        lambda st: _ops.win_update_then_collect(st, ctx.axis_name),
+        mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+        out_specs=(P(ctx.axis_name), P(ctx.axis_name)), check_vma=False,
+    )
+    out, new_state = f(state)
+    ctx.windows[name] = new_state
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Parameter-sync helpers (reference bluefog/torch/utility.py)
+# ---------------------------------------------------------------------------
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Make every rank's parameter tree equal to ``root_rank``'s (reference
+    ``bf.broadcast_parameters`` — used at init so all ranks start agreed)."""
+    return broadcast(params, root_rank)
+
+
+def allreduce_parameters(params):
+    """Replace each rank's parameters with the global average (reference
+    ``bf.allreduce_parameters`` — post-training consensus averaging)."""
+    return allreduce(params, average=True)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Broadcast an optimizer state tree (reference
+    ``bf.broadcast_optimizer_state``; here any pytree of arrays works,
+    non-array leaves pass through untouched)."""
+    arrays, treedef = jax.tree_util.tree_flatten(opt_state)
+    is_arr = [hasattr(a, "dtype") or isinstance(a, (int, float, np.ndarray)) for a in arrays]
+    stacked = [a for a, ok in zip(arrays, is_arr) if ok]
+    if stacked:
+        out = broadcast(stacked, root_rank)
+        it = iter(out)
+        arrays = [next(it) if ok else a for a, ok in zip(arrays, is_arr)]
+    return jax.tree_util.tree_unflatten(treedef, arrays)

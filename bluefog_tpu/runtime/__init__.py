@@ -1,0 +1,9 @@
+"""Runtime layer: multi-host bring-up, launcher, native (C++) components.
+
+Reference parity (upstream-relative): ``bluefog/run/`` (the ``bfrun``/
+``ibfrun`` mpirun-wrapper CLI) and the native engine pieces of
+``bluefog/common/`` that remain host-side work on TPU (timeline writer,
+cross-slice coordination).  Most of the reference's C++ engine — background
+thread, tensor queue, negotiation — is subsumed by XLA async dispatch and
+does not reappear here (SURVEY.md §7 design stance).
+"""

@@ -1,0 +1,71 @@
+"""Multi-host launcher — the reference's ``bfrun`` re-thought for TPU.
+
+Reference parity (upstream-relative): ``bluefog/run/run.py`` builds and execs
+an ``mpirun -np N -H hosts ...`` command line (SURVEY.md §3.5).  On TPU pods
+there is no mpirun: every host runs the same program and rendezvous happens in
+``jax.distributed.initialize`` against the coordinator.  This module provides
+
+- :func:`initialize_cluster` — library-call bring-up (the ``bf.init()``-time
+  process/network boundary of SURVEY.md §3.1);
+- a thin CLI (``bfrun-tpu``) that sets the coordinator env and execs the
+  training script on this host, for parity with ``bfrun`` muscle memory on
+  GCE/GKE-style deployments where each host runs the launcher.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from typing import Optional
+
+from bluefog_tpu.utils import log
+
+
+def initialize_cluster(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Rendezvous all hosts (no-op on single-host).
+
+    Mirrors ``jax.distributed.initialize`` argument conventions; on Cloud TPU
+    the arguments are auto-detected from the metadata server.
+    """
+    import jax
+
+    if num_processes == 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        log.info("cluster initialized: process %d/%d", jax.process_index(), jax.process_count())
+    except Exception as e:  # single-host dev boxes: fine to run undistributed
+        log.warn("jax.distributed.initialize skipped: %s", e)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="bfrun-tpu",
+        description="Launch a bluefog_tpu training script (bfrun analog; "
+        "run once per host on multi-host pods)",
+    )
+    ap.add_argument("--coordinator", default=None, help="host:port of process 0")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    initialize_cluster(args.coordinator, args.num_processes, args.process_id)
+    sys.argv = [args.script] + list(args.script_args)
+    os.environ.setdefault("BLUEFOG_TPU_LAUNCHED", "1")
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Virtual topology library — graphs, weights, dynamic generators, schedules.
+
+TPU-native re-implementation of the reference topology layer
+(``bluefog/common/topology_util.py``, upstream-relative — see SURVEY.md §2.2).
+The reference returns ``networkx.DiGraph`` objects; here the core object is a
+:class:`Topology` wrapping a dense row-stochastic weight matrix, which is what
+the XLA lowering actually needs.  ``networkx`` interop is provided when the
+library is installed.
+"""
+
+from bluefog_tpu.topology.graphs import (
+    Topology,
+    ExponentialTwoGraph,
+    ExponentialGraph,
+    SymmetricExponentialGraph,
+    RingGraph,
+    MeshGrid2DGraph,
+    StarGraph,
+    FullyConnectedGraph,
+    IsTopologyEquivalent,
+    IsRegularGraph,
+    GetRecvWeights,
+    GetSendWeights,
+)
+from bluefog_tpu.topology.dynamic import (
+    GetDynamicOnePeerSendRecvRanks,
+    GetExp2DynamicSendRecvMachineRanks,
+    GetInnerOuterRingDynamicSendRecvRanks,
+    GetInnerOuterExpo2DynamicSendRecvRanks,
+    one_peer_exponential_two_schedules,
+    one_peer_ring_schedules,
+    dynamic_topologies_from_generator,
+)
+from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
+from bluefog_tpu.topology.mapping import ici_ring_order, remap_topology

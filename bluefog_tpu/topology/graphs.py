@@ -1,0 +1,356 @@
+"""Static virtual topologies with row-stochastic mixing weights.
+
+Parity target: the graph constructors of the reference's
+``bluefog/common/topology_util.py`` (upstream-relative; mount was empty during
+the survey, see SURVEY.md header).  Constructor names (`ExponentialTwoGraph`,
+`ExponentialGraph`, `RingGraph`, `MeshGrid2DGraph`, ...) are confirmed by
+BASELINE.json; weight conventions follow the Bluefog paper (arXiv:2111.04287):
+each row of the mixing matrix sums to 1, with uniform ``1/(in_degree+1)``
+weights for the exponential/ring/star families and Metropolis–Hastings weights
+for the 2-D grid (symmetric doubly-stochastic).
+
+Orientation convention
+----------------------
+``W[i, j]`` is the weight rank ``i`` applies to the tensor *received from*
+rank ``j``; edge ``j -> i`` exists iff ``W[i, j] > 0`` (for ``i != j``).
+``W[i, i]`` is the self weight.  One gossip step computes
+
+    out_i = W[i, i] * x_i  +  sum_{j in InNbr(i)} W[i, j] * x_j
+
+which matches the reference's ``neighbor_allreduce(tensor, self_weight,
+src_weights)`` semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Topology",
+    "ExponentialTwoGraph",
+    "ExponentialGraph",
+    "SymmetricExponentialGraph",
+    "RingGraph",
+    "MeshGrid2DGraph",
+    "StarGraph",
+    "FullyConnectedGraph",
+    "IsTopologyEquivalent",
+    "IsRegularGraph",
+    "GetRecvWeights",
+    "GetSendWeights",
+]
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class Topology:
+    """A directed, weighted virtual communication graph.
+
+    ``eq=False``: identity-based equality/hash so instances can serve as
+    static (hashable) metadata under jit; semantic comparison goes through
+    :func:`IsTopologyEquivalent`.
+
+    Attributes:
+      weights: ``(n, n)`` float64 row-stochastic matrix, orientation per the
+        module docstring.
+      name: human-readable tag used in logs / timeline spans.
+    """
+
+    weights: np.ndarray
+    name: str = "custom"
+
+    def __post_init__(self):
+        w = np.asarray(self.weights, dtype=np.float64)
+        if w.ndim != 2 or w.shape[0] != w.shape[1]:
+            raise ValueError(f"weights must be square, got shape {w.shape}")
+        if (w < -1e-12).any():
+            raise ValueError("weights must be non-negative")
+        rows = w.sum(axis=1)
+        if not np.allclose(rows, 1.0, atol=1e-8):
+            raise ValueError(f"weights must be row-stochastic; row sums {rows}")
+        object.__setattr__(self, "weights", w)
+
+    # -- basic queries -------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.weights.shape[0]
+
+    def self_weight(self, rank: int) -> float:
+        return float(self.weights[rank, rank])
+
+    def in_neighbors(self, rank: int) -> List[int]:
+        """Ranks whose tensors ``rank`` receives (sorted)."""
+        row = self.weights[rank]
+        return [j for j in range(self.size) if j != rank and row[j] > 0.0]
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        """Ranks to which ``rank`` sends (sorted)."""
+        col = self.weights[:, rank]
+        return [i for i in range(self.size) if i != rank and col[i] > 0.0]
+
+    def in_degree(self, rank: int) -> int:
+        return len(self.in_neighbors(rank))
+
+    def out_degree(self, rank: int) -> int:
+        return len(self.out_neighbors(rank))
+
+    @property
+    def max_in_degree(self) -> int:
+        return max(self.in_degree(r) for r in range(self.size))
+
+    @property
+    def edges(self) -> List[Tuple[int, int]]:
+        """Directed edge list as ``(src, dst)`` pairs (dst receives from src)."""
+        n = self.size
+        return [
+            (j, i)
+            for i in range(n)
+            for j in range(n)
+            if i != j and self.weights[i, j] > 0.0
+        ]
+
+    # -- conversions ---------------------------------------------------------
+
+    def to_networkx(self):
+        """Return the reference-style ``networkx.DiGraph`` with edge weights.
+
+        Edge ``(u, v)`` carries ``weight=W[v, u]`` (v receives from u), and each
+        node carries a self-loop with the self weight, mirroring the upstream
+        convention of self-loops in the topology digraph.
+        """
+        import networkx as nx  # optional dependency
+
+        g = nx.DiGraph()
+        g.add_nodes_from(range(self.size))
+        for i in range(self.size):
+            g.add_edge(i, i, weight=self.weights[i, i])
+            for j in self.in_neighbors(i):
+                g.add_edge(j, i, weight=self.weights[i, j])
+        return g
+
+    @staticmethod
+    def from_networkx(graph, name: str = "networkx") -> "Topology":
+        """Build from a reference-style weighted DiGraph (self-loops = self weight)."""
+        n = graph.number_of_nodes()
+        w = np.zeros((n, n))
+        for (u, v, data) in graph.edges(data=True):
+            w[v, u] = data.get("weight", 0.0)
+        # Unweighted digraph: assign uniform 1/(in_degree+1) rows.
+        if w.sum() == 0.0:
+            for v in range(n):
+                preds = [u for u in graph.predecessors(v) if u != v]
+                k = len(preds) + 1
+                w[v, v] = 1.0 / k
+                for u in preds:
+                    w[v, u] = 1.0 / k
+        return Topology(weights=w, name=name)
+
+    @staticmethod
+    def from_edges(
+        size: int,
+        edges: Sequence[Tuple[int, int]],
+        weights: Optional[Dict[Tuple[int, int], float]] = None,
+        name: str = "custom",
+    ) -> "Topology":
+        """Build from a ``(src, dst)`` edge list.
+
+        Without explicit ``weights``, each row gets uniform ``1/(in_degree+1)``
+        (the reference's un-weighted ``set_topology(topo, is_weighted=False)``
+        behavior).
+        """
+        w = np.zeros((size, size))
+        if weights is None:
+            indeg = [0] * size
+            for (_, dst) in edges:
+                indeg[dst] += 1
+            for i in range(size):
+                w[i, i] = 1.0 / (indeg[i] + 1)
+            for (src, dst) in edges:
+                w[dst, src] = 1.0 / (indeg[dst] + 1)
+        else:
+            for (src, dst) in edges:
+                w[dst, src] = weights[(src, dst)]
+            for i in range(size):
+                w[i, i] = 1.0 - w[i].sum()
+        return Topology(weights=w, name=name)
+
+
+# ---------------------------------------------------------------------------
+# Constructors
+# ---------------------------------------------------------------------------
+
+
+def _uniform_from_out_offsets(size: int, offsets_fn, name: str) -> Topology:
+    """Build a circulant-style digraph: rank ``i`` sends to ``i + o (mod n)``.
+
+    Weights: uniform ``1/(in_degree + 1)`` per receiving rank (the reference's
+    default for the exponential family).
+    """
+    w = np.zeros((size, size))
+    indeg = np.zeros(size, dtype=int)
+    edge = np.zeros((size, size), dtype=bool)
+    for i in range(size):
+        for o in offsets_fn(i):
+            dst = (i + o) % size
+            if dst != i and not edge[dst, i]:
+                edge[dst, i] = True
+                indeg[dst] += 1
+    for i in range(size):
+        w[i, i] = 1.0 / (indeg[i] + 1)
+        for j in range(size):
+            if edge[i, j]:
+                w[i, j] = 1.0 / (indeg[i] + 1)
+    return Topology(weights=w, name=name)
+
+
+def ExponentialGraph(size: int, base: int = 2) -> Topology:
+    """Static exponential graph: ``i -> (i + base**k) % size`` for all
+    ``base**k < size``.
+
+    Reference: ``topology_util.ExponentialGraph`` (upstream-relative; name
+    confirmed in BASELINE.json).
+    """
+    if size < 1:
+        raise ValueError("size must be >= 1")
+    offsets = []
+    o = 1
+    while o < size:
+        offsets.append(o)
+        o *= base
+    return _uniform_from_out_offsets(size, lambda i: offsets, f"ExponentialGraph(base={base})")
+
+
+def ExponentialTwoGraph(size: int) -> Topology:
+    """Exponential-2 graph — the reference's default topology and the core of
+    its decentralized-SGD recipe (``topology_util.ExponentialTwoGraph``,
+    confirmed in BASELINE.json)."""
+    t = ExponentialGraph(size, base=2)
+    return dataclasses.replace(t, name="ExponentialTwoGraph")
+
+
+def SymmetricExponentialGraph(size: int, base: int = 4) -> Topology:
+    """Bidirectional exponential graph: edges to ``i ± base**k``
+    (``topology_util.SymmetricExponentialGraph``, upstream)."""
+    offsets = []
+    o = 1
+    while o < size:
+        offsets.append(o)
+        offsets.append(-o)
+        o *= base
+    return _uniform_from_out_offsets(
+        size, lambda i: offsets, f"SymmetricExponentialGraph(base={base})"
+    )
+
+
+def RingGraph(size: int, connect_style: int = 0) -> Topology:
+    """Ring topology (``topology_util.RingGraph``, confirmed in BASELINE.json).
+
+    connect_style: 0 = bidirectional (neighbors at ±1), 1 = unidirectional
+    right (``i -> i+1``), 2 = unidirectional left — matching the upstream
+    tri-state argument.
+    """
+    if connect_style not in (0, 1, 2):
+        raise ValueError("connect_style must be 0, 1 or 2")
+    if connect_style == 0:
+        offs = [1, -1]
+    elif connect_style == 1:
+        offs = [1]
+    else:
+        offs = [-1]
+    t = _uniform_from_out_offsets(size, lambda i: offs, f"RingGraph(style={connect_style})")
+    return t
+
+
+def MeshGrid2DGraph(size: int, shape: Optional[Tuple[int, int]] = None) -> Topology:
+    """2-D (non-wraparound) mesh grid with Metropolis–Hastings weights.
+
+    Reference: ``topology_util.MeshGrid2DGraph`` (name confirmed in
+    BASELINE.json).  Ranks are laid out row-major on an ``nrows x ncols`` grid
+    (the most-square factorization of ``size`` when ``shape`` is omitted) with
+    edges to the 4-neighborhood.  Weights are Metropolis–Hastings
+    ``W[i,j] = 1 / (max(deg_i, deg_j) + 1)`` with the remainder on the
+    diagonal — symmetric and doubly stochastic, the standard choice for grid
+    gossip (used by the gradient-tracking / EXTRA configs in BASELINE.json).
+    """
+    if shape is None:
+        a = int(math.floor(math.sqrt(size)))
+        while size % a != 0:
+            a -= 1
+        shape = (a, size // a)
+    nrows, ncols = shape
+    if nrows * ncols != size:
+        raise ValueError(f"shape {shape} does not match size {size}")
+
+    def nbrs(r: int) -> List[int]:
+        y, x = divmod(r, ncols)
+        out = []
+        for dy, dx in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+            yy, xx = y + dy, x + dx
+            if 0 <= yy < nrows and 0 <= xx < ncols:
+                out.append(yy * ncols + xx)
+        return out
+
+    deg = [len(nbrs(r)) for r in range(size)]
+    w = np.zeros((size, size))
+    for i in range(size):
+        for j in nbrs(i):
+            w[i, j] = 1.0 / (max(deg[i], deg[j]) + 1.0)
+        w[i, i] = 1.0 - w[i].sum()
+    return Topology(weights=w, name=f"MeshGrid2DGraph{shape}")
+
+
+def StarGraph(size: int, center_rank: int = 0) -> Topology:
+    """Star topology: bidirectional edges between ``center_rank`` and every
+    other rank, uniform ``1/(in_degree+1)`` weights
+    (``topology_util.StarGraph``, upstream)."""
+    edges = []
+    for r in range(size):
+        if r != center_rank:
+            edges.append((center_rank, r))
+            edges.append((r, center_rank))
+    t = Topology.from_edges(size, edges, name=f"StarGraph(center={center_rank})")
+    return t
+
+
+def FullyConnectedGraph(size: int) -> Topology:
+    """Complete digraph with uniform ``1/size`` weights — one gossip step is an
+    exact average (``topology_util.FullyConnectedGraph``, upstream)."""
+    w = np.full((size, size), 1.0 / size)
+    return Topology(weights=w, name="FullyConnectedGraph")
+
+
+# ---------------------------------------------------------------------------
+# Queries matching the reference API
+# ---------------------------------------------------------------------------
+
+
+def IsRegularGraph(topo: Topology) -> bool:
+    """True iff every rank's in-degree equals its out-degree (upstream
+    ``topology_util.IsRegularGraph``)."""
+    return all(topo.in_degree(r) == topo.out_degree(r) for r in range(topo.size))
+
+
+def IsTopologyEquivalent(a: Optional[Topology], b: Optional[Topology]) -> bool:
+    """Structural + weight equivalence (upstream
+    ``topology_util.IsTopologyEquivalent``)."""
+    if a is None or b is None:
+        return False
+    if a.size != b.size:
+        return False
+    return bool(np.allclose(a.weights, b.weights, atol=1e-9))
+
+
+def GetRecvWeights(topo: Topology, rank: int) -> Tuple[float, Dict[int, float]]:
+    """``(self_weight, {src_rank: weight})`` for the receiving side of one
+    gossip step (upstream ``topology_util.GetRecvWeights``)."""
+    return topo.self_weight(rank), {j: float(topo.weights[rank, j]) for j in topo.in_neighbors(rank)}
+
+
+def GetSendWeights(topo: Topology, rank: int) -> Tuple[float, Dict[int, float]]:
+    """``(self_weight, {dst_rank: weight})`` — the weight each destination will
+    apply to this rank's tensor (upstream ``topology_util.GetSendWeights``)."""
+    return topo.self_weight(rank), {i: float(topo.weights[i, rank]) for i in topo.out_neighbors(rank)}

@@ -1,0 +1,69 @@
+"""Virtual-graph -> physical ICI mesh embedding.
+
+The reference hands its virtual graph to MPI and lets the fabric route
+(``MPI_Dist_graph_create_adjacent`` in ``bluefog/common/mpi_context.cc``,
+upstream-relative).  On TPU the physical network is an ICI torus with known
+device coordinates, so we can do better: order the devices so that the hot
+virtual edges are physical ICI hops.
+
+- Ring topologies embed exactly: a snake (boustrophedon) walk over the torus
+  coordinates makes every ``i -> i+1`` edge a single ICI hop.
+- Exponential-2 edges become power-of-two strides along the snake, which XLA's
+  collective-permute handles with torus wraparound links.
+
+On hosts without coordinates (CPU test meshes) the identity order is used.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bluefog_tpu.topology.graphs import Topology
+
+__all__ = ["ici_ring_order", "remap_topology"]
+
+
+def ici_ring_order(devices: Optional[Sequence] = None) -> List:
+    """Order devices along a snaking path over their (x, y, z) torus coords so
+    consecutive devices are ICI-adjacent.  Falls back to ``id`` order when
+    coords are unavailable (CPU/virtual devices)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return sorted(devices, key=lambda d: d.id)
+        coords.append(tuple(c))
+    dims = len(coords[0])
+
+    def snake_key(c):
+        # Boustrophedon: reverse the traversal direction of each inner axis
+        # depending on the parity of the outer axes, so each step moves one hop.
+        key = []
+        flip = 0
+        for i in range(dims):
+            v = c[i] if flip % 2 == 0 else -c[i]
+            key.append(v)
+            flip += c[i] if i < dims - 1 else 0
+        return tuple(key)
+
+    order = sorted(range(len(devices)), key=lambda i: snake_key(coords[i]))
+    return [devices[i] for i in order]
+
+
+def remap_topology(topo: Topology, perm: Sequence[int]) -> Topology:
+    """Relabel ranks: new rank ``i`` plays old rank ``perm[i]``'s role.
+
+    ``W'[i, j] = W[perm[i], perm[j]]``.  Used to align a virtual topology with
+    a physical device ordering chosen by :func:`ici_ring_order`."""
+    p = np.asarray(perm)
+    if sorted(p.tolist()) != list(range(topo.size)):
+        raise ValueError("perm must be a permutation of range(size)")
+    w = topo.weights[np.ix_(p, p)]
+    return Topology(weights=w, name=f"{topo.name}|remap")

@@ -1,0 +1,17 @@
+"""Auxiliary subsystems: logging, timeline tracing, parameter sync helpers.
+
+Reference parity (upstream-relative): ``bluefog/common/logging.{h,cc}``
+(leveled BFLOG macros), ``bluefog/common/timeline.{h,cc}`` (chrome-trace
+writer), ``bluefog/torch/utility.py`` (broadcast/allreduce parameter helpers —
+those live in ``bluefog_tpu.parallel.api``).
+"""
+
+from bluefog_tpu.utils.logging import log
+from bluefog_tpu.utils.timeline import (
+    Timeline,
+    timeline_start,
+    timeline_stop,
+    timeline_start_activity,
+    timeline_end_activity,
+    timeline_context,
+)

@@ -1,0 +1,183 @@
+"""Chrome-trace timeline — host-side span profiler.
+
+Reference parity: ``bluefog/common/timeline.{h,cc}`` (upstream-relative) — a
+dedicated writer emitting ``chrome://tracing`` JSON, enabled by
+``BLUEFOG_TIMELINE=<file>``, plus the Python
+``bf.timeline_start_activity / timeline_end_activity`` span API.
+
+Here: enabled by ``BLUEFOG_TPU_TIMELINE=<file>`` or :func:`timeline_start`.
+Spans are buffered in memory and flushed by a background writer thread (the
+reference's dedicated timeline thread), in chrome trace-event format.  Device
+-side activity is better captured with ``jax.profiler`` (Perfetto); every span
+recorded here is additionally wrapped in a ``jax.profiler.TraceAnnotation``
+so host spans and XLA activity line up in one Perfetto view.
+
+A C++ writer with the same wire format lives in ``bluefog_tpu/runtime``
+(csrc/timeline.cc) and is used when the native runtime library is built; this
+pure-Python path is the always-available fallback.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Timeline",
+    "timeline_start",
+    "timeline_stop",
+    "timeline_start_activity",
+    "timeline_end_activity",
+    "timeline_context",
+]
+
+
+class Timeline:
+    """Buffered chrome-trace writer with a flusher thread."""
+
+    def __init__(self, path: str, flush_interval_s: float = 2.0):
+        self.path = path
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._open_spans: Dict[str, float] = {}
+        self._t0 = time.perf_counter()
+        self._stop = threading.Event()
+        self._native = _try_native(path)
+        if self._native is None:
+            self._thread = threading.Thread(
+                target=self._flush_loop, args=(flush_interval_s,), daemon=True
+            )
+            self._thread.start()
+        atexit.register(self.close)
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def begin(self, name: str, category: str = "activity", tid: int = 0):
+        if self._native is not None:
+            self._native.begin(name.encode(), category.encode(), tid)
+            return
+        ev = {"name": name, "cat": category, "ph": "B", "ts": self._now_us(),
+              "pid": os.getpid(), "tid": tid}
+        with self._lock:
+            self._events.append(ev)
+
+    def end(self, name: str, category: str = "activity", tid: int = 0):
+        if self._native is not None:
+            self._native.end(name.encode(), category.encode(), tid)
+            return
+        ev = {"name": name, "cat": category, "ph": "E", "ts": self._now_us(),
+              "pid": os.getpid(), "tid": tid}
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, category: str = "marker"):
+        if self._native is not None:
+            self._native.instant(name.encode(), category.encode())
+            return
+        ev = {"name": name, "cat": category, "ph": "i", "ts": self._now_us(),
+              "pid": os.getpid(), "tid": 0, "s": "p"}
+        with self._lock:
+            self._events.append(ev)
+
+    def _flush_loop(self, interval: float):
+        while not self._stop.wait(interval):
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            events = list(self._events)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, self.path)
+
+    def close(self):
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+            return
+        if not self._stop.is_set():
+            self._stop.set()
+            self.flush()
+
+
+def _try_native(path: str):
+    """Use the C++ timeline writer when the native runtime is built."""
+    try:
+        from bluefog_tpu.runtime import native
+
+        return native.TimelineWriter(path)
+    except Exception:
+        return None
+
+
+_TIMELINE: Optional[Timeline] = None
+
+
+def timeline_start(path: Optional[str] = None) -> Optional[Timeline]:
+    """Start tracing (reference: ``BLUEFOG_TIMELINE`` env / timeline ops)."""
+    global _TIMELINE
+    path = path or os.environ.get("BLUEFOG_TPU_TIMELINE")
+    if path:
+        _TIMELINE = Timeline(path)
+    return _TIMELINE
+
+
+def timeline_stop():
+    global _TIMELINE
+    if _TIMELINE is not None:
+        _TIMELINE.close()
+        _TIMELINE = None
+
+
+def _get() -> Optional[Timeline]:
+    global _TIMELINE
+    if _TIMELINE is None and os.environ.get("BLUEFOG_TPU_TIMELINE"):
+        timeline_start()
+    return _TIMELINE
+
+
+_jax_annotations: Dict[str, object] = {}
+
+
+def timeline_start_activity(name: str, category: str = "activity"):
+    """Open a named span (reference ``bf.timeline_start_activity``)."""
+    tl = _get()
+    if tl is not None:
+        tl.begin(name, category)
+    try:
+        import jax.profiler
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        _jax_annotations[name] = ann
+    except Exception:
+        pass
+    return True
+
+
+def timeline_end_activity(name: str, category: str = "activity"):
+    """Close a named span (reference ``bf.timeline_end_activity``)."""
+    tl = _get()
+    if tl is not None:
+        tl.end(name, category)
+    ann = _jax_annotations.pop(name, None)
+    if ann is not None:
+        ann.__exit__(None, None, None)
+    return True
+
+
+@contextlib.contextmanager
+def timeline_context(name: str, category: str = "activity"):
+    """Context-manager sugar over start/end activity."""
+    timeline_start_activity(name, category)
+    try:
+        yield
+    finally:
+        timeline_end_activity(name, category)

@@ -1,0 +1,40 @@
+"""Test fixture: 8 virtual CPU devices standing in for an 8-chip TPU slice.
+
+The reference runs its distributed tests under ``mpirun -np 4 pytest``
+(SURVEY.md §4); the SPMD equivalent is a host-platform device mesh — plain
+pytest, no launcher.  Env vars must be set before jax initializes a backend,
+hence at module import time here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env may point at a TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A sitecustomize may have pinned jax_platforms to a TPU plugin at interpreter
+# startup (overriding the env var); re-pin to cpu before any backend spins up.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    """Each test starts without a live bluefog context."""
+    import bluefog_tpu as bf
+
+    yield
+    bf.shutdown()
+
+
+@pytest.fixture
+def devices8():
+    d = jax.devices()
+    assert len(d) == 8, f"expected 8 virtual devices, got {len(d)}"
+    return d

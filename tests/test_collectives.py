@@ -1,0 +1,255 @@
+"""Collective-op tests on an 8-virtual-device mesh — the SPMD analog of the
+reference's ``mpirun -np N pytest test/torch_ops_test.py`` suite (SURVEY.md
+§4): each rank fills its tensor with its own rank id; results are asserted
+against the closed-form ``W @ x`` of the known mixing matrix, over dtypes and
+static/dynamic/weighted variants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import ops
+from bluefog_tpu.topology import (
+    ExponentialTwoGraph,
+    FullyConnectedGraph,
+    MeshGrid2DGraph,
+    RingGraph,
+    StarGraph,
+    build_schedule,
+    one_peer_exponential_two_schedules,
+)
+
+N = 8
+DTYPES = [jnp.float32, jnp.float64, jnp.bfloat16]
+
+
+def rank_values(shape=(4,), dtype=jnp.float32):
+    """Stacked input: rank r's tensor is all-r."""
+    base = jnp.arange(N, dtype=jnp.float32).reshape((N,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (N,) + shape).astype(dtype)
+
+
+def expected_mix(topo, x):
+    w = topo.weights
+    xs = np.asarray(x, dtype=np.float64).reshape(N, -1)
+    return (w @ xs).reshape(np.asarray(x).shape)
+
+
+TOPOS = [
+    ExponentialTwoGraph(N),
+    RingGraph(N, 0),
+    RingGraph(N, 1),
+    MeshGrid2DGraph(N),
+    StarGraph(N, center_rank=3),
+    FullyConnectedGraph(N),
+]
+
+
+@pytest.mark.parametrize("topo", TOPOS, ids=lambda t: t.name)
+def test_neighbor_allreduce_closed_form(topo):
+    bf.init(topology=topo)
+    x = rank_values((4, 3))
+    out = bf.neighbor_allreduce(x)
+    np.testing.assert_allclose(np.asarray(out), expected_mix(topo, x), rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=str)
+def test_neighbor_allreduce_dtypes(dtype):
+    if dtype == jnp.float64:
+        jax.config.update("jax_enable_x64", True)
+    try:
+        topo = RingGraph(N)
+        bf.init(topology=topo)
+        x = rank_values((8,), dtype)
+        out = bf.neighbor_allreduce(x)
+        assert out.dtype == dtype
+        tol = 5e-2 if dtype == jnp.bfloat16 else 1e-6
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float64), expected_mix(topo, x), rtol=tol, atol=tol
+        )
+    finally:
+        if dtype == jnp.float64:
+            jax.config.update("jax_enable_x64", False)
+
+
+def test_neighbor_allreduce_pytree():
+    topo = ExponentialTwoGraph(N)
+    bf.init(topology=topo)
+    tree = {"a": rank_values((2,)), "b": [rank_values((3, 2)), rank_values(())]}
+    out = bf.neighbor_allreduce(tree)
+    for leaf, ref in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)):
+        np.testing.assert_allclose(np.asarray(leaf), expected_mix(topo, ref), rtol=1e-6)
+
+
+def test_neighbor_allreduce_per_call_weights():
+    """Per-call self/recv weight overrides (the reference's per-call
+    self_weight/src_weights) — pattern static, weights traced."""
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    x = rank_values((4,))
+    out = bf.neighbor_allreduce(x, self_weight=0.5, recv_weights=jnp.array([0.25, 0.25]))
+    w = np.zeros((N, N))
+    for i in range(N):
+        w[i, i] = 0.5
+        w[i, (i - 1) % N] += 0.25
+        w[i, (i + 1) % N] += 0.25
+    ref = (w @ np.asarray(x).reshape(N, -1)).reshape(N, 4)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_neighbor_allreduce_topology_override():
+    bf.init(topology=RingGraph(N))
+    topo2 = ExponentialTwoGraph(N)
+    x = rank_values((4,))
+    out = bf.neighbor_allreduce(x, topology=topo2)
+    np.testing.assert_allclose(np.asarray(out), expected_mix(topo2, x), rtol=1e-6)
+
+
+def test_dynamic_one_peer_period():
+    """One period of one-peer exp2 via lax.switch equals applying each phase's
+    mixing matrix in sequence."""
+    bf.init()
+    ctx = bf.get_context()
+    topos = one_peer_exponential_two_schedules(N)
+    scheds = [build_schedule(t) for t in topos]
+    from jax.sharding import PartitionSpec as P
+    from bluefog_tpu.parallel.api import shard_map
+
+    x = rank_values((4,))
+
+    def step(xs, k):
+        return ops.neighbor_allreduce_dynamic(xs, scheds, k, ctx.axis_name)
+
+    f = jax.jit(
+        shard_map(
+            step, mesh=ctx.mesh, in_specs=(P("bf"), P()), out_specs=P("bf"),
+            check_vma=False,
+        )
+    )
+    cur = x
+    ref = np.asarray(x, dtype=np.float64)
+    for k in range(len(topos)):
+        cur = f(cur, jnp.asarray(k))
+        ref = (topos[k].weights @ ref.reshape(N, -1)).reshape(N, 4)
+    np.testing.assert_allclose(np.asarray(cur), ref, rtol=1e-5)
+    # after a full exp2 period every rank is the exact global average
+    np.testing.assert_allclose(
+        np.asarray(cur), np.broadcast_to(np.mean(np.arange(N)), (N, 4)), rtol=1e-5
+    )
+
+
+def test_allreduce_average_and_sum():
+    bf.init()
+    x = rank_values((4,))
+    np.testing.assert_allclose(np.asarray(bf.allreduce(x)), 3.5, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(bf.allreduce(x, average=False)), 28.0, rtol=1e-6)
+
+
+def test_broadcast():
+    bf.init()
+    x = rank_values((4,))
+    out = bf.broadcast(x, root_rank=5)
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+
+
+def test_allgather():
+    bf.init()
+    x = rank_values((2,))
+    out = bf.allgather(x)
+    assert out.shape == (N, N, 2)
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r, :, 0]), np.arange(N))
+
+
+def test_neighbor_allgather_regular():
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    x = rank_values((3,))
+    slots, mask = bf.neighbor_allgather(x)
+    assert slots.shape == (N, 2, 3)
+    assert bool(np.asarray(mask).all())
+    sched = bf.get_context().schedule
+    for r in range(N):
+        for k in range(sched.num_slots):
+            src = sched.recv_src[r, k]
+            np.testing.assert_allclose(np.asarray(slots[r, k]), float(src))
+
+
+def test_neighbor_allgather_irregular_mask():
+    topo = StarGraph(N, center_rank=0)
+    bf.init(topology=topo)
+    x = rank_values((2,))
+    slots, mask = bf.neighbor_allgather(x)
+    m = np.asarray(mask)
+    assert m[0].sum() == N - 1  # hub hears everyone
+    for r in range(1, N):
+        assert m[r].sum() == 1  # leaves hear only the hub
+        k = int(np.argmax(m[r]))
+        np.testing.assert_allclose(np.asarray(slots[r, k]), 0.0)
+
+
+def test_barrier():
+    bf.init()
+    assert bf.barrier() is True
+
+
+def test_hierarchical_neighbor_allreduce():
+    """4 machines x 2 local ranks: local exact average then machine-ring
+    gossip; all local ranks end identical (reference guarantee)."""
+    bf.init(local_size=2, machine_topology=RingGraph(4))
+    x = rank_values((4,))
+    out = np.asarray(bf.hierarchical_neighbor_allreduce(x), dtype=np.float64)
+    # machine means: (0+1)/2, (2+3)/2, ... = 0.5, 2.5, 4.5, 6.5
+    means = np.array([0.5, 2.5, 4.5, 6.5])
+    w = RingGraph(4).weights
+    ref_m = w @ means
+    for m in range(4):
+        np.testing.assert_allclose(out[2 * m], ref_m[m], rtol=1e-6)
+        np.testing.assert_allclose(out[2 * m + 1], ref_m[m], rtol=1e-6)
+
+
+def test_hierarchical_requires_machine_topology():
+    bf.init()  # local_size=1 on a single host -> machine topo exists (8 machines)
+    # but with local_size=8 there is a single machine: no machine topology
+    bf.shutdown()
+    bf.init(local_size=8)
+    with pytest.raises(RuntimeError):
+        bf.hierarchical_neighbor_allreduce(rank_values((2,)))
+
+
+def test_pair_gossip():
+    bf.init()
+    ctx = bf.get_context()
+    from jax.sharding import PartitionSpec as P
+    from bluefog_tpu.parallel.api import shard_map
+
+    # pair ranks (0<->1), (2<->3), ...
+    perm = [(i, i ^ 1) for i in range(N)]
+    f = shard_map(
+        lambda xs: ops.pair_gossip(xs, ctx.axis_name, perm=perm),
+        mesh=ctx.mesh, in_specs=(P("bf"),), out_specs=P("bf"), check_vma=False,
+    )
+    out = f(rank_values((2,)))
+    ref = np.repeat(np.arange(0, N, 2) + 0.5, 2)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], ref, rtol=1e-6)
+
+
+def test_in_out_neighbor_queries():
+    bf.init(topology=ExponentialTwoGraph(N))
+    assert bf.in_neighbor_ranks(0) == [4, 6, 7]
+    assert bf.out_neighbor_ranks(0) == [1, 2, 4]
+    assert bf.size() == N
+    assert bf.local_size() == 1
+    assert bf.machine_size() == N
+
+
+def test_set_topology_rebuilds_schedule():
+    bf.init()
+    assert bf.load_topology().name == "ExponentialTwoGraph"
+    bf.set_topology(RingGraph(N))
+    assert bf.load_topology().name.startswith("RingGraph")
+    x = rank_values((4,))
+    out = bf.neighbor_allreduce(x)
+    np.testing.assert_allclose(np.asarray(out), expected_mix(RingGraph(N), x), rtol=1e-6)

@@ -1,0 +1,176 @@
+"""One-sided window op tests — the SPMD analog of the reference's
+``test/torch_win_ops_test.py`` (SURVEY.md §4): create/put/get/accumulate/
+update semantics with closed-form expectations, plus a push-sum mass
+-conservation check."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph
+
+N = 8
+
+
+def rank_values(shape=(4,), dtype=jnp.float32):
+    base = jnp.arange(N, dtype=jnp.float32).reshape((N,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (N,) + shape).astype(dtype)
+
+
+def test_win_create_then_update_is_identity():
+    bf.init(topology=RingGraph(N))
+    x = rank_values((4,))
+    bf.win_create(x, "w")
+    out = bf.win_update("w")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+    bf.win_free("w")
+
+
+def test_win_put_update_matches_neighbor_allreduce():
+    """put-everything + update with topology weights == one gossip step."""
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    x = rank_values((4,))
+    bf.win_create(x, "w")
+    bf.win_put(x, "w")
+    out = bf.win_update("w")
+    ref = (topo.weights @ np.asarray(x, np.float64).reshape(N, -1)).reshape(N, 4)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_win_put_weighted():
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    x = rank_values((2,))
+    bf.win_create(x, "w")
+    bf.win_put(x, "w", dst_weight=0.5)
+    # update with plain sum weights: out = x + 0.5*(left + right)
+    out = bf.win_update("w", self_weight=1.0, recv_weights=jnp.array([1.0, 1.0]))
+    xs = np.asarray(x, np.float64)
+    ref = xs.copy()
+    for r in range(N):
+        ref[r] += 0.5 * (xs[(r - 1) % N] + xs[(r + 1) % N])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_win_accumulate_adds():
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    x = rank_values((2,))
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_accumulate(x, "w")
+    bf.win_accumulate(x, "w")
+    out = bf.win_update("w", self_weight=1.0, recv_weights=jnp.array([1.0, 1.0]))
+    xs = np.asarray(x, np.float64)
+    ref = np.zeros_like(xs)
+    for r in range(N):
+        ref[r] = 2 * (xs[(r - 1) % N] + xs[(r + 1) % N])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_win_get_pulls_published_values():
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    x = rank_values((2,))
+    bf.win_create(x, "w")
+    bf.win_get("w")
+    out = bf.win_update("w")
+    ref = (topo.weights @ np.asarray(x, np.float64).reshape(N, -1)).reshape(N, 2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_push_sum_mass_conservation_and_consensus():
+    """Push-sum over the one-sided path (BASELINE.json config[2] flavor):
+    each rank keeps (x, p); every step win_accumulates half its mass to the
+    ring right-neighbor and collects what landed.  Invariants: sum(x) is
+    conserved every step; x/p -> global average.  Run as the idiomatic jitted
+    shard_map + lax.scan loop (the reference's Python loop around one-sided
+    ops maps to a compiled scan here)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from bluefog_tpu import ops
+    from bluefog_tpu.parallel.api import shard_map
+    from bluefog_tpu.topology import build_schedule
+
+    topo = RingGraph(N, connect_style=1)  # i -> i+1
+    sched = build_schedule(topo)
+    bf.init(topology=topo)
+    ctx = bf.get_context()
+    steps = 120
+
+    def body(xs):
+        x0 = xs
+        p0 = jnp.ones_like(xs)
+        wx = ops.win_create(jnp.zeros_like(x0), sched, ctx.axis_name)
+        wp = ops.win_create(jnp.zeros_like(p0), sched, ctx.axis_name)
+
+        def step(carry, _):
+            x, p, wx, wp = carry
+            wx = ops.win_accumulate(wx, x * 0.5, ctx.axis_name)
+            wp = ops.win_accumulate(wp, p * 0.5, ctx.axis_name)
+            gx, wx = ops.win_update_then_collect(wx, ctx.axis_name)
+            gp, wp = ops.win_update_then_collect(wp, ctx.axis_name)
+            # collect wrote its result into self_buf; zero it so the next
+            # round's collect is again purely the received mass
+            wx = wx.replace(self_buf=jnp.zeros_like(wx.self_buf))
+            wp = wp.replace(self_buf=jnp.zeros_like(wp.self_buf))
+            x = x * 0.5 + gx
+            p = p * 0.5 + gp
+            mass = lax.psum(x, ctx.axis_name)
+            return (x, p, wx, wp), mass
+
+        (x, p, _, _), masses = lax.scan(step, (x0, p0, wx, wp), None, length=steps)
+        return x, p, masses
+
+    f = jax.jit(
+        shard_map(
+            body, mesh=ctx.mesh, in_specs=(P("bf"),),
+            out_specs=(P("bf"), P("bf"), P()), check_vma=False,
+        )
+    )
+    x, p, masses = f(rank_values((1,)))
+    total = float(np.arange(N).sum())
+    np.testing.assert_allclose(np.asarray(masses), total, rtol=1e-5)
+    ratio = np.asarray(x)[:, 0] / np.asarray(p)[:, 0]
+    np.testing.assert_allclose(ratio, np.mean(np.arange(N)), rtol=1e-4)
+
+
+def test_win_update_then_collect_resets_buffers():
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    x = rank_values((2,))
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_accumulate(x, "w")
+    out1 = bf.win_update_then_collect("w")
+    out2 = bf.win_update_then_collect("w")
+    # second collect adds nothing new (buffers were consumed)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+
+
+def test_window_pytree_support():
+    topo = ExponentialTwoGraph(N)
+    bf.init(topology=topo)
+    tree = {"a": rank_values((2,)), "b": rank_values((3, 2))}
+    bf.win_create(tree, "t")
+    bf.win_put(tree, "t")
+    out = bf.win_update("t")
+    for leaf, ref in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)):
+        expected = (topo.weights @ np.asarray(ref, np.float64).reshape(N, -1)).reshape(
+            np.asarray(ref).shape
+        )
+        np.testing.assert_allclose(np.asarray(leaf), expected, rtol=1e-6)
+
+
+def test_win_free_and_missing_window_error():
+    bf.init()
+    bf.win_create(rank_values((2,)), "w")
+    bf.win_free("w")
+    with pytest.raises(KeyError):
+        bf.win_put(rank_values((2,)), "w")
+    bf.win_create(rank_values((2,)), "a")
+    bf.win_create(rank_values((2,)), "b")
+    bf.win_free()
+    assert not bf.get_context().windows
