@@ -1,0 +1,134 @@
+"""Benchmark harness — north-star metric (BASELINE.md): ResNet-50
+decentralized-SGD **images/sec/chip**.
+
+Runs the full decentralized train step (fwd + bwd + gossip + SGD update) as
+one jitted shard_map program over all visible devices and reports throughput
+per chip.  On the driver's single real TPU chip the gossip degenerates to the
+identity (size-1 mesh) — the compute path is the genuine benchmark; on a pod
+the same program gossips over ICI.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": R}
+
+vs_baseline: ratio against the reference's per-GPU ResNet-50 throughput on
+V100 (BASELINE.md records no machine-readable number from the reference;
+360 img/s/V100 is the standard fp16 ResNet-50 figure for the 128xV100-era
+stack the reference paper benchmarked on — see BASELINE.md caveats).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu.models import ResNet50
+from bluefog_tpu.optim import DistributedNeighborAllreduceOptimizer
+from bluefog_tpu.parallel.api import shard_map
+from bluefog_tpu.topology import ExponentialTwoGraph
+
+V100_BASELINE_IMG_PER_SEC = 360.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128, help="per-chip batch")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+
+    n = len(jax.devices())
+    bf.init(topology=ExponentialTwoGraph(n))
+    ctx = bf.get_context()
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    opt = DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1, momentum=0.9), topology=ctx.schedule,
+        axis_name=ctx.axis_name, atc=False,
+    )
+
+    rng = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((args.batch, args.image_size, args.image_size, 3), jnp.bfloat16)
+    variables = model.init(rng, x0, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    params = bf.rank_shard(bf.rank_stack(params))
+    batch_stats = bf.rank_shard(bf.rank_stack(batch_stats))
+
+    imgs = jax.random.normal(
+        jax.random.PRNGKey(1), (n, args.batch, args.image_size, args.image_size, 3)
+    ).astype(jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (n, args.batch), 0, 1000)
+    imgs, labels = bf.rank_shard(imgs), bf.rank_shard(labels)
+
+    def init_opt(params_blk):
+        p = jax.tree_util.tree_map(lambda t: t[0], params_blk)
+        st = opt.init(p)
+        return jax.tree_util.tree_map(lambda t: jnp.asarray(t)[None], st)
+
+    opt_state = jax.jit(shard_map(
+        init_opt, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+        out_specs=P(ctx.axis_name), check_vma=False,
+    ))(params)
+
+    def train_step(params_blk, stats_blk, opt_blk, x_blk, y_blk):
+        p, bs, st = jax.tree_util.tree_map(lambda t: t[0],
+                                           (params_blk, stats_blk, opt_blk))
+        x, y = x_blk[0], y_blk[0]
+
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": bs}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+            return loss, mut["batch_stats"]
+
+        (loss, new_bs), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        upd, st = opt.update(g, st, p)
+        p = optax.apply_updates(p, upd)
+        return (jax.tree_util.tree_map(lambda t: t[None], (p, new_bs, st))
+                + (loss[None],))
+
+    step_fn = jax.jit(shard_map(
+        train_step, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),) * 5,
+        out_specs=(P(ctx.axis_name),) * 4, check_vma=False,
+    ), donate_argnums=(0, 1, 2))
+
+    for _ in range(max(args.warmup, 1)):  # >=1: first call pays compilation
+        params, batch_stats, opt_state, loss = step_fn(
+            params, batch_stats, opt_state, imgs, labels
+        )
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, batch_stats, opt_state, loss = step_fn(
+            params, batch_stats, opt_state, imgs, labels
+        )
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    total_images = args.steps * args.batch * n
+    img_per_sec_per_chip = total_images / dt / n
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec_per_chip / V100_BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

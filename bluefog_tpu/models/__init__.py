@@ -1,0 +1,10 @@
+"""Model zoo for the reference's example/benchmark configs.
+
+BASELINE.json configs: LeNet/MNIST (config 0), ResNet-50/ImageNet (config 1),
+BERT-large fine-tune (config 4).  Models are flax.linen modules written
+TPU-first: NHWC layouts, bf16-friendly, channel dims sized for the MXU.
+"""
+
+from bluefog_tpu.models.lenet import LeNet5
+from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from bluefog_tpu.models.bert import BertConfig, BertEncoder

@@ -1,0 +1,115 @@
+"""BERT-style transformer encoder — for the reference's hierarchical
+fine-tune config (BASELINE.json config[4]: BERT-large decentralized fine-tune
+with hierarchical_neighbor_allreduce).
+
+TPU-first: bf16 activations/matmuls with f32 layernorm + softmax, head and
+hidden dims multiples of 128 (MXU tiles), fused QKV projection, no dynamic
+shapes.  The attention core later swaps in the ring-attention layer
+(``bluefog_tpu.parallel.ring_attention``) for sequence parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024          # BERT-large
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def large() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                          intermediate_size=3072)
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        """For tests/dryruns."""
+        return BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                          num_heads=2, intermediate_size=256, max_position=128)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # fused QKV: one big MXU matmul instead of three
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (cfg.num_heads, head_dim))
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scale = 1.0 / np.sqrt(head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        if mask is not None:
+            logits = jnp.where(mask[:, None, None, :], logits, -1e9)
+        probs = nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        probs = nn.Dropout(cfg.dropout_rate)(probs, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(out.shape[:-2] + (cfg.hidden_size,))
+        return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="out")(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        y = SelfAttention(cfg)(x, mask, deterministic)
+        y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32)(x + y)
+        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(y)
+        y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
+        return nn.LayerNorm(dtype=jnp.float32)(x + y)
+
+
+class BertEncoder(nn.Module):
+    """Embeddings + transformer stack + pooled/classification head."""
+
+    cfg: BertConfig
+    num_classes: Optional[int] = None  # None: return sequence embeddings
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        pos_ids = jnp.arange(s)[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok")(input_ids)
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype, name="pos")(pos_ids)
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                             name="typ")(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+        if self.num_classes is None:
+            return x
+        pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=jnp.float32, name="pooler")(x[:, 0]))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="cls")(pooled)

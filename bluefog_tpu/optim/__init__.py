@@ -1,0 +1,18 @@
+"""Distributed optimizers — decentralized training wrappers around optax.
+
+Reference parity (upstream-relative): ``bluefog/torch/optimizers.py`` —
+``CommunicationType``, ``DistributedNeighborAllreduceOptimizer``,
+``DistributedWinPutOptimizer`` (both confirmed in BASELINE.json),
+``DistributedGradientAllreduceOptimizer``,
+``DistributedHierarchicalNeighborAllreduceOptimizer``, adapt-then-combine vs
+adapt-with-combine modes, ``num_steps_per_communication`` (local SGD).
+"""
+
+from bluefog_tpu.optim.optimizers import (
+    CommunicationType,
+    decentralized_optimizer,
+    DistributedNeighborAllreduceOptimizer,
+    DistributedGradientAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedWinPutOptimizer,
+)
