@@ -101,6 +101,7 @@ def neighbor_allreduce(
     *,
     self_weight=None,
     recv_weights=None,
+    backend: str = "auto",
 ):
     """Weighted average with in-neighbors: ``out_i = w_ii x_i + sum_k w_ik x_k``.
 
@@ -115,9 +116,31 @@ def neighbor_allreduce(
         (the ppermute pattern is static), overriding them does not recompile.
 
     Lowering: one ``lax.ppermute`` per schedule slot (a single ICI rotation for
-    circulant graphs) + fused multiply-adds.
+    circulant graphs) + fused multiply-adds.  ``backend='pallas'`` routes
+    small/medium tensors through the fused RDMA kernel
+    (:mod:`bluefog_tpu.ops.pallas_gossip`) on real TPU slices; ``'auto'``
+    keeps XLA (the right default — XLA overlaps ppermute with surrounding
+    compute, while the Pallas kernel is a win when the weighted reduction
+    dominates).
     """
     sched = _as_schedule(schedule)
+
+    if backend == "pallas":
+        from bluefog_tpu.ops import pallas_gossip
+
+        # distinct collective_id per leaf: leaf kernels have no mutual data
+        # dependencies, so XLA may overlap them — each needs its own global
+        # barrier semaphore or one kernel's handshake absorbs another's
+        leaves, treedef = jax.tree_util.tree_flatten(x)
+        outs = [
+            pallas_gossip.neighbor_allreduce_pallas(
+                leaf, sched, axis_name,
+                self_weight=self_weight, recv_weights=recv_weights,
+                collective_id=7 + idx,
+            )
+            for idx, leaf in enumerate(leaves)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, outs)
 
     def one(leaf):
         acc_dt = _acc_dtype(leaf)
@@ -221,20 +244,16 @@ def barrier(axis_name: str):
     return lax.psum(jnp.zeros((), jnp.float32), axis_name)
 
 
-def pair_gossip(x, axis_name: str, *, target_rank=None, perm=None, self_weight=0.5):
+def pair_gossip(x, axis_name: str, *, perm, self_weight=0.5):
     """Average with a single partner: ``out = w x + (1-w) x_partner``.
 
-    Either a static ``perm`` (list of ``(src, dst)``) or a uniform
-    ``target_rank`` offset pairing may be given.  Mirrors the reference's
-    ``pair_gossip`` (upstream, UNVERIFIED name — see SURVEY.md §2.2).
+    Mirrors the reference's ``pair_gossip(tensor, target_rank)`` (upstream,
+    UNVERIFIED name — see SURVEY.md §2.2).  SPMD deviation: the reference's
+    per-process ``target_rank`` argument becomes the full pairing ``perm`` —
+    a list of ``(src, dst)`` pairs covering every participating rank (all
+    ranks must agree on the pairing, which the reference leaves implicit).
+    Ranks absent from ``perm``'s destinations keep their own value.
     """
-    if perm is None:
-        if target_rank is None:
-            raise ValueError("pair_gossip needs target_rank or perm")
-        raise ValueError(
-            "SPMD pair_gossip requires the full pairing: pass perm= with "
-            "(src, dst) pairs for all participating ranks"
-        )
     got = lax.ppermute(x, axis_name, perm)
     w = jnp.asarray(self_weight, _acc_dtype(x))
     # Ranks not named as a destination receive zeros; they keep their own value.

@@ -1,0 +1,292 @@
+"""Pallas TPU kernels: gossip exchange and one-sided delivery via inter-chip
+RDMA (``pltpu.make_async_remote_copy``).
+
+This is the genuinely *native* layer of the build (SURVEY.md §7 "one-sided
+layer"): the TPU equivalent of the reference's MPI RMA machinery
+(``MPIController::WinPut/WinAccumulate/WinUpdate`` over ``MPI_Win`` memory,
+``bluefog/common/mpi_controller.cc``, upstream-relative) and of its NCCL
+send/recv emulation (``nccl_controller.cc``).
+
+Two kernels, both restricted to **circulant schedules** (every standard
+topology: ring, exponential-2, symmetric-exp, one-peer phases — each slot is
+a uniform shift ``i -> i+s``, i.e. one ICI rotation):
+
+- :func:`neighbor_allreduce_pallas` — fused gossip: per slot, RDMA the local
+  tensor into the in-neighbor slot buffer of ``rank+s`` while accumulating
+  arrived slots into ``w_self*x + sum_k w_k*recv_k``.  Against the XLA
+  lowering (ppermute + adds) this fuses the weighted reduction into the
+  arrival path — one VMEM pass instead of ppermute-materialize-then-add.
+- :func:`deliver_pallas` — the ``win_put``/``win_accumulate`` transport:
+  RDMA payloads into per-slot landing buffers (the reference's per-neighbor
+  ``MPI_Win`` memory) without touching them on the compute path; the receiver
+  consumes them only at ``win_update``.
+
+Synchronization protocol (per kernel invocation, SPMD-symmetric):
+1. barrier handshake with in/out-neighbors via the global barrier semaphore —
+   guarantees the remote landing buffers are live before any RDMA starts
+   (the reference gets this from ``MPI_Win_create``'s collective epoch);
+2. per-slot RDMA start; sender tracks ``send_sem``, the in-flight data
+   signals the *receiver's* ``recv_sem`` on arrival;
+3. ``wait_recv`` per slot before accumulating (gossip) or storing (deliver).
+
+Use on real multi-chip slices; single-chip and CPU meshes route to the XLA
+path automatically (``backend='auto'``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bluefog_tpu.topology.schedule import GossipSchedule
+
+__all__ = [
+    "is_pallas_supported",
+    "circulant_shifts",
+    "neighbor_allreduce_pallas",
+    "deliver_pallas",
+]
+
+_LANES = 128
+_SUBLANES = 8
+
+
+def circulant_shifts(sched: GossipSchedule) -> Optional[Tuple[int, ...]]:
+    """Per-slot uniform shifts, or None if the schedule is not circulant."""
+    if not sched.is_circulant:
+        return None
+    shifts = []
+    for perm in sched.perms:
+        (src0, dst0) = perm[0]
+        shifts.append((dst0 - src0) % sched.size)
+    return tuple(shifts)
+
+
+def is_pallas_supported(sched: GossipSchedule) -> bool:
+    """True when the schedule can ride the RDMA kernels (circulant) and we
+    are on a real TPU backend."""
+    if circulant_shifts(sched) is None:
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _pad_to_tiles(flat: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
+    """Pad a flat f32 vector to an (R, 128) tile-aligned 2-D block."""
+    n = flat.shape[0]
+    per_tile = _SUBLANES * _LANES
+    padded = int(np.ceil(max(n, 1) / per_tile)) * per_tile
+    flat = jnp.pad(flat, (0, padded - n))
+    return flat.reshape(padded // _LANES, _LANES), n
+
+
+def _make_exchange_kernel(shifts: Sequence[int], size: int, axis_name: str,
+                          mode: str, num_slots: int):
+    """Build the shared RDMA exchange kernel body.
+
+    mode: 'gossip'  -> out = sw*x + sum_k rw[k]*recv_k
+          'put'     -> out_bufs[k] = recv_k (masked by mask[k])
+          'acc'     -> out_bufs[k] = old_bufs[k] + recv_k (masked)
+    """
+    from jax.experimental import pallas as pl  # deferred: TPU-only path
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_shifts = len(shifts)
+
+    if mode == "gossip":
+        def kernel(x_ref, sw_ref, rw_ref, out_ref, comm_buf, send_sem, recv_sem):
+            my = lax.axis_index(axis_name)
+            barrier = pltpu.get_barrier_semaphore()
+            # handshake: signal each IN-neighbor (my-s) that my landing
+            # buffers are live; the n_shifts signals I then wait for come
+            # from my OUT-neighbors (my+s) — exactly my RDMA targets — so
+            # no RDMA starts before its destination buffer exists
+            for s in shifts:
+                pltpu.semaphore_signal(
+                    barrier, inc=1,
+                    device_id=lax.rem(my - s + size, size),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+            pltpu.semaphore_wait(barrier, n_shifts)
+
+            rdmas = []
+            for k, s in enumerate(shifts):
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=x_ref,
+                    dst_ref=comm_buf.at[k],
+                    send_sem=send_sem.at[k],
+                    recv_sem=recv_sem.at[k],
+                    device_id=lax.rem(my + s, size),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+                rdma.start()
+                rdmas.append(rdma)
+
+            acc = sw_ref[0, 0] * x_ref[:]
+            for k, rdma in enumerate(rdmas):
+                rdma.wait_recv()
+                acc = acc + rw_ref[0, k] * comm_buf[k]
+            out_ref[:] = acc
+            for rdma in rdmas:
+                rdma.wait_send()
+        return kernel
+
+    def kernel(x_ref, bufs_ref, mask_ref, out_bufs_ref, send_sem, recv_sem):
+        my = lax.axis_index(axis_name)
+        barrier = pltpu.get_barrier_semaphore()
+        # signal in-neighbors; wait for out-neighbors (RDMA targets) — see
+        # the gossip kernel's handshake comment
+        for s in shifts:
+            pltpu.semaphore_signal(
+                barrier, inc=1,
+                device_id=lax.rem(my - s + size, size),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+        pltpu.semaphore_wait(barrier, n_shifts)
+
+        rdmas = []
+        for k, s in enumerate(shifts):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref,
+                dst_ref=out_bufs_ref.at[k],
+                send_sem=send_sem.at[k],
+                recv_sem=recv_sem.at[k],
+                device_id=lax.rem(my + s, size),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdmas.append(rdma)
+        for k, rdma in enumerate(rdmas):
+            rdma.wait_recv()
+            landed = out_bufs_ref[k]
+            old = bufs_ref[k]
+            keep = mask_ref[0, k]
+            if mode == "acc":
+                new = old + landed
+            else:
+                new = landed
+            out_bufs_ref[k] = jnp.where(keep > 0, new, old)
+        for rdma in rdmas:
+            rdma.wait_send()
+    return kernel
+
+
+def neighbor_allreduce_pallas(
+    x: jnp.ndarray,
+    sched: GossipSchedule,
+    axis_name: str,
+    *,
+    self_weight=None,
+    recv_weights=None,
+    collective_id: int = 7,
+    interpret: bool = False,
+):
+    """Fused RDMA gossip step for one array (any shape/dtype; internally a
+    padded f32 (R,128) block).  Call inside ``shard_map``; circulant
+    schedules only — gate with :func:`is_pallas_supported`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shifts = circulant_shifts(sched)
+    if shifts is None:
+        raise ValueError("pallas gossip requires a circulant schedule")
+    n = sched.size
+    i = lax.axis_index(axis_name)
+
+    orig_dtype = x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    block, true_len = _pad_to_tiles(flat)
+
+    sw = (jnp.asarray(sched.self_weights, jnp.float32)[i]
+          if self_weight is None else jnp.asarray(self_weight, jnp.float32))
+    rw = (jnp.asarray(sched.recv_weights, jnp.float32)[i]
+          if recv_weights is None else jnp.asarray(recv_weights, jnp.float32))
+    sw = sw.reshape(1, 1)
+    rw = rw.reshape(1, -1)
+
+    kernel = _make_exchange_kernel(shifts, n, axis_name, "gossip", sched.num_slots)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(block.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, len(shifts)), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((len(shifts),) + block.shape, jnp.float32),
+            pltpu.SemaphoreType.DMA((len(shifts),)),
+            pltpu.SemaphoreType.DMA((len(shifts),)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id,
+        ),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(block, sw, rw)
+    return out.reshape(-1)[:true_len].reshape(x.shape).astype(orig_dtype)
+
+
+def deliver_pallas(
+    payload: jnp.ndarray,
+    bufs: jnp.ndarray,
+    sched: GossipSchedule,
+    axis_name: str,
+    *,
+    accumulate: bool,
+    collective_id: int = 8,
+    interpret: bool = False,
+):
+    """RDMA transport for ``win_put``/``win_accumulate``: sends ``payload`` to
+    every out-neighbor's landing slot; returns the updated ``(K, ...)`` slot
+    buffers for this rank.  Circulant schedules only."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shifts = circulant_shifts(sched)
+    if shifts is None:
+        raise ValueError("pallas deliver requires a circulant schedule")
+    n = sched.size
+    i = lax.axis_index(axis_name)
+
+    orig_dtype = payload.dtype
+    flat = payload.astype(jnp.float32).reshape(-1)
+    block, true_len = _pad_to_tiles(flat)
+    k_slots = len(shifts)
+    bufs_f = bufs.astype(jnp.float32).reshape(k_slots, -1)
+    bufs_block = jnp.pad(
+        bufs_f, ((0, 0), (0, block.size - bufs_f.shape[1]))
+    ).reshape((k_slots,) + block.shape)
+
+    mask = jnp.asarray(sched.recv_src >= 0, jnp.int32)[i].reshape(1, -1)
+
+    kernel = _make_exchange_kernel(
+        shifts, n, axis_name, "acc" if accumulate else "put", sched.num_slots
+    )
+    out_bufs = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(bufs_block.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_slots), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((k_slots,)),
+            pltpu.SemaphoreType.DMA((k_slots,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id,
+        ),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(block, bufs_block, mask)
+    return (out_bufs.reshape(k_slots, -1)[:, : bufs_f.shape[1]]
+            .reshape(bufs.shape).astype(orig_dtype))

@@ -116,8 +116,26 @@ def win_free(state: WindowState) -> None:
     return None
 
 
-def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool) -> WindowState:
+def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
+             backend: str = "auto") -> WindowState:
     sched = state.spec.schedule
+
+    if backend == "pallas":
+        from bluefog_tpu.ops import pallas_gossip
+
+        # distinct collective_id per leaf (leaf kernels may overlap on
+        # hardware; each needs its own barrier semaphore)
+        peer_leaves, treedef = jax.tree_util.tree_flatten(state.peer_bufs)
+        payload_leaves = treedef.flatten_up_to(payload)
+        outs = [
+            pallas_gossip.deliver_pallas(
+                leaf, peers, sched, axis_name, accumulate=accumulate,
+                collective_id=64 + idx,
+            )
+            for idx, (peers, leaf) in enumerate(zip(peer_leaves, payload_leaves))
+        ]
+        return state.replace(peer_bufs=jax.tree_util.tree_unflatten(treedef, outs))
+
     mask = _slot_mask(sched, axis_name)
 
     def per_leaf(peers, leaf):
@@ -140,17 +158,19 @@ def win_put(
     axis_name: str,
     *,
     dst_weight=1.0,
+    backend: str = "auto",
 ) -> WindowState:
     """Write ``dst_weight * x`` into every out-neighbor's landing buffer.
 
     ``dst_weight`` may be a traced scalar (push-sum sends ``1/(out_deg+1)``
     fractions — the reference's per-call ``dst_weights``).  The destination is
-    not involved until it chooses to ``win_update``.
+    not involved until it chooses to ``win_update``.  ``backend='pallas'``
+    performs the transfer as a genuine one-sided RDMA on TPU slices.
     """
     payload = jax.tree_util.tree_map(
         lambda leaf: (jnp.asarray(dst_weight, leaf.dtype) * leaf).astype(leaf.dtype), x
     )
-    return _deliver(state, payload, axis_name, accumulate=False)
+    return _deliver(state, payload, axis_name, accumulate=False, backend=backend)
 
 
 def win_accumulate(
@@ -159,13 +179,14 @@ def win_accumulate(
     axis_name: str,
     *,
     dst_weight=1.0,
+    backend: str = "auto",
 ) -> WindowState:
     """Like :func:`win_put` but adds into the destination buffer
     (``MPI_Accumulate(MPI_SUM)`` semantics)."""
     payload = jax.tree_util.tree_map(
         lambda leaf: (jnp.asarray(dst_weight, leaf.dtype) * leaf).astype(leaf.dtype), x
     )
-    return _deliver(state, payload, axis_name, accumulate=True)
+    return _deliver(state, payload, axis_name, accumulate=True, backend=backend)
 
 
 def win_get(state: WindowState, axis_name: str) -> WindowState:

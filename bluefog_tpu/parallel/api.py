@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from bluefog_tpu import ops as _ops
@@ -64,12 +65,20 @@ __all__ = [
 ]
 
 
+@functools.lru_cache(maxsize=256)
+def _schedule_for(topology: Topology) -> GossipSchedule:
+    # Topologies hash by identity, so repeated calls with the same Topology
+    # object reuse one schedule — keeping _cached_op / _cached_win_op warm
+    # instead of recompiling per call.
+    return build_schedule(topology)
+
+
 def _sched(topology) -> GossipSchedule:
     if topology is None:
         return get_context().schedule
     if isinstance(topology, GossipSchedule):
         return topology
-    return build_schedule(topology)
+    return _schedule_for(topology)
 
 
 def _smap(fn, n_in: int = 1, replicated_in: int = 0):
@@ -79,6 +88,48 @@ def _smap(fn, n_in: int = 1, replicated_in: int = 0):
     return shard_map(
         fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=P(ax), check_vma=False,
     )
+
+
+# Cache of jitted shard_map callables.  Eager api calls would otherwise
+# re-stage the shard_map on every invocation (the analog of the reference
+# re-registering MPI datatypes per call); keyed by everything that changes the
+# staged program.  Schedules hash by identity — reuse the context's schedule
+# (or hold on to your own) to stay cache-warm.
+@functools.lru_cache(maxsize=512)
+def _cached_op(op_name: str, mesh, axis_name: str, sched, *static):
+    ax = axis_name
+
+    if op_name == "neighbor_allreduce":
+        has_sw, has_rw = static
+
+        def fn(xs, sw, rw):
+            return _ops.neighbor_allreduce(
+                xs, sched, ax,
+                self_weight=sw if has_sw else None,
+                recv_weights=rw if has_rw else None,
+            )
+
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P(ax), P(), P()), out_specs=P(ax),
+            check_vma=False,
+        ))
+
+    if op_name == "allreduce":
+        (average,) = static
+        f = lambda xs: _ops.allreduce(xs, ax, average=average)
+    elif op_name == "broadcast":
+        (root,) = static
+        f = lambda xs: _ops.broadcast(xs, root, ax)
+    elif op_name == "allgather":
+        # [None] must apply per leaf, not to the tree_map'd result
+        f = lambda xs: jax.tree_util.tree_map(
+            lambda leaf: lax.all_gather(leaf, ax, axis=0, tiled=True)[None], xs
+        )
+    else:
+        raise KeyError(op_name)
+    return jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P(ax),), out_specs=P(ax), check_vma=False,
+    ))
 
 
 def rank_stack(x, size: Optional[int] = None):
@@ -107,13 +158,16 @@ def neighbor_allreduce(x, *, topology=None, self_weight=None, recv_weights=None)
     sum_j W[i,j] x[j]`` with ``W`` from ``topology`` (default: context)."""
     ctx = get_context()
     sched = _sched(topology)
-
-    f = _smap(
-        lambda xs: _ops.neighbor_allreduce(
-            xs, sched, ctx.axis_name, self_weight=self_weight, recv_weights=recv_weights
-        )
+    f = _cached_op(
+        "neighbor_allreduce", ctx.mesh, ctx.axis_name, sched,
+        self_weight is not None, recv_weights is not None,
     )
-    return f(x)
+    sw = jnp.asarray(self_weight if self_weight is not None else 0.0, jnp.float32)
+    rw = jnp.asarray(
+        recv_weights if recv_weights is not None else jnp.zeros((sched.num_slots,)),
+        jnp.float32,
+    )
+    return f(x, sw, rw)
 
 
 def neighbor_allgather(x, *, topology=None):
@@ -136,19 +190,19 @@ def neighbor_allgather(x, *, topology=None):
 
 def allreduce(x, *, average: bool = True):
     ctx = get_context()
-    return _smap(lambda xs: _ops.allreduce(xs, ctx.axis_name, average=average))(x)
+    return _cached_op("allreduce", ctx.mesh, ctx.axis_name, None, average)(x)
 
 
 def allgather(x):
     """Stacked allgather: every rank's row becomes the full stack — output
     shape ``(size, size, ...)`` per the stacked-representation convention."""
     ctx = get_context()
-    return _smap(lambda xs: _ops.allgather(xs, ctx.axis_name, axis=0, tiled=True)[None])(x)
+    return _cached_op("allgather", ctx.mesh, ctx.axis_name, None)(x)
 
 
 def broadcast(x, root_rank: int = 0):
     ctx = get_context()
-    return _smap(lambda xs: _ops.broadcast(xs, root_rank, ctx.axis_name))(x)
+    return _cached_op("broadcast", ctx.mesh, ctx.axis_name, None, root_rank)(x)
 
 
 def barrier():
@@ -186,18 +240,62 @@ def hierarchical_neighbor_allreduce(x, *, machine_topology=None, self_weight=Non
 # ---------------------------------------------------------------------------
 
 
-def _win_smap(fn, state: WindowState, *extra):
-    """shard_map an op over a registered window state (+ stacked extras)."""
-    ctx = get_context()
-    n_extra = len(extra)
-    f = shard_map(
-        fn,
-        mesh=ctx.mesh,
-        in_specs=(P(ctx.axis_name),) * (1 + n_extra),
-        out_specs=P(ctx.axis_name),
-        check_vma=False,
-    )
-    return f(state, *extra)
+@functools.lru_cache(maxsize=512)
+def _cached_win_op(op_name: str, mesh, axis_name: str, sched, *static):
+    """Jitted shard_map callables for window ops (same caching story as
+    :func:`_cached_op`)."""
+    ax = axis_name
+
+    if op_name == "create":
+        (name,) = static
+
+        def create_fn(xs):
+            return _ops.win_create(xs, sched, ax, name=name)
+
+        return jax.jit(shard_map(
+            create_fn, mesh=mesh, in_specs=(P(ax),), out_specs=P(ax),
+            check_vma=False,
+        ))
+
+    if op_name in ("put", "accumulate"):
+        op = _ops.win_put if op_name == "put" else _ops.win_accumulate
+
+        def deliver_fn(st, xs, dw):
+            return op(st, xs, ax, dst_weight=dw)
+
+        return jax.jit(shard_map(
+            deliver_fn, mesh=mesh, in_specs=(P(ax), P(ax), P()),
+            out_specs=P(ax), check_vma=False,
+        ))
+
+    if op_name == "get":
+        return jax.jit(shard_map(
+            lambda st: _ops.win_get(st, ax), mesh=mesh, in_specs=(P(ax),),
+            out_specs=P(ax), check_vma=False,
+        ))
+
+    if op_name == "update":
+        has_sw, has_rw = static
+
+        def update_fn(st, sw, rw):
+            return _ops.win_update(
+                st, ax,
+                self_weight=sw if has_sw else None,
+                recv_weights=rw if has_rw else None,
+            )
+
+        return jax.jit(shard_map(
+            update_fn, mesh=mesh, in_specs=(P(ax), P(), P()),
+            out_specs=(P(ax), P(ax)), check_vma=False,
+        ))
+
+    if op_name == "update_then_collect":
+        return jax.jit(shard_map(
+            lambda st: _ops.win_update_then_collect(st, ax), mesh=mesh,
+            in_specs=(P(ax),), out_specs=(P(ax), P(ax)), check_vma=False,
+        ))
+
+    raise KeyError(op_name)
 
 
 def win_create(x, name: str, *, topology=None, zero_init: bool = False) -> bool:
@@ -207,21 +305,9 @@ def win_create(x, name: str, *, topology=None, zero_init: bool = False) -> bool:
     sched = _sched(topology)
     if zero_init:
         x = jax.tree_util.tree_map(lambda leaf: jnp.zeros_like(leaf), x)
-
-    def fn(xs):
-        return _ops.win_create(xs, sched, ctx.axis_name, name=name)
-
-    ctx.windows[name] = _win_smap_create(fn, x)
+    f = _cached_win_op("create", ctx.mesh, ctx.axis_name, sched, name)
+    ctx.windows[name] = f(x)
     return True
-
-
-def _win_smap_create(fn, x):
-    ctx = get_context()
-    f = shard_map(
-        fn, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),), out_specs=P(ctx.axis_name),
-        check_vma=False,
-    )
-    return f(x)
 
 
 def win_free(name: Optional[str] = None) -> bool:
@@ -244,29 +330,24 @@ def _get_win(name: str) -> WindowState:
 def win_put(x, name: str, *, dst_weight=1.0) -> bool:
     ctx = get_context()
     state = _get_win(name)
-    ctx.windows[name] = _win_smap(
-        lambda st, xs: _ops.win_put(st, xs, ctx.axis_name, dst_weight=dst_weight),
-        state, x,
-    )
+    f = _cached_win_op("put", ctx.mesh, ctx.axis_name, state.spec.schedule)
+    ctx.windows[name] = f(state, x, jnp.asarray(dst_weight, jnp.float32))
     return True
 
 
 def win_accumulate(x, name: str, *, dst_weight=1.0) -> bool:
     ctx = get_context()
     state = _get_win(name)
-    ctx.windows[name] = _win_smap(
-        lambda st, xs: _ops.win_accumulate(st, xs, ctx.axis_name, dst_weight=dst_weight),
-        state, x,
-    )
+    f = _cached_win_op("accumulate", ctx.mesh, ctx.axis_name, state.spec.schedule)
+    ctx.windows[name] = f(state, x, jnp.asarray(dst_weight, jnp.float32))
     return True
 
 
 def win_get(name: str) -> bool:
     ctx = get_context()
     state = _get_win(name)
-    ctx.windows[name] = _win_smap(
-        lambda st: _ops.win_get(st, ctx.axis_name), state,
-    )
+    f = _cached_win_op("get", ctx.mesh, ctx.axis_name, state.spec.schedule)
+    ctx.windows[name] = f(state)
     return True
 
 
@@ -275,14 +356,17 @@ def win_update(name: str, *, self_weight=None, recv_weights=None):
     (reference ``bf.win_update``)."""
     ctx = get_context()
     state = _get_win(name)
-    f = shard_map(
-        lambda st: _ops.win_update(
-            st, ctx.axis_name, self_weight=self_weight, recv_weights=recv_weights
-        ),
-        mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
-        out_specs=(P(ctx.axis_name), P(ctx.axis_name)), check_vma=False,
+    sched = state.spec.schedule
+    f = _cached_win_op(
+        "update", ctx.mesh, ctx.axis_name, sched,
+        self_weight is not None, recv_weights is not None,
     )
-    out, new_state = f(state)
+    sw = jnp.asarray(self_weight if self_weight is not None else 0.0, jnp.float32)
+    rw = jnp.asarray(
+        recv_weights if recv_weights is not None else jnp.zeros((sched.num_slots,)),
+        jnp.float32,
+    )
+    out, new_state = f(state, sw, rw)
     ctx.windows[name] = new_state
     return out
 
@@ -290,10 +374,8 @@ def win_update(name: str, *, self_weight=None, recv_weights=None):
 def win_update_then_collect(name: str):
     ctx = get_context()
     state = _get_win(name)
-    f = shard_map(
-        lambda st: _ops.win_update_then_collect(st, ctx.axis_name),
-        mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
-        out_specs=(P(ctx.axis_name), P(ctx.axis_name)), check_vma=False,
+    f = _cached_win_op(
+        "update_then_collect", ctx.mesh, ctx.axis_name, state.spec.schedule
     )
     out, new_state = f(state)
     ctx.windows[name] = new_state

@@ -163,6 +163,28 @@ def test_allgather():
         np.testing.assert_allclose(np.asarray(out[r, :, 0]), np.arange(N))
 
 
+def test_allgather_pytree():
+    bf.init()
+    out = bf.allgather({"a": rank_values((2,)), "b": rank_values(())})
+    assert out["a"].shape == (N, N, 2)
+    assert out["b"].shape == (N, N)
+    np.testing.assert_allclose(np.asarray(out["b"][3]), np.arange(N))
+
+
+def test_topology_object_schedule_cached():
+    """Passing the same Topology object repeatedly must reuse one schedule
+    (and therefore one compiled program)."""
+    from bluefog_tpu.parallel.api import _schedule_for
+
+    bf.init()
+    topo = RingGraph(N)
+    assert _schedule_for(topo) is _schedule_for(topo)
+    x = rank_values((4,))
+    out1 = bf.neighbor_allreduce(x, topology=topo)
+    out2 = bf.neighbor_allreduce(x, topology=topo)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+
 def test_neighbor_allgather_regular():
     topo = RingGraph(N)
     bf.init(topology=topo)
