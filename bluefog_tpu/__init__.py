@@ -75,6 +75,10 @@ from bluefog_tpu.parallel.api import (
     broadcast_optimizer_state,
     rank_stack,
     rank_shard,
+    enqueue_host_op,
+    poll,
+    synchronize,
+    wait_all_host_ops,
 )
 from bluefog_tpu.utils import timeline_start_activity, timeline_end_activity, timeline_context
 

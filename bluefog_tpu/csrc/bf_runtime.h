@@ -1,0 +1,69 @@
+// bluefog_tpu native host runtime — public C API.
+//
+// TPU-native equivalent of the reference's C++ core (upstream-relative:
+// bluefog/common/{operations,tensor_queue,timeline,logging}.cc and
+// bluefog/torch/handle_manager.cc — SURVEY.md §2.1).  On TPU the *device*
+// dataflow lives inside XLA (async dispatch subsumes the reference's
+// negotiation phase), so what remains genuinely host-native is:
+//
+//   * an async op engine: a mutex-protected FIFO drained by a background
+//     thread, firing enqueued host callbacks (checkpoint IO, cross-slice DCN
+//     staging, metric flushes) off the critical path;
+//   * a handle manager with poll / wait-and-clear semantics (the reference's
+//     nonblocking-op handle table);
+//   * a chrome-trace timeline writer on its own thread;
+//   * leveled logging controlled by BLUEFOG_TPU_LOG_LEVEL.
+//
+// Bound from Python via ctypes (no pybind11 in this image).
+
+#ifndef BF_RUNTIME_H_
+#define BF_RUNTIME_H_
+
+#include <cstdint>
+
+extern "C" {
+
+// ---------------------------------------------------------------- logging --
+// Levels: 0=trace 1=debug 2=info 3=warn 4=error 5=fatal(off).
+int bf_log_level();
+void bf_set_log_level(int level);
+void bf_log(int level, const char* msg);
+
+// --------------------------------------------------------------- timeline --
+// Chrome trace-event JSON, written incrementally by a dedicated thread.
+int bf_timeline_start(const char* path);   // 0 ok, <0 error
+int bf_timeline_stop();                    // flush + close
+int bf_timeline_active();
+void bf_timeline_begin(const char* name, const char* cat, int64_t tid);
+void bf_timeline_end(const char* name, const char* cat, int64_t tid);
+void bf_timeline_instant(const char* name, const char* cat);
+// Async-span helpers keyed by id (for overlapping ops, ph 'b'/'e').
+void bf_timeline_async_begin(const char* name, const char* cat, int64_t id);
+void bf_timeline_async_end(const char* name, const char* cat, int64_t id);
+
+// ----------------------------------------------------------------- engine --
+// Host callback executed on the engine thread; returns a status code
+// (0 = OK; nonzero = op-defined error).
+typedef int (*bf_callback)(void* arg);
+
+int bf_engine_start();     // idempotent; spawns the background thread
+int bf_engine_shutdown();  // drains the queue, joins the thread
+int bf_engine_running();
+
+// Enqueue a host op; returns a fresh handle (>=0), or -1 if not running.
+int bf_enqueue(const char* op, const char* name, bf_callback cb, void* arg);
+
+// Handle states: -1 = unknown handle, 0 = pending, 1 = done.
+int bf_poll(int handle);
+// Block until done (timeout_ms < 0 → forever).  Returns 0 and writes the
+// callback's status to *status_out on success; -1 on unknown handle; -2 on
+// timeout.  Keeping the op status out-of-band means callbacks may return any
+// int without colliding with the sentinels.  Does NOT clear.
+int bf_wait(int handle, int timeout_ms, int* status_out);
+void bf_clear(int handle);      // forget a completed handle
+int bf_wait_all(int timeout_ms);  // wait for every pending handle
+int bf_pending_count();
+
+}  // extern "C"
+
+#endif  // BF_RUNTIME_H_

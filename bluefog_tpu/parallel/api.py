@@ -411,3 +411,40 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0):
         it = iter(out)
         arrays = [next(it) if ok else a for a, ok in zip(arrays, is_arr)]
     return jax.tree_util.tree_unflatten(treedef, arrays)
+
+
+# ---------------------------------------------------------------------------
+# Nonblocking host-op surface (reference bluefog/torch/mpi_ops.py poll /
+# synchronize over handle_manager; SURVEY.md §3.2).  Device collectives are
+# XLA-async by construction, so handles here track *host* ops (checkpoint IO,
+# DCN staging, metric flushes) running on the native C++ engine thread.
+# ---------------------------------------------------------------------------
+
+
+def enqueue_host_op(fn, *, op: str = "host_op", name: str = "") -> int:
+    """Run ``fn()`` on the background engine thread; returns a handle."""
+    from bluefog_tpu.runtime import engine
+
+    return engine().enqueue(fn, op=op, name=name)
+
+
+def poll(handle: int) -> bool:
+    """True once the host op behind ``handle`` has completed."""
+    from bluefog_tpu.runtime import engine
+
+    return engine().poll(handle)
+
+
+def synchronize(handle: int, timeout_s=None):
+    """Block until the host op completes and clear its handle (reference
+    ``bf.synchronize`` = WaitAndClear).  Re-raises the op's exception."""
+    from bluefog_tpu.runtime import engine
+
+    return engine().synchronize(handle, timeout_s=timeout_s)
+
+
+def wait_all_host_ops(timeout_s=None):
+    """Drain every pending host op (used before shutdown / checkpoints)."""
+    from bluefog_tpu.runtime import engine
+
+    return engine().wait_all(timeout_s=timeout_s)

@@ -1,9 +1,20 @@
-"""Runtime layer: multi-host bring-up, launcher, native (C++) components.
+"""Runtime layer: multi-host bring-up, launcher, native (C++) host engine.
 
 Reference parity (upstream-relative): ``bluefog/run/`` (the ``bfrun``/
-``ibfrun`` mpirun-wrapper CLI) and the native engine pieces of
-``bluefog/common/`` that remain host-side work on TPU (timeline writer,
-cross-slice coordination).  Most of the reference's C++ engine — background
-thread, tensor queue, negotiation — is subsumed by XLA async dispatch and
-does not reappear here (SURVEY.md §7 design stance).
+``ibfrun`` mpirun-wrapper CLI) and the C++ core of ``bluefog/common/``.
+On TPU the *device* dataflow (collectives, negotiation ordering) is subsumed
+by XLA async dispatch under SPMD (SURVEY.md §7 design stance); the pieces
+that remain genuinely host-side are implemented natively in
+``bluefog_tpu/csrc`` (C++17, ctypes-bound — see ``native.py``):
+
+- async op engine (tensor queue + background thread + handle manager,
+  parity: ``operations.cc``/``tensor_queue.cc``/``handle_manager.cc``) for
+  checkpoint IO, DCN staging, and other host work overlapped with the step;
+- chrome-trace timeline writer thread (parity: ``timeline.cc``);
+- leveled logging (parity: ``logging.cc``).
 """
+
+from bluefog_tpu.runtime.launch import initialize_cluster
+from bluefog_tpu.runtime.native import Engine, PyEngine, engine
+
+__all__ = ["initialize_cluster", "Engine", "PyEngine", "engine"]
