@@ -41,7 +41,12 @@ constexpr int kPending = INT32_MIN;
 
 class Engine {
  public:
+  // Start/Shutdown are serialized on lifecycle_mu_ (held across the join):
+  // a Start racing an in-progress Shutdown must block until the old Loop
+  // thread has fully exited, else resetting shutdown_ would strand that
+  // thread on queue_cv_ forever and Shutdown's join would never return.
   int Start() {
+    std::lock_guard<std::mutex> lc(lifecycle_mu_);
     std::lock_guard<std::mutex> lock(mu_);
     if (running_) return 0;
     shutdown_ = false;
@@ -51,8 +56,7 @@ class Engine {
   }
 
   int Shutdown() {
-    // Move the thread handle out under the lock so concurrent Shutdown
-    // calls cannot both join it (double-join would std::terminate).
+    std::lock_guard<std::mutex> lc(lifecycle_mu_);
     std::thread t;
     {
       std::lock_guard<std::mutex> lock(mu_);
@@ -166,6 +170,7 @@ class Engine {
     }
   }
 
+  std::mutex lifecycle_mu_;
   std::mutex mu_;
   std::condition_variable queue_cv_;
   std::condition_variable done_cv_;

@@ -85,17 +85,32 @@ class TimelineWriter {
     std::unique_lock<std::mutex> lock(mu_);
     while (!stop_) {
       cv_.wait_for(lock, std::chrono::milliseconds(100));
-      Drain();
+      // Swap the backlog out and release mu_ before serializing/writing so
+      // Record() never blocks on disk latency (the op-engine thread records
+      // spans on its critical path).  file_/first_ are touched only by this
+      // thread while it runs, and by Stop() strictly after joining it.
+      std::vector<Event> batch;
+      batch.swap(pending_);
+      lock.unlock();
+      WriteBatch(batch);
+      lock.lock();
     }
   }
 
-  // Requires mu_ held.
+  // Requires mu_ held; only called from Stop() after the writer thread has
+  // been joined (final flush).
   void Drain() {
-    if (file_ == nullptr || pending_.empty()) return;
+    std::vector<Event> batch;
+    batch.swap(pending_);
+    WriteBatch(batch);
+  }
+
+  void WriteBatch(const std::vector<Event>& batch) {
+    if (file_ == nullptr || batch.empty()) return;
     std::string out;
-    out.reserve(pending_.size() * 96);
+    out.reserve(batch.size() * 96);
     char buf[64];
-    for (const Event& e : pending_) {
+    for (const Event& e : batch) {
       if (!first_) out += ",\n";
       first_ = false;
       out += "{\"name\":\"";
@@ -119,7 +134,6 @@ class TimelineWriter {
       if (e.ph == 'i') out += ",\"s\":\"p\"";
       out += "}";
     }
-    pending_.clear();
     std::fputs(out.c_str(), file_);
     std::fflush(file_);
   }

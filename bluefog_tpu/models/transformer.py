@@ -1,0 +1,102 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+No counterpart exists in the reference (it predates LLMs; SURVEY.md §5
+"long-context": absent) — this model exists to exercise the framework's
+first-class sequence parallelism: the attention core is *pluggable*, so the
+same module runs
+
+- single-device / data-parallel with plain causal attention, or
+- sequence-parallel inside ``shard_map`` with
+  :func:`bluefog_tpu.ops.ring_attention.ring_attention` (KV ring over ICI) or
+  :func:`~bluefog_tpu.ops.ring_attention.all_to_all_attention` (Ulysses),
+  passing ``position_offset = rank * T_local`` for the sharded positions.
+
+TPU-first: bf16 activations/matmuls with f32 layernorm + softmax-accumulate,
+fused QKV, static shapes, dims sized for 128-lane MXU tiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from bluefog_tpu.ops.ring_attention import local_attention
+
+AttnFn = Callable[..., jnp.ndarray]  # (q, k, v) -> (B, T, H, D)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded up to a 128 multiple
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    max_position: int = 8192
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def small() -> "GPTConfig":
+        return GPTConfig()
+
+    @staticmethod
+    def large() -> "GPTConfig":
+        return GPTConfig(hidden_size=1536, num_layers=24, num_heads=16)
+
+    @staticmethod
+    def tiny() -> "GPTConfig":
+        """For tests/dryruns."""
+        return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=4, max_position=512, dtype=jnp.float32)
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, attn_fn: AttnFn):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (cfg.num_heads, head_dim))
+
+        a = attn_fn(heads(q), heads(k), heads(v))
+        a = a.reshape(a.shape[:-2] + (cfg.hidden_size,))
+        x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="proj")(a)
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        y = nn.Dense(cfg.mlp_ratio * cfg.hidden_size, dtype=cfg.dtype, name="up")(y)
+        y = nn.gelu(y)
+        return x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="down")(y)
+
+
+class TransformerLM(nn.Module):
+    """Tokens → logits.  ``attn_fn(q, k, v) -> out`` defaults to full causal
+    attention; inject a sequence-parallel attention inside ``shard_map`` and
+    pass this rank's global ``position_offset``."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, attn_fn: Optional[AttnFn] = None,
+                 position_offset=0):
+        cfg = self.cfg
+        if attn_fn is None:
+            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True)
+        positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="tok")(tokens)
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
+                         name="pos")(positions)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x, attn_fn)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                        name="lm_head")(x)

@@ -31,3 +31,8 @@ from bluefog_tpu.ops.windows import (
     win_update_then_collect,
     win_sync,
 )
+from bluefog_tpu.ops.ring_attention import (
+    ring_attention,
+    all_to_all_attention,
+    local_attention,
+)

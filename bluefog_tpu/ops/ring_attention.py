@@ -1,0 +1,164 @@
+"""Long-context sequence/context parallelism: ring attention + all-to-all.
+
+The reference is a pre-LLM data-parallel library with no sequence dimension
+(SURVEY.md §5 "long-context": absent), but its core primitive — neighbor
+exchange along a ring with compute overlapped — is exactly the communication
+pattern of ring attention.  This module makes long context a first-class
+capability of the framework by reusing the gossip machinery's ppermute ring:
+
+- :func:`ring_attention` — blockwise attention with the KV blocks rotating
+  around the mesh axis (one ``lax.ppermute`` per step, riding the ICI ring),
+  combined with a numerically stable online softmax (flash-attention-style
+  running max / denominator).  Memory per device is O(T/n), enabling
+  sequences n× longer than single-device attention.
+- :func:`all_to_all_attention` — DeepSpeed-Ulysses-style sequence parallelism:
+  ``lax.all_to_all`` resharding sequence↔heads, full local attention, and the
+  inverse reshard.  Fewer collective steps than the ring (2 all-to-alls vs
+  n-1 permutes) but requires ``num_heads % axis_size == 0``.
+
+Both run inside ``shard_map`` with the sequence dimension sharded over
+``axis_name``; both are jit/grad compatible (the backward pass re-runs the
+rotation in reverse via XLA's transpose of ``ppermute``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "ring_attention",
+    "all_to_all_attention",
+    "local_attention",
+]
+
+_NEG_INF = -1e30  # large finite negative: avoids -inf NaN traps in exp
+
+
+def local_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
+                    q_offset=0, k_offset=0):
+    """Plain softmax attention on local blocks (also the Ulysses inner step).
+
+    Shapes: ``q (B, Tq, H, D)``, ``k/v (B, Tk, H, D)`` → ``(B, Tq, H, D)``.
+    ``q_offset``/``k_offset`` are the *global* positions of the first query /
+    key row, used for causal masking of shifted blocks (may be traced).
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Blockwise ring attention over a sequence-sharded mesh axis.
+
+    Each rank holds the blocks ``q/k/v: (B, T_local, H, D)`` of a global
+    sequence of length ``n * T_local`` laid out in rank order.  KV blocks
+    rotate around the ring; each arrival is folded into the running
+    (max, denominator, output) online-softmax state, so the result is exactly
+    full attention over the global sequence, returned sequence-sharded.
+
+    The rotation is the same single-shift circulant permutation the gossip
+    schedule produces for :class:`~bluefog_tpu.topology.RingGraph` — on TPU it
+    rides the ICI torus ring, and XLA overlaps the next block's ppermute with
+    the current block's attention math.
+
+    For ``causal=True``, block ``j``'s keys are masked against this rank's
+    global query positions; blocks strictly in the future contribute exp(-inf)
+    = 0.  (The diagonal block is processed first, so the running max is finite
+    from step 0.)
+    """
+    n = lax.axis_size(axis_name)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, t_q, h, _ = q.shape
+    t_k = k.shape[1]
+    r = lax.axis_index(axis_name)
+
+    m = jnp.full((b, h, t_q), _NEG_INF, jnp.float32)
+    denom = jnp.zeros((b, h, t_q), jnp.float32)
+    o = jnp.zeros((b, h, t_q, q.shape[-1]), jnp.float32)
+
+    shift = [(i, (i + 1) % n) for i in range(n)]
+    qpos = r * t_q + jnp.arange(t_q)
+
+    for s in range(n):
+        src = (r - s) % n  # rank whose KV block we currently hold
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            kpos = src * t_k + jnp.arange(t_k)
+            mask = qpos[:, None] >= kpos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        denom = denom * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        m = m_new
+        if s != n - 1:
+            k = lax.ppermute(k, axis_name, shift)
+            v = lax.ppermute(v, axis_name, shift)
+
+    out = o / jnp.maximum(denom[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def all_to_all_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Ulysses-style sequence parallelism: reshard seq→heads, attend, reshard
+    back.
+
+    Input ``(B, T_local, H, D)`` sequence-sharded; requires ``H % n == 0``.
+    Two ``lax.all_to_all`` collectives replace the ring's n-1 permutes —
+    cheaper at moderate sequence lengths, while :func:`ring_attention` wins
+    when T is huge or H < n.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"num_heads={h} not divisible by axis size {n}; "
+                         "use ring_attention for head counts below the mesh size")
+
+    def seq_to_heads(x):  # (B, T/n, H, D) -> (B, T, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):  # (B, T, H/n, D) -> (B, T/n, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = local_attention(qf, kf, vf, causal=causal, scale=scale)
+    return heads_to_seq(out)

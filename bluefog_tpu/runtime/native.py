@@ -65,17 +65,24 @@ def build(force: bool = False) -> Optional[str]:
                 "-Wall", "-o", tmp,
             ] + [os.path.join(_CSRC, s) for s in _SOURCES]
             try:
-                proc = subprocess.run(
-                    cmd, capture_output=True, text=True, timeout=120
-                )
-            except (OSError, subprocess.TimeoutExpired) as e:
-                log.warn("native runtime build failed to launch: %s", e)
-                return None
-            if proc.returncode != 0:
-                log.warn("native runtime build failed:\n%s", proc.stderr)
-                return None
-            os.replace(tmp, _LIB_PATH)
-            return _LIB_PATH
+                try:
+                    proc = subprocess.run(
+                        cmd, capture_output=True, text=True, timeout=120
+                    )
+                except (OSError, subprocess.TimeoutExpired) as e:
+                    log.warn("native runtime build failed to launch: %s", e)
+                    return None
+                if proc.returncode != 0:
+                    log.warn("native runtime build failed:\n%s", proc.stderr)
+                    return None
+                os.replace(tmp, _LIB_PATH)
+                return _LIB_PATH
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
         finally:
             if lock_file is not None:
                 lock_file.close()
@@ -115,23 +122,32 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+_load_lock = threading.Lock()
+
+
 def load() -> Optional[ctypes.CDLL]:
-    """Build-if-needed and dlopen the native runtime (None on failure)."""
+    """Build-if-needed and dlopen the native runtime (None on failure).
+
+    Serialized on a lock: concurrent first callers block until the (possibly
+    slow) g++ build finishes rather than observing a half-attempted state and
+    silently pinning themselves to the pure-Python fallbacks.
+    """
     global _lib, _lib_attempted
-    if _lib is not None or _lib_attempted:
+    with _load_lock:
+        if _lib is not None or _lib_attempted:
+            return _lib
+        if os.environ.get("BLUEFOG_TPU_NO_NATIVE"):
+            _lib_attempted = True
+            return None
+        path = build()
+        if path is not None:
+            try:
+                _lib = _bind(ctypes.CDLL(path))
+            except OSError as e:
+                log.warn("native runtime load failed: %s", e)
+                _lib = None
+        _lib_attempted = True
         return _lib
-    _lib_attempted = True
-    if os.environ.get("BLUEFOG_TPU_NO_NATIVE"):
-        return None
-    path = build()
-    if path is None:
-        return None
-    try:
-        _lib = _bind(ctypes.CDLL(path))
-    except OSError as e:
-        log.warn("native runtime load failed: %s", e)
-        _lib = None
-    return _lib
 
 
 class TimelineWriter:
@@ -209,8 +225,9 @@ class Engine:
                 return 1
 
         cb = _CALLBACK_T(trampoline)
-        # Registration must precede bf_enqueue: the engine thread may finish
-        # the op (and a racing synchronize() may clear it) immediately.
+        # enqueue + registration are atomic under _handles_lock: the handle
+        # cannot escape to a racing synchronize() (which pops _handles) until
+        # both have happened, and a failed enqueue registers nothing.
         with _handles_lock:
             self._lib.bf_engine_start()  # restartable after shutdown()
             handle = self._lib.bf_enqueue(op.encode(), name.encode(), cb, None)
@@ -302,8 +319,13 @@ class PyEngine:
 
     def enqueue(self, fn, *, op="host_op", name="") -> int:
         with self._cv:
+            # Restartable after shutdown(), matching the native engine's
+            # bf_engine_start-on-enqueue behavior.
             if self._stop:
-                raise RuntimeError("engine not running")
+                self._thread.join(timeout=5)
+                self._stop = False
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+                self._thread.start()
             handle = self._next
             self._next += 1
             self._results[handle] = None  # pending

@@ -214,3 +214,13 @@ def test_handles_valid_across_engine_instances(lib):
     h = a.enqueue(boom)
     with pytest.raises(ValueError, match="cross-instance"):
         b.synchronize(h, timeout_s=5)
+
+
+def test_py_engine_restarts_after_shutdown():
+    eng = native.PyEngine()
+    eng.shutdown()
+    out = []
+    h = eng.enqueue(lambda: out.append(1))  # auto-restarts, like native
+    eng.synchronize(h, timeout_s=5)
+    assert out == [1]
+    eng.shutdown()
