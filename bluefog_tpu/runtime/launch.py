@@ -1,24 +1,29 @@
-"""Multi-host launcher — the reference's ``bfrun`` re-thought for TPU.
+"""Multi-host launcher — the reference's ``bfrun``/``ibfrun`` re-thought for TPU.
 
 Reference parity (upstream-relative): ``bluefog/run/run.py`` builds and execs
-an ``mpirun -np N -H hosts ...`` command line (SURVEY.md §3.5).  On TPU pods
+an ``mpirun -np N -H hosts -x ENV ...`` command line, and ``ibfrun`` starts an
+interactive (Jupyter/ipyparallel) cluster (SURVEY.md §3.5, §2.2).  On TPU pods
 there is no mpirun: every host runs the same program and rendezvous happens in
 ``jax.distributed.initialize`` against the coordinator.  This module provides
 
 - :func:`initialize_cluster` — library-call bring-up (the ``bf.init()``-time
   process/network boundary of SURVEY.md §3.1);
-- a thin CLI (``bfrun-tpu``) that sets the coordinator env and execs the
-  training script on this host, for parity with ``bfrun`` muscle memory on
-  GCE/GKE-style deployments where each host runs the launcher.
+- ``bfrun-tpu`` — a thin CLI that prepares the environment (coordinator
+  address, env propagation à la ``mpirun -x``, timeline, **virtual-device
+  simulation** for laptop debugging) and execs the training script;
+- ``ibfrun-tpu`` (:func:`interactive_main`) — drops into a REPL with the
+  framework initialized, the ``ibfrun`` analog for poking at topologies and
+  collectives interactively.
 """
 
 from __future__ import annotations
 
 import argparse
+import code
 import os
 import runpy
 import sys
-from typing import Optional
+from typing import List, Optional
 
 from bluefog_tpu.utils import log
 
@@ -48,23 +53,104 @@ def initialize_cluster(
         log.warn("jax.distributed.initialize skipped: %s", e)
 
 
-def main(argv=None):
+def _apply_env(args) -> None:
+    """Common env preparation for both CLIs (before jax import)."""
+    for spec in args.env or []:
+        if "=" in spec:
+            key, val = spec.split("=", 1)
+            os.environ[key] = val
+        elif spec not in os.environ:
+            raise SystemExit(f"-x {spec}: not set in the launching environment")
+        # bare `-x NAME` propagates the current value — already in os.environ
+    if args.timeline:
+        os.environ["BLUEFOG_TPU_TIMELINE"] = args.timeline
+    if args.simulate:
+        # Virtual-device debug mesh (the analog of the reference's
+        # mpirun-on-localhost testing mode; SURVEY.md §4): N CPU devices in
+        # one process.  Env vars cover child processes; the jax.config
+        # updates override any platform a sitecustomize pinned at interpreter
+        # startup (before our flags existed).  Must run before the backend
+        # is first used.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.simulate}".strip()
+        )
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable TPU tunnel plugins
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.simulate)
+
+
+def _add_common_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--coordinator", default=None, help="host:port of process 0")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument(
+        "-x", dest="env", action="append", metavar="NAME[=VALUE]",
+        help="propagate/set an environment variable (mpirun -x parity)")
+    ap.add_argument(
+        "--timeline", default=None, metavar="FILE",
+        help="write a chrome-trace timeline (BLUEFOG_TPU_TIMELINE)")
+    ap.add_argument(
+        "--simulate", type=int, default=None, metavar="N",
+        help="debug on N virtual CPU devices instead of TPU hardware")
+
+
+def main(argv: Optional[List[str]] = None):
     ap = argparse.ArgumentParser(
         prog="bfrun-tpu",
         description="Launch a bluefog_tpu training script (bfrun analog; "
         "run once per host on multi-host pods)",
     )
-    ap.add_argument("--coordinator", default=None, help="host:port of process 0")
-    ap.add_argument("--num-processes", type=int, default=None)
-    ap.add_argument("--process-id", type=int, default=None)
+    _add_common_args(ap)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
+    _apply_env(args)
     initialize_cluster(args.coordinator, args.num_processes, args.process_id)
     sys.argv = [args.script] + list(args.script_args)
     os.environ.setdefault("BLUEFOG_TPU_LAUNCHED", "1")
     runpy.run_path(args.script, run_name="__main__")
+
+
+def interactive_main(argv: Optional[List[str]] = None):
+    """``ibfrun-tpu``: REPL with the framework brought up (ibfrun analog)."""
+    ap = argparse.ArgumentParser(
+        prog="ibfrun-tpu",
+        description="Interactive bluefog_tpu session (ibfrun analog)",
+    )
+    _add_common_args(ap)
+    ap.add_argument("--topology", default="exp2",
+                    choices=["exp2", "ring", "grid", "star", "full"],
+                    help="initial virtual topology")
+    args = ap.parse_args(argv)
+
+    _apply_env(args)
+    initialize_cluster(args.coordinator, args.num_processes, args.process_id)
+
+    import jax
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology as topo_lib
+
+    n = len(jax.devices())
+    builders = {
+        "exp2": topo_lib.ExponentialTwoGraph,
+        "ring": topo_lib.RingGraph,
+        "grid": topo_lib.MeshGrid2DGraph,
+        "star": topo_lib.StarGraph,
+        "full": topo_lib.FullyConnectedGraph,
+    }
+    ctx = bf.init(topology=builders[args.topology](n)) if n > 1 else bf.init()
+    banner = (
+        f"bluefog_tpu interactive — {n} device(s), rank axis "
+        f"'{ctx.axis_name}', topology={args.topology}\n"
+        "Bound names: bf (the framework), jax, ctx (active context)."
+    )
+    code.interact(banner=banner, local={"bf": bf, "jax": jax, "ctx": ctx})
 
 
 if __name__ == "__main__":

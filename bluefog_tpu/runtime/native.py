@@ -299,12 +299,16 @@ class PyEngine:
         self._cv = threading.Condition()
         self._next = 0
         self._stop = False
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._q,), daemon=True)
         self._thread.start()
 
-    def _loop(self):
+    def _loop(self, q):
+        # Consumes its own queue (passed in, not read off self): a restart
+        # swaps in a fresh queue, so a stale shutdown sentinel can only ever
+        # stop the old thread it was meant for.
         while True:
-            item = self._q.get()
+            item = q.get()
             if item is None:
                 return
             handle, fn = item
@@ -322,14 +326,16 @@ class PyEngine:
             # Restartable after shutdown(), matching the native engine's
             # bf_engine_start-on-enqueue behavior.
             if self._stop:
-                self._thread.join(timeout=5)
                 self._stop = False
-                self._thread = threading.Thread(target=self._loop, daemon=True)
+                self._q = _queue.Queue()
+                self._thread = threading.Thread(
+                    target=self._loop, args=(self._q,), daemon=True)
                 self._thread.start()
             handle = self._next
             self._next += 1
             self._results[handle] = None  # pending
-        self._q.put((handle, fn))
+            q = self._q
+        q.put((handle, fn))
         return handle
 
     def poll(self, handle: int) -> bool:
@@ -371,9 +377,12 @@ class PyEngine:
 
     def shutdown(self):
         with self._cv:
+            if self._stop:
+                return  # idempotent: never post a second sentinel
             self._stop = True
-        self._q.put(None)
-        self._thread.join(timeout=5)
+            q, t = self._q, self._thread
+        q.put(None)
+        t.join(timeout=5)
 
 
 _engine_lock = threading.Lock()

@@ -15,3 +15,4 @@ from bluefog_tpu.utils.timeline import (
     timeline_end_activity,
     timeline_context,
 )
+from bluefog_tpu.utils.checkpoint import CheckpointManager, run_with_restart

@@ -94,17 +94,24 @@ class Timeline:
             events = list(self._events)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+            # Bare trace-event array — the same wire format the native
+            # writer (csrc/timeline.cc) emits, so consumers see one format.
+            json.dump(events, f)
         os.replace(tmp, self.path)
 
     def close(self):
+        # Idempotent: close() runs both explicitly (timeline_stop) and from
+        # atexit; the second call must not fall through to the pure-Python
+        # flush and truncate the file the native writer already finalized.
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         if self._native is not None:
             self._native.close()
             self._native = None
             return
-        if not self._stop.is_set():
-            self._stop.set()
-            self.flush()
+        self._stop.set()
+        self.flush()
 
 
 def _try_native(path: str):

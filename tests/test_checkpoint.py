@@ -1,0 +1,147 @@
+"""Checkpoint/resume: per-rank + consensus modes, async IO, restart loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluefog_tpu.utils.checkpoint import CheckpointManager, run_with_restart
+
+
+def _state(scale=1.0):
+    # rank-stacked (leading axis 4 = ranks), divergent per rank
+    return {
+        "params": {"w": jnp.arange(4 * 3, dtype=jnp.float32).reshape(4, 3) * scale,
+                   "b": jnp.ones((4, 2), jnp.bfloat16) * scale},
+        "step": jnp.asarray([0, 0, 0, 0]),
+    }
+
+
+def test_save_restore_per_rank_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    state = _state()
+    mgr.save(0, state)
+    got = mgr.restore(template=state)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                                   np.asarray(b, np.float32)),
+        got, state)
+    assert got["params"]["b"].dtype == jnp.bfloat16  # dtype preserved
+    mgr.close()
+
+
+def test_async_save_overlaps_and_joins(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))  # joins save 1 first
+    assert mgr.latest_step() == 2
+    got = mgr.restore(2, template=_state())
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]),
+                               np.asarray(_state(2.0)["params"]["w"]))
+    mgr.close()
+
+
+def test_consensus_mode_averages_ranks(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    state = {"w": jnp.stack([jnp.full((2,), float(r)) for r in range(4)])}
+    mgr.save(0, state, mode="consensus")
+    got = mgr.restore(0)
+    np.testing.assert_allclose(np.asarray(got["w"]), [1.5, 1.5])
+
+
+def test_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, async_save=False)
+    for s in range(5):
+        mgr.save(s, _state(float(s)))
+    assert mgr.all_steps() == [3, 4]
+    mgr.close()
+
+
+def test_restore_missing_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+
+
+def test_bad_mode_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    with pytest.raises(ValueError, match="mode"):
+        mgr.save(0, _state(), mode="???")
+
+
+def test_run_with_restart_recovers_and_resumes(tmp_path):
+    """Crash mid-training → restore latest → resume at the right step."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    crashes = {"left": 1}
+    seen_starts = []
+
+    def train(state, start):
+        seen_starts.append(start)
+        w = state["w"]
+        for step in range(start, 10):
+            w = w + 1.0
+            mgr.save(step, {"w": w})
+            if step == 4 and crashes["left"] > 0:
+                crashes["left"] -= 1
+                raise RuntimeError("simulated slice failure")
+        return {"w": w}
+
+    out = run_with_restart(train, mgr, {"w": jnp.zeros((4, 2))},
+                           max_restarts=3)
+    # 10 increments total regardless of the crash
+    np.testing.assert_allclose(np.asarray(out["w"]), 10.0)
+    assert seen_starts == [0, 5]  # resumed right after the last saved step
+
+
+def test_run_with_restart_gives_up(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+
+    def always_fail(state, start):
+        raise RuntimeError("permanent failure")
+
+    with pytest.raises(RuntimeError, match="permanent failure"):
+        run_with_restart(always_fail, mgr, {"w": jnp.zeros((2,))},
+                         max_restarts=2)
+
+
+def test_async_save_error_surfaces_at_wait(tmp_path, monkeypatch):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+
+    def broken_save(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(mgr._mgr, "save", broken_save)
+    mgr.save(0, _state())
+    with pytest.raises(OSError, match="disk full"):
+        mgr.wait()
+
+
+def test_consensus_mode_preserves_integer_leaves(tmp_path):
+    """Int/bool leaves (step counters, PRNG keys) must not be averaged."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    state = {
+        "w": jnp.stack([jnp.full((2,), float(r)) for r in range(4)]),
+        "step": jnp.asarray([7, 7, 7, 7], jnp.int32),
+        "key": jnp.tile(jnp.asarray([[123, 456]], jnp.uint32), (4, 1)),
+    }
+    mgr.save(0, state, mode="consensus")
+    got = mgr.restore(0)
+    np.testing.assert_allclose(np.asarray(got["w"]), [1.5, 1.5])
+    assert np.asarray(got["step"]) == 7 and got["step"].dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(got["key"]), [123, 456])
+
+
+def test_restart_counts_recovery_failures(tmp_path):
+    """A failed async save surfacing during recovery must count against
+    max_restarts instead of escaping the loop uncounted."""
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+
+    def train(state, start):
+        mgr.save(0, {"w": jnp.zeros((2,))})
+        mgr.wait()
+        mgr._pending_handle = __import__("bluefog_tpu.runtime", fromlist=["engine"]).engine().enqueue(
+            lambda: (_ for _ in ()).throw(OSError("flaky nfs")))
+        raise RuntimeError("crash after kicking off a doomed save")
+
+    with pytest.raises((OSError, RuntimeError)):
+        run_with_restart(train, mgr, {"w": jnp.zeros((2,))}, max_restarts=1)

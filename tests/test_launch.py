@@ -1,0 +1,72 @@
+"""Launcher CLI (bfrun-tpu analog): simulate mode, env propagation, timeline."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(cli_args, *, env_extra=None, timeout=180):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.runtime.launch"] + cli_args,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def test_simulate_gives_virtual_devices(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import jax\n"
+        "assert jax.devices()[0].platform == 'cpu', jax.devices()\n"
+        "assert len(jax.devices()) == 8, jax.devices()\n"
+        "print('DEVICES', len(jax.devices()))\n"
+    )
+    r = _run_cli(["--simulate", "8", str(script)])
+    assert r.returncode == 0, r.stderr
+    assert "DEVICES 8" in r.stdout
+
+
+def test_env_propagation_and_script_args(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, sys\n"
+        "print('VAR', os.environ['BF_TEST_VAR'])\n"
+        "print('ARGS', sys.argv[1:])\n"
+    )
+    r = _run_cli(["-x", "BF_TEST_VAR=hello", "--num-processes", "1",
+                  str(script), "--lr", "0.1"])
+    assert r.returncode == 0, r.stderr
+    assert "VAR hello" in r.stdout
+    assert "ARGS ['--lr', '0.1']" in r.stdout
+
+
+def test_bare_env_flag_requires_existing_var(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text("print('ran')\n")
+    r = _run_cli(["-x", "BF_DEFINITELY_UNSET_VAR", str(script)])
+    assert r.returncode != 0
+    assert "not set" in (r.stderr + r.stdout)
+
+
+def test_timeline_flag_writes_trace(tmp_path):
+    script = tmp_path / "probe.py"
+    trace = tmp_path / "trace.json"
+    script.write_text(
+        "from bluefog_tpu.utils import timeline\n"
+        "with timeline.timeline_context('launcher_span'):\n"
+        "    pass\n"
+        "timeline.timeline_stop()\n"
+    )
+    r = _run_cli(["--simulate", "2", "--timeline", str(trace), str(script)])
+    assert r.returncode == 0, r.stderr
+    events = json.loads(trace.read_text())
+    assert any(e["name"] == "launcher_span" for e in events)

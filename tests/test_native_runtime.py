@@ -224,3 +224,15 @@ def test_py_engine_restarts_after_shutdown():
     eng.synchronize(h, timeout_s=5)
     assert out == [1]
     eng.shutdown()
+
+
+def test_py_engine_double_shutdown_then_enqueue():
+    """A stale shutdown sentinel must not kill the restarted worker."""
+    eng = native.PyEngine()
+    eng.shutdown()
+    eng.shutdown()  # idempotent: no second sentinel
+    out = []
+    h = eng.enqueue(lambda: out.append(1))
+    eng.synchronize(h, timeout_s=5)
+    assert out == [1]
+    eng.shutdown()
