@@ -70,6 +70,7 @@ from bluefog_tpu.parallel.api import (
     win_accumulate,
     win_update,
     win_update_then_collect,
+    win_mutex,
     broadcast_parameters,
     allreduce_parameters,
     broadcast_optimizer_state,

@@ -1,0 +1,152 @@
+"""Mixture-of-experts transformer LM — the expert-parallel flagship variant.
+
+No counterpart in the reference (SURVEY.md §2.3: EP absent).  Pairs
+:mod:`bluefog_tpu.ops.moe` (Switch routing + all_to_all expert parallelism)
+with the :class:`~bluefog_tpu.models.transformer.TransformerLM` skeleton:
+every block's MLP is replaced by a Switch-MoE FFN whose experts are sharded
+over the ``'ep'`` mesh axis, with tokens batch-sharded over the same axis.
+
+Loss convention for training inside ``shard_map``: normalize by the GLOBAL
+token count (see ops/moe.py docstring) so raw ``jax.grad`` is exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bluefog_tpu.models.transformer import GPTConfig
+from bluefog_tpu.ops.moe import expert_parallel_ffn, moe_ffn_reference
+from bluefog_tpu.ops.ring_attention import local_attention
+
+__all__ = ["MoEConfig", "MoEMLP", "MoEBlock", "MoETransformerLM"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Switch-MoE hyperparameters on top of a :class:`GPTConfig`."""
+
+    gpt: GPTConfig
+    num_experts: int = 8
+    ep_size: int = 1
+    ep_axis: str = "ep"
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    @staticmethod
+    def tiny(ep_size: int = 1) -> "MoEConfig":
+        return MoEConfig(gpt=GPTConfig.tiny(), num_experts=4,
+                         ep_size=ep_size, capacity_factor=2.0)
+
+    def capacity(self, tokens_per_shard: int) -> int:
+        c = int(self.capacity_factor * tokens_per_shard / self.num_experts)
+        return max(c, 1)
+
+
+def _expert_init(base_init, ep_axis: Optional[str]):
+    """Fold the ep position into the RNG so each shard's experts draw
+    independent values (mirrors parallel.tensor._sharded_init)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        if ep_axis is not None:
+            key = jax.random.fold_in(key, lax.axis_index(ep_axis))
+        return base_init(key, shape, dtype)
+
+    return init
+
+
+class MoEMLP(nn.Module):
+    """Switch-MoE FFN; expert weights sharded over ``cfg.ep_axis`` when
+    ``cfg.ep_size > 1`` (params hold only the local experts), dense reference
+    path when ``ep_size == 1``."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gpt = cfg.gpt
+        if cfg.num_experts % cfg.ep_size:
+            raise ValueError(
+                f"experts {cfg.num_experts} % ep {cfg.ep_size}")
+        local_e = cfg.num_experts // cfg.ep_size
+        hidden = gpt.mlp_ratio * gpt.hidden_size
+        fold = cfg.ep_axis if cfg.ep_size > 1 else None
+
+        router = self.param("router", nn.initializers.lecun_normal(),
+                            (gpt.hidden_size, cfg.num_experts), jnp.float32)
+        wi = self.param(
+            "wi", _expert_init(
+                nn.initializers.lecun_normal(in_axis=1, out_axis=2), fold),
+            (local_e, gpt.hidden_size, hidden), jnp.float32)
+        wo = self.param(
+            "wo", _expert_init(
+                nn.initializers.lecun_normal(in_axis=1, out_axis=2), fold),
+            (local_e, hidden, gpt.hidden_size), jnp.float32)
+
+        B, T, D = x.shape
+        flat = x.reshape(B * T, D)
+        cap = cfg.capacity(B * T)
+        if cfg.ep_size == 1:
+            y, aux = moe_ffn_reference(
+                flat, router, wi.astype(gpt.dtype), wo.astype(gpt.dtype),
+                num_experts=cfg.num_experts, capacity=cap)
+        else:
+            y, aux = expert_parallel_ffn(
+                flat, router, wi.astype(gpt.dtype), wo.astype(gpt.dtype),
+                ep_axis=cfg.ep_axis, num_experts=cfg.num_experts,
+                capacity=cap)
+        self.sow("aux_loss", "moe", aux)
+        return y.reshape(B, T, D)
+
+
+class MoEBlock(nn.Module):
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, attn_fn):
+        gpt = self.cfg.gpt
+        head_dim = gpt.hidden_size // gpt.num_heads
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(gpt.dtype)
+        qkv = nn.Dense(3 * gpt.hidden_size, dtype=gpt.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (gpt.num_heads, head_dim))
+
+        a = attn_fn(heads(q), heads(k), heads(v))
+        a = a.reshape(a.shape[:-2] + (gpt.hidden_size,))
+        x = x + nn.Dense(gpt.hidden_size, dtype=gpt.dtype, name="proj")(a)
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(gpt.dtype)
+        return x + MoEMLP(self.cfg, name="moe")(y)
+
+
+class MoETransformerLM(nn.Module):
+    """Switch-MoE decoder LM.  Inside ``shard_map`` over an ``'ep'`` axis,
+    pass the per-shard token batch; collect the aux loss via
+    ``mutable=["aux_loss"]`` and add ``cfg.aux_loss_weight * sum``."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, attn_fn=None, position_offset=0):
+        cfg = self.cfg
+        gpt = cfg.gpt
+        if attn_fn is None:
+            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True)
+        positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
+        x = nn.Embed(gpt.vocab_size, gpt.hidden_size, dtype=gpt.dtype,
+                     name="tok")(tokens)
+        x = x + nn.Embed(gpt.max_position, gpt.hidden_size, dtype=gpt.dtype,
+                         name="pos")(positions)
+        for i in range(gpt.num_layers):
+            x = MoEBlock(cfg, name=f"block_{i}")(x, attn_fn)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(gpt.vocab_size, dtype=jnp.float32, use_bias=False,
+                        name="lm_head")(x)

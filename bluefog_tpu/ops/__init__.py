@@ -36,3 +36,8 @@ from bluefog_tpu.ops.ring_attention import (
     all_to_all_attention,
     local_attention,
 )
+from bluefog_tpu.ops.moe import (
+    switch_router,
+    expert_parallel_ffn,
+    moe_ffn_reference,
+)

@@ -1,0 +1,139 @@
+"""Mixture-of-experts routing + expert parallelism (GShard/Switch style).
+
+No counterpart exists in the reference (SURVEY.md §2.3: EP absent — Bluefog
+predates MoE).  The TPU build adds it as the fourth parallelism axis: experts
+are sharded over an ``'ep'`` mesh axis and tokens reach their expert via a
+pair of ``lax.all_to_all`` hops — the canonical TPU MoE dataflow (dense
+einsum dispatch, static capacity, no dynamic shapes, everything MXU-tiled).
+
+Pieces:
+
+- :func:`switch_router` — top-1 (Switch) routing with a static per-shard
+  capacity: returns dense dispatch/combine tensors.
+- :func:`expert_parallel_ffn` — dispatch → all_to_all → local expert FFNs →
+  reverse all_to_all → combine, inside ``shard_map``.
+
+Gradient convention: normalize the per-rank loss by the GLOBAL token count
+(``local_sum / total_tokens``) and raw ``jax.grad`` inside ``shard_map`` is
+exact for both expert (ep-sharded) and replicated parameters — the seeds of
+the per-rank losses then sum to the true global objective, and the
+``all_to_all`` transposes route cotangents back without scaling (verified in
+tests/test_moe.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "switch_router",
+    "expert_parallel_ffn",
+    "moe_ffn_reference",
+]
+
+
+def switch_router(x, router_kernel, *, num_experts: int, capacity: int,
+                  noise_rng=None, noise_scale: float = 0.0):
+    """Top-1 routing with static capacity.
+
+    Args:
+      x: ``(T, D)`` tokens (local shard).
+      router_kernel: ``(D, E)`` router weights (replicated).
+      capacity: max tokens per expert **per shard**; overflow tokens are
+        dropped (their combine weights are zero — the residual connection
+        carries them, as in Switch).
+      noise_rng/noise_scale: optional jitter for load-balancing exploration.
+
+    Returns:
+      ``(dispatch, combine, aux)`` — dispatch ``(T, E, C)`` one-hot float,
+      combine ``(T, E, C)`` = dispatch * router prob, and ``aux`` the Switch
+      load-balancing loss (scalar, local shard).
+    """
+    T = x.shape[0]
+    logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    if noise_rng is not None and noise_scale > 0:
+        logits = logits + noise_scale * jax.random.normal(noise_rng,
+                                                          logits.shape)
+    probs = jax.nn.softmax(logits, axis=-1)               # (T, E)
+    expert = jnp.argmax(probs, axis=-1)                   # (T,)
+    onehot = jax.nn.one_hot(expert, num_experts)          # (T, E)
+
+    # position of each token within its expert's queue (0-indexed)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot    # (T, E)
+    keep = (pos < capacity) * onehot                      # (T, E)
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity)                  # (T, E, C)
+    gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)      # (T, 1)
+    combine = dispatch * gate[..., None]
+
+    # Switch aux loss: E * sum_e fraction_tokens_e * mean_prob_e
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def _local_ffn(expert_inputs, wi, wo):
+    """(El, S, D) x (El, D, H) x (El, H, D) -> (El, S, D)."""
+    h = jnp.einsum("esd,edh->esh", expert_inputs, wi)
+    h = jax.nn.gelu(h)
+    return jnp.einsum("esh,ehd->esd", h, wo)
+
+
+def expert_parallel_ffn(x, router_kernel, wi_local, wo_local, *,
+                        ep_axis: str = "ep", num_experts: int,
+                        capacity: int, noise_rng=None,
+                        noise_scale: float = 0.0):
+    """Switch-MoE FFN with experts sharded over ``ep_axis``; call inside
+    ``shard_map`` with tokens batch-sharded over the same axis.
+
+    Args:
+      x: ``(T_local, D)`` this shard's tokens.
+      wi_local / wo_local: ``(E // ep, D, H)`` / ``(E // ep, H, D)`` — this
+        shard's experts.
+
+    Returns:
+      ``(y, aux)``: ``(T_local, D)`` expert outputs (zero for dropped
+      tokens — add the residual outside) and the local aux loss.
+    """
+    ep = lax.psum(1, ep_axis)
+    local_e = wi_local.shape[0]
+    dispatch, combine, aux = switch_router(
+        x, router_kernel, num_experts=num_experts, capacity=capacity,
+        noise_rng=noise_rng, noise_scale=noise_scale)
+
+    # (T, E, C) x (T, D) -> (E, C, D): expert-major send buffer.  Global
+    # expert e = s * (E//ep) + j lives on ep-shard s.
+    sends = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    sends = sends.reshape((ep, local_e) + sends.shape[1:])     # (ep, El, C, D)
+    # all_to_all(split 0, concat 0): chunk s goes to shard s; afterwards
+    # axis 0 indexes the SOURCE shard (verified semantics — tests/test_moe.py)
+    recvd = lax.all_to_all(sends, ep_axis, split_axis=0, concat_axis=0)
+    inputs = recvd.transpose(1, 0, 2, 3).reshape(
+        local_e, ep * capacity, x.shape[-1])                   # (El, ep*C, D)
+
+    outputs = _local_ffn(inputs, wi_local, wo_local)           # (El, ep*C, D)
+
+    # reverse route: chunk s of the capacity axis belongs to source shard s
+    outputs = outputs.reshape(local_e, ep, capacity, x.shape[-1])
+    outputs = outputs.transpose(1, 0, 2, 3)                    # (ep, El, C, D)
+    back = lax.all_to_all(outputs, ep_axis, split_axis=0, concat_axis=0)
+    expert_outputs = back.reshape(num_experts, capacity, x.shape[-1])
+
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_outputs)
+    return y, aux
+
+
+def moe_ffn_reference(x, router_kernel, wi, wo, *, num_experts: int,
+                      capacity: int):
+    """Unsharded reference: all experts local (for tests and 1-chip runs)."""
+    dispatch, combine, aux = switch_router(
+        x, router_kernel, num_experts=num_experts, capacity=capacity)
+    inputs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    outputs = _local_ffn(inputs, wi, wo)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), outputs)
+    return y, aux

@@ -13,3 +13,4 @@ module-level functions, re-expressed for SPMD: tensors carry a leading
 
 from bluefog_tpu.parallel.context import BluefogContext, get_context, init, shutdown
 from bluefog_tpu.parallel import api
+from bluefog_tpu.parallel import tensor

@@ -20,7 +20,9 @@ with compute is handled by the XLA scheduler rather than a background thread.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -57,6 +59,7 @@ __all__ = [
     "win_accumulate",
     "win_update",
     "win_update_then_collect",
+    "win_mutex",
     "broadcast_parameters",
     "allreduce_parameters",
     "broadcast_optimizer_state",
@@ -380,6 +383,32 @@ def win_update_then_collect(name: str):
     out, new_state = f(state)
     ctx.windows[name] = new_state
     return out
+
+
+_win_mutexes: Dict[str, threading.RLock] = {}
+_win_mutexes_guard = threading.Lock()
+
+
+@contextlib.contextmanager
+def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None):
+    """Mutual exclusion over window ``name`` (reference ``bf.win_mutex``,
+    an MPI passive-target ``MPI_Win_lock_all`` epoch guarding concurrent
+    one-sided access — ``bluefog/torch/mpi_win_ops.cc``).
+
+    In the SPMD model, one-sided transfers inside a jitted step are ordered by
+    data dependencies, so no device-side lock exists or is needed.  What *can*
+    race is the host-side window registry when background host ops
+    (:func:`enqueue_host_op`) and the main thread both mutate the same named
+    window; this context manager serializes those, which is the exact hazard
+    the reference's mutex exists for.  ``for_self``/``ranks`` are accepted for
+    call-site compatibility; the lock is per-window-name rather than per-rank
+    (all ranks live in one process here).
+    """
+    del for_self, ranks  # rank-granular locking is meaningless in-process
+    with _win_mutexes_guard:
+        lock = _win_mutexes.setdefault(name, threading.RLock())
+    with lock:
+        yield
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,111 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a mesh axis.
+
+No counterpart exists in the reference (SURVEY.md §2.3: PP absent).  The TPU
+build adds it as the third mesh level: ``('bf', 'pp', 'tp')`` — gossip-DP
+outermost, pipeline stages in the middle, tensor parallel innermost.
+
+Design (TPU-first, the scaling-book shard_map pipelining recipe):
+
+- Each ``pp`` rank holds the parameters of its contiguous block of layers
+  (``stack_stage_params`` shards a per-layer-stacked tree over the axis).
+- One jitted ``lax.scan`` runs ``num_micro + pp - 1`` ticks; each tick every
+  stage applies its block to the activation it holds and hands the result to
+  the next stage with a single ``lax.ppermute`` hop (nearest-neighbor on
+  ICI).  No data-dependent control flow — stage 0's input injection and the
+  last stage's output collection are ``jnp.where`` selects on the tick index,
+  so XLA compiles one static program.
+- Backward is plain ``jax.grad`` through the scan: ``ppermute`` transposes to
+  the reverse permute, giving the mirrored backward pipeline for free — no
+  hand-written 1F1B schedule.  Combine with ``jax.checkpoint`` on ``stage_fn``
+  to keep activation memory at GPipe levels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "stack_stage_params",
+    "pipeline_apply",
+    "pipeline_spmd_axis_perm",
+]
+
+
+def stack_stage_params(per_layer_params, num_stages: int):
+    """Regroup a tree of per-layer-stacked arrays (leading dim ``L``) into
+    per-stage blocks (leading dim ``num_stages``, second dim ``L //
+    num_stages``), ready to shard over the ``pp`` axis with
+    ``P('pp', ...)``."""
+
+    def regroup(leaf):
+        L = leaf.shape[0]
+        if L % num_stages:
+            raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+        return leaf.reshape((num_stages, L // num_stages) + leaf.shape[1:])
+
+    return jax.tree_util.tree_map(regroup, per_layer_params)
+
+
+def pipeline_spmd_axis_perm(num_stages: int):
+    """The stage-to-next-stage edge list for ``lax.ppermute`` (linear, not a
+    ring: the last stage's output falls off the end by design)."""
+    return [(i, i + 1) for i in range(num_stages - 1)]
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    *,
+    pp_axis: str = "pp",
+    num_stages: int,
+    num_micro: Optional[int] = None,
+):
+    """Run microbatches through the pipeline; call inside ``shard_map``.
+
+    Args:
+      stage_fn: ``(stage_params_local, activation) -> activation`` — this
+        rank's block of layers.  Activation shape must be preserved (as in
+        GPipe); wrap embed/head layers outside the pipeline or fold them into
+        stage 0 / stage -1 via ``lax.cond``-free selects.
+      stage_params: this rank's slice of :func:`stack_stage_params` output
+        (leading dim ``L // num_stages``).
+      microbatches: ``(num_micro, micro_batch, ...)`` — the full input,
+        present (replicated or sharded upstream) on every stage; only stage
+        0 reads it.
+      num_stages: static size of the ``pp`` axis.
+      num_micro: defaults to ``microbatches.shape[0]``.
+
+    Returns:
+      ``(num_micro, micro_batch, ...)`` outputs, valid on the **last** stage
+      (other stages hold garbage of the right shape — psum/collect it out
+      yourself, or use the loss pattern in tests/test_pipeline.py).
+    """
+    if num_micro is None:
+        num_micro = microbatches.shape[0]
+    stage = lax.axis_index(pp_axis)
+    total_ticks = num_micro + num_stages - 1
+    perm = pipeline_spmd_axis_perm(num_stages)
+
+    act0 = jnp.zeros_like(microbatches[0])
+
+    def tick(carry, t):
+        incoming = carry
+        # stage 0 injects microbatch t (clamped: reads garbage past the end,
+        # discarded by the output select below)
+        inject = microbatches[jnp.minimum(t, num_micro - 1)]
+        x = jnp.where(stage == 0, inject.astype(act0.dtype), incoming)
+        y = stage_fn(stage_params, x)
+        # hand to the next stage; stage 0 receives zeros (overwritten above)
+        nxt = lax.ppermute(y, pp_axis, perm)
+        return nxt, y
+
+    _, ys = lax.scan(tick, act0, jnp.arange(total_ticks))
+
+    # last stage emitted microbatch m at tick m + num_stages - 1
+    out = lax.dynamic_slice_in_dim(ys, num_stages - 1, num_micro, axis=0)
+    return out

@@ -1,0 +1,416 @@
+"""Tensor (model) parallelism — a second mesh level under the gossip axis.
+
+No counterpart exists in the reference (SURVEY.md §2.3: TP absent — Bluefog is
+a pure data-parallel library).  The TPU build adds it because decentralized
+DP composes naturally with intra-rank model sharding on a 2-level mesh: the
+outer ``'bf'`` axis carries gossip (``neighbor_allreduce`` / window ops over
+ICI ring hops), the inner ``'tp'`` axis shards each rank's model Megatron-
+style (column-parallel then row-parallel matmuls, heads sharded for
+attention).  Sequence parallelism (``bluefog_tpu.ops.ring_attention``) rides a
+third axis the same way.
+
+Design notes (TPU-first):
+
+- Everything runs inside one ``shard_map`` over the hybrid mesh, so XLA
+  schedules the tp-axis ``psum`` (ICI nearest-neighbor ring, innermost mesh
+  axis = closest chips) together with the gossip permutes.
+- Parameters are flax ``nn.Partitioned`` boxes (``manual_partitioning``);
+  the axis names double as the source of truth for the gradient correction
+  (below) and for ``gather_tp_params`` at checkpoint/eval time.
+- **Gradient correction**: the repo's train steps call ``jax.grad`` *inside*
+  ``shard_map`` (per-rank losses — required for decentralized DP, where ranks
+  hold different parameters).  In that regime XLA transposes the row-parallel
+  forward ``psum`` into a backward ``psum``, so w.r.t. a tp-sharded leaf the
+  raw gradient is ``tp_size ×`` the true one, while a replicated leaf's raw
+  gradient sees only the local shard's path.  The exact fix (verified
+  numerically in tests/test_tensor_parallel.py) is::
+
+      sharded leaf:    g / tp_size
+      replicated leaf: pmean(g, tp_axis)
+
+  which :func:`tp_value_and_grad` applies automatically from the partitioning
+  metadata.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.core import meta as flax_meta
+from jax import lax
+
+from bluefog_tpu.models.transformer import GPTConfig
+from bluefog_tpu.ops.ring_attention import local_attention
+from bluefog_tpu.topology.mapping import ici_ring_order
+
+__all__ = [
+    "make_hybrid_mesh",
+    "fold_axis_rng",
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "ColumnParallelDense",
+    "RowParallelDense",
+    "TPBlock",
+    "TPTransformerLM",
+    "tp_value_and_grad",
+    "tp_correct_grads",
+    "gather_tp_params",
+    "unbox_params",
+]
+
+
+def make_hybrid_mesh(axes: Mapping[str, int], *, devices=None,
+                     use_ici_order: bool = True):
+    """Build a multi-axis ``jax.sharding.Mesh`` from ``{name: size}`` pairs.
+
+    Axis order is the dict's insertion order, **outermost first** — put the
+    gossip axis (``'bf'``) first and the tensor axis (``'tp'``) last so tp
+    collectives land on nearest-neighbor ICI links (the device list is
+    snake-ordered along ICI, and the innermost mesh axis gets consecutive
+    devices).
+
+    Example::
+
+        mesh = make_hybrid_mesh({"bf": 4, "tp": 2})
+        # 4 gossip ranks x 2-way tensor parallel over 8 chips
+    """
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if use_ici_order:
+        devices = ici_ring_order(devices)
+    names = tuple(axes.keys())
+    sizes = tuple(int(axes[n]) for n in names)
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(f"mesh {dict(axes)} needs {need} devices, "
+                         f"have {len(devices)}")
+    return Mesh(np.array(devices[:need]).reshape(sizes), names)
+
+
+def fold_axis_rng(key, *axis_names: str):
+    """Per-shard RNG: fold each mesh position in so shards initialize
+    differently (inside ``shard_map`` all ranks see the same base key)."""
+    for ax in axis_names:
+        key = jax.random.fold_in(key, lax.axis_index(ax))
+    return key
+
+
+def _tp_size(tp_axis: str):
+    return lax.psum(1, tp_axis)
+
+
+# ---------------------------------------------------------------------------
+# Functional primitives (use inside shard_map; arrays are local shards)
+# ---------------------------------------------------------------------------
+
+
+def column_parallel_dense(x, kernel, bias=None, *, tp_axis: str = "tp",
+                          gather_output: bool = False):
+    """``y_local = x @ kernel_local`` with the **output** feature dim sharded.
+
+    No forward collective; the backward pass psums the input gradient.  With
+    ``gather_output`` the shards are all-gathered onto the last dim (use only
+    at boundaries — the point of Megatron pairing is to stay sharded until
+    the matching row-parallel layer).
+    """
+    y = x @ kernel
+    if bias is not None:
+        y = y + bias
+    if gather_output:
+        y = lax.all_gather(y, tp_axis, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel_dense(x, kernel, bias=None, *, tp_axis: str = "tp"):
+    """``y = psum_tp(x_local @ kernel_local)`` with the **input** feature dim
+    sharded (x is the sharded output of a column-parallel layer).  Bias is
+    added once, after the reduction."""
+    y = lax.psum(x @ kernel, tp_axis)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Flax modules
+# ---------------------------------------------------------------------------
+
+
+class ManualPartitioned(flax_meta.Partitioned):
+    """``nn.Partitioned`` whose unbox skips the sharding constraint.
+
+    Under a Manual (``shard_map``) mesh the arrays *are* the local shards —
+    ``with_sharding_constraint`` is both illegal and meaningless there, but
+    stock ``Partitioned.unbox`` inserts one whenever a global/abstract mesh
+    is defined.  The ``names`` metadata is kept purely as the source of truth
+    for :func:`tp_correct_grads` / :func:`gather_tp_params`.
+    """
+
+    def unbox(self, apply_constraint=True):
+        del apply_constraint
+        return self.value
+
+
+def manual_partitioning(fn, names):
+    """``manual_partitioning`` variant producing :class:`ManualPartitioned`
+    boxes (for params created inside ``shard_map``)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return ManualPartitioned(fn(*args, **kwargs), names)
+
+    return wrapper
+
+
+def _sharded_init(base_init, fold_axis: Optional[str]):
+    """Wrap an initializer to fold the tp position into the RNG so shards
+    draw independent values (otherwise every shard of a 'different' slice
+    would be identical)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        if fold_axis is not None:
+            key = jax.random.fold_in(key, lax.axis_index(fold_axis))
+        return base_init(key, shape, dtype)
+
+    return init
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with output features sharded over ``tp_axis``.
+
+    ``features`` is the **global** feature count; each shard holds
+    ``features // tp_size`` columns, annotated ``nn.Partitioned`` on the
+    output dim.
+    """
+
+    features: int
+    tp_size: int
+    tp_axis: str = "tp"
+    use_bias: bool = True
+    gather_output: bool = False
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        if self.features % self.tp_size:
+            raise ValueError(f"features {self.features} % tp {self.tp_size}")
+        local = self.features // self.tp_size
+        kernel = self.param(
+            "kernel",
+            manual_partitioning(_sharded_init(self.kernel_init, self.tp_axis),
+                                 (None, self.tp_axis)),
+            (x.shape[-1], local), jnp.float32)
+        bias = None
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                manual_partitioning(nn.initializers.zeros, (self.tp_axis,)),
+                (local,), jnp.float32)
+            bias = bias.astype(self.dtype)
+        return column_parallel_dense(
+            x.astype(self.dtype), kernel.astype(self.dtype), bias,
+            tp_axis=self.tp_axis, gather_output=self.gather_output)
+
+
+class RowParallelDense(nn.Module):
+    """Dense with input features sharded over ``tp_axis`` (the Megatron pair
+    of :class:`ColumnParallelDense`); output is psum-reduced and replicated."""
+
+    features: int
+    tp_size: int
+    tp_axis: str = "tp"
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            manual_partitioning(_sharded_init(self.kernel_init, self.tp_axis),
+                                 (self.tp_axis, None)),
+            (x.shape[-1], self.features), jnp.float32)
+        y = row_parallel_dense(x.astype(self.dtype), kernel.astype(self.dtype),
+                               tp_axis=self.tp_axis)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,),
+                              jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class TPBlock(nn.Module):
+    """Megatron-style tensor-parallel transformer block: attention heads and
+    MLP hidden dim sharded over ``tp_axis``; one psum per sublayer.  The
+    attention core is pluggable exactly like :class:`~bluefog_tpu.models.
+    transformer.Block`, so sequence parallelism (ring / Ulysses over another
+    mesh axis) composes with TP."""
+
+    cfg: GPTConfig
+    tp_size: int
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, x, attn_fn):
+        cfg = self.cfg
+        if cfg.num_heads % self.tp_size:
+            raise ValueError(f"heads {cfg.num_heads} % tp {self.tp_size}")
+        local_heads = cfg.num_heads // self.tp_size
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(cfg.dtype)
+        # Fused qkv as an (in, 3, local) kernel sharded on the LAST dim — a
+        # flat (in, 3H/tp) column-parallel shard would interleave q/k/v chunks
+        # across ranks and not survive gather_tp_params with the right
+        # correspondence.
+        local = local_heads * head_dim
+        qkv_kernel = self.param(
+            "qkv_kernel",
+            manual_partitioning(
+                _sharded_init(nn.initializers.lecun_normal(in_axis=0, out_axis=(1, 2)),
+                              self.tp_axis),
+                (None, None, self.tp_axis)),
+            (cfg.hidden_size, 3, local), jnp.float32)
+        qkv_bias = self.param(
+            "qkv_bias",
+            manual_partitioning(nn.initializers.zeros, (None, self.tp_axis)),
+            (3, local), jnp.float32)
+        qkv = (jnp.einsum("...i,ijk->...jk", y, qkv_kernel.astype(cfg.dtype))
+               + qkv_bias.astype(cfg.dtype))
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (local_heads, head_dim))
+
+        a = attn_fn(heads(q), heads(k), heads(v))
+        a = a.reshape(a.shape[:-2] + (local_heads * head_dim,))
+        x = x + RowParallelDense(cfg.hidden_size, self.tp_size,
+                                 tp_axis=self.tp_axis, dtype=cfg.dtype,
+                                 name="proj")(a)
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        y = ColumnParallelDense(cfg.mlp_ratio * cfg.hidden_size, self.tp_size,
+                                tp_axis=self.tp_axis, dtype=cfg.dtype,
+                                name="up")(y)
+        y = nn.gelu(y)
+        return x + RowParallelDense(cfg.hidden_size, self.tp_size,
+                                    tp_axis=self.tp_axis, dtype=cfg.dtype,
+                                    name="down")(y)
+
+
+class TPTransformerLM(nn.Module):
+    """Tensor-parallel :class:`~bluefog_tpu.models.transformer.TransformerLM`.
+
+    Embeddings, layernorms, and the LM head are replicated; every block is
+    tensor-parallel.  Run inside ``shard_map`` over a mesh with ``tp_axis``;
+    with ``tp_size=1`` it is numerically the full model (used by the parity
+    tests, which gather a tp>1 model's shards and replay them at tp=1).
+    """
+
+    cfg: GPTConfig
+    tp_size: int
+    tp_axis: str = "tp"
+
+    @nn.compact
+    def __call__(self, tokens, *, attn_fn=None, position_offset=0):
+        cfg = self.cfg
+        if attn_fn is None:
+            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True)
+        positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="tok")(tokens)
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
+                         name="pos")(positions)
+        for i in range(cfg.num_layers):
+            x = TPBlock(cfg, self.tp_size, tp_axis=self.tp_axis,
+                        name=f"block_{i}")(x, attn_fn)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                        name="lm_head")(x)
+
+
+# ---------------------------------------------------------------------------
+# Gradient correction + parameter gather
+# ---------------------------------------------------------------------------
+
+
+def _is_box(x) -> bool:
+    return isinstance(x, nn.Partitioned)
+
+
+def _box_mentions(box: nn.Partitioned, axis: str) -> bool:
+    return axis in tuple(box.names)
+
+
+def tp_correct_grads(grads, template, tp_axis: str = "tp"):
+    """Fix raw inside-``shard_map`` gradients of a tp-parallel model (see
+    module docstring): sharded leaves ``/ tp_size``, replicated leaves
+    ``pmean`` over ``tp_axis``.  ``template`` is the boxed
+    (``nn.Partitioned``) parameter tree from ``model.init``, the source of
+    shardedness; ``grads`` is the matching plain tree.  Leaves whose template
+    entry is unboxed are treated as replicated."""
+    tp = _tp_size(tp_axis)
+
+    def fix(box, g):
+        if _is_box(box) and _box_mentions(box, tp_axis):
+            return g / tp
+        return lax.pmean(g, tp_axis)
+
+    return jax.tree_util.tree_map(fix, template, grads, is_leaf=_is_box)
+
+
+def tp_value_and_grad(loss_fn, template, tp_axis: str = "tp"):
+    """``jax.value_and_grad`` drop-in for tensor-parallel models
+    differentiated *inside* ``shard_map``: ``loss_fn`` takes a **plain**
+    parameter tree (apply the model with plain arrays — flax's
+    ``Partitioned.unbox`` inserts a ``with_sharding_constraint`` that is
+    illegal under a Manual mesh), ``template`` is the boxed tree from
+    ``model.init``.  Returns exact per-gossip-rank gradients (verified
+    against a gathered single-shard reference in
+    tests/test_tensor_parallel.py)."""
+
+    vag = jax.value_and_grad(loss_fn)
+
+    def wrapped(params, *args, **kwargs):
+        if any(_is_box(l) for l in jax.tree_util.tree_leaves(
+                params, is_leaf=_is_box)):
+            params = unbox_params(params)
+        loss, grads = vag(params, *args, **kwargs)
+        return loss, tp_correct_grads(grads, template, tp_axis)
+
+    return wrapped
+
+
+def unbox_params(params):
+    """Strip ``nn.Partitioned`` boxes, keeping raw arrays."""
+    return jax.tree_util.tree_map(
+        lambda x: x.value if _is_box(x) else x, params, is_leaf=_is_box)
+
+
+def gather_tp_params(params, tp_axis: str = "tp", template=None):
+    """All-gather every tp-sharded leaf back to its full (unsharded) array
+    and strip the boxes — for checkpointing one consolidated model, eval on
+    fewer chips, or the tp-parity tests.  Call inside ``shard_map``.
+
+    ``template``: boxed tree to read shardedness from when ``params`` itself
+    is plain (e.g. a gradient tree matching a boxed parameter tree)."""
+    if template is None:
+        template = params
+
+    def gather(box, leaf):
+        val = leaf.value if _is_box(leaf) else leaf
+        if _is_box(box) and _box_mentions(box, tp_axis):
+            dim = tuple(box.names).index(tp_axis)
+            return lax.all_gather(val, tp_axis, axis=dim, tiled=True)
+        return val
+
+    return jax.tree_util.tree_map(gather, template, params, is_leaf=_is_box)

@@ -1,0 +1,150 @@
+"""Pipeline-parallel tests: the GPipe scan pipeline must match the sequential
+layer stack exactly — forward and backward — and compose with tp on a 2-level
+mesh.  (No reference counterpart; SURVEY.md §2.3: PP absent upstream.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from bluefog_tpu.parallel.api import shard_map
+from bluefog_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from bluefog_tpu.parallel.tensor import make_hybrid_mesh
+
+D = 16
+L = 8          # layers
+PP = 4         # stages
+MICRO = 6      # microbatches
+MB = 4         # micro batch size
+
+
+def make_layers(key):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (L, D, D)) / np.sqrt(D),
+        "b": 0.01 * jax.random.normal(kb, (L, D)),
+    }
+
+
+def apply_layer(w, b, x):
+    return jnp.tanh(x @ w + b)
+
+
+def sequential_ref(layers, xs):
+    """(MICRO, MB, D) through all L layers in order."""
+    def one(x):
+        for i in range(L):
+            x = apply_layer(layers["w"][i], layers["b"][i], x)
+        return x
+    return jax.vmap(one)(xs)
+
+
+def stage_fn(stage_params, x):
+    def body(x, wb):
+        return apply_layer(wb[0], wb[1], x), None
+    out, _ = lax.scan(body, x, (stage_params["w"], stage_params["b"]))
+    return out
+
+
+def test_stack_stage_params_shapes():
+    layers = make_layers(jax.random.PRNGKey(0))
+    staged = stack_stage_params(layers, PP)
+    assert staged["w"].shape == (PP, L // PP, D, D)
+    assert staged["b"].shape == (PP, L // PP, D)
+    with pytest.raises(ValueError):
+        stack_stage_params(layers, 3)
+
+
+def test_pipeline_forward_matches_sequential(devices8):
+    mesh = make_hybrid_mesh({"pp": PP}, devices=devices8[:PP])
+    layers = make_layers(jax.random.PRNGKey(0))
+    staged = stack_stage_params(layers, PP)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (MICRO, MB, D))
+    ref = sequential_ref(layers, xs)
+
+    def body(staged_local, xs):
+        sp = jax.tree_util.tree_map(lambda t: t[0], staged_local)
+        out = pipeline_apply(stage_fn, sp, xs, pp_axis="pp", num_stages=PP)
+        # broadcast the last stage's (only valid) output to every stage
+        last = lax.axis_index("pp") == PP - 1
+        return lax.psum(jnp.where(last, out, 0.0), "pp")
+
+    out = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))(staged, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_grads_match_sequential(devices8):
+    mesh = make_hybrid_mesh({"pp": PP}, devices=devices8[:PP])
+    layers = make_layers(jax.random.PRNGKey(0))
+    staged = stack_stage_params(layers, PP)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (MICRO, MB, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (MICRO, MB, D))
+
+    def ref_loss(layers):
+        return jnp.mean((sequential_ref(layers, xs) - tgt) ** 2)
+
+    gref = jax.grad(ref_loss)(layers)
+    gref_staged = stack_stage_params(gref, PP)
+
+    def body(staged_local, xs):
+        sp = jax.tree_util.tree_map(lambda t: t[0], staged_local)
+
+        def loss_fn(sp):
+            out = pipeline_apply(stage_fn, sp, xs, pp_axis="pp",
+                                 num_stages=PP)
+            # masked LOCAL loss — do NOT psum inside the differentiated
+            # function (its transpose would scale every grad by pp)
+            last = lax.axis_index("pp") == PP - 1
+            return jnp.sum(jnp.where(last, (out - tgt) ** 2, 0.0)) / tgt.size
+
+        loss, g = jax.value_and_grad(loss_fn)(sp)
+        loss = lax.psum(loss, "pp")  # reporting only
+        return (loss[None], jax.tree_util.tree_map(lambda t: t[None], g))
+
+    loss, g = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P()),
+        out_specs=(P("pp"), P("pp")), check_vma=False))(staged, xs)
+
+    ref_loss_val = float(ref_loss(layers))
+    np.testing.assert_allclose(np.asarray(loss), ref_loss_val, rtol=1e-5)
+    for name in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g[name]),
+                                   np.asarray(gref_staged[name]), atol=1e-5,
+                                   err_msg=f"grad mismatch for {name}")
+
+
+def test_pipeline_with_tp_inner_axis(devices8):
+    """pp=4 outer x tp=2 inner: the stage matmul sharded column-wise over tp
+    with a gather; forward still matches sequential."""
+    mesh = make_hybrid_mesh({"pp": PP, "tp": 2}, devices=devices8)
+    layers = make_layers(jax.random.PRNGKey(0))
+    staged = stack_stage_params(layers, PP)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (MICRO, MB, D))
+    ref = sequential_ref(layers, xs)
+
+    def tp_stage_fn(sp, x):
+        # column-shard each layer's W over tp, all_gather the outputs
+        def body(x, wb):
+            w, b = wb
+            i = lax.axis_index("tp")
+            wl = lax.dynamic_slice_in_dim(w, i * (D // 2), D // 2, axis=1)
+            y = lax.all_gather(x @ wl, "tp", axis=x.ndim - 1, tiled=True)
+            return jnp.tanh(y + b), None
+        out, _ = lax.scan(body, x, (sp["w"], sp["b"]))
+        return out
+
+    def body(staged_local, xs):
+        sp = jax.tree_util.tree_map(lambda t: t[0], staged_local)
+        out = pipeline_apply(tp_stage_fn, sp, xs, pp_axis="pp", num_stages=PP)
+        last = lax.axis_index("pp") == PP - 1
+        # psum over 'pp' only: tp ranks hold identical replicas already
+        return lax.psum(jnp.where(last, out, 0.0), "pp")
+
+    out = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))(staged, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -174,3 +174,49 @@ def test_win_free_and_missing_window_error():
     bf.win_create(rank_values((2,)), "b")
     bf.win_free()
     assert not bf.get_context().windows
+
+
+def test_win_mutex_serializes_host_ops():
+    """win_mutex (reference passive-target lock analog) serializes concurrent
+    host-side mutation of the same named window."""
+    import threading
+    import time
+
+    bf.init(topology=RingGraph(N))
+    x = rank_values((4,))
+    bf.win_create(x, "m")
+
+    order = []
+    release = threading.Event()
+
+    def holder():
+        with bf.win_mutex("m"):
+            order.append("holder-in")
+            release.wait(timeout=10)
+            order.append("holder-out")
+
+    t = threading.Thread(target=holder)
+    t.start()
+    deadline = time.monotonic() + 10
+    while "holder-in" not in order:
+        assert time.monotonic() < deadline, "holder thread never took the lock"
+        time.sleep(0.001)
+    waiter_done = []
+
+    def waiter():
+        with bf.win_mutex("m"):
+            order.append("waiter-in")
+        waiter_done.append(True)
+
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    assert not waiter_done  # blocked behind the holder
+    release.set()
+    t.join(timeout=10)
+    t2.join(timeout=10)
+    assert order == ["holder-in", "holder-out", "waiter-in"]
+    # reentrant within a thread (MPI lock-all is per-epoch; RLock mirrors it)
+    with bf.win_mutex("m"):
+        with bf.win_mutex("m"):
+            pass
+    bf.win_free("m")
