@@ -13,18 +13,16 @@ token count (see ops/moe.py docstring) so raw ``jax.grad`` is exact.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
-from jax import lax
 
-from bluefog_tpu.models.transformer import GPTConfig
+from bluefog_tpu.models.transformer import GPTConfig, TransformerLM
 from bluefog_tpu.ops.moe import expert_parallel_ffn, moe_ffn_reference
-from bluefog_tpu.ops.ring_attention import local_attention
+from bluefog_tpu.parallel.rng import sharded_init
 
-__all__ = ["MoEConfig", "MoEMLP", "MoEBlock", "MoETransformerLM"]
+__all__ = ["MoEConfig", "MoEMLP", "MoETransformerLM"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,18 +46,6 @@ class MoEConfig:
         return max(c, 1)
 
 
-def _expert_init(base_init, ep_axis: Optional[str]):
-    """Fold the ep position into the RNG so each shard's experts draw
-    independent values (mirrors parallel.tensor._sharded_init)."""
-
-    def init(key, shape, dtype=jnp.float32):
-        if ep_axis is not None:
-            key = jax.random.fold_in(key, lax.axis_index(ep_axis))
-        return base_init(key, shape, dtype)
-
-    return init
-
-
 class MoEMLP(nn.Module):
     """Switch-MoE FFN; expert weights sharded over ``cfg.ep_axis`` when
     ``cfg.ep_size > 1`` (params hold only the local experts), dense reference
@@ -81,11 +67,11 @@ class MoEMLP(nn.Module):
         router = self.param("router", nn.initializers.lecun_normal(),
                             (gpt.hidden_size, cfg.num_experts), jnp.float32)
         wi = self.param(
-            "wi", _expert_init(
+            "wi", sharded_init(
                 nn.initializers.lecun_normal(in_axis=1, out_axis=2), fold),
             (local_e, gpt.hidden_size, hidden), jnp.float32)
         wo = self.param(
-            "wo", _expert_init(
+            "wo", sharded_init(
                 nn.initializers.lecun_normal(in_axis=1, out_axis=2), fold),
             (local_e, hidden, gpt.hidden_size), jnp.float32)
 
@@ -105,48 +91,14 @@ class MoEMLP(nn.Module):
         return y.reshape(B, T, D)
 
 
-class MoEBlock(nn.Module):
-    cfg: MoEConfig
+def MoETransformerLM(cfg: MoEConfig) -> TransformerLM:
+    """Switch-MoE decoder LM: the :class:`TransformerLM` trunk with every
+    block's MLP swapped for a :class:`MoEMLP` (one shared attention/embedding
+    implementation — no duplicated trunk).
 
-    @nn.compact
-    def __call__(self, x, attn_fn):
-        gpt = self.cfg.gpt
-        head_dim = gpt.hidden_size // gpt.num_heads
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(gpt.dtype)
-        qkv = nn.Dense(3 * gpt.hidden_size, dtype=gpt.dtype, name="qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(t.shape[:-1] + (gpt.num_heads, head_dim))
-
-        a = attn_fn(heads(q), heads(k), heads(v))
-        a = a.reshape(a.shape[:-2] + (gpt.hidden_size,))
-        x = x + nn.Dense(gpt.hidden_size, dtype=gpt.dtype, name="proj")(a)
-
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(gpt.dtype)
-        return x + MoEMLP(self.cfg, name="moe")(y)
-
-
-class MoETransformerLM(nn.Module):
-    """Switch-MoE decoder LM.  Inside ``shard_map`` over an ``'ep'`` axis,
-    pass the per-shard token batch; collect the aux loss via
-    ``mutable=["aux_loss"]`` and add ``cfg.aux_loss_weight * sum``."""
-
-    cfg: MoEConfig
-
-    @nn.compact
-    def __call__(self, tokens, *, attn_fn=None, position_offset=0):
-        cfg = self.cfg
-        gpt = cfg.gpt
-        if attn_fn is None:
-            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True)
-        positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
-        x = nn.Embed(gpt.vocab_size, gpt.hidden_size, dtype=gpt.dtype,
-                     name="tok")(tokens)
-        x = x + nn.Embed(gpt.max_position, gpt.hidden_size, dtype=gpt.dtype,
-                         name="pos")(positions)
-        for i in range(gpt.num_layers):
-            x = MoEBlock(cfg, name=f"block_{i}")(x, attn_fn)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        return nn.Dense(gpt.vocab_size, dtype=jnp.float32, use_bias=False,
-                        name="lm_head")(x)
+    Inside ``shard_map`` over an ``'ep'`` axis, pass the per-shard token
+    batch; collect the aux loss via ``mutable=["aux_loss"]`` and add
+    ``cfg.aux_loss_weight * sum``.  Gradient convention for replicated vs
+    ep-sharded params: see the ops/moe.py module docstring.
+    """
+    return TransformerLM(cfg.gpt, mlp=lambda: MoEMLP(cfg, name="moe"))

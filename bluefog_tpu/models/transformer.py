@@ -54,7 +54,16 @@ class GPTConfig:
 
 
 class Block(nn.Module):
+    """Pre-LN attention + MLP residual block.
+
+    ``mlp`` is a pluggable sublayer factory ``() -> nn.Module`` (the module
+    maps ``(B, T, D) -> (B, T, D)``); ``None`` gives the dense GELU MLP.
+    The MoE variant (models/moe.py) injects a Switch-MoE FFN here instead of
+    duplicating the attention trunk.
+    """
+
     cfg: GPTConfig
+    mlp: Optional[Callable[[], nn.Module]] = None
 
     @nn.compact
     def __call__(self, x, attn_fn: AttnFn):
@@ -72,6 +81,8 @@ class Block(nn.Module):
         x = x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="proj")(a)
 
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(cfg.dtype)
+        if self.mlp is not None:
+            return x + self.mlp()(y)
         y = nn.Dense(cfg.mlp_ratio * cfg.hidden_size, dtype=cfg.dtype, name="up")(y)
         y = nn.gelu(y)
         return x + nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="down")(y)
@@ -80,9 +91,11 @@ class Block(nn.Module):
 class TransformerLM(nn.Module):
     """Tokens → logits.  ``attn_fn(q, k, v) -> out`` defaults to full causal
     attention; inject a sequence-parallel attention inside ``shard_map`` and
-    pass this rank's global ``position_offset``."""
+    pass this rank's global ``position_offset``.  ``mlp`` (a sublayer factory,
+    see :class:`Block`) swaps every block's MLP — e.g. for Switch-MoE."""
 
     cfg: GPTConfig
+    mlp: Optional[Callable[[], nn.Module]] = None
 
     @nn.compact
     def __call__(self, tokens, *, attn_fn: Optional[AttnFn] = None,
@@ -96,7 +109,7 @@ class TransformerLM(nn.Module):
         x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
                          name="pos")(positions)
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block_{i}")(x, attn_fn)
+            x = Block(cfg, mlp=self.mlp, name=f"block_{i}")(x, attn_fn)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False,
                         name="lm_head")(x)

@@ -14,11 +14,15 @@ Pieces:
   reverse all_to_all → combine, inside ``shard_map``.
 
 Gradient convention: normalize the per-rank loss by the GLOBAL token count
-(``local_sum / total_tokens``) and raw ``jax.grad`` inside ``shard_map`` is
-exact for both expert (ep-sharded) and replicated parameters — the seeds of
-the per-rank losses then sum to the true global objective, and the
-``all_to_all`` transposes route cotangents back without scaling (verified in
-tests/test_moe.py).
+(``local_sum / total_tokens``) so the per-rank loss seeds sum to the true
+global objective.  Then raw ``jax.grad`` inside ``shard_map`` is exact for
+the **ep-sharded expert parameters** (the ``all_to_all`` transposes route
+cotangents back without scaling).  **Replicated parameters** (router,
+embeddings, attention, …) receive only the local tokens' contribution on
+each rank — ``lax.psum`` their grads over the ep axis before the optimizer
+update, or the nominally replicated copies silently diverge (see
+tests/test_moe.py::test_expert_parallel_grads_match_reference and the
+``gr = lax.psum(gr, "ep")`` step in ``__graft_entry__.dryrun_multichip``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,39 @@
+"""Per-shard RNG helpers — leaf module (no model/ops deps) shared by the
+tensor-parallel layers (kernel shards) and the MoE model (per-shard experts).
+
+Inside ``shard_map`` every rank sees the same base PRNG key; folding the mesh
+position in makes nominally 'different-per-shard' parameters actually draw
+independent values.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fold_axis_rng", "sharded_init"]
+
+
+def fold_axis_rng(key, *axis_names: str):
+    """Per-shard RNG: fold each mesh position in so shards initialize
+    differently (inside ``shard_map`` all ranks see the same base key)."""
+    for ax in axis_names:
+        key = jax.random.fold_in(key, lax.axis_index(ax))
+    return key
+
+
+def sharded_init(base_init, fold_axis: Optional[str]):
+    """Wrap an initializer to fold the mesh position along ``fold_axis`` into
+    the RNG so shards draw independent values (otherwise every shard of a
+    'different' slice would be identical).  Shared by TP (kernel shards) and
+    EP (per-shard experts — models/moe.py)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        if fold_axis is not None:
+            key = jax.random.fold_in(key, lax.axis_index(fold_axis))
+        return base_init(key, shape, dtype)
+
+    return init

@@ -46,6 +46,7 @@ from jax import lax
 
 from bluefog_tpu.models.transformer import GPTConfig
 from bluefog_tpu.ops.ring_attention import local_attention
+from bluefog_tpu.parallel.rng import fold_axis_rng, sharded_init
 from bluefog_tpu.topology.mapping import ici_ring_order
 
 __all__ = [
@@ -95,12 +96,6 @@ def make_hybrid_mesh(axes: Mapping[str, int], *, devices=None,
     return Mesh(np.array(devices[:need]).reshape(sizes), names)
 
 
-def fold_axis_rng(key, *axis_names: str):
-    """Per-shard RNG: fold each mesh position in so shards initialize
-    differently (inside ``shard_map`` all ranks see the same base key)."""
-    for ax in axis_names:
-        key = jax.random.fold_in(key, lax.axis_index(ax))
-    return key
 
 
 def _tp_size(tp_axis: str):
@@ -170,17 +165,6 @@ def manual_partitioning(fn, names):
     return wrapper
 
 
-def _sharded_init(base_init, fold_axis: Optional[str]):
-    """Wrap an initializer to fold the tp position into the RNG so shards
-    draw independent values (otherwise every shard of a 'different' slice
-    would be identical)."""
-
-    def init(key, shape, dtype=jnp.float32):
-        if fold_axis is not None:
-            key = jax.random.fold_in(key, lax.axis_index(fold_axis))
-        return base_init(key, shape, dtype)
-
-    return init
 
 
 class ColumnParallelDense(nn.Module):
@@ -206,7 +190,7 @@ class ColumnParallelDense(nn.Module):
         local = self.features // self.tp_size
         kernel = self.param(
             "kernel",
-            manual_partitioning(_sharded_init(self.kernel_init, self.tp_axis),
+            manual_partitioning(sharded_init(self.kernel_init, self.tp_axis),
                                  (None, self.tp_axis)),
             (x.shape[-1], local), jnp.float32)
         bias = None
@@ -236,7 +220,7 @@ class RowParallelDense(nn.Module):
     def __call__(self, x):
         kernel = self.param(
             "kernel",
-            manual_partitioning(_sharded_init(self.kernel_init, self.tp_axis),
+            manual_partitioning(sharded_init(self.kernel_init, self.tp_axis),
                                  (self.tp_axis, None)),
             (x.shape[-1], self.features), jnp.float32)
         y = row_parallel_dense(x.astype(self.dtype), kernel.astype(self.dtype),
@@ -276,7 +260,7 @@ class TPBlock(nn.Module):
         qkv_kernel = self.param(
             "qkv_kernel",
             manual_partitioning(
-                _sharded_init(nn.initializers.lecun_normal(in_axis=0, out_axis=(1, 2)),
+                sharded_init(nn.initializers.lecun_normal(in_axis=0, out_axis=(1, 2)),
                               self.tp_axis),
                 (None, None, self.tp_axis)),
             (cfg.hidden_size, 3, local), jnp.float32)
