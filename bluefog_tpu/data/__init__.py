@@ -1,0 +1,20 @@
+"""Input pipeline: disjoint rank sharding, static batches, device prefetch.
+
+The reference's examples use torch DataLoader + DistributedSampler (SURVEY.md
+§2.2 "Examples"); this is the TPU-native equivalent feeding the stacked
+``(num_ranks, batch, ...)`` layout the repo's shard_map train steps consume.
+"""
+
+from bluefog_tpu.data.loader import (
+    ArraySource,
+    DistributedLoader,
+    SyntheticClassificationSource,
+    prefetch_to_device,
+)
+
+__all__ = [
+    "ArraySource",
+    "DistributedLoader",
+    "SyntheticClassificationSource",
+    "prefetch_to_device",
+]

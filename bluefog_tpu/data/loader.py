@@ -1,0 +1,242 @@
+"""TPU-native input pipeline for decentralized data-parallel training.
+
+The reference has no data subsystem of its own — its examples feed
+``torch.utils.data.DataLoader`` + ``DistributedSampler`` (upstream
+``examples/pytorch_mnist.py``; SURVEY.md §2.2 "Examples").  The TPU build
+needs a native equivalent because the input path is host-side work that must
+overlap device compute:
+
+- **Disjoint rank shards** — rank ``r`` draws the permuted index stream
+  ``r::num_ranks`` (the DistributedSampler contract: every example seen once
+  per epoch across ranks, shards disjoint).
+- **Static shapes** — batches are fixed-size (remainder dropped) so the
+  jitted train step never recompiles.
+- **Stacked layout** — each yield is a pytree of ``(num_ranks, batch, ...)``
+  arrays placed with the gossip-axis sharding
+  (:func:`bluefog_tpu.parallel.api.rank_shard`), ready for the repo's
+  ``shard_map(train_step, in_specs=P(axis))`` convention.
+- **Background prefetch** — a host thread gathers + ``device_put``s ahead of
+  the consumer, so H2D transfer rides under the previous step's compute
+  (jax device_put is async; the queue bounds look-ahead).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "ArraySource",
+    "SyntheticClassificationSource",
+    "DistributedLoader",
+    "prefetch_to_device",
+]
+
+
+class ArraySource:
+    """Index-gatherable source over parallel arrays (features, labels, ...).
+
+    Accepts numpy arrays or anything ``np.asarray``-able, including
+    ``np.load(..., mmap_mode="r")`` memory-maps for larger-than-RAM data.
+    ``source[idx_array]`` gathers a batch from every array.
+    """
+
+    def __init__(self, *arrays):
+        if not arrays:
+            raise ValueError("ArraySource needs at least one array")
+        self.arrays = tuple(
+            a if isinstance(a, np.memmap) else np.asarray(a) for a in arrays
+        )
+        n = len(self.arrays[0])
+        for a in self.arrays:
+            if len(a) != n:
+                raise ValueError(
+                    f"array lengths disagree: {[len(a) for a in self.arrays]}")
+        self._len = n
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, idx):
+        out = tuple(np.asarray(a[idx]) for a in self.arrays)
+        return out if len(out) > 1 else out[0]
+
+
+class SyntheticClassificationSource:
+    """Procedural labeled data: ``num_classes`` fixed prototypes + noise.
+
+    Deterministic per index (no stored dataset), so ranks can draw disjoint
+    shards of an arbitrarily large virtual epoch.  Shapes default to
+    ImageNet-like; pass ``shape=(28, 28, 1), num_classes=10`` for MNIST.
+    """
+
+    def __init__(self, num_examples: int, *, shape=(224, 224, 3),
+                 num_classes: int = 1000, seed: int = 0, noise: float = 0.3,
+                 dtype=np.float32):
+        self._len = int(num_examples)
+        self.shape = tuple(shape)
+        self.num_classes = int(num_classes)
+        self.noise = float(noise)
+        self.dtype = dtype
+        self._seed = seed
+        # Prototypes are generated lazily per touched class — 1000 ImageNet-
+        # sized f32 prototypes would be ~574 MB eager.
+        self._protos: dict = {}
+
+    def __len__(self) -> int:
+        return self._len
+
+    def _proto(self, label: int) -> np.ndarray:
+        p = self._protos.get(label)
+        if p is None:
+            rng = np.random.default_rng((self._seed, 2, label))
+            p = rng.standard_normal(self.shape).astype(self.dtype) * 0.8
+            self._protos[label] = p
+        return p
+
+    def __getitem__(self, idx):
+        idx = np.asarray(idx)
+        labels = np.empty(idx.shape, np.int32)
+        imgs = np.empty(idx.shape + self.shape, self.dtype)
+        for pos, i in enumerate(idx.reshape(-1)):
+            rng = np.random.default_rng((self._seed, 1, int(i)))
+            lab = int(rng.integers(0, self.num_classes))
+            labels.reshape(-1)[pos] = lab
+            flat = imgs.reshape((-1,) + self.shape)
+            flat[pos] = self._proto(lab) + self.noise * rng.standard_normal(
+                self.shape).astype(self.dtype)
+        return imgs, labels
+
+
+def _epoch_perm(n: int, seed: int, epoch: int, shuffle: bool) -> np.ndarray:
+    if not shuffle:
+        return np.arange(n)
+    return np.random.default_rng((seed, epoch)).permutation(n)
+
+
+class DistributedLoader:
+    """Epoch iterator yielding gossip-sharded stacked batches.
+
+    Each item is a pytree (tuple) of ``(num_ranks, batch, ...)`` arrays; with
+    ``device_put=True`` (default) leaves are placed with the current
+    context's rank sharding and prefetched ``prefetch`` batches ahead on a
+    background thread.
+
+    Index discipline (mirrors torch ``DistributedSampler``): one global
+    permutation per epoch (seeded by ``(seed, epoch)`` — identical on every
+    host), rank ``r`` takes ``perm[r::num_ranks]``, remainder dropped so all
+    ranks and all steps see identical static shapes.
+    """
+
+    def __init__(self, source, per_rank_batch: int, *,
+                 num_ranks: Optional[int] = None, shuffle: bool = True,
+                 seed: int = 0, device_put: bool = True, prefetch: int = 2):
+        from bluefog_tpu.parallel.api import get_context
+
+        self.source = source
+        self.batch = int(per_rank_batch)
+        if num_ranks is None:
+            num_ranks = get_context().size
+        elif device_put and num_ranks != get_context().size:
+            raise ValueError(
+                f"num_ranks={num_ranks} != context size "
+                f"{get_context().size}; rank_shard placement requires them "
+                "equal — pass device_put=False for host-only loading")
+        self.num_ranks = int(num_ranks)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.device_put = device_put
+        self.prefetch = max(int(prefetch), 0)
+        per_rank = len(source) // self.num_ranks
+        self.steps_per_epoch = per_rank // self.batch
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"source of {len(source)} examples < one batch per rank "
+                f"({self.num_ranks} ranks x {self.batch})")
+
+    def _host_batches(self, epoch: int) -> Iterator[Any]:
+        perm = _epoch_perm(len(self.source), self.seed, epoch, self.shuffle)
+        shards = [perm[r::self.num_ranks] for r in range(self.num_ranks)]
+        for step in range(self.steps_per_epoch):
+            lo = step * self.batch
+            idx = np.stack([s[lo:lo + self.batch] for s in shards])  # (R, B)
+            got = self.source[idx.reshape(-1)]
+            if not isinstance(got, tuple):
+                got = (got,)
+            yield tuple(
+                a.reshape((self.num_ranks, self.batch) + a.shape[1:])
+                for a in got)
+
+    def epoch(self, epoch: int = 0) -> Iterator[Any]:
+        """Iterate one epoch (pass the epoch number for fresh shuffling)."""
+        it = self._host_batches(epoch)
+        if not self.device_put:
+            return it
+
+        from bluefog_tpu.parallel.api import rank_shard
+
+        it = map(rank_shard, it)
+        return prefetch_to_device(it, self.prefetch) if self.prefetch else it
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+def prefetch_to_device(it: Iterator[Any], size: int) -> Iterator[Any]:
+    """Run ``it`` on a daemon thread, keeping up to ``size`` items queued.
+
+    Items are produced (and any ``device_put`` inside ``it`` issued) ahead of
+    the consumer, overlapping host work + H2D with device compute.  Exceptions
+    on the worker re-raise at the consumer's next ``next()``.
+    """
+    if size <= 0:
+        yield from it
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    END, ERR = object(), object()
+
+    def put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in it:
+                if not put(item):
+                    return
+            put(END)
+        except BaseException as e:  # noqa: BLE001 — reraised at consumer
+            put((ERR, e))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is ERR:
+                raise item[1]
+            yield item
+    finally:
+        # Consumer done or abandoned early (break/exception/GeneratorExit):
+        # unblock and join the worker, then drop queued batches so their
+        # device buffers free promptly.
+        stop.set()
+        t.join(timeout=5.0)
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

@@ -33,7 +33,7 @@ def sharded_init(base_init, fold_axis: Optional[str]):
 
     def init(key, shape, dtype=jnp.float32):
         if fold_axis is not None:
-            key = jax.random.fold_in(key, lax.axis_index(fold_axis))
+            key = fold_axis_rng(key, fold_axis)
         return base_init(key, shape, dtype)
 
     return init
