@@ -49,12 +49,50 @@ __all__ = [
     "allgather",
     "broadcast",
     "barrier",
+    "fuse_apply",
     "neighbor_allreduce",
     "neighbor_allgather",
     "neighbor_allreduce_dynamic",
     "hierarchical_neighbor_allreduce",
     "pair_gossip",
 ]
+
+
+def fuse_apply(fn, x):
+    """Tensor fusion: run a tree-polymorphic collective on ONE flat buffer
+    per dtype instead of per-leaf.
+
+    The reference batches small tensors through a fusion buffer so each
+    negotiation round issues one wire transfer (`bluefog/common/tensor_queue`
+    fusion-buffer manager, SURVEY.md §2.1).  The XLA analog: a model like
+    ResNet-50 has ~160 parameter leaves, and leaf-wise gossip emits ~160
+    ``ppermute`` ops per schedule slot — each with its own latency.  Packing
+    the tree into a single 1-D buffer per dtype turns that into one large
+    bandwidth-bound transfer per slot, then splits back.
+
+    ``fn`` must be shape-polymorphic and leaf-wise (all collectives here
+    are).  Leaves keep their dtypes: each dtype group is fused separately, so
+    mixed bf16/f32 trees behave exactly as unfused.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    if len(leaves) <= 1:
+        return fn(x)
+    groups: dict = {}  # dtype str -> leaf indices
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(str(jnp.asarray(leaf).dtype), []).append(i)
+    bufs = {
+        dt: jnp.concatenate([jnp.asarray(leaves[i]).ravel() for i in idxs])
+        for dt, idxs in groups.items()
+    }
+    out_bufs = fn(bufs)
+    out = [None] * len(leaves)
+    for dt, idxs in groups.items():
+        buf, off = out_bufs[dt], 0
+        for i in idxs:
+            sz = int(np.prod(jnp.shape(leaves[i]), dtype=np.int64))
+            out[i] = buf[off:off + sz].reshape(jnp.shape(leaves[i]))
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _as_schedule(s) -> GossipSchedule:
@@ -232,7 +270,9 @@ def broadcast(x, root_rank: int, axis_name: str):
 
     def one(leaf):
         contrib = jnp.where(i == root_rank, leaf, jnp.zeros_like(leaf))
-        return lax.psum(contrib, axis_name)
+        # psum promotes bool to int32; restore the input dtype (per-dtype
+        # parity with the reference's typed entry points, SURVEY.md §2.1)
+        return lax.psum(contrib, axis_name).astype(leaf.dtype)
 
     return jax.tree_util.tree_map(one, x)
 

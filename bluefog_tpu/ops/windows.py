@@ -152,6 +152,21 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
     return state.replace(peer_bufs=new_peers)
 
 
+def _weighted(dst_weight):
+    """``leaf -> dst_weight * leaf`` with f32 arithmetic for low-precision
+    leaves (push-sum fractions like 1/3 are not representable in bf16/f16 —
+    the same concern the reference's fp16 custom MPI sum addresses,
+    SURVEY.md §2.1 ``half.h``)."""
+
+    def apply(leaf):
+        acc = (jnp.float32 if leaf.dtype in (jnp.bfloat16, jnp.float16)
+               else leaf.dtype)
+        return (jnp.asarray(dst_weight, acc) * leaf.astype(acc)).astype(
+            leaf.dtype)
+
+    return apply
+
+
 def win_put(
     state: WindowState,
     x,
@@ -167,9 +182,7 @@ def win_put(
     not involved until it chooses to ``win_update``.  ``backend='pallas'``
     performs the transfer as a genuine one-sided RDMA on TPU slices.
     """
-    payload = jax.tree_util.tree_map(
-        lambda leaf: (jnp.asarray(dst_weight, leaf.dtype) * leaf).astype(leaf.dtype), x
-    )
+    payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
     return _deliver(state, payload, axis_name, accumulate=False, backend=backend)
 
 
@@ -183,9 +196,7 @@ def win_accumulate(
 ) -> WindowState:
     """Like :func:`win_put` but adds into the destination buffer
     (``MPI_Accumulate(MPI_SUM)`` semantics)."""
-    payload = jax.tree_util.tree_map(
-        lambda leaf: (jnp.asarray(dst_weight, leaf.dtype) * leaf).astype(leaf.dtype), x
-    )
+    payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
     return _deliver(state, payload, axis_name, accumulate=True, backend=backend)
 
 

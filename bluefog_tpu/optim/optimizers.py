@@ -128,22 +128,26 @@ def decentralized_optimizer(
         )
 
     def _combine(params, count):
+        # fuse_apply: one flat buffer per dtype → one ppermute/psum per slot
+        # instead of one per parameter leaf (reference fusion-buffer parity)
         if ct == CommunicationType.neighbor_allreduce:
-            return _gossip(params, scheds, count, axis_name)
+            return C.fuse_apply(
+                lambda t: _gossip(t, scheds, count, axis_name), params)
         if ct == CommunicationType.hierarchical_neighbor_allreduce:
-            return C.hierarchical_neighbor_allreduce(
-                params, mscheds[0], axis_name, local_size=local_size
-            )
-        if ct == CommunicationType.allreduce:
-            return C.allreduce(params, axis_name, average=True)
-        return params  # empty
+            return C.fuse_apply(
+                lambda t: C.hierarchical_neighbor_allreduce(
+                    t, mscheds[0], axis_name, local_size=local_size), params)
+        # allreduce/empty never reach here: comm_step short-circuits them
+        # (allreduce averages grads in update_fn instead of combining params)
+        return params
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("decentralized_optimizer requires params in update()")
         if ct == CommunicationType.allreduce:
-            # centralized baseline: average gradients, plain step
-            grads = C.allreduce(grads, axis_name, average=True)
+            # centralized baseline: average gradients, plain step (fused)
+            grads = C.fuse_apply(
+                lambda t: C.allreduce(t, axis_name, average=True), grads)
         updates, base_state = base.update(grads, state.base_state, params)
 
         k = num_steps_per_communication

@@ -1,0 +1,171 @@
+"""Dtype coverage across the op surface — the reference supports fp16/32/64
+plus integer allreduce via per-dtype extension entry points and a custom fp16
+MPI sum (`bluefog/torch/mpi_ops.cc` per-dtype enqueue fns, `common/half.h`;
+SURVEY.md §2.1, §4 "over dtypes fp16/32/64").  The SPMD equivalents here are
+dtype-polymorphic; these tests pin the contract:
+
+- outputs preserve the input dtype,
+- low-precision gossip accumulates in f32 (half.h's concern),
+- integer and bool collectives work where the semantics are exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import collectives as C
+from bluefog_tpu.ops import windows as W
+from bluefog_tpu.parallel.api import shard_map
+from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph
+from bluefog_tpu.topology.schedule import build_schedule
+
+N = 8
+FLOAT_DTYPES = [jnp.float32, jnp.bfloat16, jnp.float16]
+INT_DTYPES = [jnp.int32, jnp.uint32]
+
+
+def run_spmd(fn, *args, n=N):
+    ctx = bf.get_context()
+    return jax.jit(shard_map(
+        fn, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),) * len(args),
+        out_specs=P(ctx.axis_name), check_vma=False))(*args)
+
+
+def rank_values(dtype, shape=(8,)):
+    base = jnp.arange(N, dtype=jnp.float32).reshape((N,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (N,) + shape).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES, ids=str)
+def test_neighbor_allreduce_float_dtypes(dtype):
+    bf.init(topology=RingGraph(N))
+    sched = build_schedule(RingGraph(N))
+    x = rank_values(dtype)
+
+    out = run_spmd(
+        lambda b: C.neighbor_allreduce(b[0], sched, "bf")[None], x)
+    assert out.dtype == dtype
+    # ring: out_r = (x_{r-1} + x_r + x_{r+1}) / 3; exact values are small
+    # ints/3 — f32 accumulation keeps bf16/f16 within one ulp of x/3
+    W_mat = np.asarray(RingGraph(N).weights)
+    expected = W_mat @ np.arange(N, dtype=np.float64)
+    got = np.asarray(out, np.float64)[:, 0]
+    # bf16 holds ~8 mantissa bits → ~0.4% relative error on values near 4
+    tol = {jnp.float32: 1e-6, jnp.bfloat16: 5e-2, jnp.float16: 1e-2}[dtype]
+    np.testing.assert_allclose(got, expected, atol=tol)
+
+
+@pytest.mark.parametrize("dtype", INT_DTYPES, ids=str)
+def test_allreduce_sum_int(dtype):
+    bf.init()
+    x = rank_values(dtype)
+    out = run_spmd(
+        lambda b: C.allreduce(b[0], "bf", average=False)[None], x)
+    assert out.dtype == dtype
+    assert int(out[0, 0]) == sum(range(N))
+
+
+def test_broadcast_int_and_bool():
+    bf.init()
+    x = rank_values(jnp.int32)
+    out = run_spmd(lambda b: C.broadcast(b[0], 3, "bf")[None], x)
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), 3)
+
+    flags = (jnp.arange(N) % 2 == 0)[:, None]
+    out = run_spmd(lambda b: C.broadcast(b[0], 2, "bf")[None], flags)
+    assert out.dtype == jnp.bool_
+    assert np.asarray(out).all()
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES, ids=str)
+def test_allgather_and_neighbor_allgather_dtypes(dtype):
+    bf.init(topology=RingGraph(N))
+    sched = build_schedule(RingGraph(N))
+    x = rank_values(dtype)
+
+    out = run_spmd(lambda b: C.allgather(b[0], "bf")[None], x)
+    assert out.dtype == dtype
+    np.testing.assert_array_equal(
+        np.asarray(out[0], np.float32)[:, 0], np.arange(N, dtype=np.float32))
+
+    def nag(b):
+        slots, mask = C.neighbor_allgather(b[0], sched, "bf")
+        del mask
+        return slots[None]
+
+    slots = run_spmd(nag, x)
+    assert slots.dtype == dtype
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES, ids=str)
+def test_window_roundtrip_dtypes(dtype):
+    """win_create → win_put(1/3) → win_update keeps dtype and stays accurate
+    in low precision (f32 weighting inside, half.h-style)."""
+    bf.init(topology=RingGraph(N))
+    sched = build_schedule(RingGraph(N))
+    x = rank_values(dtype)
+
+    def step(b):
+        leaf = b[0]
+        st = W.win_create(leaf, sched, "bf")
+        st = W.win_put(st, leaf, "bf", dst_weight=1.0 / 3.0)
+        out, st = W.win_update(st, "bf",
+                               self_weight=1.0 / 3.0,
+                               recv_weights=jnp.ones((sched.num_slots,)))
+        return out[None]
+
+    out = run_spmd(step, x)
+    assert out.dtype == dtype
+    # out_r = x_r/3 + (x_{r-1} + x_{r+1})/3 = ring average * 3/3
+    W_mat = np.asarray(RingGraph(N).weights)
+    expected = W_mat @ np.arange(N, dtype=np.float64)
+    got = np.asarray(out, np.float64)[:, 0]
+    np.testing.assert_allclose(got, expected, atol=2e-2)
+
+
+def test_optimizer_bf16_params_finite():
+    """A gossip SGD step on bf16 parameters stays finite and bf16."""
+    import optax
+
+    from bluefog_tpu.optim import DistributedNeighborAllreduceOptimizer
+
+    bf.init(topology=ExponentialTwoGraph(N))
+    ctx = bf.get_context()
+    opt = DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1), topology=ctx.schedule, axis_name=ctx.axis_name)
+    w = bf.rank_shard(bf.rank_stack(jnp.ones((16,), jnp.bfloat16)))
+
+    def step(w_blk):
+        w = w_blk[0]
+        st = opt.init(w)
+        g = w * jnp.asarray(0.5, jnp.bfloat16)
+        upd, st = opt.update(g, st, w)
+        import optax as ox
+        return ox.apply_updates(w, upd)[None]
+
+    out = run_spmd(step, w)
+    assert out.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_mixed_dtype_pytree_gossip():
+    """Pytrees mixing bf16/f32 leaves gossip leaf-wise with per-leaf dtypes."""
+    bf.init(topology=RingGraph(N))
+    sched = build_schedule(RingGraph(N))
+    tree = {"a": rank_values(jnp.bfloat16), "b": rank_values(jnp.float32)}
+
+    def step(blk):
+        local = jax.tree_util.tree_map(lambda t: t[0], blk)
+        out = C.neighbor_allreduce(local, sched, "bf")
+        return jax.tree_util.tree_map(lambda t: t[None], out)
+
+    ctx = bf.get_context()
+    out = jax.jit(shard_map(
+        step, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+        out_specs=P(ctx.axis_name), check_vma=False))(tree)
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.float32
