@@ -30,6 +30,7 @@ from bluefog_tpu.ops.windows import (
     win_update,
     win_update_then_collect,
     win_sync,
+    win_associated_p,
 )
 from bluefog_tpu.ops.ring_attention import (
     ring_attention,

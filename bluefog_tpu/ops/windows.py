@@ -50,6 +50,7 @@ __all__ = [
     "win_update",
     "win_update_then_collect",
     "win_sync",
+    "win_associated_p",
 ]
 
 
@@ -77,11 +78,20 @@ class WindowState(struct.PyTreeNode):
         landing buffers for in-edges, one per schedule slot (reference: one
         buffer per in-neighbor).
       spec: static metadata.
+      assoc_self / assoc_peers: the **associated push-sum scalar** ``p`` and
+        its landing slots — populated when the window was created with
+        ``associated_p=True`` (the reference's win-ops-with-associated-p mode,
+        SURVEY.md §2.1 ``mpi_win_ops.cc``): every put/accumulate/get moves the
+        same weight fraction of ``p`` alongside the tensor, and updates merge
+        it with the same weights, so ``self_buf / p`` debiases directed
+        (column-substochastic) gossip.  ``None`` when the mode is off.
     """
 
     self_buf: Any
     peer_bufs: Any
     spec: WindowSpec = struct.field(pytree_node=False)
+    assoc_self: Optional[jnp.ndarray] = None
+    assoc_peers: Optional[jnp.ndarray] = None
 
 
 def _slot_mask(sched: GossipSchedule, axis_name: str):
@@ -90,25 +100,47 @@ def _slot_mask(sched: GossipSchedule, axis_name: str):
     return jnp.asarray(sched.recv_src >= 0)[i]
 
 
-def win_create(x, schedule, axis_name: str, *, name: str = "win") -> WindowState:
+def win_create(x, schedule, axis_name: str, *, name: str = "win",
+               associated_p: bool = False) -> WindowState:
     """Allocate window buffers for tensor(-tree) ``x``.
 
     Peer slots are initialized with copies of ``x`` so that a ``win_update``
     before any communication returns ``x`` unchanged (matching the reference's
     WinCreate initialization).  Collective in the reference (all ranks must
     call it); here it is pure allocation.
+
+    ``associated_p=True`` additionally carries the push-sum scalar: ``p``
+    starts at 1 on every rank; every subsequent put/accumulate/get/update
+    moves and merges it with the tensor's weights.  Read it with
+    :func:`win_associated_p`; ``self_buf / p`` is the debiased value.  In
+    this mode the landing slots start **empty** (zeros for both tensor and
+    ``p``) so the (x, p) mass pairs stay consistent: all initial mass lives
+    at self with weight 1.
     """
     sched = _as_schedule(schedule)
     k = sched.num_slots
 
     def init_peers(leaf):
+        if associated_p:
+            return jnp.zeros((k,) + leaf.shape, leaf.dtype)
         return jnp.broadcast_to(leaf[None], (k,) + leaf.shape).astype(leaf.dtype)
 
     return WindowState(
         self_buf=jax.tree_util.tree_map(jnp.asarray, x),
         peer_bufs=jax.tree_util.tree_map(init_peers, x),
         spec=WindowSpec(schedule=sched, name=name),
+        assoc_self=jnp.ones(()) if associated_p else None,
+        assoc_peers=jnp.zeros((k,)) if associated_p else None,
     )
+
+
+def win_associated_p(state: WindowState) -> jnp.ndarray:
+    """The window's associated push-sum scalar ``p`` (reference: the
+    associated-p readback)."""
+    if state.assoc_self is None:
+        raise ValueError(
+            f"window {state.spec.name!r} was created without associated_p")
+    return state.assoc_self
 
 
 def win_free(state: WindowState) -> None:
@@ -117,8 +149,26 @@ def win_free(state: WindowState) -> None:
 
 
 def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
-             backend: str = "auto") -> WindowState:
+             backend: str = "auto",
+             assoc_payload=None) -> WindowState:
     sched = state.spec.schedule
+    mask = _slot_mask(sched, axis_name)
+
+    def per_leaf(peers, leaf):
+        new_slots = []
+        for k, perm in enumerate(sched.perms):
+            recvd = lax.ppermute(leaf, axis_name, perm)
+            slot = peers[k] + recvd if accumulate else recvd
+            # Slots with no in-edge this rank got zeros from the permute:
+            # keep the old buffer there.
+            new_slots.append(jnp.where(mask[k], slot, peers[k]))
+        return jnp.stack(new_slots) if new_slots else peers
+
+    new_assoc = state.assoc_peers
+    if state.assoc_self is not None and assoc_payload is not None:
+        # the associated scalar rides the portable path on every backend —
+        # a () payload is latency noise next to the tensor transfer
+        new_assoc = per_leaf(state.assoc_peers, assoc_payload)
 
     if backend == "pallas":
         from bluefog_tpu.ops import pallas_gossip
@@ -134,22 +184,12 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
             )
             for idx, (peers, leaf) in enumerate(zip(peer_leaves, payload_leaves))
         ]
-        return state.replace(peer_bufs=jax.tree_util.tree_unflatten(treedef, outs))
-
-    mask = _slot_mask(sched, axis_name)
-
-    def per_leaf(peers, leaf):
-        new_slots = []
-        for k, perm in enumerate(sched.perms):
-            recvd = lax.ppermute(leaf, axis_name, perm)
-            slot = peers[k] + recvd if accumulate else recvd
-            # Slots with no in-edge this rank got zeros from the permute:
-            # keep the old buffer there.
-            new_slots.append(jnp.where(mask[k], slot, peers[k]))
-        return jnp.stack(new_slots) if new_slots else peers
+        return state.replace(
+            peer_bufs=jax.tree_util.tree_unflatten(treedef, outs),
+            assoc_peers=new_assoc)
 
     new_peers = jax.tree_util.tree_map(per_leaf, state.peer_bufs, payload)
-    return state.replace(peer_bufs=new_peers)
+    return state.replace(peer_bufs=new_peers, assoc_peers=new_assoc)
 
 
 def _weighted(dst_weight):
@@ -181,9 +221,20 @@ def win_put(
     fractions — the reference's per-call ``dst_weights``).  The destination is
     not involved until it chooses to ``win_update``.  ``backend='pallas'``
     performs the transfer as a genuine one-sided RDMA on TPU slices.
+
+    Associated-p windows: the scalar ``dst_weight * p`` ships alongside.
+    Mass consistency requires the tensor shipped to be the window's tracked
+    state — pass ``x=None`` (ships ``self_buf``, the safe default) or
+    ``win_sync`` the value in first; shipping an unrelated tensor silently
+    desynchronizes the (x, p) recursions and biases ``self_buf / p``.
     """
+    if x is None:
+        x = state.self_buf
     payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
-    return _deliver(state, payload, axis_name, accumulate=False, backend=backend)
+    assoc = (None if state.assoc_self is None
+             else _weighted(dst_weight)(state.assoc_self))
+    return _deliver(state, payload, axis_name, accumulate=False,
+                    backend=backend, assoc_payload=assoc)
 
 
 def win_accumulate(
@@ -195,15 +246,22 @@ def win_accumulate(
     backend: str = "auto",
 ) -> WindowState:
     """Like :func:`win_put` but adds into the destination buffer
-    (``MPI_Accumulate(MPI_SUM)`` semantics)."""
+    (``MPI_Accumulate(MPI_SUM)`` semantics).  The associated-p mass caveat in
+    :func:`win_put` applies: pass ``x=None`` to ship ``self_buf``."""
+    if x is None:
+        x = state.self_buf
     payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
-    return _deliver(state, payload, axis_name, accumulate=True, backend=backend)
+    assoc = (None if state.assoc_self is None
+             else _weighted(dst_weight)(state.assoc_self))
+    return _deliver(state, payload, axis_name, accumulate=True,
+                    backend=backend, assoc_payload=assoc)
 
 
 def win_get(state: WindowState, axis_name: str) -> WindowState:
     """Pull each in-neighbor's *published* value (their ``self_buf``) into the
     corresponding landing slot (one-sided read)."""
-    return _deliver(state, state.self_buf, axis_name, accumulate=False)
+    return _deliver(state, state.self_buf, axis_name, accumulate=False,
+                    assoc_payload=state.assoc_self)
 
 
 def win_update(
@@ -239,7 +297,11 @@ def win_update(
         return out.astype(self_leaf.dtype)
 
     out = jax.tree_util.tree_map(one, state.self_buf, state.peer_bufs)
-    return out, state.replace(self_buf=out)
+    new_state = state.replace(self_buf=out)
+    if state.assoc_self is not None:
+        new_state = new_state.replace(
+            assoc_self=one(state.assoc_self, state.assoc_peers))
+    return out, new_state
 
 
 def win_update_then_collect(state: WindowState, axis_name: str):
@@ -263,7 +325,12 @@ def win_update_then_collect(state: WindowState, axis_name: str):
 
     out = jax.tree_util.tree_map(one, state.self_buf, state.peer_bufs)
     zeroed = jax.tree_util.tree_map(jnp.zeros_like, state.peer_bufs)
-    return out, state.replace(self_buf=out, peer_bufs=zeroed)
+    new_state = state.replace(self_buf=out, peer_bufs=zeroed)
+    if state.assoc_self is not None:
+        new_state = new_state.replace(
+            assoc_self=one(state.assoc_self, state.assoc_peers),
+            assoc_peers=jnp.zeros_like(state.assoc_peers))
+    return out, new_state
 
 
 def win_sync(state: WindowState, x=None) -> WindowState:

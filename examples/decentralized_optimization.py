@@ -58,33 +58,33 @@ def grad(A, b, x):
 
 
 def push_sum(n, A, b, steps, lr):
-    """Push-sum subgradient method on the directed ring (win_accumulate)."""
+    """Push-sum subgradient method on the directed ring (win_accumulate with
+    the associated push-sum scalar — the reference's win-ops-with-associated-p
+    mode: the weight ``p`` rides every transfer automatically)."""
     topo = RingGraph(n, connect_style=1)
     sched = build_schedule(topo)
 
     def body(A_blk, b_blk):
         Ar, br = A_blk[0], b_blk[0]
-        x = jnp.zeros((DIM,))
-        w = jnp.ones(())
-        wx_win = W.win_create(jnp.zeros_like(x), sched, "bf")
-        w_win = W.win_create(jnp.zeros_like(w), sched, "bf")
+        win = W.win_create(jnp.zeros((DIM,)), sched, "bf", associated_p=True)
 
-        def step(carry, t):
-            x, w, wx_win, w_win = carry
-            z = x / jnp.maximum(w, 1e-12)       # de-biased estimate
+        def step(win, t):
+            x, p = win.self_buf, W.win_associated_p(win)
+            z = x / jnp.maximum(p, 1e-12)       # de-biased estimate
             lr_t = lr / jnp.sqrt(1.0 + t / 100.0)  # diminishing step: exact limit
-            x = x - lr_t * grad(Ar, br, z) * w  # scaled subgradient step
-            # send half the (value, weight) mass to the out-neighbor
-            wx_win2 = W.win_accumulate(wx_win, x * 0.5, "bf")
-            w_win2 = W.win_accumulate(w_win, w * 0.5, "bf")
-            gx, wx_win3 = W.win_update_then_collect(wx_win2, "bf")
-            gw, w_win3 = W.win_update_then_collect(w_win2, "bf")
-            wx_win3 = wx_win3.replace(self_buf=jnp.zeros_like(x))
-            w_win3 = w_win3.replace(self_buf=jnp.zeros_like(w))
-            return (x * 0.5 + gx, w * 0.5 + gw, wx_win3, w_win3), None
+            x = x - lr_t * grad(Ar, br, z) * p  # scaled subgradient step
+            win = W.win_sync(win, x)            # republish post-gradient mass
+            # send half the (value, p) mass to the out-neighbor — p ships
+            # automatically with the same dst_weight
+            win = W.win_accumulate(win, None, "bf", dst_weight=0.5)
+            win = win.replace(self_buf=0.5 * win.self_buf,
+                              assoc_self=0.5 * win.assoc_self)
+            _, win = W.win_update_then_collect(win, "bf")
+            return win, None
 
-        (x, w, _, _), _ = lax.scan(step, (x, w, wx_win, w_win), jnp.arange(steps))
-        return (x / jnp.maximum(w, 1e-12))[None]
+        win, _ = lax.scan(step, win, jnp.arange(steps))
+        p = W.win_associated_p(win)
+        return (win.self_buf / jnp.maximum(p, 1e-12))[None]
 
     return body
 

@@ -9,7 +9,8 @@ import numpy as np
 import pytest
 
 import bluefog_tpu as bf
-from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph
+from bluefog_tpu.ops import windows as ops
+from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph, build_schedule
 
 N = 8
 
@@ -220,3 +221,83 @@ def test_win_mutex_serializes_host_ops():
         with bf.win_mutex("m"):
             pass
     bf.win_free("m")
+
+
+class TestAssociatedP:
+    """Associated push-sum scalar (reference win-ops-with-associated-p mode,
+    SURVEY.md §2.1): p rides every transfer with the tensor's weights, and
+    x/p converges to the true average on directed graphs."""
+
+    def test_requires_flag(self):
+        bf.init(topology=RingGraph(N))
+        sched = build_schedule(RingGraph(N))
+        st = ops.win_create(jnp.zeros((3,)), sched, "bf")
+        with pytest.raises(ValueError):
+            ops.win_associated_p(st)
+
+    def test_push_sum_converges_directed(self):
+        """Directed one-way ring (column-substochastic without correction):
+        plain averaging is biased; x/p recovers the exact mean."""
+        from bluefog_tpu.topology import RingGraph as RG
+
+        bf.init(topology=RG(N, connect_style=1))
+        sched = build_schedule(RG(N, connect_style=1))
+
+        def body(x0_blk):
+            x0 = x0_blk[0]
+            st = ops.win_create(jnp.zeros_like(x0), sched, "bf",
+                                associated_p=True)
+            # publish initial mass: self buffer holds x, p starts at 1
+            st = ops.win_sync(st, x0)
+
+            def step(st, _):
+                out_deg = 1  # one out-neighbor on the directed ring
+                frac = 1.0 / (out_deg + 1)
+                st = ops.win_accumulate(st, None, "bf", dst_weight=frac)
+                # keep frac of own mass (x and p shrink identically)
+                st = st.replace(
+                    self_buf=jax.tree_util.tree_map(
+                        lambda t: frac * t, st.self_buf),
+                    assoc_self=frac * st.assoc_self)
+                x, st = ops.win_update_then_collect(st, "bf")
+                return st, None
+
+            st, _ = jax.lax.scan(step, st, jnp.arange(200))
+            p = ops.win_associated_p(st)
+            return (st.self_buf / p)[None], p[None]
+
+        ctx = bf.get_context()
+        from jax.sharding import PartitionSpec as P
+
+        from bluefog_tpu.parallel.api import shard_map as smap
+
+        x0 = rank_values((4,))
+        ratio, p = jax.jit(smap(
+            body, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+            out_specs=(P(ctx.axis_name),) * 2, check_vma=False))(x0)
+        true_mean = np.mean(np.arange(N))
+        np.testing.assert_allclose(np.asarray(ratio), true_mean, atol=1e-3)
+        # mass conservation: sum of p over ranks stays n
+        np.testing.assert_allclose(np.asarray(p).sum(), N, rtol=1e-5)
+
+    def test_win_update_merges_p_with_same_weights(self):
+        bf.init(topology=RingGraph(N))
+        sched = build_schedule(RingGraph(N))
+
+        def body(x_blk):
+            x = x_blk[0]
+            st = ops.win_create(x, sched, "bf", associated_p=True)
+            st = ops.win_put(st, x, "bf")      # also ships p = 1
+            out, st = ops.win_update(st, "bf")
+            return ops.win_associated_p(st)[None]
+
+        from jax.sharding import PartitionSpec as P
+
+        from bluefog_tpu.parallel.api import shard_map as smap
+
+        ctx = bf.get_context()
+        p = jax.jit(smap(
+            body, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+            out_specs=P(ctx.axis_name), check_vma=False))(rank_values((2,)))
+        # weights: 1/3 self + 1/3 + 1/3 from two neighbors, all p == 1
+        np.testing.assert_allclose(np.asarray(p), 1.0, atol=1e-6)
