@@ -39,15 +39,58 @@ __all__ = [
 _NEG_INF = -1e30  # large finite negative: avoids -inf NaN traps in exp
 
 
+def _flash_eligible(q, k, causal, q_offset, k_offset) -> bool:
+    """Static eligibility check for the fused TPU flash kernel.
+
+    The Pallas kernel (``jax.experimental.pallas.ops.tpu.flash_attention``)
+    needs: a TPU backend, sequence length a multiple of its 128-row block,
+    equal q/k lengths, and — because its causal mask is the standard aligned
+    one — *static* offsets with ``q_offset == k_offset`` when causal.
+    """
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    if not (isinstance(q_offset, int) and isinstance(k_offset, int)):
+        return False
+    if causal and q_offset != k_offset:
+        return False
+    t_q, t_k = q.shape[1], k.shape[1]
+    return t_q == t_k and t_q >= 128 and t_q % 128 == 0 and q.shape[-1] >= 32
+
+
 def local_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
-                    q_offset=0, k_offset=0):
+                    q_offset=0, k_offset=0, backend: str = "auto"):
     """Plain softmax attention on local blocks (also the Ulysses inner step).
 
     Shapes: ``q (B, Tq, H, D)``, ``k/v (B, Tk, H, D)`` → ``(B, Tq, H, D)``.
     ``q_offset``/``k_offset`` are the *global* positions of the first query /
     key row, used for causal masking of shifted blocks (may be traced).
+
+    ``backend``: ``'dense'`` materializes the (Tq, Tk) scores (portable);
+    ``'flash'`` forces the fused Pallas TPU kernel (O(T) memory, fwd+bwd);
+    ``'auto'`` picks flash whenever :func:`_flash_eligible` allows.
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    eligible = _flash_eligible(q, k, causal, q_offset, k_offset)
+    if backend == "flash" and not eligible:
+        raise ValueError(
+            "backend='flash' requires a TPU backend, Tq == Tk with T a "
+            "multiple of 128, head_dim >= 32, and static equal offsets when "
+            f"causal; got backend={jax.default_backend()!r}, "
+            f"Tq={q.shape[1]}, Tk={k.shape[1]}, D={q.shape[-1]}, "
+            f"causal={causal}, offsets=({q_offset}, {k_offset}) — the Pallas "
+            "kernel has no offset mask, so forcing it here would be "
+            "silently wrong")
+    use_flash = backend == "flash" or (backend == "auto" and eligible)
+    if use_flash:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _flash)
+
+        # kernel layout is (B, H, T, D)
+        out = _flash(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal, sm_scale=scale)
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -138,6 +181,7 @@ def all_to_all_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    backend: str = "auto",
 ):
     """Ulysses-style sequence parallelism: reshard seq→heads, attend, reshard
     back.
@@ -160,5 +204,6 @@ def all_to_all_attention(
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = local_attention(qf, kf, vf, causal=causal, scale=scale)
+    out = local_attention(qf, kf, vf, causal=causal, scale=scale,
+                          backend=backend)
     return heads_to_seq(out)

@@ -207,6 +207,18 @@ def _weighted(dst_weight):
     return apply
 
 
+def _prepare_payload(state: WindowState, x, dst_weight):
+    """Shared put/accumulate preamble: ``x=None`` ships the tracked
+    ``self_buf`` (the associated-p mass-safe path); the associated scalar is
+    weighted identically."""
+    if x is None:
+        x = state.self_buf
+    payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
+    assoc = (None if state.assoc_self is None
+             else _weighted(dst_weight)(state.assoc_self))
+    return payload, assoc
+
+
 def win_put(
     state: WindowState,
     x,
@@ -228,11 +240,7 @@ def win_put(
     ``win_sync`` the value in first; shipping an unrelated tensor silently
     desynchronizes the (x, p) recursions and biases ``self_buf / p``.
     """
-    if x is None:
-        x = state.self_buf
-    payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
-    assoc = (None if state.assoc_self is None
-             else _weighted(dst_weight)(state.assoc_self))
+    payload, assoc = _prepare_payload(state, x, dst_weight)
     return _deliver(state, payload, axis_name, accumulate=False,
                     backend=backend, assoc_payload=assoc)
 
@@ -248,11 +256,7 @@ def win_accumulate(
     """Like :func:`win_put` but adds into the destination buffer
     (``MPI_Accumulate(MPI_SUM)`` semantics).  The associated-p mass caveat in
     :func:`win_put` applies: pass ``x=None`` to ship ``self_buf``."""
-    if x is None:
-        x = state.self_buf
-    payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
-    assoc = (None if state.assoc_self is None
-             else _weighted(dst_weight)(state.assoc_self))
+    payload, assoc = _prepare_payload(state, x, dst_weight)
     return _deliver(state, payload, axis_name, accumulate=True,
                     backend=backend, assoc_payload=assoc)
 
