@@ -29,6 +29,7 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False              # jax.checkpoint each encoder layer
 
     @staticmethod
     def large() -> "BertConfig":
@@ -107,8 +108,10 @@ class BertEncoder(nn.Module):
                              name="typ")(token_type_ids)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        layer_cls = (nn.remat(EncoderLayer, static_argnums=(3,))
+                     if cfg.remat else EncoderLayer)
         for i in range(cfg.num_layers):
-            x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
         if self.num_classes is None:
             return x
         pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=jnp.float32, name="pooler")(x[:, 0]))

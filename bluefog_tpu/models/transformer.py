@@ -37,6 +37,9 @@ class GPTConfig:
     mlp_ratio: int = 4
     max_position: int = 8192
     dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False              # rematerialize each block's activations
+    # (jax.checkpoint): backward recomputes the block instead of storing its
+    # intermediates — O(sqrt-ish) HBM for long sequences at ~1/3 extra FLOPs
 
     @staticmethod
     def small() -> "GPTConfig":
@@ -108,8 +111,9 @@ class TransformerLM(nn.Module):
                      name="tok")(tokens)
         x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
                          name="pos")(positions)
+        block_cls = nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
         for i in range(cfg.num_layers):
-            x = Block(cfg, mlp=self.mlp, name=f"block_{i}")(x, attn_fn)
+            x = block_cls(cfg, mlp=self.mlp, name=f"block_{i}")(x, attn_fn)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False,
                         name="lm_head")(x)

@@ -1,5 +1,7 @@
 """Model-zoo sanity tests: shapes, dtypes, parameter counts."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -74,3 +76,55 @@ def test_bert_attention_mask():
     o1 = m.apply(v, ids, attention_mask=mask_full)
     o2 = m.apply(v, ids, attention_mask=mask_half)
     assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+class TestRemat:
+    """cfg.remat wraps blocks in jax.checkpoint: identical outputs and
+    gradients, less saved-activation memory (the HBM lever — SURVEY.md §7
+    design stance / task brief)."""
+
+    def test_transformer_remat_matches(self):
+        import optax
+
+        from bluefog_tpu.models.transformer import GPTConfig, TransformerLM
+
+        toks = jnp.zeros((2, 16), jnp.int32).at[:, 3].set(5)
+        lm = TransformerLM(GPTConfig.tiny())
+        lm_r = TransformerLM(
+            dataclasses.replace(GPTConfig.tiny(), remat=True))
+        params = lm.init(jax.random.PRNGKey(0), toks)
+
+        def loss(m):
+            def f(p):
+                lg = m.apply(p, toks)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    lg, jnp.roll(toks, -1, -1)).mean()
+            return f
+
+        l0, g0 = jax.value_and_grad(loss(lm))(params)
+        l1, g1 = jax.value_and_grad(loss(lm_r))(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_bert_remat_matches(self):
+        from bluefog_tpu.models.bert import BertConfig, BertEncoder
+
+        ids = jnp.ones((2, 12), jnp.int32)
+        m = BertEncoder(BertConfig.tiny(), num_classes=3)
+        m_r = BertEncoder(
+            dataclasses.replace(BertConfig.tiny(), remat=True), num_classes=3)
+        params = m.init(jax.random.PRNGKey(0), ids)
+
+        def f(mm):
+            return lambda p: jnp.sum(mm.apply(p, ids) ** 2)
+
+        l0, g0 = jax.value_and_grad(f(m))(params)
+        l1, g1 = jax.value_and_grad(f(m_r))(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)

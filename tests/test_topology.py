@@ -251,3 +251,48 @@ def test_bad_weight_matrix_rejected():
         Topology(weights=np.array([[0.5, 0.2], [0.5, 0.5]]))
     with pytest.raises(ValueError):
         Topology(weights=np.array([[1.5, -0.5], [0.0, 1.0]]))
+
+
+class TestICIRingOrder:
+    """ici_ring_order must produce a path where consecutive devices are one
+    torus hop apart (SURVEY.md §7: ring -> ICI torus ring is exact)."""
+
+    class FakeDev:
+        def __init__(self, id, coords):
+            self.id = id
+            self.coords = coords
+
+    @staticmethod
+    def _torus_dist(a, b, dims):
+        return sum(min(abs(x - y), d - abs(x - y))
+                   for x, y, d in zip(a, b, dims))
+
+    @pytest.mark.parametrize("dims", [(4, 4), (2, 4), (4, 2, 2)])
+    def test_consecutive_are_adjacent(self, dims):
+        import itertools
+
+        from bluefog_tpu.topology.mapping import ici_ring_order
+
+        devs = [self.FakeDev(i, c) for i, c in
+                enumerate(itertools.product(*[range(d) for d in dims]))]
+        # scramble to prove the sort does the work
+        import random as _r
+        _r.Random(0).shuffle(devs)
+        ordered = ici_ring_order(devs)
+        assert len(ordered) == len(devs)
+        for a, b in zip(ordered, ordered[1:]):
+            assert self._torus_dist(a.coords, b.coords, dims) == 1, (
+                f"{a.coords} -> {b.coords} is not one hop")
+        # the closing edge matters too: ring topologies wrap last -> first
+        assert self._torus_dist(ordered[-1].coords, ordered[0].coords,
+                                dims) == 1
+
+    def test_no_coords_falls_back_to_id(self):
+        from bluefog_tpu.topology.mapping import ici_ring_order
+
+        class Bare:
+            def __init__(self, id):
+                self.id = id
+
+        devs = [Bare(3), Bare(0), Bare(2), Bare(1)]
+        assert [d.id for d in ici_ring_order(devs)] == [0, 1, 2, 3]
