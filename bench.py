@@ -11,6 +11,10 @@ Prints ONE JSON line:
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
    "unit": "images/sec/chip", "vs_baseline": R}
 
+If the requested per-chip batch exhausts device memory, the harness halves
+it and retries (recorded in the "batch" field) so the driver always gets a
+number.
+
 vs_baseline: ratio against the reference's per-GPU ResNet-50 throughput on
 V100 (BASELINE.md records no machine-readable number from the reference;
 360 img/s/V100 is the standard fp16 ResNet-50 figure for the 128xV100-era
@@ -38,16 +42,9 @@ from bluefog_tpu.topology import ExponentialTwoGraph
 V100_BASELINE_IMG_PER_SEC = 360.0
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=128, help="per-chip batch")
-    ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--warmup", type=int, default=5)
-    args = ap.parse_args()
-
+def run(args, batch: int) -> float:
+    """One full measurement at the given per-chip batch; img/s/chip."""
     n = len(jax.devices())
-    bf.init(topology=ExponentialTwoGraph(n))
     ctx = bf.get_context()
 
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
@@ -57,7 +54,7 @@ def main():
     )
 
     rng = jax.random.PRNGKey(0)
-    x0 = jnp.zeros((args.batch, args.image_size, args.image_size, 3), jnp.bfloat16)
+    x0 = jnp.zeros((batch, args.image_size, args.image_size, 3), jnp.bfloat16)
     variables = model.init(rng, x0, train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
@@ -65,9 +62,9 @@ def main():
     batch_stats = bf.rank_shard(bf.rank_stack(batch_stats))
 
     imgs = jax.random.normal(
-        jax.random.PRNGKey(1), (n, args.batch, args.image_size, args.image_size, 3)
+        jax.random.PRNGKey(1), (n, batch, args.image_size, args.image_size, 3)
     ).astype(jnp.bfloat16)
-    labels = jax.random.randint(jax.random.PRNGKey(2), (n, args.batch), 0, 1000)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (n, batch), 0, 1000)
     imgs, labels = bf.rank_shard(imgs), bf.rank_shard(labels)
 
     def init_opt(params_blk):
@@ -120,12 +117,44 @@ def main():
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    total_images = args.steps * args.batch * n
-    img_per_sec_per_chip = total_images / dt / n
+    total_images = args.steps * batch * n
+    return total_images / dt / n
+
+
+def _is_oom(e: Exception) -> bool:
+    msg = str(e).upper()
+    return ("RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg
+            or "ALLOCATION" in msg and "FAILED" in msg)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128, help="per-chip batch")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+
+    bf.init(topology=ExponentialTwoGraph(len(jax.devices())))
+
+    batch = args.batch
+    while True:
+        try:
+            img_per_sec_per_chip = run(args, batch)
+            break
+        except Exception as e:  # noqa: BLE001 — halve batch only on OOM
+            if _is_oom(e) and batch > 8:
+                print(f"bench: batch {batch} exhausted memory; retrying at "
+                      f"{batch // 2}", file=sys.stderr)
+                batch //= 2
+                continue
+            raise
+
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(img_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
+        "batch": batch,
         "vs_baseline": round(img_per_sec_per_chip / V100_BASELINE_IMG_PER_SEC, 3),
     }))
 
