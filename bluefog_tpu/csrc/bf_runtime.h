@@ -64,6 +64,28 @@ void bf_clear(int handle);      // forget a completed handle
 int bf_wait_all(int timeout_ms);  // wait for every pending handle
 int bf_pending_count();
 
+// ---------------------------------------------------------------- windows --
+// Passive-target landing buffers (windows.cc): the host-memory half of the
+// one-sided window story.  A window owns a self buffer plus n_slots landing
+// slots (one per in-neighbor, as in the reference's WinTorchStorageManager);
+// writers deposit (put/accumulate) without any receiver involvement, and
+// readers consume whenever they choose.  dtype: 0 = f32, 1 = f64.
+int bf_win_create(const char* name, int n_slots, long long n_elems, int dtype);
+int bf_win_exists(const char* name);
+int bf_win_free(const char* name);
+void bf_win_free_all();
+// accumulate=0 replaces (MPI_Put), =1 adds (MPI_Accumulate MPI_SUM).
+// Returns the slot's new deposit count, <0 on error.
+long long bf_win_deposit(const char* name, int slot, const void* data,
+                         long long n_elems, int accumulate);
+// Returns deposits since the last consuming read (0 = stale); consume=1
+// zero-fills after reading so accumulated mass is consumed exactly once.
+long long bf_win_read(const char* name, int slot, void* out, long long n_elems,
+                      int consume);
+int bf_win_set_self(const char* name, const void* data, long long n_elems);
+int bf_win_read_self(const char* name, void* out, long long n_elems);
+int bf_win_num_slots(const char* name);
+
 }  // extern "C"
 
 #endif  // BF_RUNTIME_H_

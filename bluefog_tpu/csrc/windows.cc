@@ -1,0 +1,185 @@
+// Passive-target window table: host-memory landing buffers for one-sided ops.
+//
+// Reference parity (upstream-relative, SURVEY.md §2.1/§3.4):
+//   * bluefog/torch/mpi_win_ops.cc WinTorchStorageManager — per-tensor self
+//     buffer + one landing buffer per in-neighbor, backed by MPI_Win memory;
+//   * bluefog/common/mpi_controller.cc WinPut/WinAccumulate/WinUpdate —
+//     MPI_Put/MPI_Accumulate land with NO receiver involvement; the receiver
+//     merges whatever has arrived whenever it chooses.
+//
+// This is the host half of the TPU build's window story.  Device-side
+// (intra-slice) one-sided transfers ride Pallas async remote DMA
+// (ops/pallas_gossip.py); across processes/slices the transport is the
+// coordination service or DCN, and THIS table is the landing zone each
+// process exposes.  Ranks running at different speeds deposit into and
+// consume from these buffers with no rendezvous — the property the SPMD
+// ppermute path cannot express (VERDICT r1, missing #1).
+//
+// Concurrency design:
+//   * per-slot mutex, held only for the memcpy/add — writers never wait for
+//     readers to *run*, only for a bounded copy (MPI implementations
+//     serialize accumulates on the target window the same way);
+//   * deposits carry a version count; readers see how many deposits landed
+//     since their last consume (staleness is observable, as with
+//     MPI_Win_flush bookkeeping);
+//   * consume=1 zero-fills after read — push-sum mass is consumed exactly
+//     once even when reader and writers race (swap under the slot lock).
+//
+// Dtypes: f32 / f64 accumulate natively.  Low-precision tensors convert on
+// the Python side (same disposition as the reference's half.h custom-sum).
+
+#include "bf_runtime.h"
+
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::mutex mu;
+  std::vector<unsigned char> buf;
+  long long deposits = 0;  // total deposits ever (version)
+  long long fresh = 0;     // deposits since last consume
+};
+
+struct Window {
+  int dtype;          // 0 = f32, 1 = f64
+  long long n_elems;
+  size_t nbytes;
+  std::mutex self_mu;
+  std::vector<unsigned char> self_buf;
+  std::vector<std::unique_ptr<Slot>> slots;
+};
+
+std::mutex g_table_mu;
+std::unordered_map<std::string, std::shared_ptr<Window>> g_table;
+
+std::shared_ptr<Window> Find(const char* name) {
+  std::lock_guard<std::mutex> lock(g_table_mu);
+  auto it = g_table.find(name ? name : "");
+  return it == g_table.end() ? nullptr : it->second;
+}
+
+size_t ElemSize(int dtype) { return dtype == 1 ? 8 : 4; }
+
+template <typename T>
+void AddInto(unsigned char* dst, const unsigned char* src, long long n) {
+  T* d = reinterpret_cast<T*>(dst);
+  const T* s = reinterpret_cast<const T*>(src);
+  for (long long i = 0; i < n; ++i) d[i] += s[i];
+}
+
+}  // namespace
+
+extern "C" {
+
+int bf_win_create(const char* name, int n_slots, long long n_elems,
+                  int dtype) {
+  if (name == nullptr || n_slots < 0 || n_elems <= 0 ||
+      (dtype != 0 && dtype != 1)) {
+    return -1;
+  }
+  auto w = std::make_shared<Window>();
+  w->dtype = dtype;
+  w->n_elems = n_elems;
+  w->nbytes = static_cast<size_t>(n_elems) * ElemSize(dtype);
+  w->self_buf.assign(w->nbytes, 0);
+  w->slots.reserve(n_slots);
+  for (int k = 0; k < n_slots; ++k) {
+    auto s = std::make_unique<Slot>();
+    s->buf.assign(w->nbytes, 0);
+    w->slots.push_back(std::move(s));
+  }
+  std::lock_guard<std::mutex> lock(g_table_mu);
+  if (g_table.count(name)) return -2;  // already exists
+  g_table.emplace(name, std::move(w));
+  return 0;
+}
+
+int bf_win_exists(const char* name) { return Find(name) ? 1 : 0; }
+
+int bf_win_free(const char* name) {
+  std::lock_guard<std::mutex> lock(g_table_mu);
+  return g_table.erase(name ? name : "") ? 0 : -1;
+}
+
+void bf_win_free_all() {
+  std::lock_guard<std::mutex> lock(g_table_mu);
+  g_table.clear();
+}
+
+// Deposit into a landing slot.  accumulate=0 replaces (MPI_Put), =1 adds
+// (MPI_Accumulate with MPI_SUM).  Returns the slot's new version, <0 error.
+long long bf_win_deposit(const char* name, int slot, const void* data,
+                         long long n_elems, int accumulate) {
+  auto w = Find(name);
+  if (!w || slot < 0 || slot >= static_cast<int>(w->slots.size()) ||
+      n_elems != w->n_elems || data == nullptr) {
+    return -1;
+  }
+  Slot& s = *w->slots[slot];
+  std::lock_guard<std::mutex> lock(s.mu);
+  const unsigned char* src = static_cast<const unsigned char*>(data);
+  if (accumulate) {
+    if (w->dtype == 1) {
+      AddInto<double>(s.buf.data(), src, n_elems);
+    } else {
+      AddInto<float>(s.buf.data(), src, n_elems);
+    }
+  } else {
+    std::memcpy(s.buf.data(), src, w->nbytes);
+  }
+  ++s.deposits;
+  ++s.fresh;
+  return s.deposits;
+}
+
+// Read a landing slot into out.  consume=1 zero-fills after the read (and
+// resets the freshness counter) so accumulated push-sum mass is consumed
+// exactly once.  Returns the number of deposits since the last consuming
+// read (0 = nothing new landed; the caller decides how to treat staleness),
+// <0 on error.
+long long bf_win_read(const char* name, int slot, void* out, long long n_elems,
+                      int consume) {
+  auto w = Find(name);
+  if (!w || slot < 0 || slot >= static_cast<int>(w->slots.size()) ||
+      n_elems != w->n_elems || out == nullptr) {
+    return -1;
+  }
+  Slot& s = *w->slots[slot];
+  std::lock_guard<std::mutex> lock(s.mu);
+  std::memcpy(out, s.buf.data(), w->nbytes);
+  long long fresh = s.fresh;
+  if (consume) {
+    std::memset(s.buf.data(), 0, w->nbytes);
+    s.fresh = 0;
+  }
+  return fresh;
+}
+
+int bf_win_set_self(const char* name, const void* data, long long n_elems) {
+  auto w = Find(name);
+  if (!w || n_elems != w->n_elems || data == nullptr) return -1;
+  std::lock_guard<std::mutex> lock(w->self_mu);
+  std::memcpy(w->self_buf.data(), data, w->nbytes);
+  return 0;
+}
+
+int bf_win_read_self(const char* name, void* out, long long n_elems) {
+  auto w = Find(name);
+  if (!w || n_elems != w->n_elems || out == nullptr) return -1;
+  std::lock_guard<std::mutex> lock(w->self_mu);
+  std::memcpy(out, w->self_buf.data(), w->nbytes);
+  return 0;
+}
+
+int bf_win_num_slots(const char* name) {
+  auto w = Find(name);
+  return w ? static_cast<int>(w->slots.size()) : -1;
+}
+
+}  // extern "C"

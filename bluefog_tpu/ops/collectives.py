@@ -58,7 +58,7 @@ __all__ = [
 ]
 
 
-def fuse_apply(fn, x):
+def fuse_apply(fn, x, *, threshold_bytes: int = 8 << 20):
     """Tensor fusion: run a tree-polymorphic collective on ONE flat buffer
     per dtype instead of per-leaf.
 
@@ -70,6 +70,12 @@ def fuse_apply(fn, x):
     the tree into a single 1-D buffer per dtype turns that into one large
     bandwidth-bound transfer per slot, then splits back.
 
+    Leaves at or above ``threshold_bytes`` ship unfused: a large tensor is
+    already one bandwidth-bound transfer, so concatenating it buys no latency
+    and costs a full transient copy of the leaf (concat + split) in HBM —
+    the same reason the reference's fusion buffer has a size cutoff.  Set
+    ``threshold_bytes=None`` to fuse everything.
+
     ``fn`` must be shape-polymorphic and leaf-wise (all collectives here
     are).  Leaves keep their dtypes: each dtype group is fused separately, so
     mixed bf16/f32 trees behave exactly as unfused.
@@ -77,15 +83,28 @@ def fuse_apply(fn, x):
     leaves, treedef = jax.tree_util.tree_flatten(x)
     if len(leaves) <= 1:
         return fn(x)
-    groups: dict = {}  # dtype str -> leaf indices
+    big = set()
+    if threshold_bytes is not None:
+        for i, leaf in enumerate(leaves):
+            a = jnp.asarray(leaf)
+            if a.size * a.dtype.itemsize >= threshold_bytes:
+                big.add(i)
+    groups: dict = {}  # dtype str -> small-leaf indices
     for i, leaf in enumerate(leaves):
-        groups.setdefault(str(jnp.asarray(leaf).dtype), []).append(i)
+        if i not in big:
+            groups.setdefault(str(jnp.asarray(leaf).dtype), []).append(i)
     bufs = {
         dt: jnp.concatenate([jnp.asarray(leaves[i]).ravel() for i in idxs])
         for dt, idxs in groups.items()
     }
-    out_bufs = fn(bufs)
+    # One fn call over {fused buffers} ∪ {large leaves}: fn is leaf-wise, so
+    # large leaves ride the same collective unfused, with no extra copy.
+    out_all = fn({"fused": bufs,
+                  "big": {str(i): leaves[i] for i in sorted(big)}})
+    out_bufs, out_big = out_all["fused"], out_all["big"]
     out = [None] * len(leaves)
+    for i in big:
+        out[i] = out_big[str(i)]
     for dt, idxs in groups.items():
         buf, off = out_bufs[dt], 0
         for i in idxs:

@@ -211,6 +211,15 @@ def _prepare_payload(state: WindowState, x, dst_weight):
     """Shared put/accumulate preamble: ``x=None`` ships the tracked
     ``self_buf`` (the associated-p mass-safe path); the associated scalar is
     weighted identically."""
+    if x is not None and state.assoc_self is not None:
+        # Shipping a tensor that is not the window's tracked state would
+        # silently desynchronize the (x, p) push-sum recursion and bias
+        # self_buf / p — a convergence bug with no visible symptom.  Force
+        # callers through x=None (ships self_buf) or win_sync first.
+        raise ValueError(
+            f"window {state.spec.name!r} carries an associated push-sum "
+            "scalar; pass x=None (ships self_buf) or win_sync(state, x) "
+            "first so the (x, p) mass pair stays consistent")
     if x is None:
         x = state.self_buf
     payload = jax.tree_util.tree_map(_weighted(dst_weight), x)
@@ -236,9 +245,10 @@ def win_put(
 
     Associated-p windows: the scalar ``dst_weight * p`` ships alongside.
     Mass consistency requires the tensor shipped to be the window's tracked
-    state — pass ``x=None`` (ships ``self_buf``, the safe default) or
-    ``win_sync`` the value in first; shipping an unrelated tensor silently
-    desynchronizes the (x, p) recursions and biases ``self_buf / p``.
+    state — pass ``x=None`` (ships ``self_buf``) or ``win_sync`` the value in
+    first; an explicit ``x`` on an associated-p window raises, because
+    shipping an unrelated tensor silently desynchronizes the (x, p)
+    recursions and biases ``self_buf / p``.
     """
     payload, assoc = _prepare_payload(state, x, dst_weight)
     return _deliver(state, payload, axis_name, accumulate=False,

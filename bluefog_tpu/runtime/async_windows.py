@@ -1,0 +1,401 @@
+"""Genuinely asynchronous one-sided windows over the native host runtime.
+
+The portable ``ops/windows.py`` path expresses one-sided *dataflow* inside an
+SPMD program: both sides' programs contain the ppermute, so ranks advance in
+lockstep (the reference's NCCL-emulation disposition).  The reference's MPI
+backend is stronger — ``MPI_Put`` lands in the target's window with **no
+receiver involvement**, so ranks progress at different rates with no global
+barrier anywhere (upstream ``bluefog/common/mpi_controller.cc`` Win* +
+lock/flush epochs; SURVEY.md §3.4).
+
+This module reproduces that execution model on the TPU build's host runtime:
+
+- :class:`AsyncWindow` — a rank's landing zone, backed by the native window
+  table (``csrc/windows.cc``): per-slot locked buffers with deposit
+  (put/accumulate), consume-exactly-once reads, and deposit-count staleness
+  bookkeeping.  Within a host, "remote" writes are direct memory deposits
+  into the target rank's table entry (the shared-memory MPI disposition);
+  across processes the same deposit API is carried by a transport (the
+  coordination-service KV bridge in :mod:`bluefog_tpu.runtime.launch`, or
+  DCN); within a TPU slice the device-side analog is the Pallas remote-DMA
+  kernel (:mod:`bluefog_tpu.ops.pallas_gossip`).
+
+- :func:`run_async_pushsum` — the demonstration the SPMD path cannot
+  express: N rank-threads run push-sum with **rank-dependent step rates**
+  (deliberate compute skew), depositing weighted (x, p) mass into neighbors'
+  windows and consuming whatever has landed whenever they step.  Because
+  deposits accumulate and consumes are exactly-once, mass is conserved under
+  arbitrary interleaving, and every rank's ``x / p`` converges to the true
+  global mean despite the skew — the defining property of asynchronous
+  push-sum (Kempe et al.; the reference's ``DistributedWinPutOptimizer``
+  foundation).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bluefog_tpu.runtime import native
+from bluefog_tpu.topology.graphs import Topology
+
+__all__ = ["AsyncWindow", "run_async_pushsum", "PushSumReport"]
+
+_DTYPES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}
+
+
+class _PyWinTable:
+    """Pure-Python fallback mirroring ``csrc/windows.cc`` semantics
+    (BLUEFOG_TPU_NO_NATIVE / no C++ toolchain)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._wins: Dict[str, dict] = {}
+
+    def create(self, name, n_slots, n_elems, dtype):
+        with self._mu:
+            if name in self._wins:
+                return -2
+            self._wins[name] = {
+                "self": np.zeros(n_elems, dtype),
+                "self_mu": threading.Lock(),
+                "slots": [
+                    {"mu": threading.Lock(), "buf": np.zeros(n_elems, dtype),
+                     "deposits": 0, "fresh": 0}
+                    for _ in range(n_slots)
+                ],
+            }
+            return 0
+
+    def _get(self, name):
+        with self._mu:
+            return self._wins.get(name)
+
+    def free(self, name):
+        with self._mu:
+            return 0 if self._wins.pop(name, None) is not None else -1
+
+    def deposit(self, name, slot, arr, accumulate):
+        w = self._get(name)
+        if w is None or not (0 <= slot < len(w["slots"])):
+            return -1
+        s = w["slots"][slot]
+        with s["mu"]:
+            if accumulate:
+                s["buf"] += arr
+            else:
+                s["buf"][:] = arr
+            s["deposits"] += 1
+            s["fresh"] += 1
+            return s["deposits"]
+
+    def read(self, name, slot, consume):
+        w = self._get(name)
+        if w is None or not (0 <= slot < len(w["slots"])):
+            return None, -1
+        s = w["slots"][slot]
+        with s["mu"]:
+            out = s["buf"].copy()
+            fresh = s["fresh"]
+            if consume:
+                s["buf"][:] = 0
+                s["fresh"] = 0
+            return out, fresh
+
+    def set_self(self, name, arr):
+        w = self._get(name)
+        if w is None:
+            return -1
+        with w["self_mu"]:
+            w["self"][:] = arr
+        return 0
+
+    def read_self(self, name):
+        w = self._get(name)
+        if w is None:
+            return None
+        with w["self_mu"]:
+            return w["self"].copy()
+
+
+_py_table: Optional[_PyWinTable] = None
+_py_table_mu = threading.Lock()
+
+
+def _fallback() -> _PyWinTable:
+    global _py_table
+    with _py_table_mu:
+        if _py_table is None:
+            _py_table = _PyWinTable()
+        return _py_table
+
+
+class AsyncWindow:
+    """A rank's passive-target window: self buffer + one landing slot per
+    in-neighbor, living in process-global native memory so ANY thread (an
+    engine worker delivering a remote payload, a peer rank on the same host)
+    can deposit without this rank's participation.
+
+    Flat f32/f64 vectors; callers pack pytrees/low-precision leaves
+    themselves (the associated push-sum scalar is one extra trailing
+    element — see :func:`run_async_pushsum`).
+    """
+
+    def __init__(self, name: str, n_slots: int, n_elems: int,
+                 dtype=np.float32):
+        self.name = name
+        self.n_slots = n_slots
+        self.n_elems = int(n_elems)
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPES:
+            raise TypeError(f"AsyncWindow supports f32/f64, got {self.dtype}")
+        self._lib = native.load()
+        if self._lib is not None:
+            rc = self._lib.bf_win_create(
+                name.encode(), n_slots, self.n_elems, _DTYPES[self.dtype])
+            if rc == -2:
+                raise ValueError(f"window {name!r} already exists")
+            if rc != 0:
+                raise RuntimeError(f"bf_win_create({name!r}) failed: {rc}")
+        else:
+            rc = _fallback().create(name, n_slots, self.n_elems, self.dtype)
+            if rc == -2:
+                raise ValueError(f"window {name!r} already exists")
+
+    def _check(self, arr: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(arr, dtype=self.dtype).ravel()
+        if a.size != self.n_elems:
+            raise ValueError(f"size {a.size} != window n_elems {self.n_elems}")
+        return a
+
+    def deposit(self, slot: int, arr: np.ndarray, *,
+                accumulate: bool = True) -> int:
+        """Land a payload in ``slot`` (MPI_Accumulate when ``accumulate``,
+        MPI_Put otherwise).  Callable from any thread; never blocks on the
+        window's owner.  Returns the slot's deposit count."""
+        a = self._check(arr)
+        if self._lib is None:
+            v = _fallback().deposit(self.name, slot, a, accumulate)
+        else:
+            v = self._lib.bf_win_deposit(
+                self.name.encode(), slot, a.ctypes.data, self.n_elems,
+                1 if accumulate else 0)
+        if v < 0:
+            raise RuntimeError(f"deposit into {self.name!r}[{slot}] failed")
+        return int(v)
+
+    def read(self, slot: int, *, consume: bool = True
+             ) -> Tuple[np.ndarray, int]:
+        """Read a landing slot; ``consume`` zero-fills it afterwards (mass is
+        consumed exactly once).  Returns ``(value, deposits_since_last_
+        consume)`` — 0 fresh deposits means the content is stale."""
+        if self._lib is None:
+            out, fresh = _fallback().read(self.name, slot, consume)
+            if out is None:
+                raise RuntimeError(f"read of {self.name!r}[{slot}] failed")
+            return out, int(fresh)
+        out = np.empty(self.n_elems, self.dtype)
+        fresh = self._lib.bf_win_read(
+            self.name.encode(), slot, out.ctypes.data, self.n_elems,
+            1 if consume else 0)
+        if fresh < 0:
+            raise RuntimeError(f"read of {self.name!r}[{slot}] failed")
+        return out, int(fresh)
+
+    def set_self(self, arr: np.ndarray) -> None:
+        """Publish this rank's value (what passive ``win_get`` readers see)."""
+        a = self._check(arr)
+        if self._lib is None:
+            rc = _fallback().set_self(self.name, a)
+        else:
+            rc = self._lib.bf_win_set_self(self.name.encode(), a.ctypes.data,
+                                           self.n_elems)
+        if rc != 0:
+            raise RuntimeError(f"set_self of {self.name!r} failed")
+
+    def read_self(self) -> np.ndarray:
+        if self._lib is None:
+            out = _fallback().read_self(self.name)
+            if out is None:
+                raise RuntimeError(f"read_self of {self.name!r} failed")
+            return out
+        out = np.empty(self.n_elems, self.dtype)
+        if self._lib.bf_win_read_self(self.name.encode(), out.ctypes.data,
+                                      self.n_elems) != 0:
+            raise RuntimeError(f"read_self of {self.name!r} failed")
+        return out
+
+    def free(self) -> None:
+        if self._lib is None:
+            _fallback().free(self.name)
+        else:
+            self._lib.bf_win_free(self.name.encode())
+
+
+@dataclass
+class PushSumReport:
+    """Outcome of an async push-sum run."""
+
+    converged: bool
+    wall_time_s: float
+    steps_per_rank: List[int]
+    estimates: np.ndarray      # (n_ranks, n_elems)
+    true_mean: np.ndarray      # (n_elems,)
+    max_abs_err: float
+    total_mass: float          # sum of p over ranks; must stay == n_ranks
+
+
+def run_async_pushsum(
+    topology: Topology,
+    x0: np.ndarray,
+    *,
+    skew: Optional[Sequence[float]] = None,
+    tol: float = 1e-3,
+    timeout_s: float = 30.0,
+    name: str = "async_pushsum",
+    poll_interval_s: float = 0.002,
+) -> PushSumReport:
+    """Asynchronous push-sum over ``topology`` with deliberately skewed rank
+    step rates; returns once every rank's ``x / p`` is within ``tol`` of the
+    true mean (or the timeout expires).
+
+    Args:
+      topology: directed graph; rank r deposits to its out-neighbors.
+      x0: ``(n_ranks, n_elems)`` initial values; the target is their mean.
+      skew: per-rank extra sleep (seconds) per step — rank-dependent compute
+        time.  Default makes the slowest rank ~5x the fastest.
+      tol / timeout_s: convergence gate.
+
+    Protocol per rank step (no barriers anywhere):
+      1. consume own landing slots, folding received (x, p) mass in;
+      2. split mass: keep ``1/(out_deg+1)``, deposit the same fraction to
+         each out-neighbor's window (accumulate);
+      3. publish the current estimate; sleep ``skew[r]``.
+    A monitor thread watches the published estimates and raises the global
+    stop flag on convergence; ranks then drain any remaining in-flight mass
+    so the mass-conservation invariant (sum p == n) holds exactly.
+    """
+    n = topology.size
+    x0 = np.asarray(x0, np.float64)
+    if x0.shape[0] != n:
+        raise ValueError(f"x0 leading dim {x0.shape[0]} != topology size {n}")
+    n_elems = int(np.prod(x0.shape[1:], dtype=np.int64)) if x0.ndim > 1 else 1
+    x0 = x0.reshape(n, n_elems)
+    true_mean = x0.mean(axis=0)
+
+    if skew is None:
+        skew = [poll_interval_s * (1.0 + 4.0 * r / max(n - 1, 1))
+                for r in range(n)]
+
+    in_nbrs = [list(topology.in_neighbors(r)) for r in range(n)]
+    out_nbrs = [list(topology.out_neighbors(r)) for r in range(n)]
+    # slot index of src in dst's window
+    slot_of = [{src: k for k, src in enumerate(in_nbrs[r])} for r in range(n)]
+
+    wins = [AsyncWindow(f"{name}:{r}", len(in_nbrs[r]), n_elems + 1,
+                        np.float64) for r in range(n)]
+
+    stop = threading.Event()
+    steps = [0] * n
+    estimates = x0.copy()
+    est_mu = threading.Lock()
+    errors: List[BaseException] = []
+
+    def rank_loop(r: int):
+        try:
+            x = x0[r].copy()
+            p = 1.0
+            frac = 1.0 / (len(out_nbrs[r]) + 1)
+            while not stop.is_set():
+                # 1. consume whatever landed (possibly nothing — stale is ok)
+                for k in range(len(in_nbrs[r])):
+                    buf, fresh = wins[r].read(k, consume=True)
+                    if fresh > 0:
+                        x += buf[:-1]
+                        p += buf[-1]
+                # 2. split mass outward — receivers need not be listening
+                payload = np.concatenate([x * frac, [p * frac]])
+                for j in out_nbrs[r]:
+                    wins[j].deposit(slot_of[j][r], payload, accumulate=True)
+                x *= frac
+                p *= frac
+                # 3. publish estimate, then rank-dependent "compute"
+                with est_mu:
+                    estimates[r] = x / p
+                steps[r] += 1
+                time.sleep(skew[r])
+            # drain: fold in any mass still in flight so sum(p) == n exactly
+            for k in range(len(in_nbrs[r])):
+                buf, fresh = wins[r].read(k, consume=True)
+                if fresh > 0:
+                    x += buf[:-1]
+                    p += buf[-1]
+            with est_mu:
+                estimates[r] = x / p
+            wins[r].set_self(np.concatenate([x, [p]]))
+        except BaseException as e:  # surfaced by the caller
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=rank_loop, args=(r,), daemon=True)
+               for r in range(n)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+
+    converged = False
+    while time.perf_counter() - t0 < timeout_s:
+        time.sleep(poll_interval_s * 5)
+        if errors:
+            break
+        with est_mu:
+            err = float(np.abs(estimates - true_mean).max())
+        # every rank must also have taken a few steps (no vacuous pass)
+        if err < tol and min(steps) >= 3:
+            converged = True
+            break
+    stop.set()
+    # A rank can be mid-sleep in its skew delay; give every thread time to
+    # wake, drain, and publish before auditing (freeing windows under a live
+    # thread would corrupt the mass audit and poison its next deposit).
+    join_budget = max(skew) * 2 + 5.0
+    for t in threads:
+        t.join(timeout=join_budget)
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError(
+            "async push-sum rank threads failed to stop within "
+            f"{join_budget:.1f}s; aborting without freeing windows")
+    wall = time.perf_counter() - t0
+
+    if errors:
+        for w in wins:
+            w.free()
+        raise errors[0]
+
+    # Mass invariant: self mass + anything deposited after a rank's final
+    # drain (threads are joined, so slot reads race with nothing).
+    total_mass = 0.0
+    for r in range(n):
+        total_mass += float(wins[r].read_self()[-1])
+        for k in range(len(in_nbrs[r])):
+            buf, fresh = wins[r].read(k, consume=False)
+            if fresh > 0:
+                total_mass += float(buf[-1])
+    with est_mu:
+        final_err = float(np.abs(estimates - true_mean).max())
+    report = PushSumReport(
+        converged=converged and final_err < 10 * tol,
+        wall_time_s=wall,
+        steps_per_rank=list(steps),
+        estimates=estimates.copy(),
+        true_mean=true_mean,
+        max_abs_err=final_err,
+        total_mass=total_mass,
+    )
+    for w in wins:
+        w.free()
+    return report

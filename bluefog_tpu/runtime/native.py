@@ -21,7 +21,7 @@ from typing import Callable, Optional
 from bluefog_tpu.utils import log
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
-_SOURCES = ("logging.cc", "timeline.cc", "engine.cc")
+_SOURCES = ("logging.cc", "timeline.cc", "engine.cc", "windows.cc")
 _LIB_PATH = os.path.join(_CSRC, "libbf_runtime.so")
 
 _lib = None
@@ -119,6 +119,35 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_wait_all.argtypes = [ctypes.c_int]
     lib.bf_wait_all.restype = ctypes.c_int
     lib.bf_pending_count.restype = ctypes.c_int
+
+    lib.bf_win_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int
+    ]
+    lib.bf_win_create.restype = ctypes.c_int
+    lib.bf_win_exists.argtypes = [ctypes.c_char_p]
+    lib.bf_win_exists.restype = ctypes.c_int
+    lib.bf_win_free.argtypes = [ctypes.c_char_p]
+    lib.bf_win_free.restype = ctypes.c_int
+    lib.bf_win_deposit.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_int
+    ]
+    lib.bf_win_deposit.restype = ctypes.c_longlong
+    lib.bf_win_read.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_int
+    ]
+    lib.bf_win_read.restype = ctypes.c_longlong
+    lib.bf_win_set_self.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong
+    ]
+    lib.bf_win_set_self.restype = ctypes.c_int
+    lib.bf_win_read_self.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong
+    ]
+    lib.bf_win_read_self.restype = ctypes.c_int
+    lib.bf_win_num_slots.argtypes = [ctypes.c_char_p]
+    lib.bf_win_num_slots.restype = ctypes.c_int
     return lib
 
 
@@ -146,6 +175,17 @@ def load() -> Optional[ctypes.CDLL]:
             except OSError as e:
                 log.warn("native runtime load failed: %s", e)
                 _lib = None
+            except AttributeError as e:
+                # A prebuilt .so with mtime newer than the sources (rsync -a,
+                # docker layer) can predate newly added symbols; rebuild once
+                # from source before giving up.
+                log.warn("stale native runtime (%s); rebuilding", e)
+                path = build(force=True)
+                try:
+                    _lib = _bind(ctypes.CDLL(path)) if path else None
+                except (OSError, AttributeError) as e2:
+                    log.warn("native runtime reload failed: %s", e2)
+                    _lib = None
         _lib_attempted = True
         return _lib
 

@@ -1,0 +1,166 @@
+"""Async passive-target windows (csrc/windows.cc + runtime/async_windows.py).
+
+The SPMD analog tests (test_windows.py) check one-sided *dataflow*; these
+check the genuinely asynchronous *execution model*: deposits land with no
+receiver involvement, mass is consumed exactly once under real thread
+interleaving, and skewed-rate push-sum converges (the reference's
+passive-target MPI RMA property — SURVEY.md §3.4)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.runtime import async_windows as aw
+from bluefog_tpu.runtime.async_windows import AsyncWindow, run_async_pushsum
+from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph
+
+_counter = [0]
+
+
+def fresh_name(prefix="t"):
+    _counter[0] += 1
+    return f"{prefix}:{_counter[0]}"
+
+
+class TestAsyncWindow:
+    def test_put_replaces_accumulate_adds(self):
+        w = AsyncWindow(fresh_name(), 2, 4)
+        w.deposit(0, np.ones(4), accumulate=False)
+        w.deposit(0, 2 * np.ones(4), accumulate=False)
+        out, fresh = w.read(0, consume=False)
+        np.testing.assert_array_equal(out, 2 * np.ones(4, np.float32))
+        assert fresh == 2
+        w.deposit(1, np.ones(4), accumulate=True)
+        w.deposit(1, np.ones(4), accumulate=True)
+        out, fresh = w.read(1, consume=False)
+        np.testing.assert_array_equal(out, 2 * np.ones(4, np.float32))
+        w.free()
+
+    def test_consume_is_exactly_once(self):
+        w = AsyncWindow(fresh_name(), 1, 3)
+        w.deposit(0, np.full(3, 5.0))
+        out, fresh = w.read(0, consume=True)
+        assert fresh == 1
+        np.testing.assert_array_equal(out, np.full(3, 5.0, np.float32))
+        out, fresh = w.read(0, consume=True)
+        assert fresh == 0  # stale: nothing landed since
+        np.testing.assert_array_equal(out, np.zeros(3, np.float32))
+        w.free()
+
+    def test_self_publish_roundtrip(self):
+        w = AsyncWindow(fresh_name(), 0, 4, np.float64)
+        w.set_self(np.arange(4.0))
+        np.testing.assert_array_equal(w.read_self(), np.arange(4.0))
+        w.free()
+
+    def test_duplicate_name_raises(self):
+        name = fresh_name()
+        w = AsyncWindow(name, 1, 2)
+        with pytest.raises(ValueError, match="already exists"):
+            AsyncWindow(name, 1, 2)
+        w.free()
+
+    def test_size_mismatch_raises(self):
+        w = AsyncWindow(fresh_name(), 1, 4)
+        with pytest.raises(ValueError, match="n_elems"):
+            w.deposit(0, np.ones(5))
+        w.free()
+
+    def test_concurrent_accumulate_conserves_mass(self):
+        """Many writers hammering one slot + a consuming reader: every unit
+        of deposited mass is counted exactly once."""
+        w = AsyncWindow(fresh_name(), 1, 8, np.float64)
+        n_writers, per_writer = 8, 200
+        total = np.zeros(8)
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def writer(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(per_writer):
+                v = rng.normal(size=8)
+                with lock:
+                    total[:] += v
+                w.deposit(0, v, accumulate=True)
+
+        got = np.zeros(8)
+
+        def reader():
+            while not stop.is_set():
+                buf, fresh = w.read(0, consume=True)
+                if fresh:
+                    got[:] += buf
+
+        threads = [threading.Thread(target=writer, args=(s,))
+                   for s in range(n_writers)]
+        rt = threading.Thread(target=reader)
+        rt.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        rt.join()
+        buf, fresh = w.read(0, consume=True)  # final drain
+        got += buf
+        np.testing.assert_allclose(got, total, rtol=1e-12)
+        w.free()
+
+
+class TestPyFallback:
+    """Same semantics with the native library unavailable."""
+
+    @pytest.fixture(autouse=True)
+    def no_native(self, monkeypatch):
+        monkeypatch.setattr(aw.native, "load", lambda: None)
+
+    def test_accumulate_and_consume(self):
+        w = AsyncWindow(fresh_name("py"), 1, 4)
+        assert w._lib is None
+        w.deposit(0, np.ones(4))
+        w.deposit(0, np.ones(4))
+        out, fresh = w.read(0, consume=True)
+        assert fresh == 2
+        np.testing.assert_array_equal(out, 2 * np.ones(4, np.float32))
+        _, fresh = w.read(0, consume=True)
+        assert fresh == 0
+        w.free()
+
+    def test_pushsum_converges_on_fallback(self):
+        topo = RingGraph(4)
+        x0 = np.arange(4.0).reshape(4, 1)
+        rep = run_async_pushsum(topo, x0, tol=1e-3, timeout_s=30.0,
+                                name=fresh_name("pyps"))
+        assert rep.converged
+        np.testing.assert_allclose(rep.total_mass, 4.0, atol=1e-9)
+
+
+class TestAsyncPushSum:
+    @pytest.mark.parametrize("topo_cls", [RingGraph, ExponentialTwoGraph])
+    def test_skewed_ranks_converge_to_mean(self, topo_cls):
+        n = 8
+        topo = topo_cls(n)
+        rng = np.random.default_rng(1)
+        x0 = rng.normal(size=(n, 6)) * 5.0
+        rep = run_async_pushsum(topo, x0, tol=1e-3, timeout_s=60.0,
+                                name=fresh_name(f"ps{topo_cls.__name__}"))
+        assert rep.converged, (
+            f"err={rep.max_abs_err} steps={rep.steps_per_rank}")
+        # rank-dependent skew must actually have happened
+        assert max(rep.steps_per_rank) >= 2 * min(rep.steps_per_rank)
+        np.testing.assert_allclose(rep.estimates,
+                                   np.broadcast_to(rep.true_mean,
+                                                   rep.estimates.shape),
+                                   atol=1e-2)
+        np.testing.assert_allclose(rep.total_mass, n, atol=1e-9)
+
+    def test_mass_conserved_under_early_stop(self):
+        """Stopping mid-flight (tiny timeout) must not lose mass: the drain
+        protocol accounts for every deposit."""
+        n = 6
+        topo = ExponentialTwoGraph(n)
+        x0 = np.ones((n, 2)) * np.arange(n)[:, None]
+        rep = run_async_pushsum(topo, x0, tol=1e-12, timeout_s=0.2,
+                                name=fresh_name("early"))
+        np.testing.assert_allclose(rep.total_mass, n, atol=1e-9)

@@ -316,6 +316,45 @@ class TestFuseApply:
                                           np.asarray(leaf_b))
             assert leaf_a.dtype == leaf_b.dtype
 
+    def test_large_leaves_ship_unfused(self):
+        """Leaves >= threshold_bytes bypass the concat/split round-trip (the
+        reference fusion buffer's size cutoff) but still ride the same
+        collective and produce identical results."""
+        from jax.sharding import PartitionSpec as P
+
+        from bluefog_tpu.ops import collectives as C
+        from bluefog_tpu.parallel.api import shard_map as smap
+        from bluefog_tpu.topology import ExponentialTwoGraph
+        from bluefog_tpu.topology.schedule import build_schedule
+
+        bf.init(topology=ExponentialTwoGraph(N))
+        ctx = bf.get_context()
+        sched = build_schedule(ExponentialTwoGraph(N))
+        tree = {
+            "big": rank_values((64, 8), jnp.float32),    # 2 KiB >= threshold
+            "s1": rank_values((4,), jnp.float32),
+            "s2": rank_values((3,), jnp.float32),
+        }
+
+        def run(fused):
+            def step(blk):
+                local = jax.tree_util.tree_map(lambda t: t[0], blk)
+                fn = lambda t: C.neighbor_allreduce(t, sched, "bf")
+                out = (C.fuse_apply(fn, local, threshold_bytes=1024)
+                       if fused else fn(local))
+                return jax.tree_util.tree_map(lambda t: t[None], out)
+
+            return jax.jit(smap(
+                step, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+                out_specs=P(ctx.axis_name), check_vma=False))(tree)
+
+        a, b = run(True), run(False)
+        for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(a),
+                                  jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(leaf_a),
+                                          np.asarray(leaf_b))
+            assert leaf_a.dtype == leaf_b.dtype
+
     def test_single_leaf_passthrough(self):
         from bluefog_tpu.ops import collectives as C
 

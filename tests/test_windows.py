@@ -280,6 +280,16 @@ class TestAssociatedP:
         # mass conservation: sum of p over ranks stays n
         np.testing.assert_allclose(np.asarray(p).sum(), N, rtol=1e-5)
 
+    def test_explicit_x_on_associated_p_window_raises(self):
+        """Shipping a tensor that is not the tracked self_buf would silently
+        desynchronize the (x, p) recursion — the API refuses it."""
+        sched = build_schedule(RingGraph(N))
+        st = ops.win_create(jnp.ones((2,)), sched, "bf", associated_p=True)
+        with pytest.raises(ValueError, match="associated push-sum"):
+            ops.win_put(st, jnp.zeros((2,)), "bf")
+        with pytest.raises(ValueError, match="associated push-sum"):
+            ops.win_accumulate(st, jnp.zeros((2,)), "bf")
+
     def test_win_update_merges_p_with_same_weights(self):
         bf.init(topology=RingGraph(N))
         sched = build_schedule(RingGraph(N))
@@ -287,7 +297,7 @@ class TestAssociatedP:
         def body(x_blk):
             x = x_blk[0]
             st = ops.win_create(x, sched, "bf", associated_p=True)
-            st = ops.win_put(st, x, "bf")      # also ships p = 1
+            st = ops.win_put(st, None, "bf")   # ships self_buf; also ships p = 1
             out, st = ops.win_update(st, "bf")
             return ops.win_associated_p(st)[None]
 
