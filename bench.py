@@ -1,5 +1,5 @@
 """Benchmark harness — north-star metric (BASELINE.md): ResNet-50
-decentralized-SGD **images/sec/chip**.
+decentralized-SGD **images/sec/chip**, plus an honest MFU.
 
 Runs the full decentralized train step (fwd + bwd + gossip + SGD update) as
 one jitted shard_map program over all visible devices and reports throughput
@@ -7,18 +7,27 @@ per chip.  On the driver's single real TPU chip the gossip degenerates to the
 identity (size-1 mesh) — the compute path is the genuine benchmark; on a pod
 the same program gossips over ICI.
 
+Default mode **sweeps the per-chip batch** (128 → 2048, doubling; an OOM ends
+the sweep upward) and reports the best-throughput point; ``--batch N`` pins a
+single batch instead (halve-on-OOM downward so the driver always gets a
+number).
+
 Prints ONE JSON line:
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec/chip", "vs_baseline": R}
+   "unit": "images/sec/chip", "vs_baseline": R, "mfu": M, ...}
 
-If the requested per-chip batch exhausts device memory, the harness halves
-it and retries (recorded in the "batch" field) so the driver always gets a
-number.
+- ``mfu``: achieved model FLOP/s divided by the **measured** bf16 matmul peak
+  of this chip (chained 8192^2 matmuls — the MXU roofline as this machine
+  actually delivers it, not a spec-sheet constant).  Model FLOPs come from
+  XLA's own cost analysis of the compiled step when available, else the
+  standard analytic ResNet-50 estimate (3x forward, 4.09 GFLOP/img fwd).
+- ``vs_baseline``: secondary field, ratio against the reference's per-GPU
+  ResNet-50 throughput on V100 (BASELINE.md records no machine-readable
+  number from the reference; 360 img/s/V100 is the standard fp16 figure for
+  the 128xV100-era stack the reference paper benchmarked on).
 
-vs_baseline: ratio against the reference's per-GPU ResNet-50 throughput on
-V100 (BASELINE.md records no machine-readable number from the reference;
-360 img/s/V100 is the standard fp16 ResNet-50 figure for the 128xV100-era
-stack the reference paper benchmarked on — see BASELINE.md caveats).
+``--profile DIR`` additionally captures a jax.profiler trace of a few steps
+at the chosen batch (view with Perfetto / TensorBoard; see PROFILE.md).
 """
 
 import argparse
@@ -40,10 +49,50 @@ from bluefog_tpu.parallel.api import shard_map
 from bluefog_tpu.topology import ExponentialTwoGraph
 
 V100_BASELINE_IMG_PER_SEC = 360.0
+# Standard analytic ResNet-50 cost at 224x224: ~4.09 GFLOP forward per image,
+# training step ~= 3x forward (fwd + grad wrt activations + grad wrt weights).
+RESNET50_TRAIN_FLOPS_PER_IMG_224 = 3 * 4.09e9
 
 
-def run(args, batch: int) -> float:
-    """One full measurement at the given per-chip batch; img/s/chip."""
+def measure_peak_flops(steps: int = 8, chain: int = 32, n: int = 8192) -> float:
+    """Measured bf16 matmul roofline of one chip: FLOP/s sustained by a
+    chain of (n,n)@(n,n) matmuls (each iteration depends on the previous, so
+    nothing folds away).  This is the denominator of ``mfu``."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, n)).astype(jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (n, n)).astype(jnp.bfloat16)
+
+    @jax.jit
+    def run_chain(x, w):
+        return lax.fori_loop(0, chain, lambda _, z: z @ w, x)
+
+    run_chain(x, w).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = run_chain(x, w)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return 2.0 * n * n * n * chain * steps / dt
+
+
+def _cost_flops(compiled) -> float:
+    """Per-invocation FLOPs of a compiled executable per XLA's cost
+    analysis; 0.0 when the backend doesn't expose one.  Under SPMD this is
+    the **per-device** module's count (batch images worth of work)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def run(args, batch: int):
+    """One full measurement at the given per-chip batch.
+
+    Returns ``(img_per_sec_per_chip, flops_per_step_per_chip)``; the FLOP
+    count is XLA's for one device's share of the step (0.0 if unavailable).
+    """
     n = len(jax.devices())
     ctx = bf.get_context()
 
@@ -98,16 +147,29 @@ def run(args, batch: int) -> float:
         return (jax.tree_util.tree_map(lambda t: t[None], (p, new_bs, st))
                 + (loss[None],))
 
+    # AOT-compile once; the same executable serves cost analysis, warmup,
+    # profiling, and the timed loop (no second trace/compile anywhere).
     step_fn = jax.jit(shard_map(
         train_step, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),) * 5,
         out_specs=(P(ctx.axis_name),) * 4, check_vma=False,
-    ), donate_argnums=(0, 1, 2))
+    ), donate_argnums=(0, 1, 2)).lower(
+        params, batch_stats, opt_state, imgs, labels).compile()
 
-    for _ in range(max(args.warmup, 1)):  # >=1: first call pays compilation
+    flops_per_step = _cost_flops(step_fn)
+
+    for _ in range(max(args.warmup, 1)):
         params, batch_stats, opt_state, loss = step_fn(
             params, batch_stats, opt_state, imgs, labels
         )
     jax.block_until_ready(loss)
+
+    if args.profile:
+        with jax.profiler.trace(args.profile):
+            for _ in range(3):
+                params, batch_stats, opt_state, loss = step_fn(
+                    params, batch_stats, opt_state, imgs, labels
+                )
+            jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
@@ -118,45 +180,122 @@ def run(args, batch: int) -> float:
     dt = time.perf_counter() - t0
 
     total_images = args.steps * batch * n
-    return total_images / dt / n
+    return total_images / dt / n, flops_per_step
 
 
-def _is_oom(e: Exception) -> bool:
-    msg = str(e).upper()
-    return ("RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg
-            or "ALLOCATION" in msg and "FAILED" in msg)
+def _is_oom(e: BaseException) -> bool:
+    """Anchored on the canonical signals, not substrings of arbitrary
+    messages: host OOM is MemoryError; device OOM is an XLA runtime error
+    whose status is RESOURCE_EXHAUSTED (the message is the status string,
+    'RESOURCE_EXHAUSTED: ...')."""
+    if isinstance(e, MemoryError):
+        return True
+    return (type(e).__name__ == "XlaRuntimeError"
+            and str(e).lstrip().startswith("RESOURCE_EXHAUSTED"))
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=128, help="per-chip batch")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="pin one per-chip batch (halve-on-OOM); default "
+                         "sweeps 128..2048 and reports the best")
+    ap.add_argument("--sweep-max", type=int, default=2048)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace at the chosen batch")
+    ap.add_argument("--skip-peak", action="store_true",
+                    help="skip the matmul-peak measurement (mfu omitted)")
     args = ap.parse_args()
 
     bf.init(topology=ExponentialTwoGraph(len(jax.devices())))
 
-    batch = args.batch
-    while True:
-        try:
-            img_per_sec_per_chip = run(args, batch)
-            break
-        except Exception as e:  # noqa: BLE001 — halve batch only on OOM
-            if _is_oom(e) and batch > 8:
-                print(f"bench: batch {batch} exhausted memory; retrying at "
-                      f"{batch // 2}", file=sys.stderr)
-                batch //= 2
-                continue
-            raise
+    peak_flops = None if args.skip_peak else measure_peak_flops()
+    if peak_flops is not None:
+        print(f"bench: measured bf16 matmul peak "
+              f"{peak_flops / 1e12:.1f} TFLOP/s/chip", file=sys.stderr)
 
-    print(json.dumps({
+    profile_dir = args.profile
+    results = []  # (batch, img/s/chip, flops_per_step)
+    if args.batch is not None:
+        # pinned mode has exactly one successful run — trace it inline
+        batch = args.batch
+        while True:
+            try:
+                results.append((batch,) + run(args, batch))
+                profile_dir = None  # captured inline; skip the re-run
+                break
+            except Exception as e:  # noqa: BLE001 — halve batch only on OOM
+                if _is_oom(e) and batch > 8:
+                    print(f"bench: batch {batch} exhausted memory; retrying "
+                          f"at {batch // 2}", file=sys.stderr)
+                    batch //= 2
+                    continue
+                raise
+    else:
+        args.profile = None  # sweep mode: profile only the final best-batch run
+        batch = min(128, args.sweep_max)
+        oom_bound = None  # smallest batch known to OOM
+        while batch <= args.sweep_max:
+            if oom_bound is not None and batch >= oom_bound:
+                break  # deterministic OOM — don't pay the compile again
+            try:
+                r = (batch,) + run(args, batch)
+            except Exception as e:  # noqa: BLE001 — OOM steers the sweep
+                if _is_oom(e):
+                    oom_bound = batch
+                    if not results and batch > 8:
+                        # even the smallest sweep point doesn't fit: halve
+                        # downward so the driver still gets a number
+                        print(f"bench: batch {batch} exhausted memory; "
+                              f"retrying at {batch // 2}", file=sys.stderr)
+                        batch //= 2
+                        continue
+                    print(f"bench: batch {batch} exhausted memory; sweep ends",
+                          file=sys.stderr)
+                    break
+                raise
+            print(f"bench: batch {r[0]:5d} -> {r[1]:,.0f} img/s/chip",
+                  file=sys.stderr)
+            results.append(r)
+            batch *= 2
+
+    if not results:
+        raise SystemExit("bench: no batch size fit in memory")
+    best_batch, best_ips, flops_per_step = max(results, key=lambda r: r[1])
+
+    if profile_dir:
+        # trace-only re-run: run() captures 3 traced steps; steps=0 skips the
+        # (discarded) timing loop, warmup=1 covers compilation
+        args.profile, args.steps, args.warmup = profile_dir, 0, 1
+        run(args, best_batch)
+        print(f"bench: profiler trace written to {profile_dir}",
+              file=sys.stderr)
+
+    if flops_per_step > 0:
+        # cost_analysis counts the per-device SPMD module = `batch` images
+        flops_per_img = flops_per_step / best_batch
+    else:
+        flops_per_img = RESNET50_TRAIN_FLOPS_PER_IMG_224 * (
+            args.image_size / 224.0) ** 2
+    achieved_flops = best_ips * flops_per_img
+
+    out = {
         "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(img_per_sec_per_chip, 2),
+        "value": round(best_ips, 2),
         "unit": "images/sec/chip",
-        "batch": batch,
-        "vs_baseline": round(img_per_sec_per_chip / V100_BASELINE_IMG_PER_SEC, 3),
-    }))
+        "batch": best_batch,
+        "vs_baseline": round(best_ips / V100_BASELINE_IMG_PER_SEC, 3),
+        "sweep": [{"batch": b, "img_per_sec_per_chip": round(v, 2)}
+                  for b, v, _ in results],
+        "model_tflops_per_sec_per_chip": round(achieved_flops / 1e12, 2),
+        "flops_source": "xla_cost_analysis" if flops_per_step > 0 else "analytic",
+    }
+    if peak_flops is not None:
+        out["measured_peak_tflops_per_sec"] = round(peak_flops / 1e12, 2)
+        out["mfu"] = round(achieved_flops / peak_flops, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

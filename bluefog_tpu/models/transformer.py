@@ -105,7 +105,10 @@ class TransformerLM(nn.Module):
                  position_offset=0):
         cfg = self.cfg
         if attn_fn is None:
-            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True)
+            # the model layer is the perf path: opt into the fused TPU flash
+            # kernel whenever eligible (parity: tests/test_flash_attention.py)
+            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True,
+                                                      backend="auto")
         positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                      name="tok")(tokens)

@@ -58,16 +58,22 @@ def _flash_eligible(q, k, causal, q_offset, k_offset) -> bool:
 
 
 def local_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
-                    q_offset=0, k_offset=0, backend: str = "auto"):
+                    q_offset=0, k_offset=0, backend: str = "dense"):
     """Plain softmax attention on local blocks (also the Ulysses inner step).
 
     Shapes: ``q (B, Tq, H, D)``, ``k/v (B, Tk, H, D)`` → ``(B, Tq, H, D)``.
     ``q_offset``/``k_offset`` are the *global* positions of the first query /
     key row, used for causal masking of shifted blocks (may be traced).
 
-    ``backend``: ``'dense'`` materializes the (Tq, Tk) scores (portable);
-    ``'flash'`` forces the fused Pallas TPU kernel (O(T) memory, fwd+bwd);
-    ``'auto'`` picks flash whenever :func:`_flash_eligible` allows.
+    ``backend``: ``'dense'`` (default) materializes the (Tq, Tk) scores
+    (portable, covered by CI); ``'flash'`` forces the fused Pallas TPU kernel
+    (O(T) memory, fwd+bwd); ``'auto'`` picks flash whenever
+    :func:`_flash_eligible` allows.  The *op-level* default is ``'dense'`` so
+    that changing the runtime environment never silently switches which
+    kernel a direct caller executes; the model layer
+    (:mod:`bluefog_tpu.models.transformer`) opts into ``'auto'`` explicitly —
+    that is the performance path, and its flash/dense parity is asserted by
+    ``tests/test_flash_attention.py`` whenever a TPU is attached.
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
 
@@ -181,7 +187,7 @@ def all_to_all_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    backend: str = "auto",
+    backend: str = "dense",
 ):
     """Ulysses-style sequence parallelism: reshard seq→heads, attend, reshard
     back.

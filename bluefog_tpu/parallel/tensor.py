@@ -308,7 +308,8 @@ class TPTransformerLM(nn.Module):
     def __call__(self, tokens, *, attn_fn=None, position_offset=0):
         cfg = self.cfg
         if attn_fn is None:
-            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True)
+            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True,
+                                                      backend="auto")
         positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                      name="tok")(tokens)

@@ -228,17 +228,27 @@ def main():
               f"{ips:,.0f} img/s ({ips / n:,.0f}/chip)  "
               f"lr {sched(epoch * steps_per_epoch + steps_per_epoch - 1):.4f}")
 
+        # BN running stats never appear in the gossip (only params do), so
+        # each rank's batch_stats drift apart on disjoint shards.  Average
+        # them across ranks before they are consumed (eval / checkpoint) —
+        # the analog of the reference re-synchronizing buffers with
+        # broadcast_parameters before evaluation.
+        synced_bs = batch_stats
+        if (args.eval_every and (epoch + 1) % args.eval_every == 0) or (
+                mgr and (epoch + 1) % args.checkpoint_every == 0):
+            synced_bs = bf.allreduce(batch_stats)
+
         if args.eval_every and (epoch + 1) % args.eval_every == 0:
             hits = 0
             for x, y in val_loader.epoch(0):
-                hits += int(np.sum(eval_fn(params, batch_stats, x, y)))
+                hits += int(np.sum(eval_fn(params, synced_bs, x, y)))
             total = val_loader.steps_per_epoch * args.batch_size * n
             print(f"          val top-1 {hits / total:.4f}  "
                   f"({hits}/{total})")
 
         if mgr and (epoch + 1) % args.checkpoint_every == 0:
             mgr.save(epoch + 1, {
-                "params": params, "batch_stats": batch_stats,
+                "params": params, "batch_stats": synced_bs,
                 "opt_state": opt_state,
             })
     if mgr:

@@ -83,7 +83,8 @@ def main():
                                  causal=True)
     else:
         attn = functools.partial(all_to_all_attention,
-                                 axis_name=ctx.axis_name, causal=True)
+                                 axis_name=ctx.axis_name, causal=True,
+                                 backend="auto")
 
     tokens = make_batch(jax.random.PRNGKey(1), args.batch, t_global, 256,
                         args.period)
