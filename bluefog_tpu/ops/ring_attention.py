@@ -113,6 +113,58 @@ def local_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = N
     return out.astype(q.dtype)
 
 
+def _fold_block(state, q, k, v, *, scale, kpos0, qpos, masked: bool,
+                kv_tile: int):
+    """Flash-style inner step: fold one KV block into the running
+    online-softmax state ``(m, denom, o)``.
+
+    The block is processed in ``kv_tile``-sized key tiles by a ``lax.scan``
+    whose body is rematerialized — the flash-attention recipe (tiled online
+    softmax, O(t_q x tile) live score memory, activations recomputed in the
+    backward pass) expressed in XLA-friendly form instead of a hand-written
+    kernel.  ``masked=True`` applies the causal mask of global query
+    positions ``qpos`` against key positions ``kpos0 + arange`` (only the
+    diagonal block needs it; strictly-past blocks skip the mask entirely).
+    """
+    b, t_k, h, d = k.shape
+
+    # largest divisor of t_k not exceeding kv_tile, so the promised
+    # O(t_q x tile) live-score bound survives non-divisible block sizes
+    tile = min(kv_tile, t_k)
+    while t_k % tile:
+        tile -= 1
+    nt = t_k // tile
+
+    def fold_tile(carry, xs):
+        m, denom, o = carry
+        kt, vt, kt0 = xs  # (B, tile, H, D) x2, scalar global key offset
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kt, preferred_element_type=jnp.float32
+        ) * scale
+        if masked:
+            kpos = kt0 + jnp.arange(tile)
+            scores = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
+                               scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        denom = denom * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(vt.dtype), vt,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, denom, o), None
+
+    if nt == 1:
+        return jax.checkpoint(fold_tile)(state, (k, v, kpos0))[0]
+    k_tiles = k.reshape(b, nt, tile, h, d).transpose(1, 0, 2, 3, 4)
+    v_tiles = v.reshape(b, nt, tile, h, d).transpose(1, 0, 2, 3, 4)
+    offs = kpos0 + tile * jnp.arange(nt)
+    state, _ = lax.scan(jax.checkpoint(fold_tile), state,
+                        (k_tiles, v_tiles, offs))
+    return state
+
+
 def ring_attention(
     q,
     k,
@@ -121,6 +173,7 @@ def ring_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_tile: int = 512,
 ):
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
@@ -135,46 +188,76 @@ def ring_attention(
     rides the ICI torus ring, and XLA overlaps the next block's ppermute with
     the current block's attention math.
 
-    For ``causal=True``, block ``j``'s keys are masked against this rank's
-    global query positions; blocks strictly in the future contribute exp(-inf)
-    = 0.  (The diagonal block is processed first, so the running max is finite
-    from step 0.)
+    The inner step is flash-style (:func:`_fold_block`): ``kv_tile``-sized
+    online-softmax tiles with rematerialization, so a rank's live score
+    buffer is ``(B, H, t_q, kv_tile)`` regardless of block size.
+
+    For ``causal=True`` the per-step work is dispatched by a ``lax.switch``
+    on the arriving block's position: the diagonal block (processed first, so
+    the running max is finite from step 0) runs with the triangle mask,
+    strictly-past blocks run unmasked, and strictly-future blocks are
+    **skipped outright** — only the selected branch executes, so the causal
+    ring does ~half the attention FLOPs of the non-causal one instead of
+    computing scores and masking them to zero.
     """
     n = lax.axis_size(axis_name)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    b, t_q, h, _ = q.shape
+    b, t_q, h, d = q.shape
     t_k = k.shape[1]
+    if causal and t_q != t_k:
+        # block classification below (past/diagonal/future by rank index)
+        # presumes equal shard widths, which ring *self*-attention always has
+        raise ValueError(
+            f"causal ring attention requires equal q/k shard widths, got "
+            f"t_q={t_q}, t_k={t_k}")
     r = lax.axis_index(axis_name)
 
-    m = jnp.full((b, h, t_q), _NEG_INF, jnp.float32)
-    denom = jnp.zeros((b, h, t_q), jnp.float32)
-    o = jnp.zeros((b, h, t_q, q.shape[-1]), jnp.float32)
+    state = (
+        jnp.full((b, h, t_q), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, t_q), jnp.float32),
+        jnp.zeros((b, h, t_q, d), jnp.float32),
+    )
+    # the skip branch of the causal dispatch returns the carry unchanged, so
+    # the carry must already be marked varying over the mesh axis or branch
+    # output types (VMA) disagree with the fold branches
+    try:
+        _mark_varying = lambda t: lax.pcast(t, axis_name, to="varying")
+        state = jax.tree_util.tree_map(_mark_varying, state)
+    except (AttributeError, TypeError):  # older jax: pvary
+        state = jax.tree_util.tree_map(
+            lambda t: lax.pvary(t, axis_name), state)
 
     shift = [(i, (i + 1) % n) for i in range(n)]
     qpos = r * t_q + jnp.arange(t_q)
 
     for s in range(n):
         src = (r - s) % n  # rank whose KV block we currently hold
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            kpos = src * t_k + jnp.arange(t_k)
-            mask = qpos[:, None] >= kpos[None, :]
-            scores = jnp.where(mask[None, None], scores, _NEG_INF)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])
-        denom = denom * alpha + p.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p.astype(v.dtype), v,
-            preferred_element_type=jnp.float32,
-        )
-        m = m_new
+        kpos0 = src * t_k
+        if not causal:
+            state = _fold_block(state, q, k, v, scale=scale, kpos0=kpos0,
+                                qpos=qpos, masked=False, kv_tile=kv_tile)
+        elif s == 0:
+            # statically the diagonal block (src == r): triangle mask, and
+            # the running max is finite from step 0
+            state = _fold_block(state, q, k, v, scale=scale, kpos0=kpos0,
+                                qpos=qpos, masked=True, kv_tile=kv_tile)
+        else:
+            # s > 0 never sees the diagonal again: the block is strictly
+            # past (fold unmasked) or strictly future (skip outright — the
+            # cond executes only the taken branch, so future blocks are free)
+            state = lax.cond(
+                src < r,
+                lambda st, k, v, kp0: _fold_block(
+                    st, q, k, v, scale=scale, kpos0=kp0, qpos=qpos,
+                    masked=False, kv_tile=kv_tile),
+                lambda st, k, v, kp0: st,
+                state, k, v, kpos0,
+            )
         if s != n - 1:
             k = lax.ppermute(k, axis_name, shift)
             v = lax.ppermute(v, axis_name, shift)
 
+    _, denom, o = state
     out = o / jnp.maximum(denom[..., None], 1e-30)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
