@@ -60,6 +60,7 @@ from bluefog_tpu.parallel.api import (
     allgather,
     broadcast,
     neighbor_allreduce,
+    neighbor_allreduce_aperiodic,
     neighbor_allgather,
     hierarchical_neighbor_allreduce,
     barrier,

@@ -16,6 +16,7 @@ from bluefog_tpu.ops.collectives import (
     neighbor_allreduce,
     neighbor_allgather,
     neighbor_allreduce_dynamic,
+    neighbor_allreduce_aperiodic,
     hierarchical_neighbor_allreduce,
     pair_gossip,
 )

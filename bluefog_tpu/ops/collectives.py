@@ -53,6 +53,7 @@ __all__ = [
     "neighbor_allreduce",
     "neighbor_allgather",
     "neighbor_allreduce_dynamic",
+    "neighbor_allreduce_aperiodic",
     "hierarchical_neighbor_allreduce",
     "pair_gossip",
 ]
@@ -232,6 +233,61 @@ def neighbor_allreduce_dynamic(
         for s in scheds
     ]
     return lax.switch(jnp.asarray(step) % len(scheds), branches, x)
+
+
+def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str):
+    """Gossip with an **arbitrary per-call topology** in one compile:
+    ``out_i = sum_j W[i, j] x_j`` for any row-stochastic ``W`` within the
+    full graph — the TPU answer to the reference's per-call
+    ``self_weight=/src_weights=`` arguments when the *edge set* (not just
+    the weights) changes every step (``bluefog/torch/mpi_ops.py``;
+    SURVEY.md §7 hard-part #2).
+
+    How: any directed graph on ``n`` ranks decomposes into the ``n-1``
+    circulant rotations.  Each rotation's ``ppermute`` is compiled once
+    (static pattern); which rotations actually run is decided at **runtime**
+    by a ``lax.cond`` on whether any edge of that rotation carries nonzero
+    weight — changing ``W`` between calls re-selects rotations and re-weights
+    edges with zero recompilation, and unused rotations cost nothing (the
+    cond executes only the taken branch).  A one-peer dynamic exp2 step
+    therefore pays for exactly one ICI rotation, not ``n-1``.
+
+    Args:
+      x: array or pytree; each rank's local value.
+      mixing_matrix: ``(n, n)`` array, ``W[i, j]`` = the weight rank ``i``
+        applies to rank ``j``'s value (``W[i, i]`` the self weight).  Must be
+        **replicated** across ranks (pass it with a ``P()`` spec): the
+        rotation-used predicates must agree on every rank or the program
+        deadlocks, exactly as mismatched ``src_weights`` deadlock the
+        reference's MPI negotiation.
+
+    See :func:`bluefog_tpu.topology.dynamic.one_peer_exp2_mixing_matrix` for
+    a jittable step->W builder.
+    """
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    W = jnp.asarray(mixing_matrix, jnp.float32)
+    if W.shape != (n, n):
+        raise ValueError(f"mixing_matrix shape {W.shape} != ({n}, {n})")
+    rows = jnp.arange(n)
+
+    def one(leaf):
+        acc_dt = _acc_dtype(leaf)
+        out = W[i, i].astype(acc_dt) * leaf.astype(acc_dt)
+        for s in range(1, n):
+            srcs = (rows - s) % n
+            rot_w = W[rows, srcs]          # (n,) rotation-s edge weights
+            used = jnp.any(rot_w != 0.0)   # replicated: same on all ranks
+            perm = [(a, (a + s) % n) for a in range(n)]
+
+            def fold(o):
+                recvd = lax.ppermute(leaf, axis_name, perm)
+                return o + rot_w[i].astype(acc_dt) * recvd.astype(acc_dt)
+
+            out = lax.cond(used, fold, lambda o: o, out)
+        return out.astype(leaf.dtype)
+
+    return jax.tree_util.tree_map(one, x)
 
 
 def neighbor_allgather(x, schedule, axis_name: str):

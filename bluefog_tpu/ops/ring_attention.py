@@ -129,10 +129,14 @@ def _fold_block(state, q, k, v, *, scale, kpos0, qpos, masked: bool,
     b, t_k, h, d = k.shape
 
     # largest divisor of t_k not exceeding kv_tile, so the promised
-    # O(t_q x tile) live-score bound survives non-divisible block sizes
+    # O(t_q x tile) live-score bound survives non-divisible block sizes; if
+    # only degenerate divisors exist (prime-ish widths would otherwise scan
+    # near-single-key tiles), one whole-block tile beats a serial scan
     tile = min(kv_tile, t_k)
     while t_k % tile:
         tile -= 1
+    if tile < min(64, t_k):
+        tile = t_k
     nt = t_k // tile
 
     def fold_tile(carry, xs):
@@ -192,13 +196,23 @@ def ring_attention(
     online-softmax tiles with rematerialization, so a rank's live score
     buffer is ``(B, H, t_q, kv_tile)`` regardless of block size.
 
-    For ``causal=True`` the per-step work is dispatched by a ``lax.switch``
-    on the arriving block's position: the diagonal block (processed first, so
-    the running max is finite from step 0) runs with the triangle mask,
-    strictly-past blocks run unmasked, and strictly-future blocks are
-    **skipped outright** — only the selected branch executes, so the causal
-    ring does ~half the attention FLOPs of the non-causal one instead of
-    computing scores and masking them to zero.
+    For ``causal=True`` the per-step work is dispatched on the arriving
+    block's position: the diagonal block (processed first, so the running max
+    is finite from step 0) runs with the triangle mask, strictly-past blocks
+    run unmasked, and strictly-future blocks are **skipped outright** — only
+    the taken branch executes, so the causal ring does ~half the attention
+    FLOPs of the non-causal one instead of computing scores and masking them
+    to zero.
+
+    Caveat on what the skipping buys: with contiguous rank-order sharding the
+    causal work is imbalanced (rank 0 skips almost every block, rank n-1
+    none), and the ring is lock-stepped by its ppermutes — so on a real
+    slice the *per-step critical path* is set by the busiest rank and the
+    saving shows up as idle time/energy, not wall-clock.  Wall-clock parity
+    with the FLOP saving requires a load-balanced sequence layout (zigzag /
+    striped sharding, where each rank holds a front and a mirrored back
+    chunk); on a single host (the CPU test mesh) the devices share the
+    compute budget, so the saving IS wall-clock there.
     """
     n = lax.axis_size(axis_name)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])

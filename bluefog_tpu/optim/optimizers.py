@@ -92,9 +92,12 @@ def decentralized_optimizer(
     """Wrap ``base`` so each update also performs decentralized averaging.
 
     Args:
-      topology: static topology/schedule, or a *sequence* of them for
+      topology: static topology/schedule; a *sequence* of them for periodic
         time-varying gossip (cycled by the step counter, e.g.
-        ``one_peer_exponential_two_schedules(n)``).
+        ``one_peer_exponential_two_schedules(n)``); or a **callable**
+        ``step -> (n, n) mixing matrix`` (traced step) for aperiodic gossip —
+        arbitrary edge sets every round with zero recompilation
+        (e.g. ``topology.one_peer_exp2_mixing_matrix``).
       axis_name: gossip mesh axis (call inside ``shard_map``).
       communication_type: which combine to run (reference enum).
       atc: adapt-then-combine when True, adapt-with-combine (overlappable,
@@ -108,12 +111,20 @@ def decentralized_optimizer(
     """
     ct = communication_type
     scheds = None
+    matrix_fn = None
     if ct == CommunicationType.neighbor_allreduce:
         if topology is None:
             raise ValueError(
                 "communication_type=neighbor_allreduce requires a topology"
             )
-        scheds = _as_schedules(topology)
+        if callable(topology) and not isinstance(
+                topology, (Topology, GossipSchedule)):
+            # aperiodic mode: `topology(step) -> (n, n) mixing matrix` with a
+            # traced step — any edge set every round, one compile
+            # (ops.collectives.neighbor_allreduce_aperiodic)
+            matrix_fn = topology
+        else:
+            scheds = _as_schedules(topology)
     mscheds = None
     if ct == CommunicationType.hierarchical_neighbor_allreduce:
         if machine_topology is None:
@@ -131,6 +142,10 @@ def decentralized_optimizer(
         # fuse_apply: one flat buffer per dtype → one ppermute/psum per slot
         # instead of one per parameter leaf (reference fusion-buffer parity)
         if ct == CommunicationType.neighbor_allreduce:
+            if matrix_fn is not None:
+                return C.fuse_apply(
+                    lambda t: C.neighbor_allreduce_aperiodic(
+                        t, matrix_fn(count), axis_name), params)
             return C.fuse_apply(
                 lambda t: _gossip(t, scheds, count, axis_name), params)
         if ct == CommunicationType.hierarchical_neighbor_allreduce:

@@ -50,6 +50,7 @@ __all__ = [
     "broadcast",
     "barrier",
     "neighbor_allreduce",
+    "neighbor_allreduce_aperiodic",
     "neighbor_allgather",
     "hierarchical_neighbor_allreduce",
     "win_create",
@@ -117,6 +118,16 @@ def _cached_op(op_name: str, mesh, axis_name: str, sched, *static):
             check_vma=False,
         ))
 
+    if op_name == "neighbor_allreduce_aperiodic":
+
+        def ap_fn(xs, w):
+            return _ops.neighbor_allreduce_aperiodic(xs, w, ax)
+
+        return jax.jit(shard_map(
+            ap_fn, mesh=mesh, in_specs=(P(ax), P()), out_specs=P(ax),
+            check_vma=False,
+        ))
+
     if op_name == "allreduce":
         (average,) = static
         f = lambda xs: _ops.allreduce(xs, ax, average=average)
@@ -171,6 +182,17 @@ def neighbor_allreduce(x, *, topology=None, self_weight=None, recv_weights=None)
         jnp.float32,
     )
     return f(x, sw, rw)
+
+
+def neighbor_allreduce_aperiodic(x, mixing_matrix):
+    """Stacked-array gossip with an arbitrary per-call topology: ``out =
+    W @ xs`` for any row-stochastic ``(size, size)`` ``W`` — edge set *and*
+    weights are data, so changing them never recompiles (see
+    :func:`bluefog_tpu.ops.collectives.neighbor_allreduce_aperiodic`)."""
+    ctx = get_context()
+    f = _cached_op(
+        "neighbor_allreduce_aperiodic", ctx.mesh, ctx.axis_name, None)
+    return f(x, jnp.asarray(mixing_matrix, jnp.float32))
 
 
 def neighbor_allgather(x, *, topology=None):

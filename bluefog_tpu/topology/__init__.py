@@ -29,6 +29,7 @@ from bluefog_tpu.topology.dynamic import (
     GetInnerOuterExpo2DynamicSendRecvRanks,
     one_peer_exponential_two_schedules,
     one_peer_ring_schedules,
+    one_peer_exp2_mixing_matrix,
     dynamic_topologies_from_generator,
 )
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule

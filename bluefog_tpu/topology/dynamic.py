@@ -30,6 +30,7 @@ __all__ = [
     "GetInnerOuterExpo2DynamicSendRecvRanks",
     "one_peer_exponential_two_schedules",
     "one_peer_ring_schedules",
+    "one_peer_exp2_mixing_matrix",
     "dynamic_topologies_from_generator",
 ]
 
@@ -177,6 +178,30 @@ def one_peer_ring_schedules(size: int) -> List[Topology]:
     if size == 2:
         return [_one_peer_shift_topology(size, 1)]
     return [_one_peer_shift_topology(size, 1), _one_peer_shift_topology(size, -1)]
+
+
+def one_peer_exp2_mixing_matrix(size: int, step):
+    """Jittable ``step -> (n, n)`` mixing matrix for one-peer dynamic exp2.
+
+    ``step`` may be a **traced** integer (e.g. the optimizer's communication
+    counter): phase ``step % ceil(log2 n)`` pairs ``i -> i + 2^phase (mod n)``
+    with 1/2–1/2 weights — the same process as
+    :func:`one_peer_exponential_two_schedules`, but produced as *data* for
+    :func:`~bluefog_tpu.ops.collectives.neighbor_allreduce_aperiodic`
+    (arbitrary per-step edge sets, zero recompilation) instead of a
+    pre-compiled ``lax.switch`` period.
+    """
+    import jax.numpy as jnp
+
+    if size <= 1:
+        return jnp.ones((1, 1), jnp.float32)
+    phases = math.ceil(math.log2(size))
+    # 2^(phase) < size always: phase <= ceil(log2 n) - 1 => shift <= 2^(ceil-1) < n
+    shift = jnp.left_shift(1, jnp.asarray(step, jnp.int32) % phases)
+    rows = jnp.arange(size)
+    srcs = (rows - shift) % size  # src != row since 0 < shift < size
+    w = jnp.zeros((size, size), jnp.float32)
+    return w.at[rows, rows].set(0.5).at[rows, srcs].set(0.5)
 
 
 def dynamic_topologies_from_generator(
