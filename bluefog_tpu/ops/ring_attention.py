@@ -129,13 +129,13 @@ def _fold_block(state, q, k, v, *, scale, kpos0, qpos, masked: bool,
     b, t_k, h, d = k.shape
 
     # largest divisor of t_k not exceeding kv_tile, so the promised
-    # O(t_q x tile) live-score bound survives non-divisible block sizes; if
-    # only degenerate divisors exist (prime-ish widths would otherwise scan
-    # near-single-key tiles), one whole-block tile beats a serial scan
+    # O(t_q x tile) live-score bound survives non-divisible block sizes; only
+    # if nothing but degenerate divisors exist (prime-ish widths would scan
+    # near-single-key tiles) does one whole-block tile beat a serial scan
     tile = min(kv_tile, t_k)
     while t_k % tile:
         tile -= 1
-    if tile < min(64, t_k):
+    if tile < min(8, t_k, kv_tile):
         tile = t_k
     nt = t_k // tile
 

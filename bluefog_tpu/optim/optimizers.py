@@ -262,15 +262,51 @@ def DistributedWinPutOptimizer(
     topology,
     axis_name: str,
     num_steps_per_communication: int = 1,
-) -> optax.GradientTransformation:
+    async_: bool = False,
+    lr: Optional[float] = None,
+):
     """Reference ``bf.DistributedWinPutOptimizer`` (confirmed in
     BASELINE.json): after the local step, push parameters to out-neighbors via
     ``win_put`` and merge landed neighbor params via ``win_update`` — the
     one-sided, barrier-free variant (SURVEY.md §3.4).
 
-    The MPI window memory of the reference becomes window state carried inside
-    the optimizer state, allocated by ``init`` from the parameter shapes.
+    Two modes:
+
+    - ``async_=False`` (default): an ``optax.GradientTransformation`` whose
+      window dataflow compiles into the SPMD step (the MPI window memory of
+      the reference becomes window state carried inside the optimizer state,
+      allocated by ``init`` from the parameter shapes).  Same program counter
+      on every rank — the one-sidedness is dataflow, not timing.
+    - ``async_=True``: returns an
+      :class:`~bluefog_tpu.runtime.async_windows.AsyncWinPutOptimizer` —
+      rank loops on the host runtime stepping at **independent rates** over
+      real model parameters, depositing into the native passive-target
+      window table with no barrier anywhere (the reference MPI backend's
+      actual execution model).  ``base`` is ignored in this mode (the
+      subgradient-push update is plain SGD on the de-biased iterate); pass
+      the learning rate via ``lr``.
     """
+    if async_:
+        from bluefog_tpu.runtime.async_windows import AsyncWinPutOptimizer
+
+        topo = topology
+        if not isinstance(topo, Topology):
+            raise TypeError(
+                "async_=True requires a Topology (host rank loops, not a "
+                f"compiled schedule); got {type(topology)}")
+        if lr is None:
+            # `base`'s learning rate lives in optax closures and cannot be
+            # recovered — a silent default would diverge from what the sync
+            # call site requested, so demand it explicitly
+            raise ValueError(
+                "async_=True applies plain SGD on the de-biased iterate "
+                "(base is unused); pass the learning rate via lr=")
+        if num_steps_per_communication != 1:
+            raise ValueError(
+                "async_=True has no synchronous communication rounds; "
+                "num_steps_per_communication does not apply")
+        return AsyncWinPutOptimizer(topo, lr=lr)
+
     scheds = _as_schedules(topology)
     if len(scheds) != 1:
         raise ValueError(

@@ -15,10 +15,13 @@ This module reproduces that execution model on the TPU build's host runtime:
   (put/accumulate), consume-exactly-once reads, and deposit-count staleness
   bookkeeping.  Within a host, "remote" writes are direct memory deposits
   into the target rank's table entry (the shared-memory MPI disposition);
-  across processes the same deposit API is carried by a transport (the
-  coordination-service KV bridge in :mod:`bluefog_tpu.runtime.launch`, or
-  DCN); within a TPU slice the device-side analog is the Pallas remote-DMA
+  within a TPU slice the device-side analog is the Pallas remote-DMA
   kernel (:mod:`bluefog_tpu.ops.pallas_gossip`).
+
+- :class:`TreePacker` — the device↔window bridge: packs a pytree of jax
+  device arrays into one contiguous host vector (one batched
+  ``jax.device_get``) and unpacks it back, so model parameters ride the
+  window table.
 
 - :func:`run_async_pushsum` — the demonstration the SPMD path cannot
   express: N rank-threads run push-sum with **rank-dependent step rates**
@@ -29,6 +32,15 @@ This module reproduces that execution model on the TPU build's host runtime:
   global mean despite the skew — the defining property of asynchronous
   push-sum (Kempe et al.; the reference's ``DistributedWinPutOptimizer``
   foundation).
+
+- :func:`run_async_dsgd` / :class:`AsyncWinPutOptimizer` — asynchronous
+  decentralized *training* on that foundation (subgradient-push, Nedić &
+  Olshevsky): each rank-thread consumes landed (x, p) mass, de-biases
+  ``z = x / p``, takes a gradient step on real model parameters through
+  :class:`TreePacker`, and split-deposits to its out-neighbors — no barrier
+  anywhere, ranks step at independent rates.  This is the execution model of
+  the reference's ``DistributedWinPutOptimizer`` production path
+  (``bluefog/torch/optimizers.py`` + ``mpi_win_ops.cc``, SURVEY.md §3.4).
 """
 
 from __future__ import annotations
@@ -43,7 +55,15 @@ import numpy as np
 from bluefog_tpu.runtime import native
 from bluefog_tpu.topology.graphs import Topology
 
-__all__ = ["AsyncWindow", "run_async_pushsum", "PushSumReport"]
+__all__ = [
+    "AsyncWindow",
+    "TreePacker",
+    "run_async_pushsum",
+    "run_async_dsgd",
+    "AsyncWinPutOptimizer",
+    "PushSumReport",
+    "DSGDReport",
+]
 
 _DTYPES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}
 
@@ -236,6 +256,58 @@ class AsyncWindow:
             self._lib.bf_win_free(self.name.encode())
 
 
+class TreePacker:
+    """Pack a pytree of (jax or numpy) arrays into ONE contiguous host
+    vector and back — the bridge that lets model parameters ride the native
+    window table (whose buffers are flat f32/f64).
+
+    Packing does a single batched ``jax.device_get`` for the whole tree (one
+    host transfer, not one per leaf); unpacking restores original shapes and
+    dtypes, optionally as jax arrays.
+    """
+
+    def __init__(self, template, dtype=np.float64):
+        import jax
+
+        leaves, self._treedef = jax.tree_util.tree_flatten(template)
+        self._shapes = [tuple(np.shape(l)) for l in leaves]
+        self._sizes = [int(np.prod(s, dtype=np.int64)) for s in self._shapes]
+        self._dtypes = [np.dtype(getattr(l, "dtype", None) or
+                                 np.asarray(l).dtype) for l in leaves]
+        self.size = int(sum(self._sizes))
+        self.dtype = np.dtype(dtype)
+
+    def pack(self, tree, out: Optional[np.ndarray] = None) -> np.ndarray:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        if len(leaves) != len(self._sizes):
+            raise ValueError(
+                f"tree has {len(leaves)} leaves, template {len(self._sizes)}")
+        host = jax.device_get(leaves)  # one batched transfer
+        vec = np.empty(self.size, self.dtype) if out is None else out
+        if vec.shape != (self.size,) or vec.dtype != self.dtype:
+            raise ValueError(f"out must be ({self.size},) {self.dtype}")
+        off = 0
+        for a, sz in zip(host, self._sizes):
+            vec[off:off + sz] = np.asarray(a, self.dtype).ravel()
+            off += sz
+        return vec
+
+    def unpack(self, vec: np.ndarray, *, as_jax: bool = True):
+        import jax
+
+        vec = np.asarray(vec)
+        if vec.shape != (self.size,):
+            raise ValueError(f"vector shape {vec.shape} != ({self.size},)")
+        leaves, off = [], 0
+        for shape, sz, dt in zip(self._shapes, self._sizes, self._dtypes):
+            a = vec[off:off + sz].reshape(shape).astype(dt)
+            leaves.append(jax.numpy.asarray(a) if as_jax else a)
+            off += sz
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+
 @dataclass
 class PushSumReport:
     """Outcome of an async push-sum run."""
@@ -399,3 +471,208 @@ def run_async_pushsum(
     for w in wins:
         w.free()
     return report
+
+
+# ---------------------------------------------------------------------------
+# Asynchronous decentralized training (subgradient-push over the windows)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DSGDReport:
+    """Outcome of an asynchronous decentralized SGD run."""
+
+    wall_time_s: float
+    steps_per_rank: List[int]
+    losses: List[List[float]]        # per rank, per local step
+    final_params: list               # per rank, de-biased z = x/p pytrees
+    total_mass: float                # sum of p over ranks (+ in flight) == n
+    consensus_gap: float             # max over ranks of max|z_r - z_mean|
+
+
+def run_async_dsgd(
+    topology: Topology,
+    params0,
+    loss_and_grad,
+    *,
+    lr: float = 0.05,
+    duration_s: float = 5.0,
+    skew: Optional[Sequence[float]] = None,
+    name: str = "async_dsgd",
+    poll_interval_s: float = 0.0,
+) -> DSGDReport:
+    """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
+    over the passive-target windows: the execution model of the reference's
+    ``DistributedWinPutOptimizer`` (params pushed one-sidedly each step,
+    merged by the receiver whenever it steps — SURVEY.md §3.4), with **no
+    barrier anywhere** and rank-dependent step rates.
+
+    Each rank-thread's step:
+      1. consume landed ``(x, p)`` mass from its in-neighbor slots;
+      2. de-bias ``z = x / p`` (the rank's current model estimate);
+      3. ``grads, loss = loss_and_grad(rank, step, z_tree)`` on real model
+         parameters (device pytrees via :class:`TreePacker`);
+      4. ``x <- x - lr * p * grad`` (scaling by ``p`` makes the *de-biased*
+         iterate take the plain gradient step: ``z' = z - lr * grad``);
+      5. keep ``1/(out_deg+1)`` of ``(x, p)``, deposit the same fraction to
+         each out-neighbor (accumulate) — receivers need not be listening.
+
+    Mass is conserved exactly (sum of ``p`` stays ``n`` under any
+    interleaving); consensus pressure comes from the repeated split/merge.
+
+    Bias note (inherent to constant-step asynchronous SGD, not this
+    implementation): ranks stepping at different rates weight the global
+    objective by their rates — the stationary point is the *rate-weighted*
+    optimum.  Homogeneous shards (the usual DP setting) are unaffected;
+    heterogeneous objectives need rate-proportional lr correction or a
+    diminishing step size, exactly as in the reference's async mode.
+
+    Args:
+      topology: directed graph over the rank threads.
+      params0: initial model parameters (pytree; same start on every rank,
+        the reference's ``broadcast_parameters`` convention).
+      loss_and_grad: ``(rank, step, params_tree) -> (loss, grad_tree)``.
+        Called concurrently from rank threads (jitted jax fns are safe).
+      lr: SGD learning rate applied to the de-biased iterate.
+      duration_s: wall-clock training budget (ranks then drain in-flight
+        mass so the audit is exact).
+      skew: per-rank extra sleep per step; default makes the slowest rank
+        ~5x the fastest (the asynchrony the SPMD path cannot express).
+    """
+    n = topology.size
+    packer = TreePacker(params0, np.float64)
+    d = packer.size
+
+    if skew is None:
+        base = 0.001
+        skew = [base * (1.0 + 4.0 * r / max(n - 1, 1)) for r in range(n)]
+
+    in_nbrs = [list(topology.in_neighbors(r)) for r in range(n)]
+    out_nbrs = [list(topology.out_neighbors(r)) for r in range(n)]
+    slot_of = [{src: k for k, src in enumerate(in_nbrs[r])} for r in range(n)]
+
+    wins = [AsyncWindow(f"{name}:{r}", max(len(in_nbrs[r]), 1), d + 1,
+                        np.float64) for r in range(n)]
+
+    stop = threading.Event()
+    steps = [0] * n
+    losses: List[List[float]] = [[] for _ in range(n)]
+    finals: list = [None] * n
+    errors: List[BaseException] = []
+    x0 = packer.pack(params0)
+
+    def rank_loop(r: int):
+        try:
+            x = x0.copy()
+            p = 1.0
+            frac = 1.0 / (len(out_nbrs[r]) + 1)
+            # model-sized scratch, allocated once: the hot loop must not
+            # churn fresh ~d-element buffers per step (d can be 10^8)
+            gvec = np.empty(d, np.float64)
+            payload = np.empty(d + 1, np.float64)
+            while not stop.is_set():
+                for k in range(len(in_nbrs[r])):
+                    buf, fresh = wins[r].read(k, consume=True)
+                    if fresh > 0:
+                        x += buf[:-1]
+                        p += buf[-1]
+                z = x / p
+                loss, grads = loss_and_grad(r, steps[r], packer.unpack(z))
+                losses[r].append(float(loss))
+                # x/p-space gradient step: z' = z - lr*grad  =>  dx = -lr*p*g
+                packer.pack(grads, out=gvec)
+                gvec *= lr * p
+                x -= gvec
+                payload[:-1] = x
+                payload[-1] = p
+                payload *= frac
+                for j in out_nbrs[r]:
+                    wins[j].deposit(slot_of[j][r], payload, accumulate=True)
+                x *= frac
+                p *= frac
+                steps[r] += 1
+                if skew[r] > 0 or poll_interval_s > 0:
+                    time.sleep(skew[r] + poll_interval_s)
+            # drain in-flight mass so the audit below is exact
+            for k in range(len(in_nbrs[r])):
+                buf, fresh = wins[r].read(k, consume=True)
+                if fresh > 0:
+                    x += buf[:-1]
+                    p += buf[-1]
+            finals[r] = x / p
+            wins[r].set_self(np.concatenate([x, [p]]))
+        except BaseException as e:
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=rank_loop, args=(r,), daemon=True)
+               for r in range(n)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    stop.wait(duration_s)
+    stop.set()
+    join_budget = max(skew) * 4 + 30.0  # a rank may be mid-gradient
+    for t in threads:
+        t.join(timeout=join_budget)
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError("async DSGD rank threads failed to stop within "
+                           f"{join_budget:.1f}s; aborting without freeing")
+    wall = time.perf_counter() - t0
+    if errors:
+        for w in wins:
+            w.free()
+        raise errors[0]
+
+    total_mass = 0.0
+    for r in range(n):
+        total_mass += float(wins[r].read_self()[-1])
+        for k in range(len(in_nbrs[r])):
+            buf, fresh = wins[r].read(k, consume=False)
+            if fresh > 0:
+                total_mass += float(buf[-1])
+
+    zs = np.stack(finals)
+    gap = float(np.abs(zs - zs.mean(axis=0)).max())
+    report = DSGDReport(
+        wall_time_s=wall,
+        steps_per_rank=list(steps),
+        losses=losses,
+        final_params=[packer.unpack(z) for z in finals],
+        total_mass=total_mass,
+        consensus_gap=gap,
+    )
+    for w in wins:
+        w.free()
+    return report
+
+
+class AsyncWinPutOptimizer:
+    """Host-side driver object behind
+    ``DistributedWinPutOptimizer(..., async_=True)``.
+
+    Unlike the synchronous factory (an ``optax.GradientTransformation`` whose
+    window dataflow compiles into the SPMD step), the asynchronous mode
+    cannot live inside one jitted program — its whole point is that ranks do
+    NOT share a program counter.  This object therefore runs the rank loops
+    on the host runtime (:func:`run_async_dsgd`) while the per-rank gradient
+    work stays jitted jax.
+
+    Usage::
+
+        opt = DistributedWinPutOptimizer(optax.sgd(0.05), topology=topo,
+                                         axis_name="bf", async_=True)
+        report = opt.run(params0, loss_and_grad, duration_s=5.0)
+    """
+
+    def __init__(self, topology: Topology, *, lr: float, name: str = "winput_async"):
+        self.topology = topology
+        self.lr = lr
+        self.name = name
+
+    def run(self, params0, loss_and_grad, *, duration_s: float = 5.0,
+            skew: Optional[Sequence[float]] = None) -> DSGDReport:
+        return run_async_dsgd(
+            self.topology, params0, loss_and_grad, lr=self.lr,
+            duration_s=duration_s, skew=skew, name=self.name,
+        )

@@ -164,3 +164,136 @@ class TestAsyncPushSum:
         rep = run_async_pushsum(topo, x0, tol=1e-12, timeout_s=0.2,
                                 name=fresh_name("early"))
         np.testing.assert_allclose(rep.total_mass, n, atol=1e-9)
+
+
+class TestTreePacker:
+    def test_roundtrip_mixed_dtypes(self):
+        import jax
+        import jax.numpy as jnp
+
+        tree = {
+            "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones((5,), jnp.bfloat16),
+            "scale": jnp.asarray(2.5, jnp.float64),
+        }
+        packer = aw.TreePacker(tree, np.float64)
+        assert packer.size == 12 + 5 + 1
+        vec = packer.pack(tree)
+        assert vec.dtype == np.float64 and vec.shape == (18,)
+        back = packer.unpack(vec)
+        for k in tree:
+            assert back[k].dtype == tree[k].dtype
+            np.testing.assert_allclose(
+                np.asarray(back[k], np.float32), np.asarray(tree[k], np.float32))
+
+    def test_pack_into_preallocated(self):
+        tree = [np.ones(3), np.zeros(2)]
+        packer = aw.TreePacker(tree, np.float64)
+        out = np.empty(5, np.float64)
+        vec = packer.pack(tree, out=out)
+        assert vec is out
+        np.testing.assert_array_equal(vec, [1, 1, 1, 0, 0])
+
+    def test_shape_mismatch_raises(self):
+        packer = aw.TreePacker({"a": np.ones(4)})
+        with pytest.raises(ValueError):
+            packer.unpack(np.ones(3))
+
+    def test_megabyte_payload_rides_window(self):
+        """>= 1 MB model payloads survive the device->window->device trip."""
+        import jax.numpy as jnp
+
+        leaf = jnp.arange(300_000, dtype=jnp.float32)  # 1.2 MB
+        tree = {"big": leaf, "small": jnp.ones((7,), jnp.float32)}
+        packer = aw.TreePacker(tree, np.float64)
+        win = AsyncWindow(fresh_name("mb"), 1, packer.size, np.float64)
+        win.deposit(0, packer.pack(tree), accumulate=False)
+        out, fresh = win.read(0, consume=True)
+        assert fresh == 1
+        back = packer.unpack(out)
+        np.testing.assert_array_equal(np.asarray(back["big"]), np.asarray(leaf))
+        win.free()
+
+
+class TestAsyncDSGD:
+    def _quadratic_setup(self, n=4):
+        """Per-rank quadratic f_r(x) = 0.5||x - t_r||^2; the consensus
+        optimum is the mean of the targets (closed form)."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        targets = rng.standard_normal((n, 6)).astype(np.float32)
+
+        @jax.jit
+        def lg(params, target):
+            loss = 0.5 * jnp.sum((params["x"] - target) ** 2)
+            return loss, {"x": params["x"] - target}
+
+        def loss_and_grad(rank, step, params):
+            loss, g = lg(params, jnp.asarray(targets[rank]))
+            return float(loss), g
+
+        return targets, loss_and_grad
+
+    def test_skewed_ranks_converge_to_consensus_optimum(self):
+        import jax.numpy as jnp
+
+        n = 4
+        targets, loss_and_grad = self._quadratic_setup(n)
+        report = aw.run_async_dsgd(
+            RingGraph(n), {"x": jnp.zeros(6)}, loss_and_grad,
+            lr=0.08, duration_s=3.0, name=fresh_name("dsgd"),
+            skew=[0.001 * (1 + 3 * r) for r in range(n)],
+        )
+        assert abs(report.total_mass - n) < 1e-9
+        assert min(report.steps_per_rank) >= 3
+
+        # Robust gates (the exact stationary point depends on thread timing:
+        # constant-lr async SGD weights objectives by realized step rates):
+        # the mean objective must collapse vs the start, and ranks must agree.
+        def F(x):
+            return float(0.5 * ((x - targets) ** 2).sum(axis=1).mean())
+
+        # F has an irreducible variance floor F* = F(mean target), and the
+        # rate bias (see above) keeps the async stationary point a bounded
+        # distance from the *uniform* optimum — gate on closing >= half the
+        # optimality gap to it, plus consensus.
+        f0, fstar = F(np.zeros(6, np.float32)), F(targets.mean(axis=0))
+        for p in report.final_params:
+            assert F(np.asarray(p["x"])) - fstar < 0.5 * (f0 - fstar)
+        # constant-lr stationary spread grows with lr and rate asymmetry
+        assert report.consensus_gap < 0.3
+
+    def test_optimizer_factory_async_mode(self):
+        import jax.numpy as jnp
+        import optax
+
+        from bluefog_tpu.optim import DistributedWinPutOptimizer
+        from bluefog_tpu.runtime.async_windows import AsyncWinPutOptimizer
+        from bluefog_tpu.topology.schedule import build_schedule
+
+        topo = RingGraph(4)
+        opt = DistributedWinPutOptimizer(
+            optax.sgd(0.1), topology=topo, axis_name="bf", async_=True,
+            lr=0.08)
+        assert isinstance(opt, AsyncWinPutOptimizer)
+        with pytest.raises(TypeError, match="Topology"):
+            DistributedWinPutOptimizer(
+                optax.sgd(0.1), topology=build_schedule(topo),
+                axis_name="bf", async_=True)
+
+        targets, loss_and_grad = self._quadratic_setup(4)
+        opt.name = fresh_name("winput_async")
+        report = opt.run({"x": jnp.zeros(6)}, loss_and_grad, duration_s=2.0,
+                         skew=[0.002] * 4)
+        assert abs(report.total_mass - 4) < 1e-9
+
+        def F(x):
+            return float(0.5 * ((x - targets) ** 2).sum(axis=1).mean())
+
+        f0, fstar = F(np.zeros(6, np.float32)), F(targets.mean(axis=0))
+        z = np.asarray(report.final_params[0]["x"])
+        assert F(z) - fstar < 0.5 * (f0 - fstar)
+        # constant-lr stationary spread scales with lr * |grad|: loose gate
+        assert report.consensus_gap < 0.2
