@@ -86,6 +86,15 @@ int bf_win_set_self(const char* name, const void* data, long long n_elems);
 int bf_win_read_self(const char* name, void* out, long long n_elems);
 int bf_win_num_slots(const char* name);
 
+// --------------------------------------------------------------- tfrecord --
+// CRC32C (Castagnoli) of a buffer; and a TFRecord-framing indexer that fills
+// (payload offset, length) pairs for random access over on-disk shards.  See
+// tfrecord.cc for return codes.
+uint32_t bf_crc32c(const void* data, int64_t len);
+int64_t bf_tfrecord_index(const char* path, int64_t* offsets,
+                          int64_t* lengths, int64_t max_records, int verify,
+                          int64_t* bad_record);
+
 }  // extern "C"
 
 #endif  // BF_RUNTIME_H_

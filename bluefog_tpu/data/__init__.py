@@ -11,10 +11,26 @@ from bluefog_tpu.data.loader import (
     SyntheticClassificationSource,
     prefetch_to_device,
 )
+from bluefog_tpu.data.tfrecord import (
+    TFRecordSource,
+    TFRecordWriter,
+    decode_example,
+    encode_example,
+    image_classification_decoder,
+    read_records,
+    write_image_classification_shards,
+)
 
 __all__ = [
     "ArraySource",
     "DistributedLoader",
     "SyntheticClassificationSource",
     "prefetch_to_device",
+    "TFRecordSource",
+    "TFRecordWriter",
+    "decode_example",
+    "encode_example",
+    "image_classification_decoder",
+    "read_records",
+    "write_image_classification_shards",
 ]

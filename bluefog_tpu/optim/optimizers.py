@@ -307,6 +307,10 @@ def DistributedWinPutOptimizer(
                 "num_steps_per_communication does not apply")
         return AsyncWinPutOptimizer(topo, lr=lr)
 
+    if lr is not None:
+        raise ValueError(
+            "lr= applies only to async_=True (the sync path takes its "
+            "learning rate from `base`); remove lr= or set async_=True")
     scheds = _as_schedules(topology)
     if len(scheds) != 1:
         raise ValueError(

@@ -308,6 +308,24 @@ class TreePacker:
         return jax.tree_util.tree_unflatten(self._treedef, leaves)
 
 
+def _create_windows(name: str, slots_per_rank: Sequence[int],
+                    n_elems: int) -> List[AsyncWindow]:
+    """Create one window per rank, freeing the ones already created if any
+    creation fails (e.g. a name collision with a previous run whose threads
+    never stopped) — a partial failure must not poison the process-global
+    window table for every later run."""
+    wins: List[AsyncWindow] = []
+    try:
+        for r, slots in enumerate(slots_per_rank):
+            wins.append(AsyncWindow(f"{name}:{r}", slots, n_elems,
+                                    np.float64))
+    except BaseException:
+        for w in wins:
+            w.free()
+        raise
+    return wins
+
+
 @dataclass
 class PushSumReport:
     """Outcome of an async push-sum run."""
@@ -368,8 +386,8 @@ def run_async_pushsum(
     # slot index of src in dst's window
     slot_of = [{src: k for k, src in enumerate(in_nbrs[r])} for r in range(n)]
 
-    wins = [AsyncWindow(f"{name}:{r}", len(in_nbrs[r]), n_elems + 1,
-                        np.float64) for r in range(n)]
+    wins = _create_windows(
+        name, [len(in_nbrs[r]) for r in range(n)], n_elems + 1)
 
     stop = threading.Event()
     steps = [0] * n
@@ -551,8 +569,8 @@ def run_async_dsgd(
     out_nbrs = [list(topology.out_neighbors(r)) for r in range(n)]
     slot_of = [{src: k for k, src in enumerate(in_nbrs[r])} for r in range(n)]
 
-    wins = [AsyncWindow(f"{name}:{r}", max(len(in_nbrs[r]), 1), d + 1,
-                        np.float64) for r in range(n)]
+    wins = _create_windows(
+        name, [max(len(in_nbrs[r]), 1) for r in range(n)], d + 1)
 
     stop = threading.Event()
     steps = [0] * n

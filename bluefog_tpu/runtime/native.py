@@ -21,7 +21,8 @@ from typing import Callable, Optional
 from bluefog_tpu.utils import log
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
-_SOURCES = ("logging.cc", "timeline.cc", "engine.cc", "windows.cc")
+_SOURCES = ("logging.cc", "timeline.cc", "engine.cc", "windows.cc",
+            "tfrecord.cc")
 _LIB_PATH = os.path.join(_CSRC, "libbf_runtime.so")
 
 _lib = None
@@ -148,6 +149,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_win_read_self.restype = ctypes.c_int
     lib.bf_win_num_slots.argtypes = [ctypes.c_char_p]
     lib.bf_win_num_slots.restype = ctypes.c_int
+
+    lib.bf_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.bf_crc32c.restype = ctypes.c_uint32
+    lib.bf_tfrecord_index.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.bf_tfrecord_index.restype = ctypes.c_longlong
     return lib
 
 

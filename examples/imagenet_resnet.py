@@ -11,10 +11,12 @@ equivalent of the reference's hook overlap, SURVEY.md §3.3).  The standard
 cosine decay, label smoothing, SGD momentum + weight decay, top-1 eval, and
 periodic (optionally consensus-mode) checkpoints.
 
-Data: ``--data-dir`` pointing at ``train_images.npy / train_labels.npy /
-val_images.npy / val_labels.npy`` (memory-mapped; NHWC uint8 or float) trains
-real ImageNet; without it a deterministic synthetic stand-in of the same
-shapes keeps the example runnable in this offline environment.
+Data: ``--data-dir`` pointing at ``train-*.tfrecord / val-*.tfrecord`` shards
+(tf.Example with raw uint8 image/shape/label — see
+``bluefog_tpu.data.write_image_classification_shards``) or at
+``{train,val}_{images,labels}.npy`` pairs (memory-mapped) trains real
+ImageNet; without it a deterministic synthetic stand-in of the same shapes
+keeps the example runnable in this offline environment.
 
 Run (8 virtual devices):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -59,6 +61,19 @@ TOPOLOGIES = {
 
 def make_sources(args, n_ranks):
     if args.data_dir:
+        import glob
+
+        from bluefog_tpu.data import TFRecordSource
+
+        # TFRecord shards take precedence (train-*.tfrecord / val-*.tfrecord,
+        # e.g. from bluefog_tpu.data.write_image_classification_shards);
+        # otherwise fall back to memory-mapped .npy pairs.
+        if glob.glob(os.path.join(args.data_dir, "train-*.tfrecord")):
+            train = TFRecordSource(
+                os.path.join(args.data_dir, "train-*.tfrecord"))
+            val = TFRecordSource(os.path.join(args.data_dir, "val-*.tfrecord"))
+            return train, val
+
         def load(name):
             return np.load(os.path.join(args.data_dir, name), mmap_mode="r")
 
@@ -87,7 +102,8 @@ def lr_schedule(args, steps_per_epoch):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--data-dir", default=None,
-                    help="dir with {train,val}_{images,labels}.npy; synthetic if unset")
+                    help="dir with {train,val}-*.tfrecord shards or "
+                         "{train,val}_{images,labels}.npy; synthetic if unset")
     ap.add_argument("--epochs", type=int, default=90)
     ap.add_argument("--steps-per-epoch", type=int, default=32,
                     help="synthetic epoch length (ignored with --data-dir)")

@@ -1,0 +1,140 @@
+"""TFRecord container + tf.Example codec + DistributedLoader integration.
+
+Mirrors the reference test strategy (SURVEY.md §4): closed-form round-trip
+assertions over generated on-disk shards, corruption detection, and the
+DistributedSampler contract (disjoint rank shards covering every example).
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.data.tfrecord import (
+    TFRecordSource,
+    TFRecordWriter,
+    crc32c,
+    decode_example,
+    encode_example,
+    image_classification_decoder,
+    read_records,
+    write_image_classification_shards,
+)
+
+
+def test_crc32c_known_vectors():
+    # canonical CRC32C test vectors (RFC 3720 / kernel test suite)
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_crc32c_matches_python_fallback():
+    from bluefog_tpu.data import tfrecord as tfr
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=1000, dtype=np.uint8).tobytes()
+    native = crc32c(data)
+    table = tfr._py_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = int(table[(crc ^ b) & 0xFF]) ^ (crc >> 8)
+    assert native == (crc ^ 0xFFFFFFFF)
+
+
+def test_record_roundtrip(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    payloads = [b"hello", b"", b"x" * 10_000, b"\x00\xff" * 7]
+    with TFRecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+    assert list(read_records(path, verify=True)) == payloads
+
+
+def test_corruption_detected(tmp_path):
+    path = str(tmp_path / "bad.tfrecord")
+    with TFRecordWriter(path) as w:
+        w.write(b"payload-one")
+        w.write(b"payload-two")
+    data = bytearray(open(path, "rb").read())
+    data[-7] ^= 0x40  # flip a bit inside the second payload
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="checksum"):
+        list(read_records(path, verify=True))
+    # verify=False trusts the framing (lengths intact) and still reads
+    assert len(list(read_records(path, verify=False))) == 2
+
+
+def test_truncation_detected(tmp_path):
+    path = str(tmp_path / "trunc.tfrecord")
+    with TFRecordWriter(path) as w:
+        w.write(b"payload-one" * 10)
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:-20])
+    with pytest.raises(ValueError, match="framing|truncated"):
+        list(read_records(path, verify=False))
+
+
+def test_example_codec_roundtrip():
+    features = {
+        "image": b"\x01\x02\x03\x04",
+        "shape": np.asarray([2, 2, 1], np.int64),
+        "label": np.asarray([7], np.int64),
+        "weights": np.asarray([0.5, -1.25], np.float32),
+        "neg": np.asarray([-3], np.int64),
+    }
+    got = decode_example(encode_example(features))
+    assert got["image"] == [b"\x01\x02\x03\x04"]
+    np.testing.assert_array_equal(got["shape"], [2, 2, 1])
+    np.testing.assert_array_equal(got["label"], [7])
+    np.testing.assert_allclose(got["weights"], [0.5, -1.25])
+    np.testing.assert_array_equal(got["neg"], [-3])
+
+
+def _make_shards(tmp_path, n=48, hw=8, classes=10, shard_size=20):
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(n, hw, hw, 3), dtype=np.uint8)
+    labels = rng.integers(0, classes, size=n).astype(np.int64)
+    paths = write_image_classification_shards(
+        str(tmp_path), images, labels, shard_size=shard_size)
+    return images, labels, paths
+
+
+def test_source_random_access(tmp_path):
+    images, labels, paths = _make_shards(tmp_path)
+    assert len(paths) == 3  # 48 / 20 -> 20+20+8
+    src = TFRecordSource(str(tmp_path / "*.tfrecord"), verify=True)
+    assert len(src) == 48
+    # arbitrary gather order, across shard boundaries
+    idx = np.asarray([47, 0, 20, 19, 21, 5])
+    imgs, labs = src[idx]
+    np.testing.assert_array_equal(imgs, images[idx])
+    np.testing.assert_array_equal(labs, labels[idx])
+    assert imgs.dtype == np.uint8
+
+
+def test_distributed_loader_over_tfrecords(tmp_path, devices8):
+    """The DistributedSampler contract holds over on-disk shards: one epoch
+    covers every example exactly once, disjointly across ranks."""
+    import bluefog_tpu as bf
+
+    images, labels, _ = _make_shards(tmp_path, n=64)
+    bf.init()
+    from bluefog_tpu.data import DistributedLoader
+
+    src = TFRecordSource(str(tmp_path / "*.tfrecord"))
+    loader = DistributedLoader(src, per_rank_batch=2, device_put=True)
+    assert loader.steps_per_epoch == 4  # 64 / 8 ranks / 2 per batch
+
+    seen = []
+    for ximgs, ylabs in loader.epoch(0):
+        assert ximgs.shape == (8, 2, 8, 8, 3)
+        assert ylabs.shape == (8, 2)
+        seen.append(np.asarray(ximgs).reshape(-1, 8, 8, 3))
+    seen = np.concatenate(seen)
+    assert len(seen) == 64
+    # every on-disk example appears exactly once across ranks and steps
+    seen_keys = sorted(map(bytes, seen.reshape(64, -1)))
+    want_keys = sorted(map(bytes, images.reshape(64, -1)))
+    assert seen_keys == want_keys
