@@ -41,6 +41,7 @@ from bluefog_tpu.parallel.context import (
     initialized,
     size,
     rank,
+    process_rank,
     local_size,
     local_rank,
     machine_size,
@@ -72,6 +73,7 @@ from bluefog_tpu.parallel.api import (
     win_update,
     win_update_then_collect,
     win_mutex,
+    win_mutex_break,
     broadcast_parameters,
     allreduce_parameters,
     broadcast_optimizer_state,

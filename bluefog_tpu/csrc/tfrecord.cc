@@ -19,27 +19,31 @@
 
 namespace {
 
-uint32_t g_table[8][256];
-bool g_table_init = false;
-
-void init_tables() {
-  if (g_table_init) return;
-  const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
-  for (uint32_t i = 0; i < 256; ++i) {
-    uint32_t crc = i;
-    for (int k = 0; k < 8; ++k)
-      crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
-    g_table[0][i] = crc;
+struct CrcTables {
+  uint32_t t[8][256];
+  CrcTables() {
+    const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+      t[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xFF];
   }
-  for (uint32_t i = 0; i < 256; ++i)
-    for (int s = 1; s < 8; ++s)
-      g_table[s][i] =
-          (g_table[s - 1][i] >> 8) ^ g_table[0][g_table[s - 1][i] & 0xFF];
-  g_table_init = true;
+};
+
+const uint32_t (*tables())[256] {
+  // function-local static: C++11 guarantees thread-safe one-time init —
+  // concurrent first calls from writer/indexer threads are well-defined
+  static const CrcTables kTables;
+  return kTables.t;
 }
 
 uint32_t crc32c_impl(const uint8_t* p, int64_t n, uint32_t crc) {
-  init_tables();
+  const uint32_t (*g_table)[256] = tables();
   crc = ~crc;
   while (n >= 8) {
     uint64_t chunk;

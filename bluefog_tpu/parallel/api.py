@@ -61,6 +61,7 @@ __all__ = [
     "win_update",
     "win_update_then_collect",
     "win_mutex",
+    "win_mutex_break",
     "broadcast_parameters",
     "allreduce_parameters",
     "broadcast_optimizer_state",
@@ -409,28 +410,139 @@ def win_update_then_collect(name: str):
 
 _win_mutexes: Dict[str, threading.RLock] = {}
 _win_mutexes_guard = threading.Lock()
+_dist_held = threading.local()  # per-thread reentrancy counts per name
+
+
+def _coordination_client():
+    """The jax.distributed coordination-service client, or None when this is
+    a single-controller process (no distributed runtime to coordinate with).
+
+    In a multi-controller job a missing client is an ERROR, not a fallback:
+    silently downgrading to the process-local lock would let two controllers
+    into the critical section — the exact race win_mutex exists to prevent.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    try:
+        from jax._src.distributed import global_state
+
+        client = global_state.client
+    except Exception as e:
+        raise RuntimeError(
+            "win_mutex: multi-controller job but the jax.distributed "
+            "coordination-service client is unavailable — refusing to "
+            "downgrade to a process-local lock") from e
+    if client is None:
+        raise RuntimeError(
+            "win_mutex: multi-controller job but jax.distributed was not "
+            "initialized with a coordination service")
+    return client
 
 
 @contextlib.contextmanager
-def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None):
+def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
+              timeout_s: float = 60.0, poll_interval_s: float = 0.002):
     """Mutual exclusion over window ``name`` (reference ``bf.win_mutex``,
     an MPI passive-target ``MPI_Win_lock_all`` epoch guarding concurrent
     one-sided access — ``bluefog/torch/mpi_win_ops.cc``).
 
-    In the SPMD model, one-sided transfers inside a jitted step are ordered by
-    data dependencies, so no device-side lock exists or is needed.  What *can*
-    race is the host-side window registry when background host ops
-    (:func:`enqueue_host_op`) and the main thread both mutate the same named
-    window; this context manager serializes those, which is the exact hazard
-    the reference's mutex exists for.  ``for_self``/``ranks`` are accepted for
-    call-site compatibility; the lock is per-window-name rather than per-rank
-    (all ranks live in one process here).
+    Scope — stated precisely, per deployment shape:
+
+    - **Single controller** (``jax.process_count() == 1``): a process-local
+      reentrant lock per window name.  Device-side one-sided transfers inside
+      a jitted step are ordered by data dependencies, so the only real race
+      is host threads (background :func:`enqueue_host_op` workers vs the main
+      thread) mutating the same named window — which this serializes.
+    - **Multi-controller** (``jax.distributed`` initialized, >1 processes): a
+      **distributed lock on the coordination service** — acquisition is an
+      atomic key creation (the service rejects duplicates), release deletes
+      the key, and contenders poll.  This is the cross-process exclusion the
+      reference gets from ``MPI_Win_lock_all``; it is reentrant within a
+      thread, and raises ``TimeoutError`` after ``timeout_s``.
+
+      Known failure mode (same as an MPI lock whose holder dies): the lock
+      has no lease — a holder that crashes before releasing leaves the key
+      behind, and later acquisitions time out naming the dead owner.  The
+      coordination service has no compare-and-delete, so automatic stealing
+      cannot be made race-free; recover explicitly with
+      :func:`win_mutex_break` once the owner is known dead.
+
+    ``for_self``/``ranks`` are accepted for reference call-site
+    compatibility; the lock is per-window-name, not per-rank.
     """
-    del for_self, ranks  # rank-granular locking is meaningless in-process
-    with _win_mutexes_guard:
-        lock = _win_mutexes.setdefault(name, threading.RLock())
-    with lock:
+    del for_self, ranks  # lock granularity is the window name
+    client = _coordination_client()
+    if client is None:
+        with _win_mutexes_guard:
+            lock = _win_mutexes.setdefault(name, threading.RLock())
+        with lock:
+            yield
+        return
+
+    import time as _time
+
+    held = getattr(_dist_held, "counts", None)
+    if held is None:
+        held = _dist_held.counts = {}
+    if held.get(name, 0) > 0:  # reentrant within this thread
+        held[name] += 1
+        try:
+            yield
+        finally:
+            held[name] -= 1
+        return
+
+    import jax
+    import os as _os
+
+    key = f"bluefog_tpu/win_mutex/{name}"
+    owner = f"{jax.process_index()}:{_os.getpid()}:{threading.get_ident()}"
+    deadline = _time.monotonic() + timeout_s
+    while True:
+        try:
+            client.key_value_set(key, owner)  # atomic: raises if held
+            break
+        except Exception as e:
+            if "ALREADY_EXISTS" not in str(e):
+                raise
+            if _time.monotonic() > deadline:
+                holder = ""
+                try:
+                    holder = client.key_value_try_get(key)
+                except Exception:
+                    pass
+                raise TimeoutError(
+                    f"win_mutex({name!r}): lock held for {timeout_s:.0f}s "
+                    f"by {holder!r} (process:pid:thread); if that owner is "
+                    "dead, recover with win_mutex_break(name)") from e
+            _time.sleep(poll_interval_s)
+    held[name] = 1
+    try:
         yield
+    finally:
+        held[name] = 0
+        client.key_value_delete(key)
+
+
+def win_mutex_break(name: str = "win") -> bool:
+    """Forcibly release a distributed :func:`win_mutex` whose holder died
+    (the ``MPI_Win_unlock_all``-after-failure analog).  Returns True if a
+    held lock was cleared.  **Only** call this when the owner named by the
+    TimeoutError is known dead — breaking a live holder's lock removes the
+    exclusion it is relying on."""
+    client = _coordination_client()
+    if client is None:
+        with _win_mutexes_guard:
+            _win_mutexes.pop(name, None)
+        return False
+    key = f"bluefog_tpu/win_mutex/{name}"
+    try:
+        client.key_value_delete(key)
+        return True
+    except Exception:
+        return False
 
 
 # ---------------------------------------------------------------------------

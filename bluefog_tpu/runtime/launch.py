@@ -32,25 +32,44 @@ def initialize_cluster(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    initialization_timeout: Optional[int] = None,
 ) -> None:
     """Rendezvous all hosts (no-op on single-host).
 
     Mirrors ``jax.distributed.initialize`` argument conventions; on Cloud TPU
     the arguments are auto-detected from the metadata server.
+
+    Failure policy: when the caller **asked** for a cluster (any of the
+    arguments given), a rendezvous failure raises — a training job silently
+    running undistributed at 1/N scale is the worst possible outcome.  Only
+    the fully-auto-detected call (no arguments, e.g. a dev box without TPU
+    metadata) degrades to single-process with a warning.
     """
     import jax
 
     if num_processes == 1:
         return
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
+    kwargs = {}
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = initialization_timeout
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **kwargs,
         )
         log.info("cluster initialized: process %d/%d", jax.process_index(), jax.process_count())
-    except Exception as e:  # single-host dev boxes: fine to run undistributed
-        log.warn("jax.distributed.initialize skipped: %s", e)
+    except Exception as e:
+        if explicit:
+            raise RuntimeError(
+                f"cluster rendezvous failed (coordinator="
+                f"{coordinator_address}, num_processes={num_processes}, "
+                f"process_id={process_id}): {e}") from e
+        log.warn("jax.distributed.initialize skipped (auto-detect found no "
+                 "cluster): %s", e)
 
 
 def _apply_env(args) -> None:

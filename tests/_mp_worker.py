@@ -1,0 +1,116 @@
+"""Multi-process test worker (launched by test_multiprocess.py, one OS
+process per rank — the analog of the reference's ``mpirun -np N pytest``
+harness, SURVEY.md §4).
+
+argv: <process_id> <num_processes> <coordinator_port>
+
+Each process owns 2 virtual CPU devices; the global mesh spans
+``2 * num_processes`` devices across real process boundaries, with gloo
+carrying the cross-process collectives.  Asserts, printing MP_WORKER_OK on
+success:
+
+1. loud rendezvous via ``initialize_cluster`` (explicit args);
+2. ``process_rank``/``process_count`` and a spanning ``bf.init`` context;
+3. closed-form gossip (neighbor_allreduce) ACROSS the process boundary;
+4. closed-form global allreduce;
+5. ``win_mutex`` is a real cross-process lock: racing read-modify-write
+   increments on the coordination-service KV never lose an update.
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+LOCAL_DEVICES = 2
+MUTEX_ITERS = 15
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from bluefog_tpu.runtime.launch import initialize_cluster
+
+    initialize_cluster(f"127.0.0.1:{port}", nproc, pid,
+                       initialization_timeout=60)
+
+    import bluefog_tpu as bf
+    from bluefog_tpu.ops import collectives as C
+    from bluefog_tpu.parallel.api import shard_map, win_mutex
+    from bluefog_tpu.topology import RingGraph
+    from bluefog_tpu.topology.schedule import build_schedule
+
+    assert jax.process_count() == nproc, jax.process_count()
+    assert bf.process_rank() == pid
+    n = nproc * LOCAL_DEVICES
+    assert len(jax.devices()) == n
+
+    ctx = bf.init(topology=RingGraph(n))
+    assert ctx.size == n
+    # rank(): mesh-rank of this controller's first device
+    assert bf.rank() == pid * LOCAL_DEVICES, bf.rank()
+
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    sched = build_schedule(RingGraph(n))
+    xs_global = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    local = xs_global[pid * LOCAL_DEVICES:(pid + 1) * LOCAL_DEVICES]
+    xs = multihost_utils.host_local_array_to_global_array(
+        local, ctx.mesh, P(ctx.axis_name))
+
+    # 3. gossip across the process boundary, closed form: out = W @ xs
+    f = jax.jit(shard_map(
+        lambda v: C.neighbor_allreduce(v, sched, ctx.axis_name),
+        mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+        out_specs=P(ctx.axis_name), check_vma=False))
+    out = f(xs)
+    want = RingGraph(n).weights @ xs_global
+    for shard in out.addressable_shards:
+        row = shard.index[0].start  # global row of this local shard
+        np.testing.assert_allclose(
+            np.asarray(shard.data), want[row:row + 1], rtol=1e-6, atol=1e-6)
+
+    # 4. global allreduce (mean) across both processes
+    g = jax.jit(shard_map(
+        lambda v: C.allreduce(v, ctx.axis_name, average=True),
+        mesh=ctx.mesh, in_specs=(P(ctx.axis_name),), out_specs=P(ctx.axis_name),
+        check_vma=False))
+    mean_out = g(xs)
+    for shard in mean_out.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(shard.data)[0], xs_global.mean(axis=0), rtol=1e-6)
+
+    # 5. win_mutex: cross-process read-modify-write must not lose updates
+    from jax._src.distributed import global_state
+    client = global_state.client
+    if pid == 0:
+        client.key_value_set("mp_counter", "0")
+    client.wait_at_barrier("mutex_start", 30_000)
+    for _ in range(MUTEX_ITERS):
+        with win_mutex("mp_test"):
+            v = int(client.blocking_key_value_get("mp_counter", 10_000))
+            time.sleep(0.002)  # widen the race window
+            client.key_value_set("mp_counter", str(v + 1),
+                                 allow_overwrite=True)
+    client.wait_at_barrier("mutex_end", 60_000)
+    total = int(client.blocking_key_value_get("mp_counter", 10_000))
+    assert total == nproc * MUTEX_ITERS, (
+        f"lost updates: counter {total} != {nproc * MUTEX_ITERS}")
+
+    print(f"MP_WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
