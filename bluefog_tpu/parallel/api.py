@@ -500,6 +500,7 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
     key = f"bluefog_tpu/win_mutex/{name}"
     owner = f"{jax.process_index()}:{_os.getpid()}:{threading.get_ident()}"
     deadline = _time.monotonic() + timeout_s
+    backoff = poll_interval_s
     while True:
         try:
             client.key_value_set(key, owner)  # atomic: raises if held
@@ -517,7 +518,11 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
                     f"win_mutex({name!r}): lock held for {timeout_s:.0f}s "
                     f"by {holder!r} (process:pid:thread); if that owner is "
                     "dead, recover with win_mutex_break(name)") from e
-            _time.sleep(poll_interval_s)
+            # exponential backoff: N contenders busy-polling the (single)
+            # coordination service with failing RPCs would starve its
+            # heartbeat work at pod scale
+            _time.sleep(backoff)
+            backoff = min(backoff * 2, 0.1)
     held[name] = 1
     try:
         yield
@@ -534,8 +539,9 @@ def win_mutex_break(name: str = "win") -> bool:
     exclusion it is relying on."""
     client = _coordination_client()
     if client is None:
-        with _win_mutexes_guard:
-            _win_mutexes.pop(name, None)
+        # single-controller: a holder's death is process death, so there is
+        # no dead-owner state to clear — and dropping a live RLock would let
+        # a second thread into the critical section. Pure no-op.
         return False
     key = f"bluefog_tpu/win_mutex/{name}"
     try:

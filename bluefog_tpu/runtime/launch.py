@@ -124,14 +124,38 @@ def main(argv: Optional[List[str]] = None):
         "run once per host on multi-host pods)",
     )
     _add_common_args(ap)
+    ap.add_argument(
+        "--supervise", type=int, default=None, metavar="MAX_RESTARTS",
+        help="run the script as a supervised subprocess, restarting it from "
+        "its latest checkpoint when it dies (peer failure kills survivors "
+        "via the coordination service; the hang watchdog kills wedged "
+        "collectives) — up to MAX_RESTARTS times")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
     _apply_env(args)
+    os.environ.setdefault("BLUEFOG_TPU_LAUNCHED", "1")
+    if args.supervise is not None:
+        if (args.coordinator is not None or args.num_processes is not None
+                or args.process_id is not None):
+            # The supervised child must rendezvous afresh on every restart —
+            # initialize_cluster here (once, in the parent) cannot provide
+            # that, and silently dropping the flags would run the job
+            # undistributed at 1/N scale.  The script owns its own
+            # initialize_cluster call in supervised mode.
+            raise SystemExit(
+                "--supervise cannot be combined with --coordinator/"
+                "--num-processes/--process-id: the supervised script must "
+                "call initialize_cluster itself so every restart "
+                "re-rendezvouses")
+        from bluefog_tpu.utils.failure import run_supervised
+
+        raise SystemExit(run_supervised(
+            [sys.executable, args.script] + list(args.script_args),
+            max_restarts=args.supervise))
     initialize_cluster(args.coordinator, args.num_processes, args.process_id)
     sys.argv = [args.script] + list(args.script_args)
-    os.environ.setdefault("BLUEFOG_TPU_LAUNCHED", "1")
     runpy.run_path(args.script, run_name="__main__")
 
 

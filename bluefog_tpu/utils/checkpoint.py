@@ -162,12 +162,14 @@ class CheckpointManager:
 
 
 def run_with_restart(
-    train_fn: Callable[[Any, int], Any],
+    train_fn: Callable[..., Any],
     manager: CheckpointManager,
     init_state: Any,
     *,
     max_restarts: int = 3,
     recoverable: tuple = (Exception,),
+    heartbeat_timeout_s: Optional[float] = None,
+    heartbeat_grace_s: float = 30.0,
 ) -> Any:
     """Failure-detection/recovery loop (absent from the reference; SURVEY §5).
 
@@ -180,7 +182,20 @@ def run_with_restart(
     such exceptions from the collective runtime, so wrapping the train loop
     in this is the minimal elastic story; true re-sharding elasticity is out
     of reference scope.
+
+    ``heartbeat_timeout_s`` additionally arms a hang watchdog
+    (:class:`bluefog_tpu.utils.failure.Heartbeat`): ``train_fn`` is then
+    called as ``train_fn(state, start_step, heartbeat)`` and must call
+    ``heartbeat.beat(step)`` once per step.  A silent hang (a collective
+    waiting on a wedged peer) gets a :class:`HangError` injected — caught
+    here like any failure, restoring the checkpoint — and a hang stuck in
+    native code beyond ``heartbeat_grace_s`` terminates the process for the
+    outer supervisor (:func:`bluefog_tpu.utils.failure.run_supervised`).
     """
+    from bluefog_tpu.utils.failure import HangError, Heartbeat
+
+    if heartbeat_timeout_s is not None:
+        recoverable = tuple(recoverable) + (HangError,)
     restarts = 0
     while True:
         # Recovery (latest_step/restore — which also joins and re-raises a
@@ -195,7 +210,11 @@ def run_with_restart(
                 state = manager.restore(step, template=init_state)
                 start = step + 1
                 log.info("restarting from checkpoint step %d", step)
-            return train_fn(state, start)
+            if heartbeat_timeout_s is None:
+                return train_fn(state, start)
+            with Heartbeat(heartbeat_timeout_s,
+                           grace_s=heartbeat_grace_s) as hb:
+                return train_fn(state, start, hb)
         except recoverable as e:  # noqa: PERF203
             restarts += 1
             if restarts > max_restarts:

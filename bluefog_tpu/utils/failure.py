@@ -1,0 +1,243 @@
+"""Failure detection: hang watchdog + process supervisor.
+
+The reference has **no failure story** (SURVEY.md §5: a dead MPI rank kills
+the job, nothing restarts it).  The TPU build's minimum, per SURVEY §5, is
+detecting that training stopped making progress and restarting from the
+checkpoint subsystem.  Failures come in two shapes with different detectors:
+
+1. **A peer process dies.** The jax.distributed coordination service's own
+   heartbeats detect this and terminate the survivors (fatal check in the
+   runtime), so every process of the job *exits*.  Detection is free; what is
+   needed is a **supervisor** that restarts the job from the latest
+   checkpoint: :func:`run_supervised` (also wired as ``bfrun-tpu
+   --supervise N``).  The training script resumes via
+   ``CheckpointManager.latest_step()`` exactly as ``run_with_restart`` does.
+
+2. **The job hangs without dying** — a collective waiting on a wedged peer,
+   a deadlocked host thread, a stuck IO.  Nothing raises, so a watchdog must
+   notice the silence: :class:`Heartbeat` is armed with a deadline and beaten
+   once per training step; on a missed deadline it dumps every thread's
+   stack, then escalates:
+
+   - ``action='raise'``: inject :class:`HangError` into the training thread
+     (``PyThreadState_SetAsyncExc``).  This interrupts *Python-level* hangs
+     (polling loops, lock spins) and lets ``run_with_restart`` recover
+     in-process from the checkpoint.  A thread blocked inside a C call (an
+     XLA collective riding ICI) executes no bytecode and cannot be
+     interrupted this way — so if the beat still doesn't arrive within
+     ``grace_s``, the watchdog falls through to
+   - ``action='exit'`` (or the raise-path escalation): terminate the process
+     (SIGTERM, then SIGKILL) so layer 1 — the supervisor — restarts it.
+     Killing the process is the only sound recovery from a wedged device
+     collective; anything less leaves the runtime in an undefined state.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional, Sequence
+
+from bluefog_tpu.utils import log
+
+__all__ = ["HangError", "Heartbeat", "run_supervised"]
+
+
+class HangError(RuntimeError):
+    """Raised (asynchronously) in the training thread when the heartbeat
+    deadline passes — recoverable by ``run_with_restart``."""
+
+
+def _async_raise(thread_ident: int, exc_type) -> bool:
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), ctypes.py_object(exc_type))
+    if res > 1:  # "we broke the interpreter" — undo
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_ident), None)
+    return res == 1
+
+
+def _dump_stacks() -> str:
+    frames = sys._current_frames()
+    parts: List[str] = []
+    for t in threading.enumerate():
+        f = frames.get(t.ident)
+        if f is None:
+            continue
+        parts.append(f"--- thread {t.name} ({t.ident}) ---\n"
+                     + "".join(traceback.format_stack(f)))
+    return "\n".join(parts)
+
+
+class Heartbeat:
+    """Deadline watchdog over training progress.
+
+    Usage (what ``run_with_restart(heartbeat_timeout_s=...)`` does)::
+
+        hb = Heartbeat(timeout_s=60)
+        hb.start()
+        try:
+            for step in ...:
+                train_step(...)
+                hb.beat(step)
+        finally:
+            hb.stop()
+
+    On a missed deadline: thread stacks are logged, ``on_hang`` (if given)
+    is called, then per ``action``:
+
+    - ``'raise'`` (default): inject :class:`HangError` into the monitored
+      thread; if no beat or exit follows within ``grace_s`` (the thread is
+      blocked in C — e.g. a wedged device collective), terminate the
+      process so a supervisor can restart it.
+    - ``'exit'``: terminate the process immediately (SIGTERM, SIGKILL after
+      5 s).
+    - ``'callback'``: only ``on_hang`` runs (testing / custom policies).
+    """
+
+    def __init__(self, timeout_s: float, *, action: str = "raise",
+                 grace_s: float = 30.0,
+                 on_hang: Optional[Callable[[], None]] = None,
+                 thread: Optional[threading.Thread] = None):
+        if action not in ("raise", "exit", "callback"):
+            raise ValueError(f"unknown action {action!r}")
+        self.timeout_s = float(timeout_s)
+        self.grace_s = float(grace_s)
+        self.action = action
+        self.on_hang = on_hang
+        self._target = thread or threading.current_thread()
+        self._last = time.monotonic()
+        self._beats = 0
+        self._step = None
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.hangs_detected = 0
+
+    # ------------------------------------------------------------------ api
+    def beat(self, step=None) -> None:
+        """Record progress (call once per training step; thread-safe)."""
+        self._last = time.monotonic()
+        self._beats += 1
+        self._step = step
+
+    @property
+    def beats(self) -> int:
+        return self._beats
+
+    def start(self) -> "Heartbeat":
+        if self._monitor is not None:
+            raise RuntimeError("heartbeat already started")
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._monitor = threading.Thread(
+            target=self._run, name="bf-heartbeat", daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -------------------------------------------------------------- monitor
+    def _run(self) -> None:
+        poll = max(self.timeout_s / 4.0, 0.01)
+        while not self._stop.wait(poll):
+            silent_for = time.monotonic() - self._last
+            if silent_for < self.timeout_s:
+                continue
+            self.hangs_detected += 1
+            log.error(
+                "heartbeat: no progress for %.1fs (last step %r) — hang "
+                "detected.\n%s", silent_for, self._step, _dump_stacks())
+            if self.on_hang is not None:
+                try:
+                    self.on_hang()
+                except Exception as e:  # noqa: BLE001 — watchdog must go on
+                    log.error("heartbeat on_hang callback failed: %s", e)
+            if self.action == "callback":
+                self._last = time.monotonic()  # re-arm
+                continue
+            if self.action == "raise" and self._target.is_alive():
+                beats_before = self._beats
+                if time.monotonic() - self._last < self.timeout_s:
+                    # a beat landed while we were dumping stacks / running
+                    # on_hang: the step was slow, not hung — don't kill a
+                    # progressing thread
+                    continue
+                if _async_raise(self._target.ident, HangError):
+                    log.warn("heartbeat: injected HangError into %s; "
+                             "grace %.1fs", self._target.name, self.grace_s)
+                    deadline = time.monotonic() + self.grace_s
+                    while time.monotonic() < deadline:
+                        if self._stop.wait(0.05):
+                            return  # recovered: stop() was called
+                        if self._beats != beats_before:
+                            break  # recovered: training is progressing again
+                    else:
+                        log.error(
+                            "heartbeat: thread did not respond to HangError "
+                            "within %.1fs (blocked in native code) — "
+                            "terminating the process for the supervisor",
+                            self.grace_s)
+                        self._terminate()
+                        return
+                    continue
+            self._terminate()
+            return
+
+    def _terminate(self) -> None:
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(5.0)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def run_supervised(
+    argv: Sequence[str],
+    *,
+    max_restarts: int = 3,
+    min_uptime_s: float = 0.0,
+    env: Optional[dict] = None,
+) -> int:
+    """Process-level supervisor: run ``argv`` until it exits 0, restarting
+    on failure up to ``max_restarts`` times (``bfrun-tpu --supervise N``).
+
+    This is the recovery half of failure shape 1 (peer death: the jax
+    coordination service kills every process of the job) and of the
+    watchdog's kill escalation (shape 2): the re-executed script resumes
+    from its latest checkpoint (``CheckpointManager.latest_step()``), so a
+    crash or wedged collective costs at most the progress since the last
+    save.  ``min_uptime_s`` guards against hot crash loops: a run that died
+    faster than this does not earn a restart.
+    """
+    restarts = 0
+    while True:
+        t0 = time.monotonic()
+        proc = subprocess.run(list(argv), env=env)
+        uptime = time.monotonic() - t0
+        if proc.returncode == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            log.error("supervisor: giving up after %d restarts (last rc %d)",
+                      max_restarts, proc.returncode)
+            return proc.returncode
+        if uptime < min_uptime_s:
+            log.error("supervisor: died after %.1fs (< min uptime %.1fs); "
+                      "not restarting a crash loop", uptime, min_uptime_s)
+            return proc.returncode
+        log.warn("supervisor: job exited rc %d after %.1fs; restart %d/%d",
+                 proc.returncode, uptime, restarts, max_restarts)
