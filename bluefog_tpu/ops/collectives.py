@@ -43,6 +43,7 @@ from jax import lax
 
 from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
+from bluefog_tpu.utils import timeline as _tl
 
 __all__ = [
     "allreduce",
@@ -182,6 +183,12 @@ def neighbor_allreduce(
     dominates).
     """
     sched = _as_schedule(schedule)
+    # runtime per-round spans (B once inputs are live, E once the weighted
+    # merge materializes; per-rank lanes) — identity unless a timeline is
+    # active at trace time.  The reference emits the analogous per-tensor
+    # enqueue/execute stage events from operations.cc (SURVEY.md §5).
+    x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
+                         axis_name=axis_name)
 
     if backend == "pallas":
         from bluefog_tpu.ops import pallas_gossip
@@ -198,18 +205,25 @@ def neighbor_allreduce(
             )
             for idx, leaf in enumerate(leaves)
         ]
-        return jax.tree_util.tree_unflatten(treedef, outs)
+        out = jax.tree_util.tree_unflatten(treedef, outs)
+        return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
+                                axis_name=axis_name)
 
     def one(leaf):
         acc_dt = _acc_dtype(leaf)
         self_w, recv_w = _rank_weights(sched, axis_name, self_weight, recv_weights, acc_dt)
         out = self_w * leaf.astype(acc_dt)
         for k, perm in enumerate(sched.perms):
-            recvd = lax.ppermute(leaf, axis_name, perm)
-            out = out + recv_w[k] * recvd.astype(acc_dt)
+            # named_scope: per-slot attribution in jax.profiler/Perfetto
+            # device traces (free — trace-time metadata only)
+            with jax.named_scope(f"bf.neighbor_allreduce.slot{k}"):
+                recvd = lax.ppermute(leaf, axis_name, perm)
+                out = out + recv_w[k] * recvd.astype(acc_dt)
         return out.astype(leaf.dtype)
 
-    return jax.tree_util.tree_map(one, x)
+    out = jax.tree_util.tree_map(one, x)
+    return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
+                            axis_name=axis_name)
 
 
 def neighbor_allreduce_dynamic(
@@ -402,6 +416,8 @@ def hierarchical_neighbor_allreduce(
     ``machine_schedule`` is a schedule/topology over ``n_machines =
     axis_size / local_size`` nodes.
     """
+    x = _tl.device_stage(x, "bf.hierarchical_neighbor_allreduce", phase="B",
+                         axis_name=axis_name)
     msched = _as_schedule(machine_schedule)
     n_machines = msched.size
     groups = [list(range(m * local_size, (m + 1) * local_size)) for m in range(n_machines)]
@@ -433,8 +449,11 @@ def hierarchical_neighbor_allreduce(
             recv_w = jnp.asarray(recv_weights, acc_dt)
         out = self_w * local_avg
         for k, rp in enumerate(rank_perms):
-            recvd = lax.ppermute(local_avg.astype(leaf.dtype), axis_name, rp)
-            out = out + recv_w[k] * recvd.astype(acc_dt)
+            with jax.named_scope(f"bf.hierarchical.machine_slot{k}"):
+                recvd = lax.ppermute(local_avg.astype(leaf.dtype), axis_name, rp)
+                out = out + recv_w[k] * recvd.astype(acc_dt)
         return out.astype(leaf.dtype)
 
-    return jax.tree_util.tree_map(one, x)
+    out = jax.tree_util.tree_map(one, x)
+    return _tl.device_stage(out, "bf.hierarchical_neighbor_allreduce",
+                            phase="E", axis_name=axis_name)

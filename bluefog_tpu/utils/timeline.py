@@ -34,6 +34,7 @@ __all__ = [
     "timeline_start_activity",
     "timeline_end_activity",
     "timeline_context",
+    "device_stage",
 ]
 
 
@@ -59,6 +60,11 @@ class Timeline:
         return (time.perf_counter() - self._t0) * 1e6
 
     def begin(self, name: str, category: str = "activity", tid: int = 0):
+        # closed-check first: compiled device_stage callbacks keep a
+        # reference to this writer for the program's lifetime — after close
+        # they must DROP events, not grow an unflushable buffer forever
+        if getattr(self, "_closed", False):
+            return
         if self._native is not None:
             self._native.begin(name.encode(), category.encode(), tid)
             return
@@ -68,6 +74,8 @@ class Timeline:
             self._events.append(ev)
 
     def end(self, name: str, category: str = "activity", tid: int = 0):
+        if getattr(self, "_closed", False):
+            return
         if self._native is not None:
             self._native.end(name.encode(), category.encode(), tid)
             return
@@ -77,6 +85,8 @@ class Timeline:
             self._events.append(ev)
 
     def instant(self, name: str, category: str = "marker"):
+        if getattr(self, "_closed", False):
+            return
         if self._native is not None:
             self._native.instant(name.encode(), category.encode())
             return
@@ -188,3 +198,53 @@ def timeline_context(name: str, category: str = "activity"):
         yield
     finally:
         timeline_end_activity(name, category)
+
+
+def device_stage(x, name: str, *, phase: str = "B",
+                 category: str = "gossip", axis_name: Optional[str] = None):
+    """Emit a timeline event from INSIDE a jitted program at **runtime** —
+    the per-stage device-side visibility of the reference's
+    ``timeline.cc`` (events at enqueue/negotiate/execute/callback stages,
+    SURVEY.md §5), which trace-time annotation alone cannot give.
+
+    Returns ``x`` unchanged.  The event is an ``io_callback`` whose operand
+    is a scalar sliced from ``x``, so it fires once ``x``'s computation has
+    produced data — a ``phase='B'`` on a collective's inputs marks the round
+    becoming runnable, ``phase='E'`` on its outputs marks completion.  With
+    ``axis_name`` the event lands in a per-rank lane (``tid`` = mesh rank).
+
+    Precision notes: the operand is the sum of a scalar sliced from *every*
+    leaf (cheap — one element per leaf), so the event observes each leaf's
+    computation producing data, not just the first leaf's; it remains an
+    approximation of "fully materialized" (XLA may still be finishing the
+    leaves' tails).  Callbacks are ``ordered=True`` so B/E pairs in a lane
+    cannot invert or interleave across in-flight steps — Chrome-trace B/E
+    matching relies on per-lane nesting.
+
+    Trace-time gated: when no timeline is active at *trace* time this is the
+    identity with zero HLO footprint (enable the timeline before building
+    the step; an already-compiled step keeps its trace-time decision — after
+    ``timeline_stop`` its callbacks drop events).  For pure device-op
+    attribution in Perfetto use ``jax.named_scope`` / ``jax.profiler`` —
+    this API exists for the host-visible chrome-trace timeline that the
+    reference's users know.
+    """
+    if phase not in ("B", "E"):
+        raise ValueError(f"phase must be 'B' or 'E', got {phase!r}")
+    tl = _get()
+    if tl is None:
+        return x
+    import jax
+    from jax import lax
+    from jax.experimental import io_callback
+
+    leaves = [l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "ravel")]
+    token = sum((l.ravel()[0].astype("float32") for l in leaves),
+                start=jax.numpy.float32(0)) if leaves else 0
+    rank = lax.axis_index(axis_name) if axis_name is not None else 0
+
+    def cb(_tok, r):
+        (tl.begin if phase == "B" else tl.end)(name, category, tid=int(r))
+
+    io_callback(cb, None, token, rank, ordered=True)
+    return x
