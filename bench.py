@@ -99,7 +99,7 @@ def run(args, batch: int):
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     opt = DistributedNeighborAllreduceOptimizer(
         optax.sgd(0.1, momentum=0.9), topology=ctx.schedule,
-        axis_name=ctx.axis_name, atc=False,
+        axis_name=ctx.axis_name, atc=False, backend=args.backend,
     )
 
     rng = jax.random.PRNGKey(0)
@@ -207,6 +207,9 @@ def main():
                     help="capture a jax.profiler trace at the chosen batch")
     ap.add_argument("--skip-peak", action="store_true",
                     help="skip the matmul-peak measurement (mfu omitted)")
+    ap.add_argument("--backend", choices=["auto", "xla", "pallas"],
+                    default="auto",
+                    help="gossip transport (pallas = fused RDMA kernels)")
     args = ap.parse_args()
 
     bf.init(topology=ExponentialTwoGraph(len(jax.devices())))
@@ -286,6 +289,7 @@ def main():
         "value": round(best_ips, 2),
         "unit": "images/sec/chip",
         "batch": best_batch,
+        "backend": args.backend,
         "vs_baseline": round(best_ips / V100_BASELINE_IMG_PER_SEC, 3),
         "sweep": [{"batch": b, "img_per_sec_per_chip": round(v, 2)}
                   for b, v, _ in results],

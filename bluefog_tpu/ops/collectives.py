@@ -174,15 +174,25 @@ def neighbor_allreduce(
         ``self_weight=/src_weights=`` arguments.  Because only *weights* change
         (the ppermute pattern is static), overriding them does not recompile.
 
-    Lowering: one ``lax.ppermute`` per schedule slot (a single ICI rotation for
-    circulant graphs) + fused multiply-adds.  ``backend='pallas'`` routes
-    small/medium tensors through the fused RDMA kernel
-    (:mod:`bluefog_tpu.ops.pallas_gossip`) on real TPU slices; ``'auto'``
-    keeps XLA (the right default — XLA overlaps ppermute with surrounding
-    compute, while the Pallas kernel is a win when the weighted reduction
-    dominates).
+    Lowering: one ``lax.ppermute`` per schedule slot (a single ICI rotation
+    for circulant graphs) + fused multiply-adds; or the fused RDMA kernel
+    (:mod:`bluefog_tpu.ops.pallas_gossip`), which folds the weighted
+    reduction into the arrival path.  ``backend``: ``'xla'`` and
+    ``'pallas'`` force a path; ``'auto'`` selects per call under the stated
+    conditions of :func:`bluefog_tpu.ops.pallas_gossip.auto_gossip_backend`
+    (real TPU slice, multi-device, circulant schedule, every leaf within the
+    size cutoff — else XLA).
     """
     sched = _as_schedule(schedule)
+
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"unknown backend {backend!r}; expected 'auto', 'xla', or "
+            "'pallas'")
+    if backend == "auto":
+        from bluefog_tpu.ops import pallas_gossip
+
+        backend = pallas_gossip.auto_gossip_backend(sched, x)
     # runtime per-round spans (B once inputs are live, E once the weighted
     # merge materializes; per-rank lanes) — identity unless a timeline is
     # active at trace time.  The reference emits the analogous per-tensor
@@ -195,13 +205,20 @@ def neighbor_allreduce(
 
         # distinct collective_id per leaf: leaf kernels have no mutual data
         # dependencies, so XLA may overlap them — each needs its own global
-        # barrier semaphore or one kernel's handshake absorbs another's
+        # barrier semaphore or one kernel's handshake absorbs another's.
+        # Gossip owns ids [1024, 2048); the window transport owns [2048, ...)
+        # (ops/windows.py), so the two kernel families can never share a
+        # barrier semaphore inside one program.
         leaves, treedef = jax.tree_util.tree_flatten(x)
+        if len(leaves) > 1024:
+            raise ValueError(
+                f"pallas gossip over {len(leaves)} leaves exceeds the "
+                "collective-id range; fuse the tree first (fuse_apply)")
         outs = [
             pallas_gossip.neighbor_allreduce_pallas(
                 leaf, sched, axis_name,
                 self_weight=self_weight, recv_weights=recv_weights,
-                collective_id=7 + idx,
+                collective_id=1024 + idx,
             )
             for idx, leaf in enumerate(leaves)
         ]
@@ -231,6 +248,8 @@ def neighbor_allreduce_dynamic(
     schedules: Sequence,
     step,
     axis_name: str,
+    *,
+    backend: str = "auto",
 ):
     """Time-varying gossip: applies ``schedules[step % len(schedules)]``.
 
@@ -241,9 +260,10 @@ def neighbor_allreduce_dynamic(
     """
     scheds = [_as_schedule(s) for s in schedules]
     if len(scheds) == 1:
-        return neighbor_allreduce(x, scheds[0], axis_name)
+        return neighbor_allreduce(x, scheds[0], axis_name, backend=backend)
     branches = [
-        functools.partial(neighbor_allreduce, schedule=s, axis_name=axis_name)
+        functools.partial(neighbor_allreduce, schedule=s, axis_name=axis_name,
+                          backend=backend)
         for s in scheds
     ]
     return lax.switch(jnp.asarray(step) % len(scheds), branches, x)

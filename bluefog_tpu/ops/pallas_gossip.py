@@ -48,12 +48,60 @@ from bluefog_tpu.topology.schedule import GossipSchedule
 __all__ = [
     "is_pallas_supported",
     "circulant_shifts",
+    "auto_gossip_backend",
     "neighbor_allreduce_pallas",
     "deliver_pallas",
+    "DEFAULT_AUTO_MAX_BYTES",
 ]
 
 _LANES = 128
 _SUBLANES = 8
+
+# 'auto' routes a gossip leaf through the RDMA kernel only up to this many
+# bytes (counted at the kernel's internal f32 width).  Rationale: the fused
+# kernel wins by folding the weighted reduction into the arrival path (one
+# VMEM pass, no ppermute materialization) — a latency/working-set effect that
+# matters for small and medium tensors; a large tensor is one bandwidth-bound
+# ICI transfer either way, while the kernel's whole-leaf VMEM residency
+# ((num_slots+2) copies live at once) stops paying for itself and risks VMEM
+# pressure.  Override with BLUEFOG_TPU_PALLAS_MAX_BYTES.
+DEFAULT_AUTO_MAX_BYTES = 4 << 20
+
+
+def auto_gossip_backend(sched: GossipSchedule, x) -> str:
+    """Resolve ``backend='auto'`` for a gossip call: ``'pallas'`` or ``'xla'``.
+
+    The stated conditions under which auto selects the RDMA kernels — ALL
+    must hold:
+
+    1. a real TPU backend (``jax.default_backend() in ('tpu', 'axon')``) —
+       CPU test meshes always take XLA (the non-interpret kernel cannot run
+       there);
+    2. multi-device mesh (``sched.size > 1``) — nothing to exchange on one
+       chip;
+    3. a circulant schedule (every slot one uniform ICI rotation — all
+       standard topologies; irregular graphs take XLA);
+    4. every leaf at most the size cutoff (see
+       :data:`DEFAULT_AUTO_MAX_BYTES`);
+    5. not disabled via ``BLUEFOG_TPU_PALLAS_GOSSIP=0`` (the kill switch if
+       a deployment's kernels misbehave).
+    """
+    import os
+
+    if os.environ.get("BLUEFOG_TPU_PALLAS_GOSSIP", "1") in ("0", "off"):
+        return "xla"
+    if sched.size <= 1 or circulant_shifts(sched) is None:
+        return "xla"
+    if jax.default_backend() not in ("tpu", "axon"):
+        return "xla"
+    leaves = jax.tree_util.tree_leaves(x)
+    if not leaves:
+        return "xla"
+    limit = int(os.environ.get("BLUEFOG_TPU_PALLAS_MAX_BYTES",
+                               DEFAULT_AUTO_MAX_BYTES))
+    biggest = max(int(np.prod(jnp.shape(l), dtype=np.int64)) * 4
+                  for l in leaves)  # kernel width is f32
+    return "pallas" if biggest <= limit else "xla"
 
 
 def circulant_shifts(sched: GossipSchedule) -> Optional[Tuple[int, ...]]:

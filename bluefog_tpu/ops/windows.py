@@ -174,13 +174,14 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
         from bluefog_tpu.ops import pallas_gossip
 
         # distinct collective_id per leaf (leaf kernels may overlap on
-        # hardware; each needs its own barrier semaphore)
+        # hardware; each needs its own barrier semaphore).  Windows own ids
+        # [2048, ...); gossip owns [1024, 2048) — see ops/collectives.py.
         peer_leaves, treedef = jax.tree_util.tree_flatten(state.peer_bufs)
         payload_leaves = treedef.flatten_up_to(payload)
         outs = [
             pallas_gossip.deliver_pallas(
                 leaf, peers, sched, axis_name, accumulate=accumulate,
-                collective_id=64 + idx,
+                collective_id=2048 + idx,
             )
             for idx, (peers, leaf) in enumerate(zip(peer_leaves, payload_leaves))
         ]

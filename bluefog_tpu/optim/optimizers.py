@@ -72,10 +72,12 @@ def _as_schedules(topology) -> Sequence[GossipSchedule]:
     return [t if isinstance(t, GossipSchedule) else build_schedule(t) for t in topology]
 
 
-def _gossip(params, scheds, count, axis_name):
+def _gossip(params, scheds, count, axis_name, backend="auto"):
     if len(scheds) == 1:
-        return C.neighbor_allreduce(params, scheds[0], axis_name)
-    return C.neighbor_allreduce_dynamic(params, scheds, count, axis_name)
+        return C.neighbor_allreduce(params, scheds[0], axis_name,
+                                    backend=backend)
+    return C.neighbor_allreduce_dynamic(params, scheds, count, axis_name,
+                                        backend=backend)
 
 
 def decentralized_optimizer(
@@ -88,6 +90,7 @@ def decentralized_optimizer(
     num_steps_per_communication: int = 1,
     local_size: int = 1,
     machine_topology=None,
+    backend: str = "auto",
 ) -> optax.GradientTransformation:
     """Wrap ``base`` so each update also performs decentralized averaging.
 
@@ -104,6 +107,9 @@ def decentralized_optimizer(
         reference default) when False.
       num_steps_per_communication: gossip every k-th step (local SGD).
       local_size / machine_topology: for the hierarchical mode.
+      backend: gossip transport — 'xla' (ppermute), 'pallas' (fused RDMA
+        kernels), or 'auto' (per
+        :func:`bluefog_tpu.ops.pallas_gossip.auto_gossip_backend`).
 
     Returns an ``optax.GradientTransformation`` whose ``update`` REQUIRES
     ``params``; the returned updates fold the communication in, so plain
@@ -147,7 +153,8 @@ def decentralized_optimizer(
                     lambda t: C.neighbor_allreduce_aperiodic(
                         t, matrix_fn(count), axis_name), params)
             return C.fuse_apply(
-                lambda t: _gossip(t, scheds, count, axis_name), params)
+                lambda t: _gossip(t, scheds, count, axis_name, backend),
+                params)
         if ct == CommunicationType.hierarchical_neighbor_allreduce:
             return C.fuse_apply(
                 lambda t: C.hierarchical_neighbor_allreduce(
@@ -211,6 +218,7 @@ def DistributedNeighborAllreduceOptimizer(
     axis_name: str,
     atc: bool = False,
     num_steps_per_communication: int = 1,
+    backend: str = "auto",
 ) -> optax.GradientTransformation:
     """Reference ``bf.DistributedNeighborAllreduceOptimizer`` (confirmed in
     BASELINE.json): decentralized gossip averaging of parameters each step."""
@@ -218,6 +226,7 @@ def DistributedNeighborAllreduceOptimizer(
         base, topology, axis_name,
         communication_type=CommunicationType.neighbor_allreduce,
         atc=atc, num_steps_per_communication=num_steps_per_communication,
+        backend=backend,
     )
 
 

@@ -238,13 +238,27 @@ def device_stage(x, name: str, *, phase: str = "B",
     from jax import lax
     from jax.experimental import io_callback
 
-    leaves = [l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "ravel")]
-    token = sum((l.ravel()[0].astype("float32") for l in leaves),
-                start=jax.numpy.float32(0)) if leaves else 0
     rank = lax.axis_index(axis_name) if axis_name is not None else 0
 
     def cb(_tok, r):
         (tl.begin if phase == "B" else tl.end)(name, category, tid=int(r))
 
-    io_callback(cb, None, token, rank, ordered=True)
-    return x
+    # custom_jvp shell: io_callback has no JVP rule, so without this a
+    # timeline-active trace would make every instrumented collective
+    # non-differentiable.  The callback fires on the primal; tangents pass
+    # straight through (identity — linear, so reverse-mode transposes too).
+    @jax.custom_jvp
+    def stamped(y):
+        leaves = [l for l in jax.tree_util.tree_leaves(y)
+                  if hasattr(l, "ravel")]
+        token = sum((l.ravel()[0].astype("float32") for l in leaves),
+                    start=jax.numpy.float32(0)) if leaves else 0
+        io_callback(cb, None, token, rank, ordered=True)
+        return y
+
+    @stamped.defjvp
+    def _stamped_jvp(primals, tangents):
+        (y,), (t,) = primals, tangents
+        return stamped(y), t
+
+    return stamped(x)

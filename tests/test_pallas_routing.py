@@ -1,0 +1,95 @@
+"""Routing policy for the Pallas RDMA gossip transport.
+
+`backend='auto'` must provably choose per the stated conditions
+(pallas_gossip.auto_gossip_backend): real TPU + multi-device + circulant +
+small-enough payloads -> pallas; anything else -> XLA.  The policy is pure
+and cheap, so every branch is asserted directly; integration (the XLA side
+of auto on the CPU mesh + interpret-mode kernel parity) is covered by
+test_collectives.py / test_pallas_gossip.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluefog_tpu.ops import pallas_gossip as pg
+from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph, StarGraph
+from bluefog_tpu.topology.schedule import build_schedule
+
+SMALL = jnp.zeros((1024,), jnp.float32)          # 4 KiB
+BIG = jnp.zeros((2 << 20,), jnp.float32)         # 8 MiB > 4 MiB cutoff
+
+
+@pytest.fixture
+def on_tpu(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+
+def test_auto_is_xla_on_cpu():
+    sched = build_schedule(RingGraph(8))
+    assert jax.default_backend() == "cpu"
+    assert pg.auto_gossip_backend(sched, SMALL) == "xla"
+
+
+def test_auto_picks_pallas_on_tpu_small_circulant(on_tpu):
+    for topo in (RingGraph(8), ExponentialTwoGraph(8)):
+        assert pg.auto_gossip_backend(build_schedule(topo), SMALL) == "pallas"
+    # pytrees: every leaf within the cutoff
+    tree = {"a": SMALL, "b": jnp.zeros((16, 16), jnp.bfloat16)}
+    assert pg.auto_gossip_backend(build_schedule(RingGraph(8)), tree) == "pallas"
+
+
+def test_auto_respects_size_cutoff(on_tpu):
+    sched = build_schedule(RingGraph(8))
+    assert pg.auto_gossip_backend(sched, BIG) == "xla"
+    # one oversized leaf forces the whole call to XLA
+    assert pg.auto_gossip_backend(sched, {"a": SMALL, "b": BIG}) == "xla"
+    # and the cutoff is tunable
+    import os
+    os.environ["BLUEFOG_TPU_PALLAS_MAX_BYTES"] = str(1 << 30)
+    try:
+        assert pg.auto_gossip_backend(sched, BIG) == "pallas"
+    finally:
+        del os.environ["BLUEFOG_TPU_PALLAS_MAX_BYTES"]
+
+
+def test_auto_rejects_non_circulant_and_single_device(on_tpu):
+    star = build_schedule(StarGraph(8))
+    assert pg.circulant_shifts(star) is None
+    assert pg.auto_gossip_backend(star, SMALL) == "xla"
+
+    from bluefog_tpu.topology.graphs import Topology
+    solo = build_schedule(Topology(weights=np.ones((1, 1)), name="solo"))
+    assert pg.auto_gossip_backend(solo, SMALL) == "xla"
+
+
+def test_kill_switch(on_tpu, monkeypatch):
+    sched = build_schedule(RingGraph(8))
+    monkeypatch.setenv("BLUEFOG_TPU_PALLAS_GOSSIP", "0")
+    assert pg.auto_gossip_backend(sched, SMALL) == "xla"
+
+
+def test_neighbor_allreduce_consults_policy(monkeypatch):
+    """backend='auto' actually dispatches on the policy's answer."""
+    from bluefog_tpu.ops import collectives as C
+
+    calls = {}
+
+    def fake_policy(sched, x):
+        calls["hit"] = True
+        return "xla"
+
+    monkeypatch.setattr(pg, "auto_gossip_backend", fake_policy)
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bluefog_tpu.parallel.api import shard_map
+
+    sched = build_schedule(RingGraph(8))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("bf",))
+    fn = jax.jit(shard_map(
+        lambda v: C.neighbor_allreduce(v, sched, "bf", backend="auto"),
+        mesh=mesh, in_specs=(P("bf"),), out_specs=P("bf"), check_vma=False))
+    out = fn(jnp.ones((8, 4), jnp.float32))
+    jax.block_until_ready(out)
+    assert calls.get("hit"), "auto did not consult auto_gossip_backend"

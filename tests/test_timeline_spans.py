@@ -117,6 +117,32 @@ def test_dynamic_topology_spans_compile(tmp_path):
     assert len(events) >= 2 * N  # B+E per rank per step
 
 
+def test_gossip_stays_differentiable_with_timeline(tmp_path):
+    """Profiling must not break training: grad through an instrumented
+    collective works with the timeline active (io_callback has no JVP rule;
+    device_stage's custom_jvp shell keeps tangents flowing)."""
+    trace = str(tmp_path / "trace_g.json")
+    sched = build_schedule(RingGraph(N))
+    T.timeline_start(trace)
+    try:
+        def loss(v):
+            out = C.neighbor_allreduce(v, sched, "bf")
+            return (out ** 2).sum()
+
+        fn = jax.jit(shard_map(
+            jax.grad(loss), mesh=_mesh(), in_specs=(P("bf"),),
+            out_specs=P("bf"), check_vma=False))
+        g = fn(jnp.arange(N * 4, dtype=jnp.float32).reshape(N, 4))
+        jax.block_until_ready(g)
+        assert np.isfinite(np.asarray(g)).all()
+    finally:
+        T.timeline_stop()
+    # the primal's spans were still emitted
+    events = [e for e in _load_events(trace)
+              if e["name"] == "bf.neighbor_allreduce"]
+    assert events
+
+
 def test_hierarchical_spans(tmp_path):
     trace = str(tmp_path / "trace_h.json")
     msched = build_schedule(RingGraph(4))
