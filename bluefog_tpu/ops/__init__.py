@@ -18,6 +18,7 @@ from bluefog_tpu.ops.collectives import (
     neighbor_allreduce_dynamic,
     neighbor_allreduce_aperiodic,
     hierarchical_neighbor_allreduce,
+    hierarchical_neighbor_allreduce_2d,
     pair_gossip,
 )
 from bluefog_tpu.ops.windows import (
@@ -37,6 +38,8 @@ from bluefog_tpu.ops.ring_attention import (
     ring_attention,
     all_to_all_attention,
     local_attention,
+    zigzag_shard,
+    zigzag_unshard,
 )
 from bluefog_tpu.ops.moe import (
     switch_router,

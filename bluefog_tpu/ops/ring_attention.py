@@ -34,6 +34,8 @@ __all__ = [
     "ring_attention",
     "all_to_all_attention",
     "local_attention",
+    "zigzag_shard",
+    "zigzag_unshard",
 ]
 
 _NEG_INF = -1e30  # large finite negative: avoids -inf NaN traps in exp
@@ -169,6 +171,40 @@ def _fold_block(state, q, k, v, *, scale, kpos0, qpos, masked: bool,
     return state
 
 
+def _zigzag_permutation(n: int, t_total: int):
+    """Global row order for the load-balanced causal layout: the sequence is
+    cut into ``2n`` chunks and rank ``r`` holds chunks ``r`` and ``2n-1-r``
+    (a front chunk and its mirrored back chunk)."""
+    import numpy as _np
+
+    c, rem = divmod(t_total, 2 * n)
+    if rem:
+        raise ValueError(
+            f"zigzag layout needs sequence length divisible by 2*axis_size; "
+            f"got T={t_total}, n={n}")
+    order = []
+    for r_ in range(n):
+        order.extend(range(r_ * c, (r_ + 1) * c))
+        order.extend(range((2 * n - 1 - r_) * c, (2 * n - r_) * c))
+    return _np.asarray(order)
+
+
+def zigzag_shard(x, axis_size: int, axis: int = 1):
+    """Reorder a *global* sequence axis into the zigzag layout, so that
+    contiguous sharding over ``axis_size`` ranks gives each rank a front
+    chunk and its mirrored back chunk (the load-balanced causal layout)."""
+    idx = _zigzag_permutation(axis_size, x.shape[axis])
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+def zigzag_unshard(x, axis_size: int, axis: int = 1):
+    """Inverse of :func:`zigzag_shard` (restores global sequence order)."""
+    import numpy as _np
+
+    idx = _zigzag_permutation(axis_size, x.shape[axis])
+    return jnp.take(x, jnp.asarray(_np.argsort(idx)), axis=axis)
+
+
 def ring_attention(
     q,
     k,
@@ -178,6 +214,7 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_tile: int = 512,
+    layout: str = "contiguous",
 ):
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
@@ -204,15 +241,23 @@ def ring_attention(
     FLOPs of the non-causal one instead of computing scores and masking them
     to zero.
 
-    Caveat on what the skipping buys: with contiguous rank-order sharding the
-    causal work is imbalanced (rank 0 skips almost every block, rank n-1
-    none), and the ring is lock-stepped by its ppermutes — so on a real
-    slice the *per-step critical path* is set by the busiest rank and the
-    saving shows up as idle time/energy, not wall-clock.  Wall-clock parity
-    with the FLOP saving requires a load-balanced sequence layout (zigzag /
-    striped sharding, where each rank holds a front and a mirrored back
-    chunk); on a single host (the CPU test mesh) the devices share the
-    compute budget, so the saving IS wall-clock there.
+    ``layout`` selects how the global sequence is assumed to be distributed:
+
+    - ``'contiguous'`` (default): rank ``r`` holds rows ``[r*T_local,
+      (r+1)*T_local)``.  Causal skipping then saves total FLOPs but is
+      *imbalanced* — rank 0 skips almost every block, rank n-1 none — and
+      since the ring is lock-stepped by its ppermutes, on a real slice the
+      per-step critical path is the busiest rank and the saving shows up as
+      idle time/energy, not wall-clock.
+    - ``'zigzag'``: rank ``r`` holds chunks ``r`` and ``2n-1-r`` of the
+      sequence cut into ``2n`` chunks (use :func:`zigzag_shard` /
+      :func:`zigzag_unshard` to convert; output stays in zigzag order).
+      Every rank then folds **exactly two half-chunks per ring step** —
+      one always-past ``q_back x k_front`` fold plus one of ``q_front x
+      k_front`` / ``q_back x k_back`` selected by the arriving block's
+      origin — so the causal FLOP saving is perfectly load-balanced and
+      becomes wall-clock on a lock-stepped slice.  (Non-causal math is
+      position-independent, so ``layout`` only matters for ``causal=True``.)
     """
     n = lax.axis_size(axis_name)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -242,6 +287,14 @@ def ring_attention(
             lambda t: lax.pvary(t, axis_name), state)
 
     shift = [(i, (i + 1) % n) for i in range(n)]
+
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if causal and layout == "zigzag":
+        return _ring_zigzag_causal(
+            state, q, k, v, axis_name, n=n, r=r, scale=scale,
+            kv_tile=kv_tile, shift=shift)
+
     qpos = r * t_q + jnp.arange(t_q)
 
     for s in range(n):
@@ -272,6 +325,75 @@ def ring_attention(
             v = lax.ppermute(v, axis_name, shift)
 
     _, denom, o = state
+    out = o / jnp.maximum(denom[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _ring_zigzag_causal(state, q, k, v, axis_name, *, n, r, scale, kv_tile,
+                        shift):
+    """Load-balanced causal ring (zigzag layout; see :func:`ring_attention`).
+
+    Rank ``r``'s local rows are [chunk ``r``; chunk ``2n-1-r``] of the global
+    sequence in ``2n`` chunks of width ``c``.  For an arriving KV block from
+    rank ``src`` the four (q-chunk, k-chunk) pairs classify statically or by
+    ``src`` alone:
+
+    - ``q_front(r) x k_back(2n-1-src)``: always strictly future — never
+      folded.
+    - ``q_back(2n-1-r) x k_front(src)``: always strictly past — folded
+      unmasked every step.
+    - ``q_front x k_front`` is past iff ``src < r``; ``q_back x k_back`` is
+      past iff ``src > r``; exactly one of the two per step (both diagonal at
+      ``s == 0``), so every rank folds exactly two ``c``-wide chunks per
+      step — balanced, half the non-causal work.
+    """
+    t_q = q.shape[1]
+    if t_q % 2:
+        raise ValueError(
+            f"zigzag layout needs an even local width, got t_q={t_q}")
+    c = t_q // 2
+    qf, qb = q[:, :c], q[:, c:]
+    rel = jnp.arange(c)  # chunk-relative positions (diagonal masks align)
+
+    # The front and back query halves never share a fold, so carry two
+    # independent half-states (m, denom, o over c rows) and join once at the
+    # end — no per-fold slice/concat traffic.
+    def halve(t):
+        return t[..., :c], t[..., c:]
+
+    def halve_o(t):
+        return t[..., :c, :], t[..., c:, :]
+
+    m, denom, o = state
+    front = (halve(m)[0], halve(denom)[0], halve_o(o)[0])
+    back = (halve(m)[1], halve(denom)[1], halve_o(o)[1])
+
+    def fold(st, qc, kc, vc, masked):
+        return _fold_block(st, qc, kc, vc, scale=scale, kpos0=0, qpos=rel,
+                           masked=masked, kv_tile=kv_tile)
+
+    for s in range(n):
+        kf, kb = k[:, :c], k[:, c:]
+        vf, vb = v[:, :c], v[:, c:]
+        if s == 0:  # statically src == r: two diagonals + back-vs-front past
+            front = fold(front, qf, kf, vf, True)
+            back = fold(back, qb, kb, vb, True)
+            back = fold(back, qb, kf, vf, False)
+        else:
+            src = (r - s) % n
+            back = fold(back, qb, kf, vf, False)
+            front, back = lax.cond(
+                src < r,
+                lambda fr, bk, kf, vf, kb, vb: (fold(fr, qf, kf, vf, False), bk),
+                lambda fr, bk, kf, vf, kb, vb: (fr, fold(bk, qb, kb, vb, False)),
+                front, back, kf, vf, kb, vb,
+            )
+        if s != n - 1:
+            k = lax.ppermute(k, axis_name, shift)
+            v = lax.ppermute(v, axis_name, shift)
+
+    denom = jnp.concatenate([front[1], back[1]], axis=-1)
+    o = jnp.concatenate([front[2], back[2]], axis=-2)
     out = o / jnp.maximum(denom[..., None], 1e-30)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
