@@ -56,6 +56,7 @@ __all__ = [
     "neighbor_allreduce_dynamic",
     "neighbor_allreduce_aperiodic",
     "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_2d",
     "pair_gossip",
 ]
 
@@ -477,3 +478,55 @@ def hierarchical_neighbor_allreduce(
     out = jax.tree_util.tree_map(one, x)
     return _tl.device_stage(out, "bf.hierarchical_neighbor_allreduce",
                             phase="E", axis_name=axis_name)
+
+
+def hierarchical_neighbor_allreduce_2d(
+    x,
+    machine_schedule,
+    *,
+    machine_axis: str,
+    local_axis: str,
+    self_weight=None,
+    recv_weights=None,
+):
+    """Hierarchical gossip over a two-level ``(machine, local)`` mesh.
+
+    The multi-slice deployment form of :func:`hierarchical_neighbor_allreduce`:
+    instead of one flat mesh axis with ``axis_index_groups``, the mesh is
+    ``Mesh(devices.reshape(n_machines, local_size), (machine_axis,
+    local_axis))`` — in a real multi-slice/multi-pod job the outer axis maps
+    onto DCN and the inner axis onto each slice's ICI (reference analog: the
+    cross vs local MPI communicators of ``bluefog/common/mpi_context.cc``,
+    SURVEY.md §2.4).  The local exact average is a ``pmean`` riding ICI; the
+    machine gossip is a ``ppermute`` *over the machine axis itself*, so every
+    local lane crosses DCN in parallel and the counterpart-lane pairing of
+    the flat path holds by construction.
+    """
+    # lane id = linearized (machine, local) rank, matching the flat path
+    x = _tl.device_stage(x, "bf.hierarchical_neighbor_allreduce_2d", phase="B",
+                         axis_name=(machine_axis, local_axis))
+    msched = _as_schedule(machine_schedule)
+
+    def one(leaf):
+        acc_dt = _acc_dtype(leaf)
+        local_avg = lax.pmean(leaf.astype(acc_dt), local_axis)
+        m = lax.axis_index(machine_axis)
+        if self_weight is None:
+            self_w = jnp.asarray(msched.self_weights, acc_dt)[m]
+        else:
+            self_w = jnp.asarray(self_weight, acc_dt)
+        if recv_weights is None:
+            recv_w = jnp.asarray(msched.recv_weights, acc_dt)[m]
+        else:
+            recv_w = jnp.asarray(recv_weights, acc_dt)
+        out = self_w * local_avg
+        for k, perm in enumerate(msched.perms):
+            with jax.named_scope(f"bf.hierarchical2d.machine_slot{k}"):
+                recvd = lax.ppermute(local_avg.astype(leaf.dtype),
+                                     machine_axis, perm)
+                out = out + recv_w[k] * recvd.astype(acc_dt)
+        return out.astype(leaf.dtype)
+
+    out = jax.tree_util.tree_map(one, x)
+    return _tl.device_stage(out, "bf.hierarchical_neighbor_allreduce_2d",
+                            phase="E", axis_name=(machine_axis, local_axis))

@@ -242,9 +242,15 @@ def barrier():
 
 
 def hierarchical_neighbor_allreduce(x, *, machine_topology=None, self_weight=None,
-                                    recv_weights=None):
+                                    recv_weights=None, two_level_mesh=False):
     """Stacked ``bf.hierarchical_neighbor_allreduce`` (intra-machine exact
-    average + machine-level gossip; requires ``init(local_size=...)``)."""
+    average + machine-level gossip; requires ``init(local_size=...)``).
+
+    ``two_level_mesh=True`` runs over ``ctx.hier_mesh`` — an explicit
+    ``(machine, local)`` mesh where the local average is a ``pmean`` on the
+    inner (ICI) axis and the machine gossip a ``ppermute`` on the outer (DCN)
+    axis; numerically identical to the flat path, and the form a multi-slice
+    deployment uses so the machine hops ride DCN."""
     ctx = get_context()
     msched = machine_topology
     if msched is None:
@@ -253,6 +259,18 @@ def hierarchical_neighbor_allreduce(x, *, machine_topology=None, self_weight=Non
         msched = ctx.machine_schedule
     elif isinstance(msched, Topology):
         msched = build_schedule(msched)
+    if two_level_mesh:
+        mesh2 = ctx.hier_mesh
+        spec = P((ctx.machine_axis_name, ctx.local_axis_name))
+        return shard_map(
+            lambda xs: _ops.hierarchical_neighbor_allreduce_2d(
+                xs, msched,
+                machine_axis=ctx.machine_axis_name,
+                local_axis=ctx.local_axis_name,
+                self_weight=self_weight, recv_weights=recv_weights,
+            ),
+            mesh=mesh2, in_specs=(spec,), out_specs=spec, check_vma=False,
+        )(x)
     return _smap(
         lambda xs: _ops.hierarchical_neighbor_allreduce(
             xs, msched, ctx.axis_name, local_size=ctx.local_size,

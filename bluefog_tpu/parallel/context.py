@@ -76,6 +76,33 @@ class BluefogContext:
     def n_machines(self) -> int:
         return self.size // self.local_size
 
+    @property
+    def machine_axis_name(self) -> str:
+        return self.axis_name + "_machine"
+
+    @property
+    def local_axis_name(self) -> str:
+        return self.axis_name + "_local"
+
+    @property
+    def hier_mesh(self):
+        """Two-level ``(machine, local)`` mesh over the same devices — the
+        multi-slice deployment form (outer axis rides DCN, inner axis each
+        slice's ICI; reference analog: cross vs local MPI communicators,
+        ``bluefog/common/mpi_context.cc``).  Built lazily; rank ``r`` sits at
+        mesh position ``(r // local_size, r % local_size)``, so flat-mesh and
+        two-level collectives agree rank-for-rank."""
+        if self._hier_mesh is None:
+            from jax.sharding import Mesh
+
+            self._hier_mesh = Mesh(
+                np.array(self.devices).reshape(self.n_machines, self.local_size),
+                (self.machine_axis_name, self.local_axis_name),
+            )
+        return self._hier_mesh
+
+    _hier_mesh: Any = None
+
 
 _CTX: Optional[BluefogContext] = None
 

@@ -232,6 +232,79 @@ def test_hierarchical_neighbor_allreduce():
         np.testing.assert_allclose(out[2 * m + 1], ref_m[m], rtol=1e-6)
 
 
+def test_hierarchical_local_size_4():
+    """2 machines x 4 local ranks: the counterpart-lane expansion must pair
+    every one of the 4 local lanes, not just lane 0/1 (verdict weak #9)."""
+    bf.init(local_size=4, machine_topology=RingGraph(2))
+    x = rank_values((3,))
+    out = np.asarray(bf.hierarchical_neighbor_allreduce(x), dtype=np.float64)
+    means = np.array([1.5, 5.5])  # mean(0..3), mean(4..7)
+    ref_m = RingGraph(2).weights @ means
+    for m in range(2):
+        for l in range(4):
+            np.testing.assert_allclose(out[4 * m + l], ref_m[m], rtol=1e-6)
+
+
+def test_hierarchical_irregular_machine_graph():
+    """4 machines x 2 local ranks over a star machine graph — irregular
+    per-machine degree (center talks to 3 peers, leaves to 1)."""
+    topo = StarGraph(4, center_rank=1)
+    bf.init(local_size=2, machine_topology=topo)
+    x = rank_values((2,))
+    out = np.asarray(bf.hierarchical_neighbor_allreduce(x), dtype=np.float64)
+    means = np.array([0.5, 2.5, 4.5, 6.5])
+    ref_m = topo.weights @ means
+    for m in range(4):
+        np.testing.assert_allclose(out[2 * m], ref_m[m], rtol=1e-6)
+        np.testing.assert_allclose(out[2 * m + 1], ref_m[m], rtol=1e-6)
+
+
+def test_hierarchical_exp2_machine_graph_local_size_2():
+    """4 machines on the exp2 machine graph — multiple permute slots per
+    round, still exact per closed form."""
+    topo = ExponentialTwoGraph(4)
+    bf.init(local_size=2, machine_topology=topo)
+    x = rank_values((2,))
+    out = np.asarray(bf.hierarchical_neighbor_allreduce(x), dtype=np.float64)
+    means = np.array([0.5, 2.5, 4.5, 6.5])
+    ref_m = topo.weights @ means
+    for m in range(4):
+        np.testing.assert_allclose(out[2 * m], ref_m[m], rtol=1e-6)
+        np.testing.assert_allclose(out[2 * m + 1], ref_m[m], rtol=1e-6)
+
+
+@pytest.mark.parametrize("local", [2, 4])
+def test_hierarchical_two_level_mesh_matches_flat(local):
+    """Multi-slice form: explicit (machine, local) mesh — pmean on the inner
+    axis + machine-axis ppermute — must agree with the flat-mesh path and the
+    closed form for both 4x2 and 2x4 shapes."""
+    nm = N // local
+    topo = RingGraph(nm) if nm > 1 else None
+    if topo is None:
+        pytest.skip("single machine")
+    bf.init(local_size=local, machine_topology=topo)
+    x = rank_values((3,))
+    flat = np.asarray(bf.hierarchical_neighbor_allreduce(x), np.float64)
+    two = np.asarray(
+        bf.hierarchical_neighbor_allreduce(x, two_level_mesh=True), np.float64)
+    np.testing.assert_allclose(two, flat, rtol=1e-6)
+    means = np.arange(N, dtype=np.float64).reshape(nm, local).mean(1)
+    ref_m = topo.weights @ means
+    for m in range(nm):
+        for l in range(local):
+            np.testing.assert_allclose(two[local * m + l], ref_m[m], rtol=1e-6)
+
+
+def test_hier_mesh_shape():
+    bf.init(local_size=2, machine_topology=RingGraph(4))
+    ctx = bf.get_context()
+    m = ctx.hier_mesh
+    assert m.devices.shape == (4, 2)
+    assert m.axis_names == (ctx.machine_axis_name, ctx.local_axis_name)
+    # rank r sits at (r // local, r % local): flat and two-level agree
+    assert m.devices[1, 1] == ctx.devices[3]
+
+
 def test_hierarchical_requires_machine_topology():
     bf.init()  # local_size=1 on a single host -> machine topo exists (8 machines)
     # but with local_size=8 there is a single machine: no machine topology
@@ -405,3 +478,40 @@ class TestCollectiveCensus:
         fused = collective_census(make(True), tree)
         assert unfused["collective-permute"] == n_leaves * slots
         assert fused["collective-permute"] == slots
+
+
+class TestOverlapReport:
+    """parse_overlap_windows against synthetic scheduled-HLO text (the TPU
+    async form; CPU lowers collectives synchronously, so the real-module
+    TPU case is exercised by benchmarks/overlap_report.py via AOT compile)."""
+
+    HLO = "\n".join([
+        "ENTRY %main {",
+        "  %collective-permute-start.1 = (f32[8]) collective-permute-start(%p0)",
+        "  %fusion.1 = f32[8] fusion(%a), kind=kLoop",
+        "  %dot.7 = f32[8,8] dot(%b, %c)",
+        "  %collective-permute-start.12 = (f32[8]) collective-permute-start(%p1)",
+        "  %copy-done.3 = f32[8] copy-done(%cp)",   # untracked family: ignored
+        "  %convolution.2 = f32[8] convolution(%d, %e)",
+        "  %cpd.12 = f32[8] collective-permute-done(%collective-permute-start.12)",
+        "  %fusion.2 = f32[8] fusion(%f), kind=kOutput",
+        "  %cpd.1 = f32[8] collective-permute-done(%collective-permute-start.1)",
+        "}",
+    ])
+
+    def test_windows_and_exact_name_matching(self):
+        from bluefog_tpu.utils.inspect import parse_overlap_windows
+
+        rep = parse_overlap_windows(self.HLO)
+        assert rep["pairs"] == 2
+        # .12's done must NOT close .1 (prefix name): .12 saw 1 compute op
+        # (convolution), .1 saw fusion.1 + dot + convolution + fusion.2 = 4
+        assert sorted(rep["windows"]) == [1, 4]
+        assert rep["overlapped_fraction"] == 1.0
+
+    def test_no_async_pairs(self):
+        from bluefog_tpu.utils.inspect import parse_overlap_windows
+
+        rep = parse_overlap_windows(
+            "%pp = f32[8] collective-permute(%x)\n%f = f32[8] fusion(%x)")
+        assert rep["pairs"] == 0 and rep["mean_compute_in_flight"] == 0.0

@@ -159,3 +159,28 @@ def test_hierarchical_spans(tmp_path):
     events = [e for e in _load_events(trace)
               if e["name"] == "bf.hierarchical_neighbor_allreduce"]
     assert {e["ph"] for e in events} == {"B", "E"}
+
+
+def test_hierarchical_2d_spans(tmp_path):
+    """The two-level-mesh path emits the same B/E gossip spans as the flat
+    path, with lanes = linearized (machine, local) ranks."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    trace = str(tmp_path / "trace_h2.json")
+    msched = build_schedule(RingGraph(4))
+    mesh2 = Mesh(np.array(jax.devices()[:N]).reshape(4, 2), ("m", "l"))
+    T.timeline_start(trace)
+    try:
+        fn = jax.jit(shard_map(
+            lambda v: C.hierarchical_neighbor_allreduce_2d(
+                v, msched, machine_axis="m", local_axis="l"),
+            mesh=mesh2, in_specs=(P(("m", "l")),), out_specs=P(("m", "l")),
+            check_vma=False))
+        jax.block_until_ready(fn(jnp.ones((N, 4), jnp.float32)))
+    finally:
+        T.timeline_stop()
+    events = [e for e in _load_events(trace)
+              if e["name"] == "bf.hierarchical_neighbor_allreduce_2d"]
+    assert {e["ph"] for e in events} == {"B", "E"}
+    assert {e["tid"] for e in events} == set(range(N))
