@@ -156,6 +156,14 @@ def run(args, batch: int):
         params, batch_stats, opt_state, imgs, labels).compile()
 
     flops_per_step = _cost_flops(step_fn)
+    try:
+        ma = step_fn.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        mem = {"temp": int(ma.temp_size_in_bytes),
+               "args": int(ma.argument_size_in_bytes)}
+    except Exception:
+        mem = None
 
     for _ in range(max(args.warmup, 1)):
         params, batch_stats, opt_state, loss = step_fn(
@@ -180,15 +188,48 @@ def run(args, batch: int):
     dt = time.perf_counter() - t0
 
     total_images = args.steps * batch * n
-    return total_images / dt / n, flops_per_step
+    return total_images / dt / n, flops_per_step, mem
+
+
+def _hbm_limit_bytes() -> int:
+    """Per-chip accelerator memory capacity, or 0 if the platform doesn't
+    expose it (``BFTPU_HBM_BYTES`` overrides for relays that hide it)."""
+    import os
+
+    env = os.environ.get("BFTPU_HBM_BYTES")
+    if env:
+        return int(env)
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        return int(stats.get("bytes_limit", 0)) if stats else 0
+    except Exception:
+        return 0
+
+
+def _predicts_oom(mem, limit: int) -> bool:
+    """Would doubling the batch exceed HBM?  Temp (activation) memory scales
+    ~linearly with batch; arguments are mostly batch-independent params.
+    Deliberately conservative (1.9x, 95% of capacity): a false 'fits' just
+    pays the compile-and-fail we would have paid anyway, while a false
+    'OOM' would silently drop a feasible sweep point."""
+    if not mem or not limit:
+        return False
+    return 1.9 * mem["temp"] + mem["args"] > 0.95 * limit
 
 
 def _is_oom(e: BaseException) -> bool:
     """Anchored on the canonical signals, not substrings of arbitrary
     messages: host OOM is MemoryError; device OOM is an XLA runtime error
     whose status is RESOURCE_EXHAUSTED (the message is the status string,
-    'RESOURCE_EXHAUSTED: ...')."""
+    'RESOURCE_EXHAUSTED: ...').  One relay-specific case: compile-time HBM
+    exhaustion through the axon remote-compile proxy arrives as a
+    JaxRuntimeError whose status is INTERNAL (the HTTP hop erases it), so
+    for that type only we accept XLA:TPU's canonical compile-OOM sentence
+    ('Ran out of memory in memory space hbm')."""
     if isinstance(e, MemoryError):
+        return True
+    if (type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+            and "Ran out of memory in memory space" in str(e)):
         return True
     return (type(e).__name__ == "XlaRuntimeError"
             and str(e).lstrip().startswith("RESOURCE_EXHAUSTED"))
@@ -220,7 +261,7 @@ def main():
               f"{peak_flops / 1e12:.1f} TFLOP/s/chip", file=sys.stderr)
 
     profile_dir = args.profile
-    results = []  # (batch, img/s/chip, flops_per_step)
+    results = []  # (batch, img/s/chip, flops_per_step, mem_info)
     if args.batch is not None:
         # pinned mode has exactly one successful run — trace it inline
         batch = args.batch
@@ -258,15 +299,32 @@ def main():
                     print(f"bench: batch {batch} exhausted memory; sweep ends",
                           file=sys.stderr)
                     break
+                if results:
+                    # A bigger point failing for any other reason (remote
+                    # compile relays surface HBM exhaustion as opaque
+                    # UNAVAILABLE/INTERNAL errors) must not cost the sweep
+                    # its already-measured result — report what we have.
+                    print(f"bench: batch {batch} failed "
+                          f"({type(e).__name__}: {str(e)[:120]}); sweep ends "
+                          f"with measured points", file=sys.stderr)
+                    break
                 raise
             print(f"bench: batch {r[0]:5d} -> {r[1]:,.0f} img/s/chip",
                   file=sys.stderr)
             results.append(r)
+            # Skip a doomed next point: a compile that only discovers OOM
+            # costs many minutes on remote-compile relays.
+            if batch * 2 <= args.sweep_max and _predicts_oom(
+                    r[3], _hbm_limit_bytes()):
+                print(f"bench: batch {batch * 2} predicted to exceed HBM "
+                      f"(temp {r[3]['temp'] / 2**30:.1f} GiB at {batch}); "
+                      f"sweep ends", file=sys.stderr)
+                break
             batch *= 2
 
     if not results:
         raise SystemExit("bench: no batch size fit in memory")
-    best_batch, best_ips, flops_per_step = max(results, key=lambda r: r[1])
+    best_batch, best_ips, flops_per_step, _ = max(results, key=lambda r: r[1])
 
     if profile_dir:
         # trace-only re-run: run() captures 3 traced steps; steps=0 skips the
@@ -291,8 +349,8 @@ def main():
         "batch": best_batch,
         "backend": args.backend,
         "vs_baseline": round(best_ips / V100_BASELINE_IMG_PER_SEC, 3),
-        "sweep": [{"batch": b, "img_per_sec_per_chip": round(v, 2)}
-                  for b, v, _ in results],
+        "sweep": [{"batch": r[0], "img_per_sec_per_chip": round(r[1], 2)}
+                  for r in results],
         "model_tflops_per_sec_per_chip": round(achieved_flops / 1e12, 2),
         "flops_source": "xla_cost_analysis" if flops_per_step > 0 else "analytic",
     }

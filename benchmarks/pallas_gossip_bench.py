@@ -54,13 +54,20 @@ def main():
     on_tpu = devs[0].platform in ("tpu", "axon")
     mesh = Mesh(np.array(devs), ("bf",))
     if n > 1:
-        topo = ExponentialTwoGraph(n)
+        sched = build_schedule(ExponentialTwoGraph(n))
     else:
-        # self-loopback schedule: one shift-0 "rotation" onto this chip
-        from bluefog_tpu.topology.graphs import Topology
+        # Self-loopback: ONE real shift-0 slot so the kernel genuinely posts
+        # a remote DMA (to itself) — build_schedule would fold a 1x1 graph's
+        # self-edge into self_weights and emit zero slots, which measures
+        # nothing, so construct the degenerate circulant schedule directly.
+        from bluefog_tpu.topology.schedule import GossipSchedule
 
-        topo = Topology(weights=np.ones((1, 1)), name="SelfLoop")
-    sched = build_schedule(topo)
+        sched = GossipSchedule(
+            size=1, perms=(((0, 0),),),
+            self_weights=np.array([0.5]),
+            recv_weights=np.array([[0.5]]),
+            recv_src=np.array([[0]]),
+            is_circulant=True, name="SelfLoop")
 
     rows = []
     auto_choice = {}

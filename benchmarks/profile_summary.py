@@ -1,0 +1,75 @@
+"""Summarize a jax.profiler trace: top device-time sinks per op category.
+
+``bench.py --profile DIR`` captures a TensorBoard-format trace
+(``DIR/plugins/profile/<run>/<host>.trace.json.gz`` — Chrome trace events).
+This digests it into the top-N device ops by total duration — the data behind
+PROFILE.md's sink table — without needing TensorBoard.
+
+Run:  python benchmarks/profile_summary.py /tmp/bench_profile [--top 15]
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+
+
+def find_trace(root):
+    pats = [os.path.join(root, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(root, "**", "*.trace.json.gz")]
+    for p in pats:
+        hits = sorted(glob.glob(p, recursive=True))
+        if hits:
+            return hits[-1]  # latest run
+    raise SystemExit(f"no *.trace.json.gz under {root}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args()
+
+    path = find_trace(args.trace_dir)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+
+    # device-track pids: XLA op events carry 'dur' and live on TPU/device
+    # process tracks; host python tracks are excluded by name
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e.get("args", {}).get("name", "")
+    device_pids = {pid for pid, name in pid_names.items()
+                   if re.search(r"TPU|device|/device", name, re.I)}
+
+    by_op = defaultdict(float)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "?")
+        # collapse XLA's uniquifier suffixes: fusion.123 -> fusion
+        base = re.sub(r"[.\d]+$", "", name) or name
+        by_op[base] += e["dur"]
+        total += e["dur"]
+
+    if not by_op:
+        raise SystemExit("no device op events found in trace")
+    print(f"trace: {path}")
+    print(f"total device op time: {total / 1e3:.2f} ms "
+          f"(over the captured steps)")
+    print(f"{'op':40s} {'ms':>10s} {'share':>7s}")
+    for op, dur in sorted(by_op.items(), key=lambda kv: -kv[1])[:args.top]:
+        print(f"{op:40s} {dur / 1e3:10.2f} {dur / total:7.1%}")
+
+
+if __name__ == "__main__":
+    main()

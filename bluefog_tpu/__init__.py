@@ -84,7 +84,13 @@ from bluefog_tpu.parallel.api import (
     synchronize,
     wait_all_host_ops,
 )
-from bluefog_tpu.utils import timeline_start_activity, timeline_end_activity, timeline_context
+from bluefog_tpu.utils import (
+    timeline_start,
+    timeline_stop,
+    timeline_start_activity,
+    timeline_end_activity,
+    timeline_context,
+)
 from bluefog_tpu.utils.checkpoint import CheckpointManager, run_with_restart
 
 __version__ = "0.1.0"

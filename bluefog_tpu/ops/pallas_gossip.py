@@ -90,8 +90,8 @@ def auto_gossip_backend(sched: GossipSchedule, x) -> str:
 
     if os.environ.get("BLUEFOG_TPU_PALLAS_GOSSIP", "1") in ("0", "off"):
         return "xla"
-    if sched.size <= 1 or circulant_shifts(sched) is None:
-        return "xla"
+    if sched.size <= 1 or not circulant_shifts(sched):
+        return "xla"  # non-circulant (None) or zero slots (()): both XLA
     if jax.default_backend() not in ("tpu", "axon"):
         return "xla"
     leaves = jax.tree_util.tree_leaves(x)
@@ -245,6 +245,15 @@ def neighbor_allreduce_pallas(
     shifts = circulant_shifts(sched)
     if shifts is None:
         raise ValueError("pallas gossip requires a circulant schedule")
+    if not shifts:
+        # 0-slot schedule (no edges — e.g. identity mixing): nothing to
+        # exchange, and a grid-free kernel with zero receive buffers cannot
+        # lower; the gossip degenerates to the self-weighted term.
+        i0 = lax.axis_index(axis_name)
+        sw0 = (jnp.asarray(sched.self_weights, jnp.float32)[i0]
+               if self_weight is None
+               else jnp.asarray(self_weight, jnp.float32))
+        return (sw0 * x.astype(jnp.float32)).astype(x.dtype)
     n = sched.size
     i = lax.axis_index(axis_name)
 

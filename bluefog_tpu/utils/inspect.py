@@ -15,7 +15,8 @@ from typing import Any, Dict, Mapping
 
 import jax
 
-__all__ = ["collective_census", "compiled_flops"]
+__all__ = ["collective_census", "compiled_flops", "collective_overlap_report",
+           "parse_overlap_windows"]
 
 _COLLECTIVE_OPS = (
     "collective-permute",
@@ -49,6 +50,66 @@ def collective_census(fn, *args, static_argnums=(), **lower_kwargs) -> Dict[str,
         if n:
             census[op] = n
     return census
+
+
+def collective_overlap_report(fn, *args, **lower_kwargs) -> Dict[str, Any]:
+    """Measure communication/compute overlap in the *compiled schedule*.
+
+    The reference overlaps gossip with backprop via per-parameter hooks and a
+    background thread (SURVEY.md §3.3 — "this overlap is the performance
+    contract"); under XLA the analogous contract is that collectives lower to
+    ``-start``/``-done`` pairs with real compute scheduled inside the window.
+    This walks the post-optimization HLO in emission order and, for every
+    async collective window, counts the compute instructions (fusions,
+    convolutions, dots, custom-calls) placed between ``start`` and ``done`` —
+    compiler-level proof that the transfer is in flight while the math runs.
+
+    Returns ``{"pairs": n, "windows": [per-window compute counts],
+    "mean_compute_in_flight": float, "overlapped_fraction": share of windows
+    with >= 1 compute op inside}``.
+    """
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    hlo = jitted.lower(*args, **lower_kwargs).compile().as_text()
+    return parse_overlap_windows(hlo)
+
+
+def parse_overlap_windows(hlo: str) -> Dict[str, Any]:
+    """Parse a post-optimization HLO module's text (in schedule order) into
+    the overlap report of :func:`collective_overlap_report`."""
+    start_re = re.compile(
+        r"^\s*%?(?P<name>[\w.\-]+)\s*=.*\b[\w\-]+-start\(")
+    collective_done_re = re.compile(
+        "(" + "|".join(re.escape(op) for op in _COLLECTIVE_OPS) + r")-done\(")
+    compute_re = re.compile(r"\b(fusion|convolution|dot|custom-call)\(")
+    open_windows: Dict[str, int] = {}
+    windows = []
+    for line in hlo.splitlines():
+        m = start_re.match(line)
+        if m and any(f"{op}-start(" in line for op in _COLLECTIVE_OPS):
+            open_windows[m.group("name")] = 0
+            continue
+        # only dones of the tracked collective families close windows, and
+        # only by exact operand-name match (%name followed by a delimiter —
+        # a done for %start.12 must not also close %start.1); an unmatched
+        # done closes nothing.
+        if collective_done_re.search(line) and open_windows:
+            closed = [n for n in open_windows
+                      if re.search(rf"%{re.escape(n)}[),\s]", line)]
+            for n in closed:
+                windows.append(open_windows.pop(n))
+            if closed:
+                continue
+        if open_windows and compute_re.search(line):
+            for n in open_windows:
+                open_windows[n] += 1
+    pairs = len(windows)
+    return {
+        "pairs": pairs,
+        "windows": windows,
+        "mean_compute_in_flight": (sum(windows) / pairs) if pairs else 0.0,
+        "overlapped_fraction": (sum(1 for w in windows if w > 0) / pairs)
+        if pairs else 0.0,
+    }
 
 
 def compiled_flops(fn, *args, **lower_kwargs) -> float:

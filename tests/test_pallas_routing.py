@@ -64,6 +64,35 @@ def test_auto_rejects_non_circulant_and_single_device(on_tpu):
     assert pg.auto_gossip_backend(solo, SMALL) == "xla"
 
 
+def test_auto_rejects_zero_slot_schedules(on_tpu):
+    """A multi-device identity topology builds a circulant schedule with ZERO
+    slots (no edges); auto must take XLA — the grid-free kernel cannot lower
+    with no receive buffers."""
+    from bluefog_tpu.topology.graphs import Topology
+
+    ident = build_schedule(Topology(weights=np.eye(8), name="identity8"))
+    assert ident.num_slots == 0 and ident.is_circulant
+    assert pg.auto_gossip_backend(ident, SMALL) == "xla"
+
+
+def test_pallas_zero_slot_degenerates_to_self_term():
+    """Forced backend='pallas' on a 0-slot schedule returns sw*x instead of
+    crashing in kernel lowering (interpret-free: no kernel is built)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bluefog_tpu.parallel.api import shard_map
+    from bluefog_tpu.topology.graphs import Topology
+
+    sched = build_schedule(Topology(weights=np.eye(8), name="identity8"))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("bf",))
+    xs = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    out = jax.jit(shard_map(
+        lambda v: pg.neighbor_allreduce_pallas(v[0], sched, "bf")[None],
+        mesh=mesh, in_specs=(P("bf"),), out_specs=P("bf"),
+        check_vma=False))(xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xs), rtol=1e-6)
+
+
 def test_kill_switch(on_tpu, monkeypatch):
     sched = build_schedule(RingGraph(8))
     monkeypatch.setenv("BLUEFOG_TPU_PALLAS_GOSSIP", "0")
