@@ -10,3 +10,4 @@ from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from bluefog_tpu.models.bert import BertConfig, BertEncoder
 from bluefog_tpu.models.transformer import GPTConfig, TransformerLM
 from bluefog_tpu.models.moe import MoEConfig, MoETransformerLM
+from bluefog_tpu.models.vit import ViTConfig, ViT

@@ -1,0 +1,93 @@
+"""Vision Transformer — attention on the image side of the zoo.
+
+No counterpart exists in the reference (CNN-era data-parallel library;
+SURVEY.md §2.2 examples are LeNet/ResNet) — this model exists so the
+framework's attention stack (pluggable ``attn_fn``, flash backend, remat)
+is exercised by an *image* workload as well as the LM, under any of the
+gossip/data-parallel optimizers.
+
+Reuses the transformer trunk (:class:`bluefog_tpu.models.transformer.Block`)
+with non-causal attention: ViT is the same pre-LN residual architecture with
+patch embedding instead of token embedding and a classification head over
+the [CLS] position.  TPU-first: bf16 matmuls, f32 layernorm/softmax, static
+shapes; the patchify is one strided conv (an MXU matmul after im2col).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from bluefog_tpu.models.transformer import Block, GPTConfig
+from bluefog_tpu.ops.ring_attention import local_attention
+
+__all__ = ["ViTConfig", "ViT"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @staticmethod
+    def base() -> "ViTConfig":
+        return ViTConfig()  # ViT-B/16
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        """For tests/dryruns."""
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                         hidden_size=64, num_layers=2, num_heads=4,
+                         dtype=jnp.float32)
+
+    def trunk(self) -> GPTConfig:
+        """The transformer-block config this ViT shares with the LM trunk."""
+        n_tokens = (self.image_size // self.patch_size) ** 2 + 1
+        return GPTConfig(
+            vocab_size=1,  # unused by Block
+            hidden_size=self.hidden_size, num_layers=self.num_layers,
+            num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+            max_position=n_tokens, dtype=self.dtype, remat=self.remat)
+
+
+class ViT(nn.Module):
+    """Images ``(B, H, W, C)`` → logits ``(B, num_classes)``."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False, attn_fn=None):
+        cfg = self.cfg
+        trunk = cfg.trunk()
+        if attn_fn is None:
+            attn_fn = lambda q, k, v: local_attention(q, k, v, causal=False,
+                                                      backend="auto")
+        b = x.shape[0]
+        x = nn.Conv(cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, name="patchify")(x.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.hidden_size)  # (B, n_patches, D)
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, cfg.hidden_size), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype), (b, 1, cfg.hidden_size)),
+             x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], cfg.hidden_size), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+
+        block_cls = (nn.remat(Block, static_argnums=(2,))
+                     if trunk.remat else Block)
+        for i in range(cfg.num_layers):
+            x = block_cls(trunk, name=f"block_{i}")(x, attn_fn)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x[:, 0])
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)

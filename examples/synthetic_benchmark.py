@@ -88,6 +88,33 @@ def build_model(name, image_size, seq_len, dtype):
 
         return loss_fn, params, make_batch
 
+    if name.startswith("vit"):
+        import dataclasses
+
+        from bluefog_tpu.models import ViT, ViTConfig
+
+        cfg = (ViTConfig.base() if name == "vit-base"
+               else ViTConfig.tiny())
+        # honor --image-size like the resnet branch (must stay a multiple of
+        # the patch size for the patchify conv to tile exactly)
+        image_size = image_size - (image_size % cfg.patch_size)
+        cfg = dataclasses.replace(cfg, dtype=dtype, image_size=image_size)
+        model = ViT(cfg)
+        hw, classes = cfg.image_size, cfg.num_classes
+        params = model.init(rng, jnp.zeros((1, hw, hw, 3), dtype))
+
+        def make_batch(key, n, b):
+            return (jax.random.normal(key, (n, b, hw, hw, 3), dtype),
+                    jax.random.randint(key, (n, b), 0, classes))
+
+        def loss_fn(p, batch):
+            x, y = batch
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        return loss_fn, params, make_batch
+
     if name.startswith("bert"):
         cfg = BertConfig.large() if name == "bert-large" else BertConfig.base()
         model = BertEncoder(cfg, num_classes=2)
@@ -157,7 +184,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50",
                     choices=["lenet", "resnet18", "resnet50", "bert-base",
-                             "bert-large", "gpt-small"])
+                             "bert-large", "gpt-small", "vit-tiny",
+                             "vit-base"])
     ap.add_argument("--comm", default="neighbor",
                     choices=["none", "allreduce", "neighbor", "hierarchical",
                              "winput"])

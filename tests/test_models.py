@@ -52,6 +52,50 @@ def test_resnet_batchnorm_mutable_update():
     assert any(jax.tree_util.tree_leaves(changed))
 
 
+def test_vit_tiny_forward_and_grad():
+    from bluefog_tpu.models import ViT, ViTConfig
+
+    cfg = ViTConfig.tiny()
+    m = ViT(cfg)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 32, 32, 3))
+    out = m.apply(v, x)
+    assert out.shape == (3, 10)
+    assert out.dtype == jnp.float32
+
+    def loss(p):
+        return (m.apply(p, x) ** 2).mean()
+
+    g = jax.grad(loss)(v)
+    assert np.isfinite(
+        np.asarray([np.sum(np.asarray(t, np.float64))
+                    for t in jax.tree_util.tree_leaves(g)])).all()
+
+
+def test_vit_base_param_count():
+    from bluefog_tpu.models import ViT, ViTConfig
+
+    m = ViT(ViTConfig.base())
+    v = jax.eval_shape(
+        lambda k: m.init(k, jnp.zeros((1, 224, 224, 3), jnp.bfloat16)),
+        jax.random.PRNGKey(0))
+    total = n_params(v["params"])
+    assert 85e6 < total < 88e6  # canonical ViT-B/16: ~86.6M
+
+
+def test_vit_remat_matches():
+    from bluefog_tpu.models import ViT, ViTConfig
+
+    cfg = ViTConfig.tiny()
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    m = ViT(cfg)
+    v = m.init(jax.random.PRNGKey(0), x)
+    m_r = ViT(dataclasses.replace(cfg, remat=True))
+    np.testing.assert_allclose(
+        np.asarray(m.apply(v, x)), np.asarray(m_r.apply(v, x)),
+        rtol=1e-6, atol=1e-6)
+
+
 def test_bert_tiny_forward():
     cfg = BertConfig.tiny()
     m = BertEncoder(cfg, num_classes=3)
