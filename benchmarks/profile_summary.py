@@ -39,21 +39,35 @@ def main():
         data = json.load(f)
     events = data.get("traceEvents", data if isinstance(data, list) else [])
 
-    # device-track pids: XLA op events carry 'dur' and live on TPU/device
-    # process tracks; host python tracks are excluded by name
-    pid_names = {}
+    # Select per-op device events WITHOUT double counting their enclosing
+    # spans: TensorBoard traces put one "XLA Ops" thread (per-instruction
+    # events) next to "XLA Modules"/"Steps" threads whose events span whole
+    # compiled steps — summing a pid wholesale counts every op twice.
+    pid_names, tid_names = {}, {}
     for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
             pid_names[e["pid"]] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tid_names[(e.get("pid"), e.get("tid"))] = (
+                e.get("args", {}).get("name", ""))
+    op_tids = {k for k, v in tid_names.items() if re.search(r"XLA Ops", v)}
     device_pids = {pid for pid, name in pid_names.items()
                    if re.search(r"TPU|device|/device", name, re.I)}
+
+    def selected(e):
+        if op_tids:
+            return (e.get("pid"), e.get("tid")) in op_tids
+        tname = tid_names.get((e.get("pid"), e.get("tid")), "")
+        if re.search(r"Modules|Steps", tname):
+            return False  # step/module envelopes, not per-op time
+        return not device_pids or e.get("pid") in device_pids
 
     by_op = defaultdict(float)
     total = 0.0
     for e in events:
-        if e.get("ph") != "X" or "dur" not in e:
-            continue
-        if device_pids and e.get("pid") not in device_pids:
+        if e.get("ph") != "X" or "dur" not in e or not selected(e):
             continue
         name = e.get("name", "?")
         # collapse XLA's uniquifier suffixes: fusion.123 -> fusion
@@ -63,6 +77,10 @@ def main():
 
     if not by_op:
         raise SystemExit("no device op events found in trace")
+    if not op_tids and not device_pids:
+        print("WARNING: no 'XLA Ops' thread or device pid in this trace — "
+              "host-side events are being summed (CPU-only capture?); "
+              "capture on a TPU for a meaningful sink table", file=sys.stderr)
     print(f"trace: {path}")
     print(f"total device op time: {total / 1e3:.2f} ms "
           f"(over the captured steps)")

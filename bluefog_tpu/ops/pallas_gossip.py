@@ -116,9 +116,9 @@ def circulant_shifts(sched: GossipSchedule) -> Optional[Tuple[int, ...]]:
 
 
 def is_pallas_supported(sched: GossipSchedule) -> bool:
-    """True when the schedule can ride the RDMA kernels (circulant) and we
-    are on a real TPU backend."""
-    if circulant_shifts(sched) is None:
+    """True when the schedule can ride the RDMA kernels (circulant, at least
+    one slot) and we are on a real TPU backend."""
+    if not circulant_shifts(sched):
         return False
     try:
         return jax.devices()[0].platform == "tpu"
@@ -310,6 +310,11 @@ def deliver_pallas(
     shifts = circulant_shifts(sched)
     if shifts is None:
         raise ValueError("pallas deliver requires a circulant schedule")
+    if not shifts:
+        # 0-slot schedule: no out-neighbors, nothing lands — the slot
+        # buffers are unchanged (a zero-receive grid-free kernel cannot
+        # lower; same degenerate case as neighbor_allreduce_pallas).
+        return bufs
     n = sched.size
     i = lax.axis_index(axis_name)
 

@@ -75,6 +75,27 @@ def test_auto_rejects_zero_slot_schedules(on_tpu):
     assert pg.auto_gossip_backend(ident, SMALL) == "xla"
 
 
+def test_deliver_pallas_zero_slot_returns_bufs_unchanged():
+    """The window transport has the same degenerate case as gossip: no
+    out-neighbors -> slot buffers unchanged, no kernel built."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bluefog_tpu.parallel.api import shard_map
+    from bluefog_tpu.topology.graphs import Topology
+
+    sched = build_schedule(Topology(weights=np.eye(8), name="identity8"))
+    assert not pg.is_pallas_supported(sched)  # and the guard below holds too
+    mesh = Mesh(np.array(jax.devices()[:8]), ("bf",))
+    payload = jnp.ones((8, 4), jnp.float32)
+    bufs = jnp.zeros((8, 0, 4), jnp.float32)  # K=0 slots
+    out = jax.jit(shard_map(
+        lambda p, b: pg.deliver_pallas(p[0], b[0], sched, "bf",
+                                       accumulate=False)[None],
+        mesh=mesh, in_specs=(P("bf"), P("bf")), out_specs=P("bf"),
+        check_vma=False))(payload, bufs)
+    assert out.shape == (8, 0, 4)
+
+
 def test_pallas_zero_slot_degenerates_to_self_term():
     """Forced backend='pallas' on a 0-slot schedule returns sw*x instead of
     crashing in kernel lowering (interpret-free: no kernel is built)."""
