@@ -102,14 +102,18 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, attn_fn: Optional[AttnFn] = None,
-                 position_offset=0):
+                 position_offset=0, positions=None):
         cfg = self.cfg
         if attn_fn is None:
             # the model layer is the perf path: opt into the fused TPU flash
             # kernel whenever eligible (parity: tests/test_flash_attention.py)
             attn_fn = lambda q, k, v: local_attention(q, k, v, causal=True,
                                                       backend="auto")
-        positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
+        if positions is None:
+            positions = position_offset + jnp.arange(tokens.shape[1])[None, :]
+        # else: explicit per-token global positions — required by layouts
+        # whose local block is not contiguous (e.g. the zigzag causal ring,
+        # where a rank holds a front chunk and its mirrored back chunk)
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                      name="tok")(tokens)
         x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,

@@ -36,7 +36,7 @@ import numpy as np
 from bluefog_tpu.utils import log
 from bluefog_tpu.utils.timeline import timeline_context
 
-__all__ = ["CheckpointManager", "run_with_restart"]
+__all__ = ["CheckpointManager", "run_with_restart", "resize_rank_state"]
 
 
 def _consensus(state):
@@ -156,9 +156,121 @@ class CheckpointManager:
                 step, args=self._ocp.args.StandardRestore(abstract))
         return self._mgr.restore(step)
 
+    def stored_shapes(self, step: int):
+        """Flat list of leaf shapes of a stored checkpoint WITHOUT loading
+        its data (Orbax item metadata), in tree-flatten order; ``None`` when
+        metadata is unavailable."""
+        self.wait()
+        try:
+            md = self._mgr.item_metadata(step)
+            tree = getattr(md, "tree", md)
+            return [tuple(getattr(m, "shape", ()))
+                    for m in jax.tree_util.tree_leaves(tree)]
+        except Exception:
+            return None
+
     def close(self):
         self.wait()
         self._mgr.close()
+
+
+def resize_rank_state(state, new_size: int):
+    """Elastic re-topology: map a rank-stacked tree saved at world size N
+    onto ``new_size`` = M ranks (the reference has no elastic story at all —
+    a rank failure kills the MPI job, SURVEY.md §5; here a shrunken or grown
+    slice resumes from the same checkpoint).
+
+    Shrink (M < N): surviving rank ``j`` folds ranks ``j, j+M, j+2M, ...`` —
+    floating leaves by averaging (each orphaned replica's divergence is
+    merged instead of dropped, so no rank's progress is discarded), integer
+    leaves take the group's first member.  Grow (M > N): new rank ``j``
+    starts from a copy of rank ``j % N`` (re-mixed apart by the first gossip
+    rounds).  0-d / non-array leaves pass through.
+    """
+    def one(leaf):
+        if not (hasattr(leaf, "ndim") and getattr(leaf, "ndim", 0) >= 1):
+            return leaf
+        arr = np.asarray(leaf)
+        n = arr.shape[0]
+        if n == new_size:
+            return arr
+        if new_size < n:
+            if np.issubdtype(arr.dtype, np.inexact):
+                return np.stack([
+                    arr[j::new_size].astype(np.float64).mean(axis=0)
+                    for j in range(new_size)
+                ]).astype(arr.dtype)
+            return arr[:new_size]
+        reps = -(-new_size // n)
+        return np.tile(arr, (reps,) + (1,) * (arr.ndim - 1))[:new_size]
+
+    return jax.tree_util.tree_map(one, state)
+
+
+def _leading_dim(tree) -> Optional[int]:
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "ndim") and getattr(leaf, "ndim", 0) >= 1:
+            return int(np.shape(leaf)[0])
+    return None
+
+
+def _classify_shapes(stored_shapes, template):
+    """Compare stored leaf shapes (flat list from ``stored_shapes``) against
+    the template: ``'exact'`` (same shapes everywhere), ``'rank_resize'`` (a
+    PURE rank-axis change: every array leaf's leading dim is its tree's
+    world size, trailing dims match pairwise), or ``'mismatch'``.  A
+    ``consensus``-mode checkpoint (no rank axis) or a different model is a
+    mismatch — resizing it would silently average along a weight axis and
+    corrupt the model."""
+    s_leaves = [tuple(s) for s in stored_shapes]
+    t_leaves = [np.shape(t) for t in jax.tree_util.tree_leaves(template)]
+    if len(s_leaves) != len(t_leaves):
+        return "mismatch"
+    if s_leaves == t_leaves:
+        return "exact"
+    n_src = next((s[0] for s in s_leaves if len(s)), None)
+    n_tgt = next((t[0] for t in t_leaves if len(t)), None)
+    if n_src is None or n_tgt is None or n_src == n_tgt:
+        return "mismatch"
+    for s, t in zip(s_leaves, t_leaves):
+        if (len(s) == 0) != (len(t) == 0):
+            return "mismatch"
+        if len(s) == 0:
+            continue
+        if s[0] != n_src or t[0] != n_tgt or s[1:] != t[1:]:
+            return "mismatch"
+    return "rank_resize"
+
+
+def _restore_elastic(manager: CheckpointManager, step: int, template):
+    """Restore ``step`` into ``template``, validating shapes from checkpoint
+    METADATA first (no data IO): exact match takes the ordinary templated
+    restore; a pure rank-axis change (world shrank/grew) loads raw once and
+    resizes; anything else raises loudly — Orbax's templated restore would
+    otherwise silently truncate mismatched arrays."""
+    shapes = manager.stored_shapes(step)
+    if shapes is None:  # metadata unavailable: previous behavior
+        return manager.restore(step, template=template)
+    kind = _classify_shapes(shapes, template)
+    if kind == "exact":
+        return manager.restore(step, template=template)
+    if kind == "mismatch":
+        raise ValueError(
+            f"checkpoint step {step} shapes do not match the template and "
+            "are not a pure world-size change — refusing to restore "
+            "(a templated restore would silently truncate)")
+    n_src = next((s[0] for s in shapes if len(s)), None)
+    n_tgt = _leading_dim(template)
+    log.warn("elastic resume: checkpoint world size %d -> current %d",
+             n_src, n_tgt)
+    raw = resize_rank_state(manager.restore(step), n_tgt)
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    r_leaves = jax.tree_util.tree_leaves(raw)
+    cast = [np.asarray(r).astype(np.asarray(t).dtype)
+            if hasattr(t, "dtype") or isinstance(t, (int, float, np.ndarray))
+            else r
+            for t, r in zip(t_leaves, r_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, cast)
 
 
 def run_with_restart(
@@ -180,8 +292,12 @@ def run_with_restart(
     ``latest_step + 1`` — bounded by ``max_restarts``, after which the last
     failure propagates.  On TPU pods, slice/host failures surface as exactly
     such exceptions from the collective runtime, so wrapping the train loop
-    in this is the minimal elastic story; true re-sharding elasticity is out
-    of reference scope.
+    in this is the minimal elastic story.  Re-topology is supported: if the
+    restarted process brings a *different* world size (``init_state``'s rank
+    axis differs from the checkpoint's), the state is resized via
+    :func:`resize_rank_state` — shrink folds orphaned replicas into
+    survivors by averaging, grow clones — so training continues on whatever
+    slice remains.
 
     ``heartbeat_timeout_s`` additionally arms a hang watchdog
     (:class:`bluefog_tpu.utils.failure.Heartbeat`): ``train_fn`` is then
@@ -207,7 +323,10 @@ def run_with_restart(
             if step is None:
                 state, start = init_state, 0
             else:
-                state = manager.restore(step, template=init_state)
+                # elastic: the checkpoint may have been written by a
+                # different world size (lost or regained slice) — the rank
+                # axis is resized to match init_state's world
+                state = _restore_elastic(manager, step, init_state)
                 start = step + 1
                 log.info("restarting from checkpoint step %d", step)
             if heartbeat_timeout_s is None:

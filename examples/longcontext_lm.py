@@ -47,7 +47,8 @@ def make_batch(key, batch, t_global, vocab, period):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--attn", choices=["ring", "ulysses"], default="ring")
+    ap.add_argument("--attn", choices=["ring", "ring-zigzag", "ulysses"],
+                    default="ring")
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--t-local", type=int, default=64,
@@ -78,9 +79,13 @@ def main():
     print(f"ranks={n} global_seq={t_global} attn={args.attn} "
           f"period={args.period} remat={args.remat}")
 
+    zigzag = args.attn == "ring-zigzag"
     if args.attn == "ring":
         attn = functools.partial(ring_attention, axis_name=ctx.axis_name,
                                  causal=True)
+    elif zigzag:
+        attn = functools.partial(ring_attention, axis_name=ctx.axis_name,
+                                 causal=True, layout="zigzag")
     else:
         attn = functools.partial(all_to_all_attention,
                                  axis_name=ctx.axis_name, causal=True,
@@ -92,19 +97,42 @@ def main():
     opt = optax.adam(args.lr)
     opt_state = opt.init(params)
 
-    def lm_step(params, opt_state, tokens_blk):
+    if zigzag:
+        # the load-balanced layout's local block is NOT contiguous (front
+        # chunk r + mirrored back chunk 2n-1-r), so global next-token
+        # targets are computed in global order then resharded like the
+        # tokens, and per-token global positions are built from the rank id
+        from bluefog_tpu.ops.ring_attention import zigzag_shard
+
+        if args.t_local % 2:
+            raise SystemExit("--t-local must be even for ring-zigzag")
+        targets_global = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], 1)
+        tokens_in = zigzag_shard(tokens, n)
+        targets_in = zigzag_shard(targets_global, n)
+        c = args.t_local // 2
+    else:
+        tokens_in, targets_in = tokens, tokens  # targets via ppermute below
+
+    def lm_step(params, opt_state, tokens_blk, tgt_blk):
         # tokens_blk: (B, T_local) — this shard's block of the sequence
-        offset = lax.axis_index(ctx.axis_name) * tokens_blk.shape[1]
+        r = lax.axis_index(ctx.axis_name)
 
         def loss_fn(p):
-            logits = lm.apply(p, tokens_blk, attn_fn=attn,
-                              position_offset=offset)
-            # next-token targets across shard boundaries: first token of the
-            # NEXT rank's block wraps in (global periodic sequence)
-            nxt = lax.ppermute(
-                tokens_blk[:, :1], ctx.axis_name,
-                [(i, (i - 1) % n) for i in range(n)])
-            tgt = jnp.concatenate([tokens_blk[:, 1:], nxt], axis=1)
+            if zigzag:
+                pos = jnp.concatenate(
+                    [r * c + jnp.arange(c),
+                     (2 * n - 1 - r) * c + jnp.arange(c)])[None, :]
+                logits = lm.apply(p, tokens_blk, attn_fn=attn, positions=pos)
+                tgt = tgt_blk
+            else:
+                logits = lm.apply(p, tokens_blk, attn_fn=attn,
+                                  position_offset=r * tokens_blk.shape[1])
+                # next-token targets across shard boundaries: first token of
+                # the NEXT rank's block wraps in (global periodic sequence)
+                nxt = lax.ppermute(
+                    tokens_blk[:, :1], ctx.axis_name,
+                    [(i, (i - 1) % n) for i in range(n)])
+                tgt = jnp.concatenate([tokens_blk[:, 1:], nxt], axis=1)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, tgt).mean()
 
@@ -116,14 +144,15 @@ def main():
 
     step = jax.jit(shard_map(
         lm_step, mesh=ctx.mesh,
-        in_specs=(P(), P(), P(None, ctx.axis_name)),
+        in_specs=(P(), P(), P(None, ctx.axis_name), P(None, ctx.axis_name)),
         out_specs=(P(), P(), P()), check_vma=False,
     ), donate_argnums=(0, 1))
 
     first = last = None
     t0 = time.perf_counter()
     for s in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, tokens)
+        params, opt_state, loss = step(params, opt_state, tokens_in,
+                                       targets_in)
         loss = float(loss)
         first = first if first is not None else loss
         last = loss

@@ -145,3 +145,70 @@ def test_restart_counts_recovery_failures(tmp_path):
 
     with pytest.raises((OSError, RuntimeError)):
         run_with_restart(train, mgr, {"w": jnp.zeros((2,))}, max_restarts=1)
+
+
+class TestElasticResume:
+    """Re-topology: resume a checkpoint written at world N on M ranks."""
+
+    def test_resize_shrink_folds_orphans_by_mean(self):
+        from bluefog_tpu.utils.checkpoint import resize_rank_state
+
+        state = {"w": np.arange(8 * 2, dtype=np.float32).reshape(8, 2),
+                 "step": np.full((8,), 7, np.int64)}
+        out = resize_rank_state(state, 4)
+        # rank j folds old ranks j and j+4 by mean
+        want = (state["w"][:4] + state["w"][4:]) / 2
+        np.testing.assert_allclose(out["w"], want)
+        np.testing.assert_array_equal(out["step"], np.full((4,), 7))
+        assert out["w"].dtype == np.float32
+
+    def test_resize_grow_clones(self):
+        from bluefog_tpu.utils.checkpoint import resize_rank_state
+
+        state = {"w": np.arange(4 * 2, dtype=np.float32).reshape(4, 2)}
+        out = resize_rank_state(state, 8)
+        np.testing.assert_array_equal(out["w"][:4], state["w"])
+        np.testing.assert_array_equal(out["w"][4:], state["w"])
+
+    def test_run_with_restart_across_world_sizes(self, tmp_path):
+        """Save at world 4, crash, resume at world 2: train_fn sees the
+        folded 2-rank state and the right start step."""
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        state4 = _state()
+        mgr.save(5, state4)
+
+        template2 = {
+            "params": {"w": jnp.zeros((2, 3), jnp.float32),
+                       "b": jnp.zeros((2, 2), jnp.bfloat16)},
+            "step": jnp.zeros((2,), jnp.int32),
+        }
+        seen = {}
+
+        def train_fn(state, start):
+            seen["start"] = start
+            seen["w"] = np.asarray(state["params"]["w"], np.float32)
+            seen["b_dtype"] = np.asarray(state["params"]["b"]).dtype
+            return state
+
+        run_with_restart(train_fn, mgr, template2)
+        assert seen["start"] == 6
+        w4 = np.asarray(state4["params"]["w"], np.float32)
+        np.testing.assert_allclose(seen["w"], (w4[:2] + w4[2:]) / 2)
+        mgr.close()
+
+    def test_consensus_checkpoint_is_not_resized(self, tmp_path):
+        """A consensus-mode (un-stacked) checkpoint must NOT be mistaken for
+        a world-size change — restoring it into a stacked template raises
+        instead of silently averaging weight axes."""
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(3, _state(), mode="consensus")  # leaves lose the rank axis
+
+        template2 = {
+            "params": {"w": jnp.zeros((2, 3), jnp.float32),
+                       "b": jnp.zeros((2, 2), jnp.bfloat16)},
+            "step": jnp.zeros((2,), jnp.int32),
+        }
+        with pytest.raises(Exception):
+            run_with_restart(lambda s, start: s, mgr, template2,
+                             max_restarts=0)
+        mgr.close()
