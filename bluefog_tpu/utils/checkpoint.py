@@ -157,21 +157,57 @@ class CheckpointManager:
         return self._mgr.restore(step)
 
     def stored_shapes(self, step: int):
-        """Flat list of leaf shapes of a stored checkpoint WITHOUT loading
-        its data (Orbax item metadata), in tree-flatten order; ``None`` when
-        metadata is unavailable."""
+        """Leaf shapes of a stored checkpoint WITHOUT loading its data
+        (Orbax item metadata), keyed by normalized tree path; ``None`` when
+        metadata is unavailable.  Path keying (not flatten order) matters:
+        Orbax stores every container as a dict (sorted keys) while live
+        templates may hold namedtuples/dataclasses flattened in field
+        order."""
         self.wait()
         try:
             md = self._mgr.item_metadata(step)
             tree = getattr(md, "tree", md)
-            return [tuple(getattr(m, "shape", ()))
-                    for m in jax.tree_util.tree_leaves(tree)]
+            return {k: tuple(getattr(m, "shape", ()))
+                    for k, m in _path_leaves(tree).items()}
         except Exception:
             return None
 
     def close(self):
         self.wait()
         self._mgr.close()
+
+
+def _norm_key(p) -> str:
+    """Normalize a tree-path entry so dict keys (Orbax's storage form) and
+    namedtuple/dataclass attributes (live templates) compare equal."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(p, attr):
+            return str(getattr(p, attr))
+    return str(p)
+
+
+def _path_leaves(tree):
+    """``{normalized_path_tuple: leaf}`` for every leaf of ``tree``."""
+    return {tuple(_norm_key(p) for p in path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def _resize_leaf(leaf, new_size: int):
+    if not (hasattr(leaf, "ndim") and getattr(leaf, "ndim", 0) >= 1):
+        return leaf
+    arr = np.asarray(leaf)
+    n = arr.shape[0]
+    if n == new_size:
+        return arr
+    if new_size < n:
+        if np.issubdtype(arr.dtype, np.inexact):
+            return np.stack([
+                arr[j::new_size].astype(np.float64).mean(axis=0)
+                for j in range(new_size)
+            ]).astype(arr.dtype)
+        return arr[:new_size]
+    reps = -(-new_size // n)
+    return np.tile(arr, (reps,) + (1,) * (arr.ndim - 1))[:new_size]
 
 
 def resize_rank_state(state, new_size: int):
@@ -187,24 +223,8 @@ def resize_rank_state(state, new_size: int):
     starts from a copy of rank ``j % N`` (re-mixed apart by the first gossip
     rounds).  0-d / non-array leaves pass through.
     """
-    def one(leaf):
-        if not (hasattr(leaf, "ndim") and getattr(leaf, "ndim", 0) >= 1):
-            return leaf
-        arr = np.asarray(leaf)
-        n = arr.shape[0]
-        if n == new_size:
-            return arr
-        if new_size < n:
-            if np.issubdtype(arr.dtype, np.inexact):
-                return np.stack([
-                    arr[j::new_size].astype(np.float64).mean(axis=0)
-                    for j in range(new_size)
-                ]).astype(arr.dtype)
-            return arr[:new_size]
-        reps = -(-new_size // n)
-        return np.tile(arr, (reps,) + (1,) * (arr.ndim - 1))[:new_size]
-
-    return jax.tree_util.tree_map(one, state)
+    return jax.tree_util.tree_map(
+        lambda leaf: _resize_leaf(leaf, new_size), state)
 
 
 def _leading_dim(tree) -> Optional[int]:
@@ -214,25 +234,25 @@ def _leading_dim(tree) -> Optional[int]:
     return None
 
 
-def _classify_shapes(stored_shapes, template):
-    """Compare stored leaf shapes (flat list from ``stored_shapes``) against
-    the template: ``'exact'`` (same shapes everywhere), ``'rank_resize'`` (a
-    PURE rank-axis change: every array leaf's leading dim is its tree's
-    world size, trailing dims match pairwise), or ``'mismatch'``.  A
-    ``consensus``-mode checkpoint (no rank axis) or a different model is a
-    mismatch — resizing it would silently average along a weight axis and
-    corrupt the model."""
-    s_leaves = [tuple(s) for s in stored_shapes]
-    t_leaves = [np.shape(t) for t in jax.tree_util.tree_leaves(template)]
-    if len(s_leaves) != len(t_leaves):
+def _classify_shapes(stored, template):
+    """Compare stored leaf shapes (path-keyed dict from ``stored_shapes``)
+    against the template: ``'exact'`` (same paths, same shapes),
+    ``'rank_resize'`` (same paths; a PURE rank-axis change — every array
+    leaf's leading dim is its tree's world size, trailing dims match per
+    path), or ``'mismatch'``.  A ``consensus``-mode checkpoint (no rank
+    axis) or a different model is a mismatch — resizing it would silently
+    average along a weight axis and corrupt the model."""
+    t_shapes = {k: np.shape(v) for k, v in _path_leaves(template).items()}
+    if set(stored) != set(t_shapes):
         return "mismatch"
-    if s_leaves == t_leaves:
+    if all(tuple(stored[k]) == t_shapes[k] for k in t_shapes):
         return "exact"
-    n_src = next((s[0] for s in s_leaves if len(s)), None)
-    n_tgt = next((t[0] for t in t_leaves if len(t)), None)
+    n_src = next((s[0] for s in stored.values() if len(s)), None)
+    n_tgt = next((s[0] for s in t_shapes.values() if len(s)), None)
     if n_src is None or n_tgt is None or n_src == n_tgt:
         return "mismatch"
-    for s, t in zip(s_leaves, t_leaves):
+    for k, t in t_shapes.items():
+        s = tuple(stored[k])
         if (len(s) == 0) != (len(t) == 0):
             return "mismatch"
         if len(s) == 0:
@@ -247,7 +267,9 @@ def _restore_elastic(manager: CheckpointManager, step: int, template):
     METADATA first (no data IO): exact match takes the ordinary templated
     restore; a pure rank-axis change (world shrank/grew) loads raw once and
     resizes; anything else raises loudly — Orbax's templated restore would
-    otherwise silently truncate mismatched arrays."""
+    otherwise silently truncate mismatched arrays.  Leaves are aligned by
+    tree PATH, never by flatten position: Orbax's dicts sort keys while
+    template namedtuples/dataclasses flatten in field order."""
     shapes = manager.stored_shapes(step)
     if shapes is None:  # metadata unavailable: previous behavior
         return manager.restore(step, template=template)
@@ -259,18 +281,21 @@ def _restore_elastic(manager: CheckpointManager, step: int, template):
             f"checkpoint step {step} shapes do not match the template and "
             "are not a pure world-size change — refusing to restore "
             "(a templated restore would silently truncate)")
-    n_src = next((s[0] for s in shapes if len(s)), None)
+    n_src = next((s[0] for s in shapes.values() if len(s)), None)
     n_tgt = _leading_dim(template)
     log.warn("elastic resume: checkpoint world size %d -> current %d",
              n_src, n_tgt)
-    raw = resize_rank_state(manager.restore(step), n_tgt)
-    t_leaves, treedef = jax.tree_util.tree_flatten(template)
-    r_leaves = jax.tree_util.tree_leaves(raw)
-    cast = [np.asarray(r).astype(np.asarray(t).dtype)
-            if hasattr(t, "dtype") or isinstance(t, (int, float, np.ndarray))
-            else r
-            for t, r in zip(t_leaves, r_leaves)]
-    return jax.tree_util.tree_unflatten(treedef, cast)
+    raw_map = _path_leaves(manager.restore(step))
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    out = []
+    for path, t_leaf in paths_and_leaves:
+        key = tuple(_norm_key(p) for p in path)
+        r = _resize_leaf(raw_map[key], n_tgt)
+        if hasattr(t_leaf, "dtype") or isinstance(t_leaf,
+                                                  (int, float, np.ndarray)):
+            r = np.asarray(r).astype(np.asarray(t_leaf).dtype)
+        out.append(r)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def run_with_restart(

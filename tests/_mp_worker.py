@@ -13,7 +13,10 @@ success:
 2. ``process_rank``/``process_count`` and a spanning ``bf.init`` context;
 3. closed-form gossip (neighbor_allreduce) ACROSS the process boundary;
 4. closed-form global allreduce;
-5. ``win_mutex`` is a real cross-process lock: racing read-modify-write
+5. hierarchical gossip with the process boundary as the machine boundary,
+   in BOTH forms — flat mesh and the two-level (machine, local) mesh whose
+   outer axis crosses processes (the multi-slice/DCN shape);
+6. ``win_mutex`` is a real cross-process lock: racing read-modify-write
    increments on the coordination-service KV never lose an update.
 """
 
@@ -92,7 +95,46 @@ def main():
         np.testing.assert_allclose(
             np.asarray(shard.data)[0], xs_global.mean(axis=0), rtol=1e-6)
 
-    # 5. win_mutex: cross-process read-modify-write must not lose updates
+    # 5. hierarchical gossip with the PROCESS boundary as the machine
+    # boundary — both forms: flat mesh (axis_index_groups) and the
+    # two-level (machine, local) mesh whose outer axis crosses processes
+    # (the multi-slice/DCN shape).  Closed form: machine means, then W @ m.
+    ctx2 = bf.init(topology=RingGraph(n), local_size=LOCAL_DEVICES,
+                   machine_topology=RingGraph(nproc), use_ici_order=False)
+    assert bf.machine_rank() == pid and bf.local_rank() == 0
+    msched = ctx2.machine_schedule
+    means = xs_global.reshape(nproc, LOCAL_DEVICES, -1).mean(axis=1)
+    want_h = (RingGraph(nproc).weights @ means)
+
+    flat_fn = jax.jit(shard_map(
+        lambda v: C.hierarchical_neighbor_allreduce(
+            v, msched, ctx2.axis_name, local_size=LOCAL_DEVICES),
+        mesh=ctx2.mesh, in_specs=(P(ctx2.axis_name),),
+        out_specs=P(ctx2.axis_name), check_vma=False))
+    xs2 = multihost_utils.host_local_array_to_global_array(
+        local, ctx2.mesh, P(ctx2.axis_name))
+    for shard in flat_fn(xs2).addressable_shards:
+        row = shard.index[0].start
+        np.testing.assert_allclose(
+            np.asarray(shard.data)[0], want_h[row // LOCAL_DEVICES],
+            rtol=1e-6, atol=1e-6)
+
+    spec2 = P((ctx2.machine_axis_name, ctx2.local_axis_name))
+    two_fn = jax.jit(shard_map(
+        lambda v: C.hierarchical_neighbor_allreduce_2d(
+            v, msched, machine_axis=ctx2.machine_axis_name,
+            local_axis=ctx2.local_axis_name),
+        mesh=ctx2.hier_mesh, in_specs=(spec2,), out_specs=spec2,
+        check_vma=False))
+    xs3 = multihost_utils.host_local_array_to_global_array(
+        local, ctx2.hier_mesh, spec2)
+    for shard in two_fn(xs3).addressable_shards:
+        row = shard.index[0].start
+        np.testing.assert_allclose(
+            np.asarray(shard.data)[0], want_h[row // LOCAL_DEVICES],
+            rtol=1e-6, atol=1e-6)
+
+    # 6. win_mutex: cross-process read-modify-write must not lose updates
     from jax._src.distributed import global_state
     client = global_state.client
     if pid == 0:

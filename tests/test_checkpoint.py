@@ -208,7 +208,35 @@ class TestElasticResume:
                        "b": jnp.zeros((2, 2), jnp.bfloat16)},
             "step": jnp.zeros((2,), jnp.int32),
         }
-        with pytest.raises(Exception):
+        with pytest.raises(ValueError, match="refusing to restore"):
             run_with_restart(lambda s, start: s, mgr, template2,
                              max_restarts=0)
+        mgr.close()
+
+    def test_namedtuple_fields_align_by_path_not_position(self, tmp_path):
+        """Orbax stores containers as sorted-key dicts; templates with
+        namedtuples flatten in FIELD order.  Both the exact-restore check
+        and the elastic resize must align leaves by path, or same-shape
+        fields get silently swapped."""
+        import collections
+
+        NT = collections.namedtuple("NT", ["nu", "mu"])  # non-alphabetical
+        state4 = {"opt": NT(nu=jnp.full((4, 3), 1.0),
+                            mu=jnp.full((4, 3), 2.0)),
+                  "w": jnp.zeros((4, 2))}
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(1, state4)
+
+        # exact restore through run_with_restart (same world, namedtuple)
+        got = run_with_restart(lambda s, start: s, mgr, state4)
+        np.testing.assert_allclose(np.asarray(got["opt"].nu), 1.0)
+        np.testing.assert_allclose(np.asarray(got["opt"].mu), 2.0)
+
+        # elastic 4 -> 2: fields must keep their identities
+        template2 = {"opt": NT(nu=jnp.zeros((2, 3)), mu=jnp.zeros((2, 3))),
+                     "w": jnp.zeros((2, 2))}
+        got2 = run_with_restart(lambda s, start: s, mgr, template2)
+        np.testing.assert_allclose(np.asarray(got2["opt"].nu), 1.0)
+        np.testing.assert_allclose(np.asarray(got2["opt"].mu), 2.0)
+        assert np.shape(got2["opt"].nu) == (2, 3)
         mgr.close()
