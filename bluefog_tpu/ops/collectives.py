@@ -161,6 +161,7 @@ def neighbor_allreduce(
     *,
     self_weight=None,
     recv_weights=None,
+    send_weights=None,
     backend: str = "auto",
 ):
     """Weighted average with in-neighbors: ``out_i = w_ii x_i + sum_k w_ik x_k``.
@@ -174,6 +175,16 @@ def neighbor_allreduce(
         ``(num_slots,)``), the analog of the reference's per-call
         ``self_weight=/src_weights=`` arguments.  Because only *weights* change
         (the ppermute pattern is static), overriding them does not recompile.
+      send_weights: optional per-call SENDER-side scaling, the analog of the
+        reference's ``dst_weights=`` (each rank scales what it ships per out
+        slot before the transfer): ``(num_slots,)`` traced — slot ``k``'s
+        payload leaves this rank as ``send_weights[k] * x`` — or a
+        ``(size, num_slots)`` table, from which each rank takes its own row.
+        The receiver's ``recv_weights`` then apply on top, exactly as
+        upstream composes ``src_weights`` x ``dst_weights``.  Sender-side
+        scaling is an XLA-path feature: ``backend='auto'`` quietly keeps
+        XLA, and forcing ``backend='pallas'`` with it raises (the fused
+        kernel folds weights on the arrival path only).
 
     Lowering: one ``lax.ppermute`` per schedule slot (a single ICI rotation
     for circulant graphs) + fused multiply-adds; or the fused RDMA kernel
@@ -191,15 +202,25 @@ def neighbor_allreduce(
             f"unknown backend {backend!r}; expected 'auto', 'xla', or "
             "'pallas'")
     if backend == "auto":
-        from bluefog_tpu.ops import pallas_gossip
+        if send_weights is not None:
+            backend = "xla"  # sender-side scaling is an XLA-path feature
+        else:
+            from bluefog_tpu.ops import pallas_gossip
 
-        backend = pallas_gossip.auto_gossip_backend(sched, x)
+            backend = pallas_gossip.auto_gossip_backend(sched, x)
     # runtime per-round spans (B once inputs are live, E once the weighted
     # merge materializes; per-rank lanes) — identity unless a timeline is
     # active at trace time.  The reference emits the analogous per-tensor
     # enqueue/execute stage events from operations.cc (SURVEY.md §5).
     x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
                          axis_name=axis_name)
+
+    if send_weights is not None and backend == "pallas":
+        raise NotImplementedError(
+            "backend='pallas' cannot honor send_weights: the fused RDMA "
+            "kernel folds weights on the ARRIVAL path only.  Use "
+            "backend='xla' (same math), or fold the sender scaling into "
+            "recv_weights when it is uniform per slot")
 
     if backend == "pallas":
         from bluefog_tpu.ops import pallas_gossip
@@ -227,6 +248,12 @@ def neighbor_allreduce(
         return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                                 axis_name=axis_name)
 
+    send_w = (None if send_weights is None
+              else jnp.asarray(send_weights, jnp.float32))
+    if send_w is not None and send_w.ndim == 2:
+        # (size, num_slots) table: take this rank's row
+        send_w = send_w[lax.axis_index(axis_name)]
+
     def one(leaf):
         acc_dt = _acc_dtype(leaf)
         self_w, recv_w = _rank_weights(sched, axis_name, self_weight, recv_weights, acc_dt)
@@ -235,7 +262,10 @@ def neighbor_allreduce(
             # named_scope: per-slot attribution in jax.profiler/Perfetto
             # device traces (free — trace-time metadata only)
             with jax.named_scope(f"bf.neighbor_allreduce.slot{k}"):
-                recvd = lax.ppermute(leaf, axis_name, perm)
+                shipped = (leaf if send_w is None
+                           else (send_w[k].astype(acc_dt)
+                                 * leaf.astype(acc_dt)).astype(leaf.dtype))
+                recvd = lax.ppermute(shipped, axis_name, perm)
                 out = out + recv_w[k] * recvd.astype(acc_dt)
         return out.astype(leaf.dtype)
 

@@ -105,17 +105,18 @@ def _cached_op(op_name: str, mesh, axis_name: str, sched, *static):
     ax = axis_name
 
     if op_name == "neighbor_allreduce":
-        has_sw, has_rw = static
+        has_sw, has_rw, has_dw = static
 
-        def fn(xs, sw, rw):
+        def fn(xs, sw, rw, dw):
             return _ops.neighbor_allreduce(
                 xs, sched, ax,
                 self_weight=sw if has_sw else None,
                 recv_weights=rw if has_rw else None,
+                send_weights=dw if has_dw else None,
             )
 
         return jax.jit(shard_map(
-            fn, mesh=mesh, in_specs=(P(ax), P(), P()), out_specs=P(ax),
+            fn, mesh=mesh, in_specs=(P(ax), P(), P(), P()), out_specs=P(ax),
             check_vma=False,
         ))
 
@@ -168,21 +169,31 @@ def rank_shard(x):
 # ---------------------------------------------------------------------------
 
 
-def neighbor_allreduce(x, *, topology=None, self_weight=None, recv_weights=None):
+def neighbor_allreduce(x, *, topology=None, self_weight=None, recv_weights=None,
+                       send_weights=None):
     """Stacked-array ``bf.neighbor_allreduce``: ``out[i] = W[i,i] x[i] +
-    sum_j W[i,j] x[j]`` with ``W`` from ``topology`` (default: context)."""
+    sum_j W[i,j] x[j]`` with ``W`` from ``topology`` (default: context).
+
+    ``send_weights`` is the reference's per-call ``dst_weights``: slot-indexed
+    sender-side scaling applied to the shipped payload (``(num_slots,)``, or
+    ``(size, num_slots)`` for a per-rank table)."""
     ctx = get_context()
     sched = _sched(topology)
     f = _cached_op(
         "neighbor_allreduce", ctx.mesh, ctx.axis_name, sched,
         self_weight is not None, recv_weights is not None,
+        send_weights is not None,
     )
     sw = jnp.asarray(self_weight if self_weight is not None else 0.0, jnp.float32)
     rw = jnp.asarray(
         recv_weights if recv_weights is not None else jnp.zeros((sched.num_slots,)),
         jnp.float32,
     )
-    return f(x, sw, rw)
+    dw = jnp.asarray(
+        send_weights if send_weights is not None else jnp.zeros((sched.num_slots,)),
+        jnp.float32,
+    )
+    return f(x, sw, rw, dw)
 
 
 def neighbor_allreduce_aperiodic(x, mixing_matrix):

@@ -99,6 +99,32 @@ def test_neighbor_allreduce_per_call_weights():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
 
 
+def test_neighbor_allreduce_send_weights():
+    """Reference per-call ``dst_weights`` parity: rank i ships
+    ``send_w[i, k] * x_i`` in slot k, so the effective mix is
+    ``out_j = w_jj x_j + sum_k recv_w[j,k] * send_w[src,k] * x_src``."""
+    topo = RingGraph(N)
+    bf.init(topology=topo)
+    sched = build_schedule(topo)
+    x = rank_values((3,))
+
+    # uniform (num_slots,) vector: every rank halves what it ships
+    half = np.full((sched.num_slots,), 0.5, np.float32)
+    out = np.asarray(bf.neighbor_allreduce(x, send_weights=half), np.float64)
+    w = topo.weights.copy()
+    off = w - np.diag(np.diag(w))
+    want = (np.diag(np.diag(w)) + 0.5 * off) @ np.asarray(x, np.float64).reshape(N, -1)
+    np.testing.assert_allclose(out.reshape(N, -1), want, rtol=1e-6)
+
+    # per-rank (size, num_slots) table: rank i scales its payload by i
+    table = np.tile(np.arange(N, dtype=np.float32)[:, None],
+                    (1, sched.num_slots))
+    out2 = np.asarray(bf.neighbor_allreduce(x, send_weights=table), np.float64)
+    scaled = off * np.arange(N)[None, :]  # column src scaled by src's factor
+    want2 = (np.diag(np.diag(w)) + scaled) @ np.asarray(x, np.float64).reshape(N, -1)
+    np.testing.assert_allclose(out2.reshape(N, -1), want2, rtol=1e-6)
+
+
 def test_neighbor_allreduce_topology_override():
     bf.init(topology=RingGraph(N))
     topo2 = ExponentialTwoGraph(N)
