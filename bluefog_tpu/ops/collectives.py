@@ -197,23 +197,7 @@ def neighbor_allreduce(
     """
     sched = _as_schedule(schedule)
 
-    if backend not in ("auto", "xla", "pallas"):
-        raise ValueError(
-            f"unknown backend {backend!r}; expected 'auto', 'xla', or "
-            "'pallas'")
-    if backend == "auto":
-        if send_weights is not None:
-            backend = "xla"  # sender-side scaling is an XLA-path feature
-        else:
-            from bluefog_tpu.ops import pallas_gossip
-
-            backend = pallas_gossip.auto_gossip_backend(sched, x)
-    # runtime per-round spans (B once inputs are live, E once the weighted
-    # merge materializes; per-rank lanes) — identity unless a timeline is
-    # active at trace time.  The reference emits the analogous per-tensor
-    # enqueue/execute stage events from operations.cc (SURVEY.md §5).
-    x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
-                         axis_name=axis_name)
+    from bluefog_tpu.ops import pallas_gossip
 
     if send_weights is not None and backend == "pallas":
         raise NotImplementedError(
@@ -221,10 +205,18 @@ def neighbor_allreduce(
             "kernel folds weights on the ARRIVAL path only.  Use "
             "backend='xla' (same math), or fold the sender scaling into "
             "recv_weights when it is uniform per slot")
+    if send_weights is not None and backend == "auto":
+        backend = "xla"  # sender-side scaling is an XLA-path feature
+    else:
+        backend = pallas_gossip.resolve_backend(backend, sched, x)
+    # runtime per-round spans (B once inputs are live, E once the weighted
+    # merge materializes; per-rank lanes) — identity unless a timeline is
+    # active at trace time.  The reference emits the analogous per-tensor
+    # enqueue/execute stage events from operations.cc (SURVEY.md §5).
+    x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
+                         axis_name=axis_name)
 
     if backend == "pallas":
-        from bluefog_tpu.ops import pallas_gossip
-
         # distinct collective_id per leaf: leaf kernels have no mutual data
         # dependencies, so XLA may overlap them — each needs its own global
         # barrier semaphore or one kernel's handshake absorbs another's.

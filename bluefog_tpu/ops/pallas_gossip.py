@@ -104,6 +104,30 @@ def auto_gossip_backend(sched: GossipSchedule, x) -> str:
     return "pallas" if biggest <= limit else "xla"
 
 
+def resolve_backend(backend: str, sched: GossipSchedule, x) -> str:
+    """Shared backend resolution for every transport that can ride the RDMA
+    kernels (gossip and the window deliver path): validate the name and
+    resolve ``'auto'`` through :func:`auto_gossip_backend`."""
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"unknown backend {backend!r}; expected 'auto', 'xla', or "
+            "'pallas'")
+    if backend == "auto":
+        return auto_gossip_backend(sched, x)
+    return backend
+
+
+def window_collective_id_base(name: str) -> int:
+    """Deterministic per-window collective-id base.  Two windows delivered
+    in ONE jitted program must not share barrier semaphores, so each
+    window's leaf kernels enumerate from a name-derived base: 2048 + a CRC32
+    bucket spaced 1024 apart (the per-call leaf cap).  Stable across
+    processes (CRC32, not Python hash) as SPMD requires."""
+    import zlib
+
+    return 2048 + (zlib.crc32(name.encode()) % (1 << 20)) * 1024
+
+
 def circulant_shifts(sched: GossipSchedule) -> Optional[Tuple[int, ...]]:
     """Per-slot uniform shifts, or None if the schedule is not circulant."""
     if not sched.is_circulant:

@@ -152,6 +152,12 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
              backend: str = "auto",
              assoc_payload=None) -> WindowState:
     sched = state.spec.schedule
+    # same routing policy as gossip (auto_gossip_backend's stated
+    # conditions) — the window transport is the same fused RDMA kernel
+    # family in 'put'/'acc' mode
+    from bluefog_tpu.ops import pallas_gossip
+
+    backend = pallas_gossip.resolve_backend(backend, sched, payload)
     mask = _slot_mask(sched, axis_name)
 
     def per_leaf(peers, leaf):
@@ -171,17 +177,19 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
         new_assoc = per_leaf(state.assoc_peers, assoc_payload)
 
     if backend == "pallas":
-        from bluefog_tpu.ops import pallas_gossip
-
         # distinct collective_id per leaf (leaf kernels may overlap on
-        # hardware; each needs its own barrier semaphore).  Windows own ids
-        # [2048, ...); gossip owns [1024, 2048) — see ops/collectives.py.
+        # hardware; each needs its own barrier semaphore), and a distinct
+        # NAME-derived base per window — two windows delivered in one
+        # jitted program (e.g. gradient-tracking's x and y windows) must
+        # not share semaphores either.  Windows own ids [2048, ...); gossip
+        # owns [1024, 2048) — see ops/collectives.py.
+        base = pallas_gossip.window_collective_id_base(state.spec.name)
         peer_leaves, treedef = jax.tree_util.tree_flatten(state.peer_bufs)
         payload_leaves = treedef.flatten_up_to(payload)
         outs = [
             pallas_gossip.deliver_pallas(
                 leaf, peers, sched, axis_name, accumulate=accumulate,
-                collective_id=2048 + idx,
+                collective_id=base + idx,
             )
             for idx, (peers, leaf) in enumerate(zip(peer_leaves, payload_leaves))
         ]

@@ -143,3 +143,24 @@ def test_neighbor_allreduce_consults_policy(monkeypatch):
     out = fn(jnp.ones((8, 4), jnp.float32))
     jax.block_until_ready(out)
     assert calls.get("hit"), "auto did not consult auto_gossip_backend"
+
+
+def test_win_put_consults_policy(monkeypatch):
+    """The window transport's backend='auto' routes through the same
+    policy as gossip (deliver = the RDMA kernels in put/acc mode)."""
+    import bluefog_tpu as bf
+
+    calls = {}
+    real = pg.auto_gossip_backend
+
+    def fake_policy(sched, x):
+        calls["hit"] = True
+        return real(sched, x)
+
+    monkeypatch.setattr(pg, "auto_gossip_backend", fake_policy)
+    bf.init(topology=RingGraph(8))
+    x = jnp.ones((8, 4), jnp.float32)
+    assert bf.win_create(x, "routing_probe")
+    bf.win_put(x, "routing_probe")
+    assert calls.get("hit"), "window auto did not consult auto_gossip_backend"
+    bf.win_free("routing_probe")
