@@ -17,6 +17,7 @@ from bluefog_tpu.ops.collectives import (
     neighbor_allgather,
     neighbor_allreduce_dynamic,
     neighbor_allreduce_aperiodic,
+    fuse_apply,
     hierarchical_neighbor_allreduce,
     hierarchical_neighbor_allreduce_2d,
     pair_gossip,

@@ -321,6 +321,31 @@ def test_hierarchical_two_level_mesh_matches_flat(local):
             np.testing.assert_allclose(two[local * m + l], ref_m[m], rtol=1e-6)
 
 
+def test_hierarchical_two_level_bf16():
+    """bf16 payloads through the two-level mesh accumulate in f32 (same
+    contract as every other collective here)."""
+    bf.init(local_size=2, machine_topology=RingGraph(4))
+    x = rank_values((4,), jnp.bfloat16)
+    flat = np.asarray(bf.hierarchical_neighbor_allreduce(x), np.float64)
+    two = np.asarray(
+        bf.hierarchical_neighbor_allreduce(x, two_level_mesh=True), np.float64)
+    np.testing.assert_allclose(two, flat, rtol=1e-2)
+
+
+def test_send_weights_bf16():
+    bf.init(topology=RingGraph(N))
+    sched = build_schedule(RingGraph(N))
+    x = rank_values((3,), jnp.bfloat16)
+    half = np.full((sched.num_slots,), 0.5, np.float32)
+    out = bf.neighbor_allreduce(x, send_weights=half)
+    assert out.dtype == jnp.bfloat16
+    w = RingGraph(N).weights
+    off = w - np.diag(np.diag(w))
+    want = (np.diag(np.diag(w)) + 0.5 * off) @ np.arange(N, dtype=np.float64)[:, None] * np.ones((1, 3))
+    np.testing.assert_allclose(np.asarray(out, np.float64).reshape(N, 3),
+                               want, rtol=2e-2)
+
+
 def test_hier_mesh_shape():
     bf.init(local_size=2, machine_topology=RingGraph(4))
     ctx = bf.get_context()

@@ -177,6 +177,21 @@ def test_ring_attention_zigzag_tiled_long_sequence():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_ring_attention_zigzag_bf16():
+    """bf16 inputs through the zigzag ring: f32 online-softmax state keeps
+    the result within bf16 tolerance of the f32 oracle."""
+    q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(seed=5))
+    want = local_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                           v.astype(jnp.float32), causal=True)
+    ring = _sharded(functools.partial(ring_attention, axis_name="sp",
+                                      causal=True, layout="zigzag"))
+    got = zigzag_unshard(
+        ring(zigzag_shard(q, N), zigzag_shard(k, N), zigzag_shard(v, N)), N)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=0.05, atol=0.05)
+
+
 def test_ring_attention_bf16_stable():
     q, k, v = _qkv(seed=3, dtype=jnp.bfloat16)
     got = _sharded(functools.partial(ring_attention, axis_name="sp",
