@@ -83,7 +83,7 @@ def _gossip(params, scheds, count, axis_name, backend="auto"):
 def decentralized_optimizer(
     base: optax.GradientTransformation,
     topology: Union[Topology, GossipSchedule, Sequence, None],
-    axis_name: str,
+    axis_name: Union[str, Sequence[str]],
     *,
     communication_type: CommunicationType = CommunicationType.neighbor_allreduce,
     atc: bool = False,
@@ -101,7 +101,10 @@ def decentralized_optimizer(
         ``step -> (n, n) mixing matrix`` (traced step) for aperiodic gossip —
         arbitrary edge sets every round with zero recompilation
         (e.g. ``topology.one_peer_exp2_mixing_matrix``).
-      axis_name: gossip mesh axis (call inside ``shard_map``).
+      axis_name: gossip mesh axis (call inside ``shard_map``); the
+        hierarchical mode also accepts the ``(machine_axis, local_axis)``
+        pair of a two-level mesh (``ctx.hier_mesh`` — the multi-slice/DCN
+        form, dispatching to ``hierarchical_neighbor_allreduce_2d``).
       communication_type: which combine to run (reference enum).
       atc: adapt-then-combine when True, adapt-with-combine (overlappable,
         reference default) when False.
@@ -156,6 +159,14 @@ def decentralized_optimizer(
                 lambda t: _gossip(t, scheds, count, axis_name, backend),
                 params)
         if ct == CommunicationType.hierarchical_neighbor_allreduce:
+            if isinstance(axis_name, (tuple, list)):
+                # two-level (machine, local) mesh: the multi-slice form —
+                # axis_name = (machine_axis, local_axis)
+                m_ax, l_ax = axis_name
+                return C.fuse_apply(
+                    lambda t: C.hierarchical_neighbor_allreduce_2d(
+                        t, mscheds[0], machine_axis=m_ax, local_axis=l_ax),
+                    params)
             return C.fuse_apply(
                 lambda t: C.hierarchical_neighbor_allreduce(
                     t, mscheds[0], axis_name, local_size=local_size), params)
@@ -244,13 +255,26 @@ def DistributedHierarchicalNeighborAllreduceOptimizer(
     base: optax.GradientTransformation,
     *,
     machine_topology,
-    local_size: int,
-    axis_name: str,
+    local_size: Optional[int] = None,
+    axis_name,
     atc: bool = False,
     num_steps_per_communication: int = 1,
 ) -> optax.GradientTransformation:
     """Reference ``bf.DistributedHierarchicalNeighborAllreduceOptimizer``:
-    intra-machine exact average + machine-level gossip each step."""
+    intra-machine exact average + machine-level gossip each step.
+
+    ``axis_name`` is either the flat gossip axis (then ``local_size`` is
+    required — machines are ``axis_index_groups``) or the
+    ``(machine_axis, local_axis)`` pair of a two-level mesh
+    (``ctx.hier_mesh`` — the multi-slice/DCN form; ``local_size`` is implied
+    by the mesh and may be omitted)."""
+    if isinstance(axis_name, (tuple, list)):
+        if len(axis_name) != 2:
+            raise ValueError(
+                f"two-level axis_name must be (machine_axis, local_axis), "
+                f"got {axis_name!r}")
+    elif local_size is None:
+        raise ValueError("flat-mesh hierarchical mode requires local_size")
     return decentralized_optimizer(
         base, None, axis_name,
         communication_type=CommunicationType.hierarchical_neighbor_allreduce,

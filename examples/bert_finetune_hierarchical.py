@@ -69,6 +69,10 @@ def main():
     ap.add_argument("--local-size", type=int, default=2,
                     help="chips per machine (intra-machine exact average)")
     ap.add_argument("--atc", action="store_true")
+    ap.add_argument("--two-level-mesh", action="store_true",
+                    help="run over the explicit (machine, local) mesh — the "
+                         "multi-slice/DCN deployment form (machine hops on "
+                         "the outer axis)")
     args = ap.parse_args()
 
     n = len(jax.devices())
@@ -92,10 +96,20 @@ def main():
                     args.num_classes, seed=0)
     loader = DistributedLoader(src, args.batch_size)
 
+    two_level = args.two_level_mesh and ctx.machine_schedule is not None
+    if args.two_level_mesh and ctx.machine_schedule is None:
+        print("WARNING: --two-level-mesh ignored: only one machine "
+              "(raise the device count or lower --local-size)")
+    # the step's mesh/specs are the only thing the two-level form changes:
+    # same model, same optimizer API — axis_name becomes the axis pair
+    axis = ((ctx.machine_axis_name, ctx.local_axis_name) if two_level
+            else ctx.axis_name)
+    mesh = ctx.hier_mesh if two_level else ctx.mesh
+    spec = P(axis)
     if ctx.machine_schedule is not None:
         opt = DistributedHierarchicalNeighborAllreduceOptimizer(
             optax.adamw(args.lr), machine_topology=ctx.machine_schedule,
-            local_size=args.local_size, axis_name=ctx.axis_name, atc=args.atc)
+            local_size=args.local_size, axis_name=axis, atc=args.atc)
     else:  # single machine: degenerate to plain gossip
         from bluefog_tpu.optim import DistributedNeighborAllreduceOptimizer
         opt = DistributedNeighborAllreduceOptimizer(
@@ -112,8 +126,8 @@ def main():
                                       opt.init(p))
 
     opt_state = jax.jit(shard_map(
-        init_opt, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
-        out_specs=P(ctx.axis_name), check_vma=False))(params)
+        init_opt, mesh=mesh, in_specs=(spec,),
+        out_specs=spec, check_vma=False))(params)
 
     def train_step(p_blk, opt_blk, ids_blk, y_blk):
         p, st = jax.tree_util.tree_map(lambda t: t[0], (p_blk, opt_blk))
@@ -133,8 +147,8 @@ def main():
         return out + (loss[None], acc[None])
 
     step_fn = jax.jit(shard_map(
-        train_step, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),) * 4,
-        out_specs=(P(ctx.axis_name),) * 4, check_vma=False,
+        train_step, mesh=mesh, in_specs=(spec,) * 4,
+        out_specs=(spec,) * 4, check_vma=False,
     ), donate_argnums=(0, 1))
 
     for epoch in range(args.epochs):

@@ -178,6 +178,46 @@ def test_hierarchical_optimizer_converges():
         np.testing.assert_allclose(w[2 * m], w[2 * m + 1], rtol=1e-6)
 
 
+def test_hierarchical_optimizer_two_level_mesh_matches_flat():
+    """The optimizer's (machine_axis, local_axis) form over ctx.hier_mesh
+    produces the same trajectory as the flat form (multi-slice/DCN shape)."""
+    flat = DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(0.05), machine_topology=RingGraph(4), local_size=2,
+        axis_name="bf", atc=True)
+    w_flat = run_quadratic(flat)
+
+    bf.init(local_size=2, machine_topology=RingGraph(4))
+    ctx = bf.get_context()
+    two = DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(0.05), machine_topology=ctx.machine_schedule,
+        axis_name=(ctx.machine_axis_name, ctx.local_axis_name), atc=True)
+
+    def body(c):
+        w0 = jnp.zeros_like(c)
+        state = two.init(w0)
+
+        def step(carry, _):
+            w, st = carry
+            g = w - c
+            upd, st = two.update(g, st, w)
+            return (optax.apply_updates(w, upd), st), None
+
+        (w, _), _ = lax.scan(step, (w0, state), None, length=300)
+        return w
+
+    spec = P((ctx.machine_axis_name, ctx.local_axis_name))
+    f = jax.jit(shard_map(body, mesh=ctx.hier_mesh, in_specs=(spec,),
+                          out_specs=spec, check_vma=False))
+    w_two = np.asarray(f(targets()))
+    np.testing.assert_allclose(w_two, w_flat, rtol=1e-5, atol=1e-6)
+
+
+def test_hierarchical_optimizer_flat_requires_local_size():
+    with pytest.raises(ValueError, match="local_size"):
+        DistributedHierarchicalNeighborAllreduceOptimizer(
+            optax.sgd(0.05), machine_topology=RingGraph(4), axis_name="bf")
+
+
 def test_adam_base_optimizer():
     """Any optax transformation works as the base (the reference wraps
     arbitrary torch.optim instances)."""
