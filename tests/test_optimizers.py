@@ -36,10 +36,14 @@ def targets():
     return jnp.broadcast_to(jnp.arange(N, dtype=jnp.float32)[:, None], (N, DIM))
 
 
-def run_quadratic(opt, steps=300, dim=DIM):
-    """Jitted shard_map training loop on per-rank quadratics."""
-    bf.init()
-    ctx = bf.get_context()
+def run_quadratic(opt, steps=300, dim=DIM, mesh=None, spec=None):
+    """Jitted shard_map training loop on per-rank quadratics.  ``mesh`` /
+    ``spec`` default to the flat context mesh; pass ``ctx.hier_mesh`` + its
+    axis-pair spec to run the same loop on the two-level mesh."""
+    if mesh is None:
+        bf.init()
+        ctx = bf.get_context()
+        mesh, spec = ctx.mesh, P("bf")
 
     def body(c):
         w0 = jnp.zeros_like(c)
@@ -54,8 +58,8 @@ def run_quadratic(opt, steps=300, dim=DIM):
         (w, _), _ = lax.scan(step, (w0, state), None, length=steps)
         return w
 
-    f = jax.jit(shard_map(body, mesh=ctx.mesh, in_specs=(P("bf"),),
-                          out_specs=P("bf"), check_vma=False))
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                          out_specs=spec, check_vma=False))
     return np.asarray(f(targets()))
 
 
@@ -191,24 +195,9 @@ def test_hierarchical_optimizer_two_level_mesh_matches_flat():
     two = DistributedHierarchicalNeighborAllreduceOptimizer(
         optax.sgd(0.05), machine_topology=ctx.machine_schedule,
         axis_name=(ctx.machine_axis_name, ctx.local_axis_name), atc=True)
-
-    def body(c):
-        w0 = jnp.zeros_like(c)
-        state = two.init(w0)
-
-        def step(carry, _):
-            w, st = carry
-            g = w - c
-            upd, st = two.update(g, st, w)
-            return (optax.apply_updates(w, upd), st), None
-
-        (w, _), _ = lax.scan(step, (w0, state), None, length=300)
-        return w
-
-    spec = P((ctx.machine_axis_name, ctx.local_axis_name))
-    f = jax.jit(shard_map(body, mesh=ctx.hier_mesh, in_specs=(spec,),
-                          out_specs=spec, check_vma=False))
-    w_two = np.asarray(f(targets()))
+    w_two = run_quadratic(
+        two, mesh=ctx.hier_mesh,
+        spec=P((ctx.machine_axis_name, ctx.local_axis_name)))
     np.testing.assert_allclose(w_two, w_flat, rtol=1e-5, atol=1e-6)
 
 
