@@ -36,10 +36,12 @@ def targets():
     return jnp.broadcast_to(jnp.arange(N, dtype=jnp.float32)[:, None], (N, DIM))
 
 
-def run_quadratic(opt, steps=300, dim=DIM, mesh=None, spec=None):
-    """Jitted shard_map training loop on per-rank quadratics.  ``mesh`` /
-    ``spec`` default to the flat context mesh; pass ``ctx.hier_mesh`` + its
-    axis-pair spec to run the same loop on the two-level mesh."""
+def run_quadratic(opt, steps=300, mesh=None, spec=None):
+    """Jitted shard_map training loop on per-rank quadratics.  ``mesh`` and
+    ``spec`` must be passed together (e.g. ``ctx.hier_mesh`` + its axis-pair
+    spec for the two-level mesh); both omitted = flat context mesh."""
+    if (mesh is None) != (spec is None):
+        raise ValueError("pass mesh and spec together")
     if mesh is None:
         bf.init()
         ctx = bf.get_context()

@@ -17,18 +17,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bluefog_tpu.utils.inspect import collective_overlap_report
 
 
+TOPO_NAME = "v5e:2x4"
+
+
 def _tpu_topology():
     try:
         from jax.experimental import topologies
-
+    except ImportError as e:  # API moved/removed in a jax upgrade
+        pytest.skip(f"jax topologies API unavailable: {e}")
+    try:
         return topologies.get_topology_desc(platform="tpu",
-                                            topology_name="v5e:2x4")
-    except Exception as e:  # no libtpu / unsupported version
+                                            topology_name=TOPO_NAME)
+    except RuntimeError as e:  # no libtpu on this machine
         pytest.skip(f"TPU AOT topology unavailable: {e}")
+    # anything else (ValueError from a typo'd name, ...) must FAIL, not
+    # skip — PARITY.md advertises this test as enforced where libtpu exists
 
 
 def test_gossip_step_overlaps_in_compiled_tpu_schedule():
+    # (benchmarks/overlap_report.py compiles the same harness shape with a
+    # heavier model for the published numbers; this test stays small so the
+    # suite remains fast)
     topo = _tpu_topology()
+    n = len(topo.devices)  # single source for every shape below
     mesh = Mesh(np.array(topo.devices), ("bf",))
 
     from bluefog_tpu.models import LeNet5
@@ -38,7 +49,7 @@ def test_gossip_step_overlaps_in_compiled_tpu_schedule():
     from bluefog_tpu.topology.schedule import build_schedule
 
     model = LeNet5(num_classes=10)
-    sched = build_schedule(ExponentialTwoGraph(8))
+    sched = build_schedule(ExponentialTwoGraph(n))
     opt = DistributedNeighborAllreduceOptimizer(
         optax.sgd(0.1), topology=sched, axis_name="bf")
 
@@ -59,19 +70,20 @@ def test_gossip_step_overlaps_in_compiled_tpu_schedule():
         step, mesh=mesh, in_specs=(P("bf"),) * 3,
         out_specs=(P("bf"), P("bf")), check_vma=False))
 
+    batch = 8
     params = jax.eval_shape(
-        lambda k: model.init(k, jnp.zeros((8, 28, 28, 1))),
+        lambda k: model.init(k, jnp.zeros((batch, 28, 28, 1))),
         jax.random.PRNGKey(0))
 
     def stacked(t):
-        return jax.ShapeDtypeStruct((8,) + t.shape, t.dtype,
+        return jax.ShapeDtypeStruct((n,) + t.shape, t.dtype,
                                     sharding=NamedSharding(mesh, P("bf")))
 
     args = (
         jax.tree_util.tree_map(stacked, params),
-        jax.ShapeDtypeStruct((8, 8, 28, 28, 1), jnp.float32,
+        jax.ShapeDtypeStruct((n, batch, 28, 28, 1), jnp.float32,
                              sharding=NamedSharding(mesh, P("bf"))),
-        jax.ShapeDtypeStruct((8, 8), jnp.int32,
+        jax.ShapeDtypeStruct((n, batch), jnp.int32,
                              sharding=NamedSharding(mesh, P("bf"))),
     )
     rep = collective_overlap_report(fn, *args)
