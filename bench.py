@@ -32,7 +32,9 @@ at the chosen batch (view with Perfetto / TensorBoard; see PROFILE.md).
 
 import argparse
 import json
+import os
 import sys
+import threading
 import time
 
 import jax
@@ -194,8 +196,6 @@ def run(args, batch: int):
 def _hbm_limit_bytes() -> int:
     """Per-chip accelerator memory capacity, or 0 if the platform doesn't
     expose it (``BFTPU_HBM_BYTES`` overrides for relays that hide it)."""
-    import os
-
     env = os.environ.get("BFTPU_HBM_BYTES")
     if env:
         return int(env)
@@ -235,6 +235,35 @@ def _is_oom(e: BaseException) -> bool:
             and str(e).lstrip().startswith("RESOURCE_EXHAUSTED"))
 
 
+def _device_init_watchdog(timeout_s: float):
+    """Bound the first device query.  The axon relay can hold a stale chip
+    claim that makes ``jax.devices()`` block FOREVER (observed twice this
+    round); a benchmark that hangs is worse than one that fails — the
+    driver's capture should record a clear failure, not wedge.  Returns the
+    devices, or exits 3 with a diagnostic.  A probe that ERRORS (rather
+    than hangs) is reported as that error, not as a timeout."""
+    out = {}
+
+    def probe():
+        try:
+            out["devices"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — report, don't misdiagnose
+            out["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "error" in out:
+        raise out["error"]
+    if "devices" not in out:
+        print(f"bench: device init did not complete within {timeout_s:.0f}s "
+              "— the TPU relay likely holds a stale claim (see PROFILE.md); "
+              "set BFTPU_DEVICE_INIT_TIMEOUT_S (seconds) to wait longer",
+              file=sys.stderr, flush=True)
+        os._exit(3)
+    return out["devices"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=None,
@@ -253,7 +282,15 @@ def main():
                     help="gossip transport (pallas = fused RDMA kernels)")
     args = ap.parse_args()
 
-    bf.init(topology=ExponentialTwoGraph(len(jax.devices())))
+    try:
+        init_timeout = float(
+            os.environ.get("BFTPU_DEVICE_INIT_TIMEOUT_S", 1800))
+    except ValueError:
+        raise SystemExit(
+            "bench: BFTPU_DEVICE_INIT_TIMEOUT_S must be a number of seconds, "
+            f"got {os.environ['BFTPU_DEVICE_INIT_TIMEOUT_S']!r}")
+    devices = _device_init_watchdog(init_timeout)
+    bf.init(topology=ExponentialTwoGraph(len(devices)))
 
     peak_flops = None if args.skip_peak else measure_peak_flops()
     if peak_flops is not None:

@@ -127,11 +127,11 @@ def main():
     active = [r for r in range(n)
               if report.steps_per_rank[r] >= 0.25 * med]
     active_drop = [drop[r] for r in active]
-    if min(active_drop) < 0.35 or float(np.mean(drop)) < 0.35:
+    if min(active_drop) < 0.35 or float(np.mean(active_drop)) < 0.35:
         ok = False
         print(f"FAIL: loss did not converge "
               f"(min active-rank drop {min(active_drop):.0%}, "
-              f"mean drop {float(np.mean(drop)):.0%})")
+              f"mean active-rank drop {float(np.mean(active_drop)):.0%})")
     if len(active) < n:
         print(f"note: {n - len(active)} rank(s) starved by host load "
               f"(steps {report.steps_per_rank}); their local-loss check "
