@@ -38,6 +38,7 @@ from jax import lax
 
 from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
+from bluefog_tpu.utils import timeline as _tl
 
 __all__ = [
     "WindowSpec",
@@ -150,8 +151,13 @@ def win_free(state: WindowState) -> None:
 
 def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
              backend: str = "auto",
-             assoc_payload=None) -> WindowState:
+             assoc_payload=None, op_name: str = "bf.win_deliver") -> WindowState:
     sched = state.spec.schedule
+    # per-op B/E runtime spans (identity without an active timeline): B once
+    # the payload is live, E once the landing buffers materialize — the
+    # reference's per-tensor stage events for the window family
+    payload = _tl.device_stage(payload, op_name, phase="B",
+                               category="window", axis_name=axis_name)
     # same routing policy as gossip (auto_gossip_backend's stated
     # conditions) — the window transport is the same fused RDMA kernel
     # family in 'put'/'acc' mode
@@ -193,11 +199,11 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
             )
             for idx, (peers, leaf) in enumerate(zip(peer_leaves, payload_leaves))
         ]
-        return state.replace(
-            peer_bufs=jax.tree_util.tree_unflatten(treedef, outs),
-            assoc_peers=new_assoc)
-
-    new_peers = jax.tree_util.tree_map(per_leaf, state.peer_bufs, payload)
+        new_peers = jax.tree_util.tree_unflatten(treedef, outs)
+    else:
+        new_peers = jax.tree_util.tree_map(per_leaf, state.peer_bufs, payload)
+    new_peers = _tl.device_stage(new_peers, op_name, phase="E",
+                                 category="window", axis_name=axis_name)
     return state.replace(peer_bufs=new_peers, assoc_peers=new_assoc)
 
 
@@ -261,7 +267,8 @@ def win_put(
     """
     payload, assoc = _prepare_payload(state, x, dst_weight)
     return _deliver(state, payload, axis_name, accumulate=False,
-                    backend=backend, assoc_payload=assoc)
+                    backend=backend, assoc_payload=assoc,
+                    op_name="bf.win_put")
 
 
 def win_accumulate(
@@ -277,14 +284,15 @@ def win_accumulate(
     :func:`win_put` applies: pass ``x=None`` to ship ``self_buf``."""
     payload, assoc = _prepare_payload(state, x, dst_weight)
     return _deliver(state, payload, axis_name, accumulate=True,
-                    backend=backend, assoc_payload=assoc)
+                    backend=backend, assoc_payload=assoc,
+                    op_name="bf.win_accumulate")
 
 
 def win_get(state: WindowState, axis_name: str) -> WindowState:
     """Pull each in-neighbor's *published* value (their ``self_buf``) into the
     corresponding landing slot (one-sided read)."""
     return _deliver(state, state.self_buf, axis_name, accumulate=False,
-                    assoc_payload=state.assoc_self)
+                    assoc_payload=state.assoc_self, op_name="bf.win_get")
 
 
 def win_update(
@@ -303,6 +311,9 @@ def win_update(
     sched = state.spec.schedule
     i = lax.axis_index(axis_name)
     mask = _slot_mask(sched, axis_name)
+    state = state.replace(self_buf=_tl.device_stage(
+        state.self_buf, "bf.win_update", phase="B", category="window",
+        axis_name=axis_name))
 
     def one(self_leaf, peers):
         acc_dt = jnp.float32 if self_leaf.dtype in (jnp.bfloat16, jnp.float16) else self_leaf.dtype
@@ -320,6 +331,8 @@ def win_update(
         return out.astype(self_leaf.dtype)
 
     out = jax.tree_util.tree_map(one, state.self_buf, state.peer_bufs)
+    out = _tl.device_stage(out, "bf.win_update", phase="E",
+                           category="window", axis_name=axis_name)
     new_state = state.replace(self_buf=out)
     if state.assoc_self is not None:
         new_state = new_state.replace(

@@ -15,9 +15,9 @@ native C++ window table by ``TreePacker``); deposits are passive-target
 
 Asserts, and exits nonzero on failure:
   1. the skew materialized (fastest rank took >= 2x the steps of the slowest),
-  2. loss fell by >= 35% on the mean AND on every rank that got scheduled
-     (>= 25% of the median step count — a rank starved by host load takes
-     its model from neighbors' deposits; the consensus checks still bind),
+  2. loss fell by >= 35% on every rank that got scheduled (>= 25% of the
+     median step count — a rank starved by host load takes its model from
+     neighbors' deposits; the consensus checks still bind for it),
   3. push-sum mass is conserved exactly (sum of p == n to 1e-9),
   4. ranks agree: consensus gap is small relative to parameter scale.
 
@@ -127,11 +127,10 @@ def main():
     active = [r for r in range(n)
               if report.steps_per_rank[r] >= 0.25 * med]
     active_drop = [drop[r] for r in active]
-    if min(active_drop) < 0.35 or float(np.mean(active_drop)) < 0.35:
+    if min(active_drop) < 0.35:
         ok = False
         print(f"FAIL: loss did not converge "
-              f"(min active-rank drop {min(active_drop):.0%}, "
-              f"mean active-rank drop {float(np.mean(active_drop)):.0%})")
+              f"(min active-rank drop {min(active_drop):.0%})")
     if len(active) < n:
         print(f"note: {n - len(active)} rank(s) starved by host load "
               f"(steps {report.steps_per_rank}); their local-loss check "

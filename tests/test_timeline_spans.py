@@ -161,6 +161,34 @@ def test_hierarchical_spans(tmp_path):
     assert {e["ph"] for e in events} == {"B", "E"}
 
 
+def test_window_op_spans(tmp_path):
+    """win_put / win_update emit paired B/E spans from inside the jitted
+    step (the reference's per-tensor stages cover the window family too)."""
+    import jax.numpy as jnp
+
+    from bluefog_tpu.ops import windows as W
+
+    trace = str(tmp_path / "trace_w.json")
+    sched = build_schedule(RingGraph(N))
+    T.timeline_start(trace)
+    try:
+        def step(v):
+            st = W.win_create(v, sched, "bf", name="span_probe")
+            st = W.win_put(st, v, "bf", backend="xla")
+            out, _ = W.win_update(st, "bf")
+            return out
+
+        fn = jax.jit(shard_map(
+            step, mesh=_mesh(), in_specs=(P("bf"),), out_specs=P("bf"),
+            check_vma=False))
+        jax.block_until_ready(fn(jnp.ones((N, 4), jnp.float32)))
+    finally:
+        T.timeline_stop()
+    for name in ("bf.win_put", "bf.win_update"):
+        events = [e for e in _load_events(trace) if e["name"] == name]
+        assert {e["ph"] for e in events} == {"B", "E"}, name
+
+
 def test_hierarchical_2d_spans(tmp_path):
     """The two-level-mesh path emits the same B/E gossip spans as the flat
     path, with lanes = linearized (machine, local) ranks."""
