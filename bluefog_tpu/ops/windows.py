@@ -351,6 +351,9 @@ def win_update_then_collect(state: WindowState, axis_name: str):
     """
     sched = state.spec.schedule
     mask = _slot_mask(sched, axis_name)
+    state = state.replace(self_buf=_tl.device_stage(
+        state.self_buf, "bf.win_update_then_collect", phase="B",
+        category="window", axis_name=axis_name))
 
     def one(self_leaf, peers):
         acc_dt = jnp.float32 if self_leaf.dtype in (jnp.bfloat16, jnp.float16) else self_leaf.dtype
@@ -360,6 +363,8 @@ def win_update_then_collect(state: WindowState, axis_name: str):
         return out.astype(self_leaf.dtype)
 
     out = jax.tree_util.tree_map(one, state.self_buf, state.peer_bufs)
+    out = _tl.device_stage(out, "bf.win_update_then_collect", phase="E",
+                           category="window", axis_name=axis_name)
     zeroed = jax.tree_util.tree_map(jnp.zeros_like, state.peer_bufs)
     new_state = state.replace(self_buf=out, peer_bufs=zeroed)
     if state.assoc_self is not None:

@@ -175,8 +175,11 @@ def test_window_op_spans(tmp_path):
         def step(v):
             st = W.win_create(v, sched, "bf", name="span_probe")
             st = W.win_put(st, v, "bf", backend="xla")
-            out, _ = W.win_update(st, "bf")
-            return out
+            st = W.win_accumulate(st, v, "bf", backend="xla")
+            st = W.win_get(st, "bf")
+            out, st = W.win_update(st, "bf")
+            out2, _ = W.win_update_then_collect(st, "bf")
+            return out + out2
 
         fn = jax.jit(shard_map(
             step, mesh=_mesh(), in_specs=(P("bf"),), out_specs=P("bf"),
@@ -184,7 +187,8 @@ def test_window_op_spans(tmp_path):
         jax.block_until_ready(fn(jnp.ones((N, 4), jnp.float32)))
     finally:
         T.timeline_stop()
-    for name in ("bf.win_put", "bf.win_update"):
+    for name in ("bf.win_put", "bf.win_accumulate", "bf.win_get",
+                 "bf.win_update", "bf.win_update_then_collect"):
         events = [e for e in _load_events(trace) if e["name"] == name]
         assert {e["ph"] for e in events} == {"B", "E"}, name
 
