@@ -17,7 +17,9 @@ success:
    in BOTH forms — flat mesh and the two-level (machine, local) mesh whose
    outer axis crosses processes (the multi-slice/DCN shape);
 6. ``win_mutex`` is a real cross-process lock: racing read-modify-write
-   increments on the coordination-service KV never lose an update.
+   increments on the coordination-service KV never lose an update;
+7. ``win_mutex_break`` recovers a stale lock whose owner died (timeout
+   names the dead owner; after break the mutex is acquirable again).
 """
 
 import os
@@ -150,6 +152,26 @@ def main():
     total = int(client.blocking_key_value_get("mp_counter", 10_000))
     assert total == nproc * MUTEX_ITERS, (
         f"lost updates: counter {total} != {nproc * MUTEX_ITERS}")
+
+    # 7. win_mutex_break: a dead owner's stale lock blocks acquisition
+    # (TimeoutError naming the owner), break clears it, and the mutex is
+    # acquirable again — the MPI_Win_unlock_all-after-failure analog.
+    from bluefog_tpu.parallel.api import win_mutex_break
+
+    if pid == 0:
+        client.key_value_set("bluefog_tpu/win_mutex/stale_probe",
+                             "999:1:1")  # an owner that no longer exists
+    client.wait_at_barrier("break_start", 30_000)
+    if pid == 1:
+        try:
+            with win_mutex("stale_probe", timeout_s=0.5):
+                raise AssertionError("acquired a lock a dead owner holds")
+        except TimeoutError as e:
+            assert "999:1:1" in str(e), e  # names the dead owner
+        assert win_mutex_break("stale_probe") is True
+        with win_mutex("stale_probe", timeout_s=5):
+            pass  # recovered
+    client.wait_at_barrier("break_end", 60_000)
 
     print(f"MP_WORKER_OK {pid}", flush=True)
 

@@ -87,6 +87,17 @@ def test_rendezvous_timeout_kills_the_process():
     assert "SILENT_FALLBACK" not in out.stdout
 
 
+def test_win_mutex_break_single_controller_noop():
+    """Single controller: a holder's death is process death — break is a
+    documented no-op returning False (never drops a live RLock)."""
+    import bluefog_tpu as bf
+
+    bf.init()
+    with bf.win_mutex("solo"):
+        assert bf.win_mutex_break("solo") is False
+    assert bf.win_mutex_break("solo") is False
+
+
 def test_rendezvous_exception_policy(monkeypatch):
     """When initialize raises a catchable error: explicit cluster arguments
     escalate to RuntimeError; the fully-auto-detected call only warns."""
