@@ -68,15 +68,28 @@ _SUBLANES = 8
 DEFAULT_AUTO_MAX_BYTES = 4 << 20
 
 
+def on_tpu_platform() -> bool:
+    """THE platform predicate for every pallas-transport gate (auto routing
+    and :func:`is_pallas_supported` both call this — one predicate, one
+    answer).  True on a real TPU backend, whether reached directly
+    (``'tpu'``) or through the axon relay (``'axon'``); either name may show
+    up as the backend name or the device platform depending on the relay, so
+    both are consulted."""
+    try:
+        names = {jax.default_backend(), jax.devices()[0].platform}
+    except Exception:
+        return False
+    return bool(names & {"tpu", "axon"})
+
+
 def auto_gossip_backend(sched: GossipSchedule, x) -> str:
     """Resolve ``backend='auto'`` for a gossip call: ``'pallas'`` or ``'xla'``.
 
     The stated conditions under which auto selects the RDMA kernels — ALL
     must hold:
 
-    1. a real TPU backend (``jax.default_backend() in ('tpu', 'axon')``) —
-       CPU test meshes always take XLA (the non-interpret kernel cannot run
-       there);
+    1. a real TPU backend (:func:`on_tpu_platform`) — CPU test meshes
+       always take XLA (the non-interpret kernel cannot run there);
     2. multi-device mesh (``sched.size > 1``) — nothing to exchange on one
        chip;
     3. a circulant schedule (every slot one uniform ICI rotation — all
@@ -92,7 +105,7 @@ def auto_gossip_backend(sched: GossipSchedule, x) -> str:
         return "xla"
     if sched.size <= 1 or not circulant_shifts(sched):
         return "xla"  # non-circulant (None) or zero slots (()): both XLA
-    if jax.default_backend() not in ("tpu", "axon"):
+    if not on_tpu_platform():
         return "xla"
     leaves = jax.tree_util.tree_leaves(x)
     if not leaves:
@@ -117,15 +130,35 @@ def resolve_backend(backend: str, sched: GossipSchedule, x) -> str:
     return backend
 
 
+# CRC32 bucket -> window name that claimed it.  Two window names hashing to
+# the same bucket would silently share barrier semaphores inside one jitted
+# program — the exact hazard the name-derived base exists to prevent — so the
+# first claimant owns the bucket and any later colliding name raises.
+WINDOW_LEAF_CAP = 1024  # collective ids per window; bases are spaced this far
+_claimed_bases: dict = {}
+
+
 def window_collective_id_base(name: str) -> int:
     """Deterministic per-window collective-id base.  Two windows delivered
     in ONE jitted program must not share barrier semaphores, so each
     window's leaf kernels enumerate from a name-derived base: 2048 + a CRC32
-    bucket spaced 1024 apart (the per-call leaf cap).  Stable across
-    processes (CRC32, not Python hash) as SPMD requires."""
+    bucket spaced :data:`WINDOW_LEAF_CAP` apart (the per-call leaf cap).
+    Stable across processes (CRC32, not Python hash) as SPMD requires.
+
+    Bucket collisions (distinct names, same CRC32 bucket) raise rather than
+    silently sharing semaphores; rename one window to resolve.
+    """
     import zlib
 
-    return 2048 + (zlib.crc32(name.encode()) % (1 << 20)) * 1024
+    bucket = zlib.crc32(name.encode()) % (1 << 20)
+    owner = _claimed_bases.setdefault(bucket, name)
+    if owner != name:
+        raise ValueError(
+            f"window name {name!r} collides with existing window {owner!r} "
+            f"in collective-id bucket {bucket} (CRC32 % 2^20); the two would "
+            "share barrier semaphores if delivered in one program — rename "
+            "one of them")
+    return 2048 + bucket * WINDOW_LEAF_CAP
 
 
 def circulant_shifts(sched: GossipSchedule) -> Optional[Tuple[int, ...]]:
@@ -141,13 +174,12 @@ def circulant_shifts(sched: GossipSchedule) -> Optional[Tuple[int, ...]]:
 
 def is_pallas_supported(sched: GossipSchedule) -> bool:
     """True when the schedule can ride the RDMA kernels (circulant, at least
-    one slot) and we are on a real TPU backend."""
-    if not circulant_shifts(sched):
+    one slot, more than one device) and we are on a real TPU backend (the
+    shared :func:`on_tpu_platform` predicate — never disagrees with
+    ``'auto'`` routing about the same schedule)."""
+    if sched.size <= 1 or not circulant_shifts(sched):
         return False
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    return on_tpu_platform()
 
 
 def _pad_to_tiles(flat: jnp.ndarray) -> Tuple[jnp.ndarray, int]:

@@ -252,10 +252,12 @@ def ring_attention(
     - ``'zigzag'``: rank ``r`` holds chunks ``r`` and ``2n-1-r`` of the
       sequence cut into ``2n`` chunks (use :func:`zigzag_shard` /
       :func:`zigzag_unshard` to convert; output stays in zigzag order).
-      Every rank then folds **exactly two half-chunks per ring step** —
-      one always-past ``q_back x k_front`` fold plus one of ``q_front x
-      k_front`` / ``q_back x k_back`` selected by the arriving block's
-      origin — so the causal FLOP saving is perfectly load-balanced and
+      At step 0 every rank folds its two (half-cost) masked diagonals plus
+      the always-past ``q_back x k_front`` fold; every steady-state step
+      folds **exactly two half-chunks** — that same ``q_back x k_front``
+      fold plus one of ``q_front x k_front`` / ``q_back x k_back`` selected
+      by the arriving block's origin — so the causal FLOP saving is
+      identically load-balanced across ranks and
       becomes wall-clock on a lock-stepped slice.  (Non-causal math is
       position-independent, so ``layout`` only matters for ``causal=True``.)
     """

@@ -191,6 +191,12 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
         # owns [1024, 2048) — see ops/collectives.py.
         base = pallas_gossip.window_collective_id_base(state.spec.name)
         peer_leaves, treedef = jax.tree_util.tree_flatten(state.peer_bufs)
+        if len(peer_leaves) > pallas_gossip.WINDOW_LEAF_CAP:
+            raise ValueError(
+                f"window {state.spec.name!r} has {len(peer_leaves)} leaves, "
+                f"above the {pallas_gossip.WINDOW_LEAF_CAP}-leaf pallas cap "
+                "(collective ids would bleed into the next window's bucket); "
+                "use backend='xla' or fuse leaves")
         payload_leaves = treedef.flatten_up_to(payload)
         outs = [
             pallas_gossip.deliver_pallas(

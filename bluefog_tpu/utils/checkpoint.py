@@ -291,9 +291,14 @@ def _restore_elastic(manager: CheckpointManager, step: int, template):
     for path, t_leaf in paths_and_leaves:
         key = tuple(_norm_key(p) for p in path)
         r = _resize_leaf(raw_map[key], n_tgt)
-        if hasattr(t_leaf, "dtype") or isinstance(t_leaf,
-                                                  (int, float, np.ndarray)):
-            r = np.asarray(r).astype(np.asarray(t_leaf).dtype)
+        # .dtype straight off the template leaf: np.asarray(t_leaf) on an
+        # abstract leaf (jax.ShapeDtypeStruct) yields a 0-d object array and
+        # would silently cast the restored leaf to object dtype
+        t_dtype = getattr(t_leaf, "dtype", None)
+        if t_dtype is None and isinstance(t_leaf, (int, float)):
+            t_dtype = np.asarray(t_leaf).dtype
+        if t_dtype is not None:
+            r = np.asarray(r).astype(t_dtype)
         out.append(r)
     return jax.tree_util.tree_unflatten(treedef, out)
 

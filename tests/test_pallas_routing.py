@@ -114,6 +114,44 @@ def test_pallas_zero_slot_degenerates_to_self_term():
     np.testing.assert_allclose(np.asarray(out), np.asarray(xs), rtol=1e-6)
 
 
+def test_gate_predicates_agree(on_tpu):
+    """is_pallas_supported and 'auto' routing share ONE platform predicate
+    (on_tpu_platform) — they can never disagree about the same schedule
+    (round-3 advisory: the old gates split on the axon relay)."""
+    from bluefog_tpu.topology.graphs import Topology
+
+    for topo in (RingGraph(8), ExponentialTwoGraph(8), StarGraph(8),
+                 Topology(weights=np.ones((1, 1)), name="solo"),
+                 Topology(weights=np.eye(8), name="identity8")):
+        sched = build_schedule(topo)
+        assert pg.is_pallas_supported(sched) == \
+            (pg.auto_gossip_backend(sched, SMALL) == "pallas"), topo.name
+
+
+def test_gate_predicates_agree_on_cpu():
+    sched = build_schedule(RingGraph(8))
+    assert not pg.on_tpu_platform()
+    assert not pg.is_pallas_supported(sched)
+    assert pg.auto_gossip_backend(sched, SMALL) == "xla"
+
+
+def test_window_base_collision_raises(monkeypatch):
+    """Two distinct window names in one CRC32 bucket would share barrier
+    semaphores; the registry refuses the second claimant."""
+    import zlib
+
+    # operate on a copy so neither the probe claim nor the 'stable_window'
+    # claim below leaks into the process-global registry
+    monkeypatch.setattr(pg, "_claimed_bases", dict(pg._claimed_bases))
+    bucket = zlib.crc32(b"collision_probe") % (1 << 20)
+    monkeypatch.setitem(pg._claimed_bases, bucket, "earlier_window")
+    with pytest.raises(ValueError, match="collides"):
+        pg.window_collective_id_base("collision_probe")
+    # same-name re-derivation is always fine (idempotent claims)
+    base = pg.window_collective_id_base("stable_window")
+    assert pg.window_collective_id_base("stable_window") == base
+
+
 def test_kill_switch(on_tpu, monkeypatch):
     sched = build_schedule(RingGraph(8))
     monkeypatch.setenv("BLUEFOG_TPU_PALLAS_GOSSIP", "0")
