@@ -71,6 +71,19 @@ int bf_pending_count();
 // writers deposit (put/accumulate) without any receiver involvement, and
 // readers consume whenever they choose.  dtype: 0 = f32, 1 = f64.
 int bf_win_create(const char* name, int n_slots, long long n_elems, int dtype);
+// Cross-process variants: the segment lives in named POSIX shared memory
+// (uid-namespaced), so a deposit from another OS process lands in the
+// owner's window — the MPI_Put-across-process-boundaries semantic.  The
+// creator owns (and unlinks on free); peers attach, spinning up to
+// timeout_ms for the owner to publish.  bf_win_shm_unlink removes a stale
+// segment (e.g. from a crashed run) by window name without mapping it.
+int bf_win_create_shm(const char* name, int n_slots, long long n_elems,
+                      int dtype);
+int bf_win_attach_shm(const char* name, int timeout_ms);
+int bf_win_shm_unlink(const char* name);
+// Fills the window's geometry (any pointer may be NULL); -1 if unknown.
+int bf_win_info(const char* name, int* n_slots, long long* n_elems,
+                int* dtype);
 int bf_win_exists(const char* name);
 int bf_win_free(const char* name);
 void bf_win_free_all();

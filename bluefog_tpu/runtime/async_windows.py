@@ -13,10 +13,12 @@ This module reproduces that execution model on the TPU build's host runtime:
 - :class:`AsyncWindow` — a rank's landing zone, backed by the native window
   table (``csrc/windows.cc``): per-slot locked buffers with deposit
   (put/accumulate), consume-exactly-once reads, and deposit-count staleness
-  bookkeeping.  Within a host, "remote" writes are direct memory deposits
-  into the target rank's table entry (the shared-memory MPI disposition);
-  within a TPU slice the device-side analog is the Pallas remote-DMA
-  kernel (:mod:`bluefog_tpu.ops.pallas_gossip`).
+  bookkeeping.  Within a process, "remote" writes are direct memory
+  deposits into the target rank's table entry; across OS *processes* on a
+  host the same table rides named POSIX shared memory (``shm=True`` /
+  ``attach=True`` — the shared-memory MPI disposition, robust process-shared
+  mutexes included); within a TPU slice the device-side analog is the
+  Pallas remote-DMA kernel (:mod:`bluefog_tpu.ops.pallas_gossip`).
 
 - :class:`TreePacker` — the device↔window bridge: packs a pytree of jax
   device arrays into one contiguous host vector (one batched
@@ -45,6 +47,7 @@ This module reproduces that execution model on the TPU build's host runtime:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -60,9 +63,12 @@ __all__ = [
     "TreePacker",
     "run_async_pushsum",
     "run_async_dsgd",
+    "run_async_dsgd_rank",
     "AsyncWinPutOptimizer",
     "PushSumReport",
     "DSGDReport",
+    "FileBarrier",
+    "shm_unlink_window",
 ]
 
 _DTYPES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}
@@ -160,21 +166,72 @@ class AsyncWindow:
     engine worker delivering a remote payload, a peer rank on the same host)
     can deposit without this rank's participation.
 
+    With ``shm=True`` the window is backed by named POSIX shared memory
+    instead (``csrc/windows.cc`` create/attach_shm): the owner process
+    creates its landing zone, peer *processes* attach the same name and
+    deposit directly — ``MPI_Put`` crossing a real process boundary with no
+    receiver involvement (upstream ``mpi_controller.cc`` Win*, SURVEY §3.4).
+    ``attach=True`` opens a window another process owns (geometry is read
+    from the segment; ``n_slots``/``n_elems``/``dtype`` args are ignored);
+    the attach spins up to ``attach_timeout_s`` so create/attach order
+    between processes is free.  Cross-process mode requires the native
+    runtime (no pure-Python fallback — process-shared robust mutexes are a
+    pthread feature).
+
     Flat f32/f64 vectors; callers pack pytrees/low-precision leaves
     themselves (the associated push-sum scalar is one extra trailing
     element — see :func:`run_async_pushsum`).
     """
 
-    def __init__(self, name: str, n_slots: int, n_elems: int,
-                 dtype=np.float32):
+    def __init__(self, name: str, n_slots: int = 0, n_elems: int = 0,
+                 dtype=np.float32, *, shm: bool = False, attach: bool = False,
+                 attach_timeout_s: float = 10.0):
         self.name = name
+        self.shm = bool(shm or attach)
+        self._lib = native.load()
+        if self.shm:
+            if self._lib is None:
+                raise RuntimeError(
+                    "cross-process (shm) windows require the native runtime "
+                    "(unset BLUEFOG_TPU_NO_NATIVE / install a C++ toolchain)")
+            if attach:
+                rc = self._lib.bf_win_attach_shm(
+                    name.encode(), int(attach_timeout_s * 1000))
+                if rc == -2:
+                    raise ValueError(
+                        f"window {name!r} already open in this process")
+                if rc != 0:
+                    raise RuntimeError(
+                        f"attach to shm window {name!r} failed ({rc}): owner "
+                        f"did not publish within {attach_timeout_s}s?")
+                import ctypes
+
+                ns = ctypes.c_int()
+                ne = ctypes.c_longlong()
+                dt = ctypes.c_int()
+                self._lib.bf_win_info(name.encode(), ctypes.byref(ns),
+                                      ctypes.byref(ne), ctypes.byref(dt))
+                self.n_slots = ns.value
+                self.n_elems = int(ne.value)
+                self.dtype = np.dtype(np.float64 if dt.value == 1
+                                      else np.float32)
+                return
         self.n_slots = n_slots
         self.n_elems = int(n_elems)
         self.dtype = np.dtype(dtype)
         if self.dtype not in _DTYPES:
             raise TypeError(f"AsyncWindow supports f32/f64, got {self.dtype}")
-        self._lib = native.load()
-        if self._lib is not None:
+        if self.shm:
+            rc = self._lib.bf_win_create_shm(
+                name.encode(), n_slots, self.n_elems, _DTYPES[self.dtype])
+            if rc == -2:
+                raise ValueError(
+                    f"shm window {name!r} already exists (live duplicate or "
+                    "stale segment from a crashed run — "
+                    "shm_unlink_window() cleans the latter)")
+            if rc != 0:
+                raise RuntimeError(f"bf_win_create_shm({name!r}) failed: {rc}")
+        elif self._lib is not None:
             rc = self._lib.bf_win_create(
                 name.encode(), n_slots, self.n_elems, _DTYPES[self.dtype])
             if rc == -2:
@@ -662,6 +719,215 @@ def run_async_dsgd(
     )
     for w in wins:
         w.free()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Cross-process asynchronous training (one OS process per rank, shm windows)
+# ---------------------------------------------------------------------------
+
+
+def shm_unlink_window(name: str) -> bool:
+    """Remove a stale shm window segment (e.g. left by a crashed owner) by
+    window name; True if a segment was removed.  Safe to call when nothing
+    exists.  Requires the native runtime."""
+    lib = native.load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable for shm windows")
+    return lib.bf_win_shm_unlink(name.encode()) == 0
+
+
+class FileBarrier:
+    """Filesystem barrier between rank *processes* on one host.
+
+    The asynchronous runners need a handful of rendezvous points around the
+    training loop (windows created / deposits stopped / results published /
+    audit finished) and explicitly NO collective runtime in between — a
+    shared directory is the whole requirement, so the barrier does not drag
+    jax.distributed into the async path.  Rank ``r`` touches
+    ``<dir>/<stage>.<r>`` and waits until all ``n`` exist."""
+
+    def __init__(self, path: str, n_ranks: int, rank: int):
+        self.path = path
+        self.n = int(n_ranks)
+        self.rank = int(rank)
+        os.makedirs(path, exist_ok=True)
+
+    def wait(self, stage: str, timeout_s: float = 120.0) -> None:
+        open(os.path.join(self.path, f"{stage}.{self.rank}"), "w").close()
+        want = [os.path.join(self.path, f"{stage}.{r}")
+                for r in range(self.n)]
+        t0 = time.perf_counter()
+        while not all(os.path.exists(p) for p in want):
+            if time.perf_counter() - t0 > timeout_s:
+                missing = [p for p in want if not os.path.exists(p)]
+                raise TimeoutError(
+                    f"barrier {stage!r} timed out; missing {missing}")
+            time.sleep(0.005)
+
+
+def run_async_dsgd_rank(
+    topology: Topology,
+    rank: int,
+    params0,
+    loss_and_grad,
+    *,
+    barrier: FileBarrier,
+    lr: float = 0.05,
+    duration_s: float = 3.0,
+    skew_s: float = 0.0,
+    name: str = "async_dsgd_mp",
+    poll_interval_s: float = 0.0,
+) -> Optional[DSGDReport]:
+    """One rank of an asynchronous decentralized SGD run where every rank is
+    its own OS PROCESS — the reference's actual deployment shape
+    (``mpirun -np N``, one MPI rank per process; SURVEY §3.4) rather than
+    :func:`run_async_dsgd`'s rank-thread model.
+
+    Each process creates its own landing window in named shared memory and
+    deposits into its out-neighbors' windows directly — cross-process
+    ``MPI_Put`` with no receiver involvement and NO barrier anywhere in the
+    training loop (``barrier`` fires exactly four times, all outside the
+    loop: windows created, deposits stopped, per-rank results published,
+    audit finished; the loop itself is rendezvous-free, which is the entire
+    point).
+
+    The algorithm, mass-conservation invariant, and bias caveats are those
+    of :func:`run_async_dsgd` (subgradient-push); ``skew_s`` is this rank's
+    extra per-step sleep (pass different values per process to realize the
+    skewed execution the SPMD path cannot express).
+
+    Returns a :class:`DSGDReport` on rank 0 (``losses`` filled only at index
+    ``rank`` — other ranks' loss curves stay in their processes), ``None``
+    elsewhere.
+    """
+    d = TreePacker(params0, np.float64).size
+    n_in = len(list(topology.in_neighbors(rank)))
+
+    # each rank owns its window name exclusively, so a leftover segment can
+    # only be stale (crashed previous run) — clean and recreate
+    shm_unlink_window(f"{name}:{rank}")
+    win = AsyncWindow(f"{name}:{rank}", max(n_in, 1), d + 1,
+                      np.float64, shm=True)
+    # every window this process opens, freed in the finally below — a
+    # mid-run exception (loss_and_grad raising, a peer dying at a barrier)
+    # must not leak named segments into /dev/shm
+    opened: List[AsyncWindow] = [win]
+
+    def _open(*args, **kwargs) -> AsyncWindow:
+        w = AsyncWindow(*args, **kwargs)
+        opened.append(w)
+        return w
+
+    try:
+        return _run_dsgd_rank_body(
+            topology, rank, params0, loss_and_grad, barrier=barrier, lr=lr,
+            duration_s=duration_s, skew_s=skew_s, name=name,
+            poll_interval_s=poll_interval_s, win=win, open_window=_open)
+    finally:
+        for w in opened:
+            try:
+                w.free()
+            except Exception:
+                pass
+
+
+def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
+                        lr, duration_s, skew_s, name, poll_interval_s, win,
+                        open_window):
+    n = topology.size
+    packer = TreePacker(params0, np.float64)
+    d = packer.size
+    in_nbrs = list(topology.in_neighbors(rank))
+    out_nbrs = list(topology.out_neighbors(rank))
+    meta = None
+    if rank == 0:
+        # per-rank (steps, last_loss) land here so the report can carry
+        # every rank's step count across the process boundary
+        shm_unlink_window(f"{name}:meta")
+        meta = open_window(f"{name}:meta", n, 2, np.float64, shm=True)
+    barrier.wait("created")
+    if rank != 0:
+        meta = open_window(f"{name}:meta", attach=True)
+    peers = {j: open_window(f"{name}:{j}", attach=True) for j in out_nbrs}
+    peer_slot = {j: list(topology.in_neighbors(j)).index(rank)
+                 for j in out_nbrs}
+
+    x = packer.pack(params0)
+    p = 1.0
+    frac = 1.0 / (len(out_nbrs) + 1)
+    gvec = np.empty(d, np.float64)
+    payload = np.empty(d + 1, np.float64)
+    losses: List[float] = []
+    steps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < duration_s:
+        for k in range(len(in_nbrs)):
+            buf, fresh = win.read(k, consume=True)
+            if fresh > 0:
+                x += buf[:-1]
+                p += buf[-1]
+        z = x / p
+        loss, grads = loss_and_grad(rank, steps, packer.unpack(z))
+        losses.append(float(loss))
+        packer.pack(grads, out=gvec)
+        gvec *= lr * p
+        x -= gvec
+        payload[:-1] = x
+        payload[-1] = p
+        payload *= frac
+        for j in out_nbrs:
+            peers[j].deposit(peer_slot[j], payload, accumulate=True)
+        x *= frac
+        p *= frac
+        steps += 1
+        if skew_s > 0 or poll_interval_s > 0:
+            time.sleep(skew_s + poll_interval_s)
+    # no rank deposits after this barrier, so the drain below is exact
+    barrier.wait("stopped")
+    wall = time.perf_counter() - t0
+    for k in range(len(in_nbrs)):
+        buf, fresh = win.read(k, consume=True)
+        if fresh > 0:
+            x += buf[:-1]
+            p += buf[-1]
+    win.set_self(np.concatenate([x, [p]]))
+    meta.deposit(rank, np.array([steps, losses[-1] if losses else 0.0]),
+                 accumulate=False)
+    barrier.wait("done")
+
+    report = None
+    if rank == 0:
+        wins = {rank: win}
+        wins.update(peers)
+        for r in range(n):
+            if r not in wins:
+                wins[r] = open_window(f"{name}:{r}", attach=True)
+        total_mass = 0.0
+        zs = np.empty((n, d))
+        for r in range(n):
+            s = wins[r].read_self()
+            zs[r] = s[:-1] / s[-1]
+            total_mass += float(s[-1])
+            for k in range(wins[r].n_slots):
+                buf, fresh = wins[r].read(k, consume=False)
+                if fresh > 0:
+                    total_mass += float(buf[-1])
+        steps_all = [int(meta.read(r, consume=False)[0][0])
+                     for r in range(n)]
+        all_losses: List[List[float]] = [[] for _ in range(n)]
+        all_losses[rank] = losses
+        report = DSGDReport(
+            wall_time_s=wall,
+            steps_per_rank=steps_all,
+            losses=all_losses,
+            final_params=[packer.unpack(z) for z in zs],
+            total_mass=total_mass,
+            consensus_gap=float(np.abs(zs - zs.mean(axis=0)).max()),
+        )
+    # owners unlink only after the audit has read every segment (the
+    # caller's finally frees everything this process opened)
+    barrier.wait("audited")
     return report
 
 

@@ -125,6 +125,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int
     ]
     lib.bf_win_create.restype = ctypes.c_int
+    lib.bf_win_create_shm.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int
+    ]
+    lib.bf_win_create_shm.restype = ctypes.c_int
+    lib.bf_win_attach_shm.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.bf_win_attach_shm.restype = ctypes.c_int
+    lib.bf_win_shm_unlink.argtypes = [ctypes.c_char_p]
+    lib.bf_win_shm_unlink.restype = ctypes.c_int
+    lib.bf_win_info.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int)
+    ]
+    lib.bf_win_info.restype = ctypes.c_int
     lib.bf_win_exists.argtypes = [ctypes.c_char_p]
     lib.bf_win_exists.restype = ctypes.c_int
     lib.bf_win_free.argtypes = [ctypes.c_char_p]
