@@ -55,6 +55,36 @@ V100_BASELINE_IMG_PER_SEC = 360.0
 # training step ~= 3x forward (fwd + grad wrt activations + grad wrt weights).
 RESNET50_TRAIN_FLOPS_PER_IMG_224 = 3 * 4.09e9
 
+# Last-good results cache: written after every successful run, emitted with
+# "stale": true when the TPU relay refuses device init (degraded mode) — a
+# capture must never end with *nothing* (VERDICT r3 missing #2).
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_CACHE.json")
+
+# Nominal public spec sheets (bf16 dense peak TFLOP/s, HBM GB/s) keyed by
+# device_kind substring — the cross-check for the measured peak.  The relay
+# has produced non-physical measured peaks (58 -> ~1000 PFLOP/s round to
+# round on one chip, PROFILE.md §2); numbers derived from an implausible
+# denominator are flagged, not silently reported.
+NOMINAL_SPECS = {
+    "v6 lite": (918.0, 1640.0), "v6e": (918.0, 1640.0),
+    "v5 lite": (197.0, 819.0), "v5e": (197.0, 819.0),
+    "v5p": (459.0, 2765.0),
+    "v4": (275.0, 1228.0),
+    "v3": (123.0, 900.0),
+    "v2": (46.0, 700.0),
+}
+
+
+def nominal_spec(devices):
+    """(bf16 peak TFLOP/s, HBM GB/s) from the public spec sheet for this
+    chip, or (None, None) when the device kind is unrecognized."""
+    kind = getattr(devices[0], "device_kind", "").lower()
+    for key in sorted(NOMINAL_SPECS, key=len, reverse=True):
+        if key in kind:
+            return NOMINAL_SPECS[key]
+    return None, None
+
 
 def measure_peak_flops(steps: int = 8, chain: int = 32, n: int = 8192) -> float:
     """Measured bf16 matmul roofline of one chip: FLOP/s sustained by a
@@ -235,13 +265,73 @@ def _is_oom(e: BaseException) -> bool:
             and str(e).lstrip().startswith("RESOURCE_EXHAUSTED"))
 
 
+def perf_sanity_fields(devices, peak_flops, achieved_flops, best_mem,
+                       flops_per_step, best_batch, best_ips) -> dict:
+    """Sanity-gated peak / MFU / roofline fields (VERDICT r3 weak #1).
+
+    The relay has produced non-physical measured peaks (58 TFLOP/s to
+    ~1000 PFLOP/s on one chip); a reader must be able to tell relay noise
+    from regression, so the JSON carries BOTH denominators (measured and
+    nominal-spec), a plausibility verdict choosing between them, and a
+    bytes-moved roofline estimate."""
+    out: dict = {}
+    nom_peak_tf, nom_hbm_gbps = nominal_spec(devices)
+    if nom_peak_tf is not None:
+        out["nominal_peak_tflops_per_sec"] = nom_peak_tf
+        out["device_kind"] = getattr(devices[0], "device_kind", "?")
+    if peak_flops is not None:
+        measured_tf = peak_flops / 1e12
+        out["measured_peak_tflops_per_sec"] = round(measured_tf, 2)
+        out["mfu_vs_measured"] = round(achieved_flops / peak_flops, 4)
+        if nom_peak_tf is not None:
+            # a real chip cannot beat its spec by >1.5x or deliver <20% of
+            # it on a pure matmul chain; outside that band the measurement
+            # is relay noise (caching/eliding through the remote hop)
+            plausible = 0.2 * nom_peak_tf <= measured_tf <= 1.5 * nom_peak_tf
+            out["measured_peak_plausible"] = plausible
+            out["mfu_vs_nominal"] = round(
+                achieved_flops / (nom_peak_tf * 1e12), 4)
+            out["mfu"] = (out["mfu_vs_measured"] if plausible
+                          else out["mfu_vs_nominal"])
+            out["mfu_denominator"] = ("measured_peak" if plausible
+                                      else "nominal_spec")
+            if not plausible:
+                print(f"bench: measured peak {measured_tf:.0f} TFLOP/s is "
+                      f"NON-PHYSICAL for {out.get('device_kind')} (spec "
+                      f"{nom_peak_tf:.0f}); mfu reported against the spec",
+                      file=sys.stderr)
+        else:
+            out["mfu"] = out["mfu_vs_measured"]
+            out["mfu_denominator"] = "measured_peak_unverified"
+        out["mfu_plausible"] = out["mfu"] <= 1.0  # >100% of peak: not physical
+    if best_mem and nom_hbm_gbps:
+        # crude per-step roofline: HBM traffic ~ activations (temp) + one
+        # read of the arguments; compute bound from the nominal peak
+        # (a known HBM spec implies a known FLOP spec — same table row)
+        bytes_est = best_mem["temp"] + best_mem["args"]
+        mem_ms = bytes_est / (nom_hbm_gbps * 1e9) * 1e3
+        comp_ms = (flops_per_step / (nom_peak_tf * 1e12) * 1e3
+                   if flops_per_step else None)
+        measured_ms = best_batch / best_ips * 1e3
+        out["roofline_estimate"] = {
+            "hbm_bytes_per_step_est": int(bytes_est),
+            "min_step_ms_memory": round(mem_ms, 2),
+            "min_step_ms_compute": (round(comp_ms, 2)
+                                    if comp_ms is not None else None),
+            "measured_step_ms": round(measured_ms, 2),
+            "bound": ("memory" if comp_ms is None or mem_ms > comp_ms
+                      else "compute"),
+        }
+    return out
+
+
 def _device_init_watchdog(timeout_s: float):
     """Bound the first device query.  The axon relay can hold a stale chip
-    claim that makes ``jax.devices()`` block FOREVER (observed twice this
-    round); a benchmark that hangs is worse than one that fails — the
-    driver's capture should record a clear failure, not wedge.  Returns the
-    devices, or exits 3 with a diagnostic.  A probe that ERRORS (rather
-    than hangs) is reported as that error, not as a timeout."""
+    claim that makes ``jax.devices()`` block FOREVER (observed twice in
+    round 3); a benchmark that hangs is worse than one that fails, and one
+    that fails with *nothing* is almost as bad — so both failure shapes
+    (hang past the timeout, UNAVAILABLE error) route to the degraded-mode
+    emitter instead of a bare nonzero exit."""
     out = {}
 
     def probe():
@@ -254,14 +344,101 @@ def _device_init_watchdog(timeout_s: float):
     t.start()
     t.join(timeout_s)
     if "error" in out:
-        raise out["error"]
+        err = out["error"]
+        if not _is_relay_unavailable(err):
+            # a genuine environment breakage (no TPU installed, broken
+            # jax/libtpu) must fail LOUD, not masquerade as a transient
+            # relay wedge with stale-but-rc-0 numbers round after round
+            raise err
+        _degraded_exit(f"device init failed: {type(err).__name__}: "
+                       f"{str(err)[:200]}")
     if "devices" not in out:
         print(f"bench: device init did not complete within {timeout_s:.0f}s "
               "— the TPU relay likely holds a stale claim (see PROFILE.md); "
               "set BFTPU_DEVICE_INIT_TIMEOUT_S (seconds) to wait longer",
               file=sys.stderr, flush=True)
-        os._exit(3)
+        # the probe thread is still BLOCKED inside jax.devices() holding
+        # jax's backend-init lock; sys.exit would run jax atexit teardown
+        # against that lock and hang after emitting — hard-exit instead
+        _degraded_exit(
+            f"device init hung past {timeout_s:.0f}s (stale relay claim)",
+            hard=True)
     return out["devices"]
+
+
+def _is_relay_unavailable(e: BaseException) -> bool:
+    """True for the relay-shaped init failures (transient, degrade-worthy):
+    the axon relay surfaces a wedged/stale chip claim as UNAVAILABLE or
+    DEADLINE_EXCEEDED canonical statuses (possibly wrapped in jax's
+    'Unable to initialize backend' RuntimeError)."""
+    msg = str(e)
+    return ("UNAVAILABLE" in msg or "DEADLINE_EXCEEDED" in msg
+            or "Unavailable" in msg)
+
+
+def _aot_overlap_evidence(timeout_s: float = 900.0):
+    """Compile-only evidence that survives a wedged chip claim: the AOT
+    topology API (v5e:2x4) keeps working while ``jax.devices()`` hangs, so
+    degraded mode still proves the compiled schedule overlaps gossip with
+    compute (benchmarks/overlap_report.py, run out-of-process so a hang
+    cannot take the bench down with it)."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "overlap_report.py")
+    # The child only needs libtpu's AOT compiler (get_topology_desc), not a
+    # TPU backend: pin its runtime platform to CPU so it can neither fight
+    # the parent for the libtpu lockfile nor touch the (possibly wedged)
+    # relay claim.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    # the parent's failed init may still hold the libtpu lockfile; AOT
+    # compilation needs no exclusive TPU system, so opt out of the lock
+    env["ALLOW_MULTIPLE_LIBTPU_LOAD"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            timeout=timeout_s, env=env)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if proc.returncode != 0:
+        return {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": "no JSON in overlap report output"}
+
+
+def _degraded_exit(reason: str, hard: bool = False):
+    """The TPU refused to initialize.  Emit the last-good cached metrics
+    flagged stale plus AOT compile-only evidence and exit 0 — a wedged
+    relay must never end a round with no perf artifact (VERDICT r3 #2).
+
+    ``hard`` exits via os._exit (no interpreter teardown) for the hung-probe
+    path, where a blocked jax.devices() thread would deadlock atexit."""
+    out = {"stale": True, "degraded_reason": reason}
+    try:
+        with open(CACHE_PATH) as f:
+            out.update(json.load(f))
+        out["stale"] = True  # cache must not un-flag the degradation
+    except (OSError, json.JSONDecodeError) as e:
+        out.update({
+            "metric": "resnet50_images_per_sec_per_chip",
+            "value": None, "unit": "images/sec/chip",
+            "cache_error": f"{type(e).__name__}: {e}",
+        })
+    print("bench: DEGRADED MODE — emitting last-good cached metrics + AOT "
+          f"overlap evidence ({reason})", file=sys.stderr, flush=True)
+    out["aot_overlap"] = _aot_overlap_evidence()
+    print(json.dumps(out), flush=True)
+    if hard:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+    sys.exit(0)
 
 
 def main():
@@ -361,7 +538,8 @@ def main():
 
     if not results:
         raise SystemExit("bench: no batch size fit in memory")
-    best_batch, best_ips, flops_per_step, _ = max(results, key=lambda r: r[1])
+    best_batch, best_ips, flops_per_step, best_mem = max(
+        results, key=lambda r: r[1])
 
     if profile_dir:
         # trace-only re-run: run() captures 3 traced steps; steps=0 skips the
@@ -391,10 +569,16 @@ def main():
         "model_tflops_per_sec_per_chip": round(achieved_flops / 1e12, 2),
         "flops_source": "xla_cost_analysis" if flops_per_step > 0 else "analytic",
     }
-    if peak_flops is not None:
-        out["measured_peak_tflops_per_sec"] = round(peak_flops / 1e12, 2)
-        out["mfu"] = round(achieved_flops / peak_flops, 4)
+    out.update(perf_sanity_fields(
+        devices, peak_flops, achieved_flops, best_mem, flops_per_step,
+        best_batch, best_ips))
     print(json.dumps(out))
+    try:
+        with open(CACHE_PATH, "w") as f:
+            json.dump({**out, "cached_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%S%z")}, f, indent=1)
+    except OSError as e:
+        print(f"bench: could not write {CACHE_PATH}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,163 @@
+"""bench.py resilience: degraded mode + perf sanity gates.
+
+Round 3 ended with BENCH_r03.json as a bare failure record (rc=1, relay
+refused device init) — no perf artifact at all.  The verdict's directive:
+device-init failure must emit last-good cached metrics flagged stale plus
+AOT compile-only evidence and exit 0 (a round can never end with nothing),
+and perf numbers must carry plausibility gates (the relay has produced
+measured "peaks" off by >1000x from any physical chip).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(_REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeDev:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+class TestNominalSpec:
+    def test_known_kinds(self, bench):
+        assert bench.nominal_spec([FakeDev("TPU v5 lite")]) == (197.0, 819.0)
+        assert bench.nominal_spec([FakeDev("TPU v5p")]) == (459.0, 2765.0)
+        assert bench.nominal_spec([FakeDev("TPU v4")]) == (275.0, 1228.0)
+        assert bench.nominal_spec([FakeDev("TPU v6 lite")]) == (918.0, 1640.0)
+
+    def test_longest_match_wins(self, bench):
+        # "v5 lite" contains "v5"-family substrings; must not fall through
+        # to a shorter key with different numbers
+        tf, _ = bench.nominal_spec([FakeDev("tpu v5 lite chip")])
+        assert tf == 197.0
+
+    def test_unknown_kind(self, bench):
+        assert bench.nominal_spec([FakeDev("QuantumAbacus 3000")]) == (None,
+                                                                       None)
+
+
+class TestSanityGates:
+    def test_plausible_peak_uses_measured(self, bench):
+        # 160 TF measured on a 197 TF chip: plausible
+        f = bench.perf_sanity_fields(
+            [FakeDev("TPU v5 lite")], peak_flops=160e12,
+            achieved_flops=80e12, best_mem=None, flops_per_step=0,
+            best_batch=128, best_ips=1000.0)
+        assert f["measured_peak_plausible"] is True
+        assert f["mfu_denominator"] == "measured_peak"
+        assert f["mfu"] == f["mfu_vs_measured"] == 0.5
+
+    def test_non_physical_peak_falls_back_to_spec(self, bench):
+        # the round-3 failure shape: ~1000 PFLOP/s "measured" on one chip
+        f = bench.perf_sanity_fields(
+            [FakeDev("TPU v5 lite")], peak_flops=1000e15,
+            achieved_flops=100e12, best_mem=None, flops_per_step=0,
+            best_batch=128, best_ips=1000.0)
+        assert f["measured_peak_plausible"] is False
+        assert f["mfu_denominator"] == "nominal_spec"
+        assert f["mfu"] == pytest.approx(100e12 / 197e12, rel=1e-3)
+        # both denominators are still visible to the reader
+        assert "mfu_vs_measured" in f and "mfu_vs_nominal" in f
+
+    def test_mfu_above_one_is_flagged(self, bench):
+        f = bench.perf_sanity_fields(
+            [FakeDev("TPU v5 lite")], peak_flops=150e12,
+            achieved_flops=400e12, best_mem=None, flops_per_step=0,
+            best_batch=128, best_ips=1000.0)
+        assert f["mfu_plausible"] is False
+
+    def test_mfu_plausible_emitted_true_on_healthy_runs(self, bench):
+        # the key must be PRESENT either way — absence is ambiguous
+        f = bench.perf_sanity_fields(
+            [FakeDev("TPU v5 lite")], peak_flops=160e12,
+            achieved_flops=80e12, best_mem=None, flops_per_step=0,
+            best_batch=128, best_ips=1000.0)
+        assert f["mfu_plausible"] is True
+
+    def test_relay_error_classifier(self, bench):
+        relay = RuntimeError(
+            "Unable to initialize backend 'axon': UNAVAILABLE: TPU backend "
+            "setup/compile error (Unavailable).")
+        broken = RuntimeError(
+            "Unable to initialize backend 'tpu': UNKNOWN: TPU "
+            "initialization failed: No jellyfish device found.")
+        assert bench._is_relay_unavailable(relay) is True
+        assert bench._is_relay_unavailable(broken) is False
+
+    def test_roofline_estimate(self, bench):
+        mem = {"temp": 8 << 30, "args": 100 << 20}  # 8 GiB act, 100 MiB args
+        f = bench.perf_sanity_fields(
+            [FakeDev("TPU v5 lite")], peak_flops=150e12,
+            achieved_flops=50e12, best_mem=mem,
+            flops_per_step=128 * 12.27e9, best_batch=128, best_ips=10000.0)
+        r = f["roofline_estimate"]
+        assert r["hbm_bytes_per_step_est"] == mem["temp"] + mem["args"]
+        # 8.1 GiB over 819 GB/s ~ 10.6 ms; compute 1.57 TF over 197 TF ~ 8 ms
+        assert r["min_step_ms_memory"] == pytest.approx(10.6, abs=0.5)
+        assert r["bound"] == "memory"
+        assert r["measured_step_ms"] == pytest.approx(12.8, abs=0.1)
+
+    def test_unknown_device_reports_unverified(self, bench):
+        f = bench.perf_sanity_fields(
+            [FakeDev("mystery")], peak_flops=100e12, achieved_flops=10e12,
+            best_mem=None, flops_per_step=0, best_batch=1, best_ips=1.0)
+        assert f["mfu_denominator"] == "measured_peak_unverified"
+        assert "nominal_peak_tflops_per_sec" not in f
+
+
+class TestDegradedMode:
+    def test_emits_stale_cache_and_exits_zero(self, bench, monkeypatch,
+                                              tmp_path, capsys):
+        cache = tmp_path / "cache.json"
+        cache.write_text(json.dumps({
+            "metric": "resnet50_images_per_sec_per_chip",
+            "value": 97262.15, "unit": "images/sec/chip", "batch": 128,
+            "vs_baseline": 270.173, "cached_at": "yesterday"}))
+        monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+        monkeypatch.setattr(bench, "_aot_overlap_evidence",
+                            lambda: {"collective_windows": 12,
+                                     "overlapped_fraction": 1.0})
+        with pytest.raises(SystemExit) as exc:
+            bench._degraded_exit("relay wedged (test)")
+        assert exc.value.code == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["stale"] is True
+        assert out["value"] == 97262.15  # last-good number, not nothing
+        assert out["degraded_reason"] == "relay wedged (test)"
+        assert out["aot_overlap"]["overlapped_fraction"] == 1.0
+
+    def test_no_cache_still_emits_artifact(self, bench, monkeypatch,
+                                           tmp_path, capsys):
+        monkeypatch.setattr(bench, "CACHE_PATH",
+                            str(tmp_path / "missing.json"))
+        monkeypatch.setattr(bench, "_aot_overlap_evidence",
+                            lambda: {"error": "skipped in test"})
+        with pytest.raises(SystemExit) as exc:
+            bench._degraded_exit("no cache case")
+        assert exc.value.code == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["stale"] is True and out["value"] is None
+        assert "cache_error" in out
+
+    def test_repo_cache_is_valid_seed(self, bench):
+        """The committed BENCH_CACHE.json must parse and carry a real
+        number, or degraded mode at the driver's capture emits nothing."""
+        with open(bench.CACHE_PATH) as f:
+            cached = json.load(f)
+        assert cached["metric"] == "resnet50_images_per_sec_per_chip"
+        assert cached["value"] > 0
+        assert "cached_at" in cached
