@@ -148,6 +148,25 @@ class _PyWinTable:
             return w["self"].copy()
 
 
+# One process-wide pool for TreePacker's parallel leaf casts (np.copyto /
+# astype release the GIL): shared across packer instances so N concurrent
+# rank loops cannot multiply idle worker threads, created under a lock,
+# daemon threads so it never blocks interpreter exit.
+_CAST_WORKERS = min(8, os.cpu_count() or 1)
+_cast_pool_obj = None
+_cast_pool_mu = threading.Lock()
+
+
+def _cast_pool():
+    global _cast_pool_obj
+    with _cast_pool_mu:
+        if _cast_pool_obj is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _cast_pool_obj = ThreadPoolExecutor(max_workers=_CAST_WORKERS)
+        return _cast_pool_obj
+
+
 _py_table: Optional[_PyWinTable] = None
 _py_table_mu = threading.Lock()
 
@@ -323,8 +342,16 @@ class TreePacker:
     dtypes, optionally as jax arrays.
     """
 
+    # float dtypes (width <= 32 bit) eligible for the fused device fast
+    # path: staging through an f32 wire is lossless for them.  Integer
+    # leaves (PRNG keys, step counters) stay on the host loop — int32
+    # through f32 would corrupt values above 2^24, while the f64 host wire
+    # keeps them exact.
+    _F32_SAFE = (np.dtype(np.float32), np.dtype(np.float16))
+
     def __init__(self, template, dtype=np.float64):
         import jax
+        import jax.numpy as jnp
 
         leaves, self._treedef = jax.tree_util.tree_flatten(template)
         self._shapes = [tuple(np.shape(l)) for l in leaves]
@@ -333,6 +360,15 @@ class TreePacker:
                                  np.asarray(l).dtype) for l in leaves]
         self.size = int(sum(self._sizes))
         self.dtype = np.dtype(dtype)
+        # device fusion pays on real accelerators (ONE host transfer instead
+        # of per-leaf); on the CPU backend it only adds copies — there the
+        # win is parallel host casts (numpy releases the GIL in copyto)
+        self._fusable = all(
+            dt in self._F32_SAFE or dt == jnp.bfloat16.dtype
+            for dt in self._dtypes) and jax.default_backend() != "cpu"
+        self._device_pack = None    # built lazily, cached per instance
+        self._device_unpack = None
+        self._offs = np.cumsum([0] + self._sizes)
 
     def pack(self, tree, out: Optional[np.ndarray] = None) -> np.ndarray:
         import jax
@@ -341,15 +377,45 @@ class TreePacker:
         if len(leaves) != len(self._sizes):
             raise ValueError(
                 f"tree has {len(leaves)} leaves, template {len(self._sizes)}")
-        host = jax.device_get(leaves)  # one batched transfer
         vec = np.empty(self.size, self.dtype) if out is None else out
         if vec.shape != (self.size,) or vec.dtype != self.dtype:
             raise ValueError(f"out must be ({self.size},) {self.dtype}")
-        off = 0
-        for a, sz in zip(host, self._sizes):
-            vec[off:off + sz] = np.asarray(a, self.dtype).ravel()
-            off += sz
+        if self._fusable and all(isinstance(l, jax.Array) for l in leaves):
+            # fused fast path: ravel+concat ON DEVICE (one compiled
+            # program), ONE contiguous f32 transfer, one vectorized host
+            # widen — instead of a per-leaf transfer + f64 copy each.
+            # Per-leaf shapes are validated as the host path's slice
+            # assignment would: a wrong-shaped leaf must raise, not land
+            # at the wrong offsets.
+            for l, s in zip(leaves, self._shapes):
+                if tuple(l.shape) != s:
+                    raise ValueError(
+                        f"leaf shape {tuple(l.shape)} != template {s}")
+            if self._device_pack is None:
+                import jax.numpy as jnp
+
+                self._device_pack = jax.jit(lambda ls: jnp.concatenate(
+                    [jnp.ravel(l).astype(jnp.float32) for l in ls]))
+            vec[:] = np.asarray(self._device_pack(leaves))
+            return vec
+        host = jax.device_get(leaves)  # one batched transfer
+        self._scatter(vec, host)
         return vec
+
+    def _scatter(self, vec: np.ndarray, host) -> None:
+        """Cast-copy each host leaf into its slice of ``vec``.  Leaves are
+        copied concurrently for large trees: np.copyto releases the GIL, so
+        the dominant cost (widening casts to the f64 wire) parallelizes
+        across cores."""
+        def one(i, a):
+            np.copyto(vec[self._offs[i]:self._offs[i + 1]],
+                      np.asarray(a).reshape(-1), casting="unsafe")
+
+        if len(host) > 1 and self.size >= (1 << 20) and _CAST_WORKERS > 1:
+            list(_cast_pool().map(lambda ia: one(*ia), enumerate(host)))
+        else:
+            for i, a in enumerate(host):
+                one(i, a)
 
     def unpack(self, vec: np.ndarray, *, as_jax: bool = True):
         import jax
@@ -357,11 +423,31 @@ class TreePacker:
         vec = np.asarray(vec)
         if vec.shape != (self.size,):
             raise ValueError(f"vector shape {vec.shape} != ({self.size},)")
-        leaves, off = [], 0
-        for shape, sz, dt in zip(self._shapes, self._sizes, self._dtypes):
-            a = vec[off:off + sz].reshape(shape).astype(dt)
-            leaves.append(jax.numpy.asarray(a) if as_jax else a)
-            off += sz
+        if as_jax and self._fusable:
+            # one narrow host cast, ONE transfer, fused device split
+            if self._device_unpack is None:
+                def du(flat):
+                    return [
+                        flat[o:o + sz].reshape(shape).astype(dt)
+                        for o, sz, shape, dt in zip(
+                            self._offs, self._sizes, self._shapes,
+                            self._dtypes)
+                    ]
+
+                self._device_unpack = jax.jit(du)
+            leaves = self._device_unpack(
+                jax.numpy.asarray(np.asarray(vec, np.float32)))
+            return jax.tree_util.tree_unflatten(self._treedef, leaves)
+        def cut(i):
+            return (vec[self._offs[i]:self._offs[i + 1]]
+                    .reshape(self._shapes[i]).astype(self._dtypes[i]))
+
+        if (len(self._sizes) > 1 and self.size >= (1 << 20)
+                and _CAST_WORKERS > 1):
+            host = list(_cast_pool().map(cut, range(len(self._sizes))))
+        else:
+            host = [cut(i) for i in range(len(self._sizes))]
+        leaves = [jax.numpy.asarray(a) if as_jax else a for a in host]
         return jax.tree_util.tree_unflatten(self._treedef, leaves)
 
 
