@@ -74,6 +74,7 @@ from bluefog_tpu.parallel.api import (
     win_update_then_collect,
     win_mutex,
     win_mutex_break,
+    win_mutex_sweep,
     broadcast_parameters,
     allreduce_parameters,
     broadcast_optimizer_state,

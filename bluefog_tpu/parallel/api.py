@@ -62,6 +62,7 @@ __all__ = [
     "win_update_then_collect",
     "win_mutex",
     "win_mutex_break",
+    "win_mutex_sweep",
     "broadcast_parameters",
     "allreduce_parameters",
     "broadcast_optimizer_state",
@@ -470,9 +471,29 @@ def _coordination_client():
     return client
 
 
+_WIN_MUTEX_PREFIX = "bluefog_tpu/win_mutex/"
+_LEASE_MARK = " lease_until="
+
+
+def _parse_lock_value(v: str):
+    """``(owner, lease_expiry_unix_or_None, lease_duration_s_or_None)``
+    from a lock key's value (stamp format ``<expiry>[/<duration>]``).
+    Values without the lease marker (older writers, hand-planted keys) have
+    no lease and are NEVER auto-stolen."""
+    if _LEASE_MARK in v:
+        owner, _, stamp = v.rpartition(_LEASE_MARK)
+        expiry, _, dur = stamp.partition("/")
+        try:
+            return owner, float(expiry), (float(dur) if dur else None)
+        except ValueError:
+            return v, None, None
+    return v, None, None
+
+
 @contextlib.contextmanager
 def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
-              timeout_s: float = 60.0, poll_interval_s: float = 0.002):
+              timeout_s: float = 60.0, poll_interval_s: float = 0.002,
+              lease_s: float = 30.0):
     """Mutual exclusion over window ``name`` (reference ``bf.win_mutex``,
     an MPI passive-target ``MPI_Win_lock_all`` epoch guarding concurrent
     one-sided access — ``bluefog/torch/mpi_win_ops.cc``).
@@ -491,12 +512,27 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
       reference gets from ``MPI_Win_lock_all``; it is reentrant within a
       thread, and raises ``TimeoutError`` after ``timeout_s``.
 
-      Known failure mode (same as an MPI lock whose holder dies): the lock
-      has no lease — a holder that crashes before releasing leaves the key
-      behind, and later acquisitions time out naming the dead owner.  The
-      coordination service has no compare-and-delete, so automatic stealing
-      cannot be made race-free; recover explicitly with
-      :func:`win_mutex_break` once the owner is known dead.
+    **Lease / failure semantics** (multi-controller): the lock value carries
+    a lease stamp (expiry + duration) that a background heartbeat refreshes
+    every ``lease_s/3`` while the holder is alive — a live holder is never
+    stolen no matter how long its critical section runs.  If the holder
+    DIES, the heartbeat stops and the next contender recovers the lock
+    automatically.  Stealing requires ALL of: (a) the stamp is wall-clock
+    expired, (b) the contender has watched the value stay *unchanged* for a
+    full lease duration on its own monotonic clock — so cross-host clock
+    skew alone can never steal from a heartbeating holder — and (c) the
+    contender wins the atomic break subkey and re-confirms the value is
+    still unchanged immediately before deleting.  Keys without a lease
+    stamp (planted by hand or by older writers) are never auto-stolen;
+    those still need :func:`win_mutex_break` after the owner is known dead.
+    ``lease_s=None`` disables the lease entirely (release failures then
+    propagate, since no self-healing would follow them).  A holder frozen
+    (not dead) past its lease can be stolen; its refresher detects the loss
+    on its next beat, logs it, and stops re-stamping so the double-hold is
+    bounded by one refresh period.  Residual window, stated honestly: the
+    service has no compare-and-delete, so a breaker dying between its
+    re-confirmation and the delete can still race a revival — the same
+    post-failure ambiguity MPI has after ``MPI_Win_lock_all`` owner loss.
 
     ``for_self``/``ranks`` are accepted for reference call-site
     compatibility; the lock is per-window-name, not per-rank.
@@ -526,17 +562,26 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
     import jax
     import os as _os
 
-    key = f"bluefog_tpu/win_mutex/{name}"
+    key = _WIN_MUTEX_PREFIX + name
     owner = f"{jax.process_index()}:{_os.getpid()}:{threading.get_ident()}"
+
+    def stamped():
+        if lease_s is None:
+            return owner
+        return (f"{owner}{_LEASE_MARK}"
+                f"{_time.time() + lease_s:.3f}/{lease_s:.1f}")
+
     deadline = _time.monotonic() + timeout_s
     backoff = poll_interval_s
+    tracker = _StealTracker(client, key, owner)
     while True:
         try:
-            client.key_value_set(key, owner)  # atomic: raises if held
+            client.key_value_set(key, stamped())  # atomic: raises if held
             break
         except Exception as e:
             if "ALREADY_EXISTS" not in str(e):
                 raise
+            tracker.poll()
             if _time.monotonic() > deadline:
                 holder = ""
                 try:
@@ -545,19 +590,207 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
                     pass
                 raise TimeoutError(
                     f"win_mutex({name!r}): lock held for {timeout_s:.0f}s "
-                    f"by {holder!r} (process:pid:thread); if that owner is "
-                    "dead, recover with win_mutex_break(name)") from e
+                    f"by {holder!r} (process:pid:thread); a leased lock "
+                    "recovers automatically when its owner dies — if this "
+                    "one has no lease and the owner is dead, recover with "
+                    "win_mutex_break(name)") from e
             # exponential backoff: N contenders busy-polling the (single)
             # coordination service with failing RPCs would starve its
             # heartbeat work at pod scale
             _time.sleep(backoff)
             backoff = min(backoff * 2, 0.1)
     held[name] = 1
+    stop_refresh = threading.Event()
+    refresher = None
+    if lease_s is not None:
+        def refresh():
+            # a live holder's lease must never lapse: re-stamp well inside
+            # the lease period until release.  If the key is no longer ours
+            # (stolen from a frozen incarnation of us), say so and STOP —
+            # blindly re-stamping would silently overwrite the new holder.
+            from bluefog_tpu.utils import log
+
+            while not stop_refresh.wait(lease_s / 3.0):
+                try:
+                    cur = client.key_value_try_get(key)
+                except Exception:
+                    cur = None
+                if cur is None or _parse_lock_value(cur)[0] != owner:
+                    log.error(
+                        "win_mutex(%r): lease LOST (key now %r) — this "
+                        "holder was frozen past its lease and the lock was "
+                        "stolen; exclusion is no longer guaranteed for the "
+                        "remainder of this critical section", name, cur)
+                    return
+                try:
+                    client.key_value_set(key, stamped(),
+                                         allow_overwrite=True)
+                except Exception:
+                    return  # service gone — job is tearing down
+        refresher = threading.Thread(target=refresh, daemon=True)
+        refresher.start()
     try:
         yield
     finally:
         held[name] = 0
-        client.key_value_delete(key)
+        stop_refresh.set()
+        joined = True
+        if refresher is not None:
+            refresher.join(timeout=5)
+            joined = not refresher.is_alive()
+        if not joined:
+            # a refresher stuck in an in-flight key_value_set could land
+            # AFTER our delete and resurrect the key as a ghost; leave the
+            # key to lease expiry instead (self-healing, bounded by lease_s)
+            from bluefog_tpu.utils import log
+
+            log.warn("win_mutex(%r): refresher still in flight at release; "
+                     "leaving key to lease expiry", name)
+        elif lease_s is None:
+            # no lease means no self-healing: a failed delete here must be
+            # LOUD, or the key wedges every later acquisition silently
+            client.key_value_delete(key)
+        else:
+            try:
+                # shrink (not close — no CAS) the stolen-lock window: only
+                # delete what is still ours
+                cur = client.key_value_try_get(key)
+                if _parse_lock_value(cur)[0] == owner:
+                    client.key_value_delete(key)
+            except Exception as e:
+                from bluefog_tpu.utils import log
+
+                log.warn("win_mutex(%r): release delete failed (%s); the "
+                         "lease will self-heal", name, e)
+
+
+class _StealTracker:
+    """Per-contender steal state: recovers a key whose leased holder died.
+
+    Rate-limited (one try_get per ~lease/10, not per poll — N contenders
+    must not double the coordination service's RPC load), and skew-immune:
+    stealing additionally requires the value to have stayed UNCHANGED for a
+    full lease duration on this contender's monotonic clock, which a live
+    holder's heartbeat (every lease/3) makes impossible regardless of how
+    far apart the hosts' wall clocks are."""
+
+    def __init__(self, client, key: str, owner: str):
+        self.client = client
+        self.key = key
+        self.owner = owner
+        self.observed: Optional[str] = None
+        self.first_seen = 0.0   # monotonic time self.observed appeared
+        self.next_check = 0.0   # monotonic rate limiter
+
+    def poll(self) -> None:
+        import time as _time
+
+        now_m = _time.monotonic()
+        if now_m < self.next_check:
+            return
+        try:
+            cur = self.client.key_value_try_get(self.key)
+        except Exception:
+            self.observed = None
+            return  # key gone — the acquire loop will race for it
+        if cur != self.observed:
+            self.observed, self.first_seen = cur, now_m
+        _, expiry, dur = _parse_lock_value(cur)
+        if expiry is None:
+            self.next_check = now_m + 1.0
+            return  # lease-less values are never auto-stolen
+        confirm_s = max(1.0, dur if dur is not None else 2.0)
+        self.next_check = now_m + max(0.5, confirm_s / 10.0)
+        if _time.time() <= expiry:
+            return  # writer-clock says live
+        if now_m - self.first_seen < confirm_s:
+            return  # not yet watched unchanged for a full lease
+        if _break_stale(self.client, self.key, self.owner, cur):
+            self.observed = None
+
+
+def _break_stale(client, key: str, breaker: str, observed: str) -> bool:
+    """Delete ``key`` iff its value is still exactly ``observed``,
+    serialized through an atomic break subkey (one breaker at a time; a
+    last-moment refresh or re-acquire changes the value and aborts).
+    Returns True if the stale key was deleted."""
+    import time as _time
+
+    now = _time.time()
+    bkey = key + ".break"
+    bval = f"{breaker}{_LEASE_MARK}{now + 10.0:.3f}/10.0"
+    try:
+        client.key_value_set(bkey, bval)  # atomic: one breaker at a time
+    except Exception as e:
+        if "ALREADY_EXISTS" not in str(e):
+            return False
+        # the previous breaker may itself have died mid-break
+        try:
+            bheld = client.key_value_try_get(bkey)
+            _, bexp, _ = _parse_lock_value(bheld)
+            if bexp is not None and now > bexp:
+                client.key_value_delete(bkey)
+        except Exception:
+            pass
+        return False
+    stole = False
+    try:
+        cur = client.key_value_try_get(key)
+        if cur == observed:  # unchanged since observed expired: truly stale
+            client.key_value_delete(key)
+            stole = True
+            from bluefog_tpu.utils import log
+
+            log.warn("win_mutex: broke expired lock %s (was %r)", key,
+                     observed)
+    except Exception:
+        pass
+    finally:
+        try:
+            client.key_value_delete(bkey)
+        except Exception:
+            pass
+    return stole
+
+
+def win_mutex_sweep(grace_s: float = 0.0) -> int:
+    """Clear every win_mutex key whose lease expired more than ``grace_s``
+    ago — the restart-path janitor (a supervisor-restarted worker calls this
+    before re-entering training so locks its previous incarnation died
+    holding cannot deadlock the job until per-acquire stealing notices).
+
+    Deletions go through the same break-subkey + value-unchanged protocol
+    as per-acquire stealing (on a FRESH read, not the enumeration snapshot),
+    so the sweep serializes with live contenders and cannot delete a lock
+    that was just stolen and re-acquired.  Returns the number of keys
+    cleared; 0 under a single controller or when the service cannot
+    enumerate keys."""
+    import os as _os
+    import time as _time
+
+    client = _coordination_client()
+    if client is None:
+        return 0
+    try:
+        entries = client.key_value_dir_get(_WIN_MUTEX_PREFIX)
+    except Exception:
+        return 0
+    removed = 0
+    now = _time.time()
+    breaker = f"sweep:{_os.getpid()}:{threading.get_ident()}"
+    for entry in entries:
+        key = entry[0] if isinstance(entry, (tuple, list)) else entry
+        if key.endswith(".break"):
+            continue  # subkeys are owned by the break protocol itself
+        try:
+            value = client.key_value_try_get(key)  # fresh, never snapshot
+        except Exception:
+            continue
+        _, expiry, _ = _parse_lock_value(value)
+        if expiry is not None and now > expiry + grace_s:
+            if _break_stale(client, key, breaker, value):
+                removed += 1
+    return removed
 
 
 def win_mutex_break(name: str = "win") -> bool:
@@ -572,9 +805,8 @@ def win_mutex_break(name: str = "win") -> bool:
         # no dead-owner state to clear — and dropping a live RLock would let
         # a second thread into the critical section. Pure no-op.
         return False
-    key = f"bluefog_tpu/win_mutex/{name}"
     try:
-        client.key_value_delete(key)
+        client.key_value_delete(_WIN_MUTEX_PREFIX + name)
         return True
     except Exception:
         return False

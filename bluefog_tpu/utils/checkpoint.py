@@ -371,3 +371,15 @@ def run_with_restart(
                 raise
             log.warn("training failed (%s); restart %d/%d",
                      e, restarts, max_restarts)
+            # janitor: win_mutex keys whose lease expired (e.g. held by a
+            # worker thread the failure killed) must not deadlock the
+            # restarted attempt until per-acquire stealing notices
+            try:
+                from bluefog_tpu.parallel.api import win_mutex_sweep
+
+                swept = win_mutex_sweep()
+                if swept:
+                    log.warn("cleared %d expired win_mutex lease(s) before "
+                             "restart", swept)
+            except Exception:
+                pass

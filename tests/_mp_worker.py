@@ -19,7 +19,14 @@ success:
 6. ``win_mutex`` is a real cross-process lock: racing read-modify-write
    increments on the coordination-service KV never lose an update;
 7. ``win_mutex_break`` recovers a stale lock whose owner died (timeout
-   names the dead owner; after break the mutex is acquirable again).
+   names the dead owner; after break the mutex is acquirable again) —
+   the manual path, still needed for lease-less keys;
+8. a LEASED lock whose owner died auto-recovers with no manual break
+   (the lease expired, the next contender steals through the break
+   subkey), while a LIVE slow holder is never stolen (its heartbeat
+   refreshes the lease faster than it expires);
+9. ``win_mutex_sweep`` clears exactly the expired-lease keys (the
+   supervisor-restart janitor).
 """
 
 import os
@@ -172,6 +179,62 @@ def main():
         with win_mutex("stale_probe", timeout_s=5):
             pass  # recovered
     client.wait_at_barrier("break_end", 60_000)
+
+    # 8a. expired lease -> automatic recovery, no manual break anywhere.
+    # A dead leased holder leaves exactly this state behind: a value with
+    # a lease stamp in the past and no heartbeat refreshing it.
+    from bluefog_tpu.parallel.api import (_LEASE_MARK, _WIN_MUTEX_PREFIX,
+                                          win_mutex_sweep)
+
+    if pid == 0:
+        client.key_value_set(
+            _WIN_MUTEX_PREFIX + "lease_probe",
+            f"999:1:1{_LEASE_MARK}{time.time() - 5.0:.3f}")
+    client.wait_at_barrier("lease_start", 30_000)
+    if pid == 1:
+        t0 = time.monotonic()
+        with win_mutex("lease_probe", timeout_s=15):
+            pass  # stolen from the dead owner automatically
+        # expected ~2-3s: the contender must watch the value stay
+        # unchanged for the confirmation window before it may steal
+        assert time.monotonic() - t0 < 12, "auto-recovery took too long"
+    client.wait_at_barrier("lease_mid", 60_000)
+
+    # 8b. a live holder with a SHORT lease and a LONGER critical section is
+    # never stolen: the heartbeat out-refreshes the lease (and every
+    # refresh resets contenders' unchanged-value confirmation clocks).
+    if pid == 0:
+        with win_mutex("live_probe", lease_s=3.0):
+            client.wait_at_barrier("live_held", 30_000)
+            time.sleep(4.0)  # > one full lease period
+        client.wait_at_barrier("live_done", 60_000)
+    else:
+        client.wait_at_barrier("live_held", 30_000)
+        try:
+            with win_mutex("live_probe", timeout_s=1.5):
+                raise AssertionError("stole a LIVE holder's lock")
+        except TimeoutError:
+            pass
+        client.wait_at_barrier("live_done", 60_000)
+        with win_mutex("live_probe", timeout_s=10):
+            pass  # released normally: acquirable
+    client.wait_at_barrier("live_end", 60_000)
+
+    # 9. sweep clears exactly the expired-lease keys
+    if pid == 0:
+        now = time.time()
+        client.key_value_set(_WIN_MUTEX_PREFIX + "sweep_a",
+                             f"9:1:1{_LEASE_MARK}{now - 60:.3f}")
+        client.key_value_set(_WIN_MUTEX_PREFIX + "sweep_b",
+                             f"9:2:2{_LEASE_MARK}{now - 60:.3f}")
+        client.key_value_set(_WIN_MUTEX_PREFIX + "sweep_live",
+                             f"9:3:3{_LEASE_MARK}{now + 600:.3f}")
+        removed = win_mutex_sweep()
+        assert removed == 2, f"sweep removed {removed}, expected 2"
+        # the unexpired key survived
+        assert client.key_value_try_get(_WIN_MUTEX_PREFIX + "sweep_live")
+        client.key_value_delete(_WIN_MUTEX_PREFIX + "sweep_live")
+    client.wait_at_barrier("sweep_end", 60_000)
 
     print(f"MP_WORKER_OK {pid}", flush=True)
 
