@@ -292,7 +292,8 @@ def neighbor_allreduce_dynamic(
     return lax.switch(jnp.asarray(step) % len(scheds), branches, x)
 
 
-def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str):
+def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str,
+                                 max_rotations: Optional[int] = None):
     """Gossip with an **arbitrary per-call topology** in one compile:
     ``out_i = sum_j W[i, j] x_j`` for any row-stochastic ``W`` within the
     full graph — the TPU answer to the reference's per-call
@@ -300,14 +301,29 @@ def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str):
     the weights) changes every step (``bluefog/torch/mpi_ops.py``;
     SURVEY.md §7 hard-part #2).
 
-    How: any directed graph on ``n`` ranks decomposes into the ``n-1``
-    circulant rotations.  Each rotation's ``ppermute`` is compiled once
-    (static pattern); which rotations actually run is decided at **runtime**
-    by a ``lax.cond`` on whether any edge of that rotation carries nonzero
-    weight — changing ``W`` between calls re-selects rotations and re-weights
-    edges with zero recompilation, and unused rotations cost nothing (the
-    cond executes only the taken branch).  A one-peer dynamic exp2 step
-    therefore pays for exactly one ICI rotation, not ``n-1``.
+    How (default, ``max_rotations=None``): any directed graph on ``n``
+    ranks decomposes into the ``n-1`` circulant rotations.  Each rotation's
+    ``ppermute`` is compiled once (static pattern); which rotations
+    actually run is decided at **runtime** by a ``lax.cond`` on whether any
+    edge of that rotation carries nonzero weight — changing ``W`` between
+    calls re-selects rotations and re-weights edges with zero
+    recompilation, and unused rotations cost nothing (the cond executes
+    only the taken branch).  A one-peer dynamic exp2 step therefore pays
+    for exactly one ICI rotation, not ``n-1``.
+
+    **Degree-capped form** (``max_rotations=D``): the full decomposition
+    emits ``n-1`` conditional ppermutes — a program-size/compile-time cost
+    that grows linearly with the mesh (127 at a v5p-128 target).  With a
+    cap, the program instead materializes ``D`` rotation slots whose shifts
+    are selected at RUNTIME (the active rotations of ``W``, lowest shift
+    first), each executed as a conditional power-of-two ppermute chain
+    (``ceil(log2 n)`` static ppermutes per slot, only the set bits of the
+    shift taken) — ``D * ceil(log2 n)`` ppermutes total, e.g. 21 instead of
+    127 for ``D=3, n=128``.  Dynamic graphs are typically degree-bounded
+    (one-peer: 1 rotation/step; static exp2: log2 n), so ``D`` small is the
+    common case.  Contract: if ``W`` activates MORE than ``D`` rotations,
+    every output is poisoned with NaN (fail-loud — silently dropping edges
+    would corrupt the consensus direction instead).
 
     Args:
       x: array or pytree; each rank's local value.
@@ -317,6 +333,8 @@ def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str):
         rotation-used predicates must agree on every rank or the program
         deadlocks, exactly as mismatched ``src_weights`` deadlock the
         reference's MPI negotiation.
+      max_rotations: program-size cap ``D`` (see above), or None for the
+        full ``n-1``-rotation decomposition.
 
     See :func:`bluefog_tpu.topology.dynamic.one_peer_exp2_mixing_matrix` for
     a jittable step->W builder.
@@ -327,6 +345,10 @@ def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str):
     if W.shape != (n, n):
         raise ValueError(f"mixing_matrix shape {W.shape} != ({n}, {n})")
     rows = jnp.arange(n)
+
+    if max_rotations is not None:
+        return _aperiodic_capped(x, W, axis_name, n, i, rows,
+                                 int(max_rotations))
 
     def one(leaf):
         acc_dt = _acc_dtype(leaf)
@@ -342,6 +364,61 @@ def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str):
                 return o + rot_w[i].astype(acc_dt) * recvd.astype(acc_dt)
 
             out = lax.cond(used, fold, lambda o: o, out)
+        return out.astype(leaf.dtype)
+
+    return jax.tree_util.tree_map(one, x)
+
+
+def _aperiodic_capped(x, W, axis_name: str, n: int, i, rows, cap: int):
+    """Degree-capped aperiodic gossip body: ``cap`` runtime-shift rotation
+    slots, each a conditional power-of-two ppermute chain."""
+    if cap < 1:
+        raise ValueError(f"max_rotations must be >= 1, got {cap}")
+    cap = min(cap, n - 1)  # only n-1 distinct rotations exist
+    # per-rotation activity, computed once for the whole tree (replicated
+    # on every rank, as the predicates must be)
+    shifts_all = jnp.arange(1, n)                        # (n-1,)
+    srcs_all = (rows[None, :] - shifts_all[:, None]) % n  # (n-1, n)
+    rot_w_all = W[rows[None, :], srcs_all]               # (n-1, n)
+    used = jnp.any(rot_w_all != 0.0, axis=1)             # (n-1,)
+    used_count = used.sum()
+    # the first `cap` ACTIVE shifts, lowest first (stable argsort of the
+    # inactive mask); slots beyond the active count are disabled
+    order = jnp.argsort(~used, stable=True)[:cap]
+    sel_shift = shifts_all[order]                        # (cap,) runtime
+    sel_active = used[order]
+    overflow = used_count > cap
+
+    # power-of-two ppermute chain: shift s executes only its set bits
+    pows = []
+    p = 1
+    while p < n:
+        pows.append(p)
+        p *= 2
+
+    def one(leaf):
+        acc_dt = _acc_dtype(leaf)
+        out = W[i, i].astype(acc_dt) * leaf.astype(acc_dt)
+        for d in range(cap):
+            shift = sel_shift[d]
+
+            def fold(o, shift=shift):
+                rot = leaf
+                for pk in pows:
+                    perm = [(a, (a + pk) % n) for a in range(n)]
+                    bit = (shift // pk) % 2 == 1
+
+                    def hop(r, perm=perm):
+                        return lax.ppermute(r, axis_name, perm)
+
+                    rot = lax.cond(bit, hop, lambda r: r, rot)
+                # this rank's weight for the arriving value: W[i, i-shift]
+                w = W[i, (i - shift) % n]
+                return o + w.astype(acc_dt) * rot.astype(acc_dt)
+
+            out = lax.cond(sel_active[d], fold, lambda o: o, out)
+        # exceeding the cap must be LOUD inside jit: poison, don't drop
+        out = jnp.where(overflow, jnp.full_like(out, jnp.nan), out)
         return out.astype(leaf.dtype)
 
     return jax.tree_util.tree_map(one, x)

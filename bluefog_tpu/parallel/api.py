@@ -122,9 +122,11 @@ def _cached_op(op_name: str, mesh, axis_name: str, sched, *static):
         ))
 
     if op_name == "neighbor_allreduce_aperiodic":
+        (max_rotations,) = static
 
         def ap_fn(xs, w):
-            return _ops.neighbor_allreduce_aperiodic(xs, w, ax)
+            return _ops.neighbor_allreduce_aperiodic(
+                xs, w, ax, max_rotations=max_rotations)
 
         return jax.jit(shard_map(
             ap_fn, mesh=mesh, in_specs=(P(ax), P()), out_specs=P(ax),
@@ -197,14 +199,17 @@ def neighbor_allreduce(x, *, topology=None, self_weight=None, recv_weights=None,
     return f(x, sw, rw, dw)
 
 
-def neighbor_allreduce_aperiodic(x, mixing_matrix):
+def neighbor_allreduce_aperiodic(x, mixing_matrix, *,
+                                 max_rotations: Optional[int] = None):
     """Stacked-array gossip with an arbitrary per-call topology: ``out =
     W @ xs`` for any row-stochastic ``(size, size)`` ``W`` — edge set *and*
-    weights are data, so changing them never recompiles (see
-    :func:`bluefog_tpu.ops.collectives.neighbor_allreduce_aperiodic`)."""
+    weights are data, so changing them never recompiles.  ``max_rotations``
+    caps program size for large meshes (degree-bounded dynamic graphs); see
+    :func:`bluefog_tpu.ops.collectives.neighbor_allreduce_aperiodic`."""
     ctx = get_context()
     f = _cached_op(
-        "neighbor_allreduce_aperiodic", ctx.mesh, ctx.axis_name, None)
+        "neighbor_allreduce_aperiodic", ctx.mesh, ctx.axis_name, None,
+        max_rotations)
     return f(x, jnp.asarray(mixing_matrix, jnp.float32))
 
 
