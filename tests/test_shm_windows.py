@@ -110,6 +110,51 @@ def test_self_buffer_visible_across_processes():
         win.free()
 
 
+def test_concurrent_cross_process_accumulates_never_lose_updates():
+    """Two writer PROCESSES hammer the same slot with accumulates; the
+    process-shared mutex must serialize the read-modify-writes exactly
+    (no lost update, no torn sum) — the MPI_Accumulate atomicity contract."""
+    name = _uniq("shm_race")
+    reps = 300
+    win = AsyncWindow(name, n_slots=1, n_elems=8, dtype=np.float64, shm=True)
+    try:
+        code = (
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS']='cpu'\n"
+            "os.environ['PALLAS_AXON_POOL_IPS']=''\n"
+            "import numpy as np\n"
+            "from bluefog_tpu.runtime.async_windows import AsyncWindow\n"
+            f"w = AsyncWindow({name!r}, attach=True)\n"
+            "p = np.full(8, float(sys.argv[1]))\n"
+            f"for _ in range({reps}):\n"
+            "    w.deposit(0, p, accumulate=True)\n"
+            "w.free()\n"
+        )
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", code, str(v)], env=_clean_env(),
+            cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for v in (1.0, 3.0)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=120)
+                outs.append(out)
+        finally:
+            # never orphan a writer against a freed segment (timeout or a
+            # first-proc failure must reap the sibling too)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 2 * reps
+        np.testing.assert_allclose(buf, np.full(8, reps * (1.0 + 3.0)))
+    finally:
+        win.free()
+
+
 def test_attach_timeout_is_loud():
     with pytest.raises(RuntimeError, match="did not publish"):
         AsyncWindow(_uniq("shm_nobody"), attach=True, attach_timeout_s=0.05)
