@@ -1,0 +1,172 @@
+"""win_mutex lease machinery, unit-tested against a fake coordination
+client (the multi-process end-to-end behavior lives in
+tests/_mp_worker.py §8-9; these tests pin the edge cases deterministically).
+"""
+
+import time
+
+import pytest
+
+from bluefog_tpu.parallel import api as A
+
+
+class FakeClient:
+    """In-memory stand-in for jax's DistributedRuntimeClient KV surface."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.kv:
+            raise RuntimeError(f"ALREADY_EXISTS: {key}")
+        self.kv[key] = value
+
+    def key_value_try_get(self, key):
+        if key not in self.kv:
+            raise RuntimeError(f"NOT_FOUND: {key}")
+        return self.kv[key]
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in self.kv.items() if k.startswith(prefix)]
+
+
+def stamp(owner, expiry, dur=None):
+    s = f"{owner}{A._LEASE_MARK}{expiry:.3f}"
+    return s + (f"/{dur:.1f}" if dur is not None else "")
+
+
+class TestParse:
+    def test_lease_with_duration(self):
+        o, e, d = A._parse_lock_value(stamp("0:1:2", 1234.5, 30.0))
+        assert (o, e, d) == ("0:1:2", 1234.5, 30.0)
+
+    def test_lease_without_duration(self):
+        o, e, d = A._parse_lock_value(stamp("0:1:2", 1234.5))
+        assert (o, e, d) == ("0:1:2", 1234.5, None)
+
+    def test_legacy_value_has_no_lease(self):
+        assert A._parse_lock_value("999:1:1") == ("999:1:1", None, None)
+
+    def test_owner_containing_colons_survives(self):
+        # rpartition on the marker, not on ':'
+        o, e, _ = A._parse_lock_value(stamp("7:4242:139684", 99.0, 5.0))
+        assert o == "7:4242:139684" and e == 99.0
+
+
+class TestStealTracker:
+    def _tracker(self, client, key="bluefog_tpu/win_mutex/t"):
+        return A._StealTracker(client, key, "me")
+
+    def test_never_steals_leaseless_values(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        c.kv[key] = "999:1:1"  # hand-planted, no lease
+        t = self._tracker(c)
+        for _ in range(3):
+            t.poll()
+            t.next_check = 0.0  # defeat the rate limiter for the test
+            time.sleep(0.01)
+        assert c.kv[key] == "999:1:1"
+
+    def test_never_steals_unexpired(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        c.kv[key] = stamp("0:1:1", time.time() + 60, 30.0)
+        t = self._tracker(c)
+        t.poll()
+        t.next_check = 0.0
+        t.poll()
+        assert key in c.kv
+
+    def test_steals_only_after_confirmation_window(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        # expired on the wall clock, 0.1s lease duration -> confirmation
+        # window is clamped to >= 1s of observed-unchanged
+        c.kv[key] = stamp("0:1:1", time.time() - 5, 0.1)
+        t = self._tracker(c)
+        t.poll()
+        assert key in c.kv, "stole before watching a full lease duration"
+        t.next_check = 0.0
+        t.first_seen -= 2.0  # simulate having watched it unchanged for 2s
+        t.poll()
+        assert key not in c.kv, "did not steal a confirmed-dead lock"
+        assert key + ".break" not in c.kv, "break subkey leaked"
+
+    def test_value_change_resets_confirmation(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        c.kv[key] = stamp("0:1:1", time.time() - 5, 0.1)
+        t = self._tracker(c)
+        t.poll()
+        t.first_seen -= 2.0
+        # holder refreshed (value changed) right before the steal check
+        c.kv[key] = stamp("0:1:1", time.time() - 4.9, 0.1)
+        t.next_check = 0.0
+        t.poll()  # observes the NEW value: confirmation restarts
+        assert key in c.kv
+
+    def test_break_subkey_held_blocks_second_breaker(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        c.kv[key] = stamp("0:1:1", time.time() - 5, 0.1)
+        c.kv[key + ".break"] = stamp("other", time.time() + 5)
+        t = self._tracker(c)
+        t.poll()
+        t.first_seen -= 2.0
+        t.next_check = 0.0
+        t.poll()
+        assert key in c.kv, "stole while another breaker held the subkey"
+
+    def test_stale_break_subkey_is_cleared(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        c.kv[key] = stamp("0:1:1", time.time() - 5, 0.1)
+        c.kv[key + ".break"] = stamp("dead_breaker", time.time() - 1)
+        assert A._break_stale(c, key, "me", c.kv[key]) is False
+        assert key + ".break" not in c.kv  # cleared for the next attempt
+
+
+class TestBreakStale:
+    def test_deletes_only_unchanged_value(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        observed = stamp("0:1:1", time.time() - 5, 1.0)
+        c.kv[key] = stamp("2:2:2", time.time() + 60, 30.0)  # re-acquired
+        assert A._break_stale(c, key, "me", observed) is False
+        assert key in c.kv
+
+    def test_deletes_stale(self):
+        c = FakeClient()
+        key = "bluefog_tpu/win_mutex/t"
+        v = stamp("0:1:1", time.time() - 5, 1.0)
+        c.kv[key] = v
+        assert A._break_stale(c, key, "me", v) is True
+        assert key not in c.kv and key + ".break" not in c.kv
+
+
+class TestSweep:
+    def test_sweep_uses_fresh_reads_and_break_protocol(self, monkeypatch):
+        c = FakeClient()
+        now = time.time()
+        c.kv[A._WIN_MUTEX_PREFIX + "dead"] = stamp("1:1:1", now - 60, 5.0)
+        c.kv[A._WIN_MUTEX_PREFIX + "live"] = stamp("2:2:2", now + 60, 30.0)
+        c.kv[A._WIN_MUTEX_PREFIX + "legacy"] = "3:3:3"
+        c.kv[A._WIN_MUTEX_PREFIX + "x.break"] = stamp("b", now + 5)
+        monkeypatch.setattr(A, "_coordination_client", lambda: c)
+        assert A.win_mutex_sweep() == 1
+        assert A._WIN_MUTEX_PREFIX + "dead" not in c.kv
+        assert A._WIN_MUTEX_PREFIX + "live" in c.kv
+        assert A._WIN_MUTEX_PREFIX + "legacy" in c.kv  # never auto-cleared
+        assert A._WIN_MUTEX_PREFIX + "x.break" in c.kv  # owned by breakers
+
+    def test_sweep_grace(self, monkeypatch):
+        c = FakeClient()
+        now = time.time()
+        c.kv[A._WIN_MUTEX_PREFIX + "recent"] = stamp("1:1:1", now - 2, 5.0)
+        monkeypatch.setattr(A, "_coordination_client", lambda: c)
+        assert A.win_mutex_sweep(grace_s=10.0) == 0
+        assert A.win_mutex_sweep(grace_s=1.0) == 1
