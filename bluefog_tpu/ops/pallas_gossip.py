@@ -58,7 +58,8 @@ _LANES = 128
 _SUBLANES = 8
 
 # 'auto' routes a gossip leaf through the RDMA kernel only up to this many
-# bytes (counted at the kernel's internal f32 width).  Rationale: the fused
+# bytes (counted at the on-wire width: bf16 leaves ship as bf16, the rest
+# as f32).  Rationale: the fused
 # kernel wins by folding the weighted reduction into the arrival path (one
 # VMEM pass, no ppermute materialization) — a latency/working-set effect that
 # matters for small and medium tensors; a large tensor is one bandwidth-bound
@@ -112,8 +113,10 @@ def auto_gossip_backend(sched: GossipSchedule, x) -> str:
         return "xla"
     limit = int(os.environ.get("BLUEFOG_TPU_PALLAS_MAX_BYTES",
                                DEFAULT_AUTO_MAX_BYTES))
-    biggest = max(int(np.prod(jnp.shape(l), dtype=np.int64)) * 4
-                  for l in leaves)  # kernel width is f32
+    biggest = max(
+        int(np.prod(jnp.shape(l), dtype=np.int64)) *
+        np.dtype(_wire_dtype(getattr(l, "dtype", jnp.float32))).itemsize
+        for l in leaves)  # wire width: bf16 leaves ship as bf16, rest f32
     return "pallas" if biggest <= limit else "xla"
 
 
@@ -182,10 +185,23 @@ def is_pallas_supported(sched: GossipSchedule) -> bool:
     return on_tpu_platform()
 
 
+def _wire_dtype(dtype) -> jnp.dtype:
+    """On-wire dtype for a leaf: bf16 leaves ship as bf16 (HALF the ICI
+    bytes — the dominant cost of a gossip step on real hardware), everything
+    else as f32.  Reduction precision per kernel: the GOSSIP kernel's
+    weighted sum runs in f32 regardless of wire (the XLA path's
+    ``_acc_dtype`` discipline); the deliver kernel's ``acc`` mode adds in
+    the wire dtype, exactly matching the portable window path's leaf-dtype
+    slot adds (``ops/windows.py`` ``peers[k] + recvd``)."""
+    return jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+
+
 def _pad_to_tiles(flat: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
-    """Pad a flat f32 vector to an (R, 128) tile-aligned 2-D block."""
+    """Pad a flat vector to a tile-aligned (R, 128) 2-D block (min sublane
+    count is dtype-dependent: 8 for f32, 16 for bf16)."""
     n = flat.shape[0]
-    per_tile = _SUBLANES * _LANES
+    sublanes = _SUBLANES * (4 // max(flat.dtype.itemsize, 1))
+    per_tile = sublanes * _LANES
     padded = int(np.ceil(max(n, 1) / per_tile)) * per_tile
     flat = jnp.pad(flat, (0, padded - n))
     return flat.reshape(padded // _LANES, _LANES), n
@@ -233,11 +249,14 @@ def _make_exchange_kernel(shifts: Sequence[int], size: int, axis_name: str,
                 rdma.start()
                 rdmas.append(rdma)
 
-            acc = sw_ref[0, 0] * x_ref[:]
+            # accumulate in f32 whatever the wire dtype (bf16 wires halve
+            # ICI bytes; the reduction still runs at f32, matching the XLA
+            # path's _acc_dtype discipline)
+            acc = sw_ref[0, 0] * x_ref[:].astype(jnp.float32)
             for k, rdma in enumerate(rdmas):
                 rdma.wait_recv()
-                acc = acc + rw_ref[0, k] * comm_buf[k]
-            out_ref[:] = acc
+                acc = acc + rw_ref[0, k] * comm_buf[k].astype(jnp.float32)
+            out_ref[:] = acc.astype(out_ref.dtype)
             for rdma in rdmas:
                 rdma.wait_send()
         return kernel
@@ -293,8 +312,10 @@ def neighbor_allreduce_pallas(
     interpret: bool = False,
 ):
     """Fused RDMA gossip step for one array (any shape/dtype; internally a
-    padded f32 (R,128) block).  Call inside ``shard_map``; circulant
-    schedules only — gate with :func:`is_pallas_supported`."""
+    padded tile-aligned (R,128) block in the wire dtype — bf16 for bf16
+    leaves, halving ICI bytes; f32 otherwise; accumulation is f32 either
+    way).  Call inside ``shard_map``; circulant schedules only — gate with
+    :func:`is_pallas_supported`."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -314,7 +335,8 @@ def neighbor_allreduce_pallas(
     i = lax.axis_index(axis_name)
 
     orig_dtype = x.dtype
-    flat = x.astype(jnp.float32).reshape(-1)
+    wire = _wire_dtype(orig_dtype)
+    flat = x.astype(wire).reshape(-1)
     block, true_len = _pad_to_tiles(flat)
 
     sw = (jnp.asarray(sched.self_weights, jnp.float32)[i]
@@ -327,7 +349,7 @@ def neighbor_allreduce_pallas(
     kernel = _make_exchange_kernel(shifts, n, axis_name, "gossip", sched.num_slots)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(block.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(block.shape, wire),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
@@ -335,7 +357,7 @@ def neighbor_allreduce_pallas(
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((len(shifts),) + block.shape, jnp.float32),
+            pltpu.VMEM((len(shifts),) + block.shape, wire),
             pltpu.SemaphoreType.DMA((len(shifts),)),
             pltpu.SemaphoreType.DMA((len(shifts),)),
         ],
@@ -375,10 +397,11 @@ def deliver_pallas(
     i = lax.axis_index(axis_name)
 
     orig_dtype = payload.dtype
-    flat = payload.astype(jnp.float32).reshape(-1)
+    wire = _wire_dtype(orig_dtype)
+    flat = payload.astype(wire).reshape(-1)
     block, true_len = _pad_to_tiles(flat)
     k_slots = len(shifts)
-    bufs_f = bufs.astype(jnp.float32).reshape(k_slots, -1)
+    bufs_f = bufs.astype(wire).reshape(k_slots, -1)
     bufs_block = jnp.pad(
         bufs_f, ((0, 0), (0, block.size - bufs_f.shape[1]))
     ).reshape((k_slots,) + block.shape)
@@ -390,7 +413,7 @@ def deliver_pallas(
     )
     out_bufs = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(bufs_block.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(bufs_block.shape, wire),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),

@@ -102,6 +102,54 @@ def test_pallas_deliver_put_and_accumulate():
             np.testing.assert_allclose(out[r, slot], 2.0 * src, rtol=1e-6)
 
 
+def test_pallas_deliver_bf16_wire():
+    """bf16 payloads ride a bf16 wire (half the ICI bytes) through the
+    window transport too; accumulate semantics match the portable path's
+    leaf-dtype adds."""
+    topo = RingGraph(N)
+    sched = build_schedule(topo)
+    k = sched.num_slots
+
+    def body(xs):
+        x = xs[0].astype(jnp.bfloat16)
+        bufs = jnp.zeros((k,) + x.shape, jnp.bfloat16)
+        bufs = pallas_gossip.deliver_pallas(
+            x, bufs, sched, "bf", accumulate=False, interpret=True)
+        bufs = pallas_gossip.deliver_pallas(
+            x, bufs, sched, "bf", accumulate=True, interpret=True)
+        assert bufs.dtype == jnp.bfloat16
+        return bufs[None]
+
+    out = np.asarray(_run(body, rank_values((3, 7))), np.float64)
+    for r in range(N):
+        for slot in range(k):
+            src = sched.recv_src[r, slot]
+            np.testing.assert_allclose(out[r, slot], 2.0 * src,
+                                       rtol=1e-2, atol=1e-2)
+
+
+def test_wire_dtype_selection_and_auto_cutoff():
+    """bf16 leaves are counted at 2 bytes by the auto policy (the wire is
+    bf16), so a bf16 leaf up to 2x the f32 cutoff still routes pallas."""
+    import jax as _jax
+
+    assert pallas_gossip._wire_dtype(jnp.bfloat16) == jnp.bfloat16
+    assert pallas_gossip._wire_dtype(jnp.float32) == jnp.float32
+    assert pallas_gossip._wire_dtype(jnp.float16) == jnp.float32
+
+    sched = build_schedule(ExponentialTwoGraph(N))
+    cutoff_elems = pallas_gossip.DEFAULT_AUTO_MAX_BYTES // 4
+    f32_big = jnp.zeros((cutoff_elems + 1,), jnp.float32)
+    bf16_same = jnp.zeros((cutoff_elems + 1,), jnp.bfloat16)
+    try:
+        orig = _jax.default_backend
+        _jax.default_backend = lambda: "tpu"
+        assert pallas_gossip.auto_gossip_backend(sched, f32_big) == "xla"
+        assert pallas_gossip.auto_gossip_backend(sched, bf16_same) == "pallas"
+    finally:
+        _jax.default_backend = orig
+
+
 def test_pallas_rejects_non_circulant():
     sched = build_schedule(MeshGrid2DGraph(6))
     with pytest.raises(ValueError, match="circulant"):
