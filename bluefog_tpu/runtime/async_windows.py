@@ -53,10 +53,33 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import contextlib
+
 import numpy as np
 
 from bluefog_tpu.runtime import native
 from bluefog_tpu.topology.graphs import Topology
+from bluefog_tpu.utils import timeline as _timeline
+
+
+@contextlib.contextmanager
+def _host_span(name: str):
+    """B/E timeline span in a PER-THREAD lane (tid = thread ident): the
+    async windows are deposited into by concurrent rank threads, and
+    same-name spans from different threads must neither overwrite each
+    other's bookkeeping nor mis-nest in one trace lane.  No-op (no jax
+    annotation either — that bookkeeping is per-call cost) when no
+    timeline is recording."""
+    tl = _timeline.current()
+    if tl is None:
+        yield
+        return
+    tid = threading.get_ident() % 1_000_000
+    tl.begin(name, "async_window", tid)
+    try:
+        yield
+    finally:
+        tl.end(name, "async_window", tid)
 
 __all__ = [
     "AsyncWindow",
@@ -274,12 +297,14 @@ class AsyncWindow:
         MPI_Put otherwise).  Callable from any thread; never blocks on the
         window's owner.  Returns the slot's deposit count."""
         a = self._check(arr)
-        if self._lib is None:
-            v = _fallback().deposit(self.name, slot, a, accumulate)
-        else:
-            v = self._lib.bf_win_deposit(
-                self.name.encode(), slot, a.ctypes.data, self.n_elems,
-                1 if accumulate else 0)
+        op = "win_accumulate" if accumulate else "win_put"
+        with _host_span(f"{op}.{self.name}"):
+            if self._lib is None:
+                v = _fallback().deposit(self.name, slot, a, accumulate)
+            else:
+                v = self._lib.bf_win_deposit(
+                    self.name.encode(), slot, a.ctypes.data, self.n_elems,
+                    1 if accumulate else 0)
         if v < 0:
             raise RuntimeError(f"deposit into {self.name!r}[{slot}] failed")
         return int(v)
@@ -289,15 +314,17 @@ class AsyncWindow:
         """Read a landing slot; ``consume`` zero-fills it afterwards (mass is
         consumed exactly once).  Returns ``(value, deposits_since_last_
         consume)`` — 0 fresh deposits means the content is stale."""
-        if self._lib is None:
-            out, fresh = _fallback().read(self.name, slot, consume)
-            if out is None:
-                raise RuntimeError(f"read of {self.name!r}[{slot}] failed")
-            return out, int(fresh)
-        out = np.empty(self.n_elems, self.dtype)
-        fresh = self._lib.bf_win_read(
-            self.name.encode(), slot, out.ctypes.data, self.n_elems,
-            1 if consume else 0)
+        with _host_span(f"win_update.{self.name}"):
+            if self._lib is None:
+                out, fresh = _fallback().read(self.name, slot, consume)
+                if out is None:
+                    raise RuntimeError(
+                        f"read of {self.name!r}[{slot}] failed")
+                return out, int(fresh)
+            out = np.empty(self.n_elems, self.dtype)
+            fresh = self._lib.bf_win_read(
+                self.name.encode(), slot, out.ctypes.data, self.n_elems,
+                1 if consume else 0)
         if fresh < 0:
             raise RuntimeError(f"read of {self.name!r}[{slot}] failed")
         return out, int(fresh)

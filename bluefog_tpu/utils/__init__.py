@@ -14,5 +14,6 @@ from bluefog_tpu.utils.timeline import (
     timeline_start_activity,
     timeline_end_activity,
     timeline_context,
+    timeline_active,
 )
 from bluefog_tpu.utils.checkpoint import CheckpointManager, run_with_restart

@@ -34,6 +34,7 @@ __all__ = [
     "timeline_start_activity",
     "timeline_end_activity",
     "timeline_context",
+    "timeline_active",
     "device_stage",
 ]
 
@@ -160,7 +161,40 @@ def _get() -> Optional[Timeline]:
     return _TIMELINE
 
 
-_jax_annotations: Dict[str, object] = {}
+# jax.profiler.TraceAnnotation is thread-local state; the bookkeeping is
+# therefore per-thread, with a STACK per span name — concurrent (or nested)
+# same-name spans on different threads must never pop each other's
+# annotation (that would __exit__ TLS entered on another thread).
+_jax_annotations = threading.local()
+
+
+def _ann_push(name: str, ann) -> None:
+    stacks = getattr(_jax_annotations, "stacks", None)
+    if stacks is None:
+        stacks = _jax_annotations.stacks = {}
+    stacks.setdefault(name, []).append(ann)
+
+
+def _ann_pop(name: str):
+    stacks = getattr(_jax_annotations, "stacks", None)
+    if not stacks:
+        return None
+    lst = stacks.get(name)
+    return lst.pop() if lst else None
+
+
+def timeline_active() -> bool:
+    """True when a timeline is recording — the cheap guard hot paths use to
+    skip span bookkeeping entirely (start/end_activity also open a
+    jax.profiler annotation, which is not free per-call)."""
+    return _get() is not None
+
+
+def current() -> Optional[Timeline]:
+    """The active :class:`Timeline`, or None when not recording.  Hot paths
+    that need per-thread span lanes (e.g. AsyncWindow's host loop) call
+    ``begin``/``end`` on this directly with their own ``tid``."""
+    return _get()
 
 
 def timeline_start_activity(name: str, category: str = "activity"):
@@ -173,7 +207,7 @@ def timeline_start_activity(name: str, category: str = "activity"):
 
         ann = jax.profiler.TraceAnnotation(name)
         ann.__enter__()
-        _jax_annotations[name] = ann
+        _ann_push(name, ann)
     except Exception:
         pass
     return True
@@ -184,7 +218,7 @@ def timeline_end_activity(name: str, category: str = "activity"):
     tl = _get()
     if tl is not None:
         tl.end(name, category)
-    ann = _jax_annotations.pop(name, None)
+    ann = _ann_pop(name)
     if ann is not None:
         ann.__exit__(None, None, None)
     return True

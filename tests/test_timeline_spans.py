@@ -216,3 +216,60 @@ def test_hierarchical_2d_spans(tmp_path):
               if e["name"] == "bf.hierarchical_neighbor_allreduce_2d"]
     assert {e["ph"] for e in events} == {"B", "E"}
     assert {e["tid"] for e in events} == set(range(N))
+
+
+def test_async_window_host_spans(tmp_path):
+    """AsyncWindow deposit/read emit host-side B/E spans when a timeline is
+    recording — the genuinely-asynchronous path's observability (the jitted
+    window family's spans cannot see host-loop deposits) — and skip span
+    bookkeeping entirely when none is (timeline_active guard)."""
+    import numpy as np
+
+    from bluefog_tpu.runtime.async_windows import AsyncWindow
+
+    trace = str(tmp_path / "trace_aw.json")
+    T.timeline_start(trace)
+    try:
+        win = AsyncWindow("span_aw", 1, 4, np.float64)
+        win.deposit(0, np.ones(4), accumulate=True)
+        win.deposit(0, np.ones(4), accumulate=False)
+        win.read(0, consume=True)
+        win.free()
+    finally:
+        T.timeline_stop()
+    names = {e["name"] for e in _load_events(trace)}
+    for want in ("win_accumulate.span_aw", "win_put.span_aw",
+                 "win_update.span_aw"):
+        assert want in names, (want, names)
+    assert not T.timeline_active()
+
+
+def test_concurrent_same_name_activities_are_thread_safe(tmp_path):
+    """start/end_activity from many threads with ONE span name: per-thread
+    annotation stacks mean no thread ever pops (and __exit__s) another
+    thread's jax TraceAnnotation, and no exception escapes."""
+    import threading
+
+    trace = str(tmp_path / "trace_mt.json")
+    T.timeline_start(trace)
+    errors = []
+    try:
+        def worker():
+            try:
+                for _ in range(50):
+                    T.timeline_start_activity("shared_span", "mt")
+                    T.timeline_end_activity("shared_span", "mt")
+            except BaseException as e:  # must never happen
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        T.timeline_stop()
+    assert not errors, errors
+    events = [e for e in _load_events(trace) if e["name"] == "shared_span"]
+    assert len([e for e in events if e["ph"] == "B"]) == 300
+    assert len([e for e in events if e["ph"] == "E"]) == 300
