@@ -1,0 +1,307 @@
+"""Cross-HOST one-sided window transport: TCP deposits into the native table.
+
+The passive-target window story by deployment scope (upstream
+``bluefog/common/mpi_controller.cc`` Win* — ``MPI_Put`` lands anywhere in
+the job; SURVEY.md §3.4):
+
+- same process / rank threads — the in-process native table
+  (``csrc/windows.cc``, anonymous mapping);
+- same host, separate OS processes — the named-shm backing
+  (``AsyncWindow(shm=True)``);
+- **separate hosts (DCN)** — THIS module: every process can run one
+  :class:`WindowServer` exposing its windows on a TCP port; peers hold a
+  :class:`RemoteWindow` and deposit/read with no receiver involvement
+  beyond the server's daemon thread (the MPI progress-thread analog).
+  Within a TPU slice the device-side transport remains the Pallas RDMA
+  kernels; this is the host path that crosses slice/DCN boundaries, where
+  the reference used MPI over the cluster fabric.
+
+Wire protocol (little-endian, one request per round-trip):
+
+  request:  magic u32 | op u8 | name_len u16 | name utf-8 |
+            slot i32 | flags u8 | dtype u8 | n_elems i64 | payload
+  response: status i64 (>=0 ok / deposit-count; <0 error) |
+            [GET_SELF only: dtype u8 | n_elems i64 | payload]
+
+ops: 0 = DEPOSIT (flags bit0 = accumulate), 1 = GET_SELF, 2 = READ_SLOT
+(flags bit0 = consume; response carries the fresh-count as status and the
+slot payload).  dtype: 0 = f32, 1 = f64 (the native table's types).
+
+Connections are persistent (a peer ranks' deposit stream reuses one
+socket); the server is a daemon ``ThreadingTCPServer`` writing straight
+into the process's native window table, so owner threads never
+participate in a transfer — deposits land while the owner computes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from bluefog_tpu.runtime import native
+from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS
+
+__all__ = ["WindowServer", "RemoteWindow"]
+
+_MAGIC = 0xBF_51_0E_01
+_HDR = struct.Struct("<IBH")          # magic, op, name_len
+_BODY = struct.Struct("<iBBq")        # slot, flags, dtype, n_elems
+_STATUS = struct.Struct("<q")
+_SELF_HDR = struct.Struct("<Bq")      # dtype, n_elems
+
+_OP_DEPOSIT = 0
+_OP_GET_SELF = 1
+_OP_READ_SLOT = 2
+
+# the ONE dtype-id table (async_windows owns np.dtype -> id; invert here)
+_DTYPES = {v: k for k, v in _DTYPE_IDS.items()}
+
+# error statuses (negative, disjoint from the native table's -1)
+_ERR_GEOMETRY = -2   # dtype/n_elems disagree with the window's geometry
+_ERR_NO_WINDOW = -3
+_ERR_BAD_OP = -100
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-message")
+        got += r
+    return bytes(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        self.server.track(self.request)  # type: ignore[attr-defined]
+
+    def finish(self):
+        self.server.untrack(self.request)  # type: ignore[attr-defined]
+
+    def _geometry_ok(self, lib, name, dtype, n_elems):
+        """The client's claimed (dtype, n_elems) must MATCH the window's
+        actual geometry before anything is allocated or the native table is
+        touched: the C entry points validate n_elems only and then copy
+        nbytes = n_elems * window_elem_size — a lying dtype would otherwise
+        over-read the payload or overflow the reply buffer, and a huge
+        n_elems would allocate unbounded memory in the owner process."""
+        ns = ctypes.c_int()
+        ne = ctypes.c_longlong()
+        dt = ctypes.c_int()
+        if lib.bf_win_info(name, ctypes.byref(ns), ctypes.byref(ne),
+                           ctypes.byref(dt)) != 0:
+            return _ERR_NO_WINDOW
+        if dt.value != dtype or ne.value != n_elems:
+            return _ERR_GEOMETRY
+        return 0
+
+    def handle(self):
+        lib = self.server.lib  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    hdr = _recv_exact(sock, _HDR.size)
+                except ConnectionError:
+                    return  # peer done
+                magic, op, name_len = _HDR.unpack(hdr)
+                if magic != _MAGIC:
+                    return  # not ours; drop the connection
+                name = _recv_exact(sock, name_len)
+                slot, flags, dtype, n_elems = _BODY.unpack(
+                    _recv_exact(sock, _BODY.size))
+                if dtype not in _DTYPES or op not in (
+                        _OP_DEPOSIT, _OP_GET_SELF, _OP_READ_SLOT):
+                    sock.sendall(_STATUS.pack(_ERR_BAD_OP))
+                    return  # cannot even parse the payload; drop
+                err = self._geometry_ok(lib, name, dtype, n_elems)
+                if op == _OP_DEPOSIT:
+                    if err:
+                        # the payload is still on the wire and its length
+                        # is client-claimed, so the stream cannot be
+                        # resynced — report and drop the connection
+                        sock.sendall(_STATUS.pack(err))
+                        return
+                    nbytes = n_elems * _DTYPES[dtype].itemsize
+                    payload = _recv_exact(sock, nbytes)
+                    arr = np.frombuffer(payload, _DTYPES[dtype])
+                    rc = lib.bf_win_deposit(name, slot, arr.ctypes.data,
+                                            n_elems, flags & 1)
+                    sock.sendall(_STATUS.pack(rc))
+                    continue
+                if err:
+                    sock.sendall(_STATUS.pack(err))
+                    continue
+                out = np.empty(n_elems, _DTYPES[dtype])
+                if op == _OP_GET_SELF:
+                    rc = lib.bf_win_read_self(name, out.ctypes.data, n_elems)
+                else:
+                    rc = lib.bf_win_read(name, slot, out.ctypes.data,
+                                         n_elems, flags & 1)
+                sock.sendall(_STATUS.pack(rc))
+                if rc >= 0:
+                    sock.sendall(_SELF_HDR.pack(dtype, n_elems))
+                    sock.sendall(out.tobytes())
+        except (ConnectionError, OSError):
+            return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns: set = set()
+        self._conns_mu = threading.Lock()
+
+    def track(self, sock):
+        with self._conns_mu:
+            self._conns.add(sock)
+
+    def untrack(self, sock):
+        with self._conns_mu:
+            self._conns.discard(sock)
+
+    def close_connections(self):
+        """stop() must QUIESCE: shutting down the accept loop alone leaves
+        persistent handler connections serving deposits into windows the
+        owner now believes are frozen."""
+        with self._conns_mu:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class WindowServer:
+    """Expose this process's native windows for remote one-sided access.
+
+    ``WindowServer().start()`` binds (default: an ephemeral port on all
+    interfaces) and serves deposits/reads on daemon threads.  The address
+    to hand to peers is ``.address``.  Requires the native runtime (the
+    same table the shm and in-process paths use)."""
+
+    def __init__(self):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError(
+                "WindowServer requires the native runtime window table")
+        self._server: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, host: str = "0.0.0.0", port: int = 0) -> Tuple[str, int]:
+        if self._server is not None:
+            raise RuntimeError("server already running")
+        self._server = _Server((host, port), _Handler)
+        self._server.lib = self._lib  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self.address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        """``(host, port)`` for peers.  A wildcard bind is substituted with
+        a routable address of this host (peers cannot connect to
+        ``0.0.0.0``); pass an explicit ``host`` to ``start`` to control
+        exactly what is advertised."""
+        assert self._server is not None, "server not started"
+        host, port = self._server.server_address[:2]
+        if host in ("0.0.0.0", "::"):
+            try:
+                host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+        return host, port
+
+    def stop(self) -> None:
+        """Quiesce: stop accepting AND close live peer connections, so no
+        deposit can land after stop() returns."""
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.close_connections()
+            self._server.server_close()
+            if self._thread is not None:
+                self._thread.join(timeout=10)
+            self._server = None
+            self._thread = None
+
+
+class RemoteWindow:
+    """Client handle to a window served by another host's
+    :class:`WindowServer` — ``deposit`` is ``MPI_Put``/``MPI_Accumulate``
+    across the DCN, ``read_self`` the passive ``win_get``.  One persistent
+    connection per handle; NOT thread-safe (one handle per rank thread,
+    like an MPI endpoint)."""
+
+    def __init__(self, address: Tuple[str, int], name: str,
+                 timeout_s: float = 30.0):
+        self.name = name
+        self._name_b = name.encode()
+        self._sock = socket.create_connection(address, timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _request(self, op: int, slot: int, flags: int, dtype_id: int,
+                 n_elems: int, payload: bytes = b"") -> int:
+        msg = (_HDR.pack(_MAGIC, op, len(self._name_b)) + self._name_b +
+               _BODY.pack(slot, flags, dtype_id, n_elems) + payload)
+        self._sock.sendall(msg)
+        (rc,) = _STATUS.unpack(_recv_exact(self._sock, _STATUS.size))
+        return rc
+
+    def _recv_array(self) -> np.ndarray:
+        dtype, n_elems = _SELF_HDR.unpack(
+            _recv_exact(self._sock, _SELF_HDR.size))
+        raw = _recv_exact(self._sock, n_elems * _DTYPES[dtype].itemsize)
+        return np.frombuffer(raw, _DTYPES[dtype]).copy()
+
+    def deposit(self, slot: int, arr: np.ndarray, *,
+                accumulate: bool = True) -> int:
+        a = np.ascontiguousarray(arr)
+        if a.dtype not in _DTYPE_IDS:
+            raise TypeError(f"RemoteWindow supports f32/f64, got {a.dtype}")
+        rc = self._request(_OP_DEPOSIT, slot, 1 if accumulate else 0,
+                           _DTYPE_IDS[a.dtype], a.size, a.tobytes())
+        if rc < 0:
+            raise RuntimeError(
+                f"remote deposit into {self.name!r}[{slot}] failed ({rc}): "
+                "window missing, slot out of range, or size/dtype mismatch")
+        return rc
+
+    def read_self(self, n_elems: int, dtype=np.float64) -> np.ndarray:
+        rc = self._request(_OP_GET_SELF, 0, 0,
+                           _DTYPE_IDS[np.dtype(dtype)], n_elems)
+        if rc < 0:
+            raise RuntimeError(f"remote read_self of {self.name!r} failed")
+        return self._recv_array()
+
+    def read(self, slot: int, n_elems: int, dtype=np.float64, *,
+             consume: bool = True) -> Tuple[np.ndarray, int]:
+        rc = self._request(_OP_READ_SLOT, slot, 1 if consume else 0,
+                           _DTYPE_IDS[np.dtype(dtype)], n_elems)
+        if rc < 0:
+            raise RuntimeError(f"remote read of {self.name!r}[{slot}] failed")
+        return self._recv_array(), rc
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
