@@ -879,6 +879,108 @@ class FileBarrier:
             time.sleep(0.005)
 
 
+class _ShmTransport:
+    """Same-host rank processes: windows in named shared memory."""
+
+    def create(self, wname: str, n_slots: int, n_elems: int) -> AsyncWindow:
+        # each rank owns its window name exclusively, so a leftover segment
+        # can only be stale (crashed previous run) — clean and recreate
+        shm_unlink_window(wname)
+        return AsyncWindow(wname, n_slots, n_elems, np.float64, shm=True)
+
+    def publish(self, barrier: FileBarrier, rank: int) -> None:
+        pass  # the shm namespace IS the rendezvous
+
+    def collect(self, barrier: FileBarrier, n: int) -> None:
+        pass
+
+    def open(self, owner: int, wname: str, n_slots: int, n_elems: int):
+        return AsyncWindow(wname, attach=True)
+
+    def close(self) -> None:
+        pass
+
+
+class _RemoteHandle:
+    """AsyncWindow-shaped adapter over a :class:`RemoteWindow` (geometry
+    captured at open time, as the remote protocol requires it per call)."""
+
+    def __init__(self, rw, n_slots: int, n_elems: int):
+        self._rw = rw
+        self.n_slots = n_slots
+        self.n_elems = n_elems
+        self.dtype = np.dtype(np.float64)
+
+    def deposit(self, slot, arr, *, accumulate=True):
+        return self._rw.deposit(
+            slot, np.ascontiguousarray(arr, self.dtype),
+            accumulate=accumulate)
+
+    def read(self, slot, *, consume=True):
+        return self._rw.read(slot, self.n_elems, self.dtype, consume=consume)
+
+    def read_self(self):
+        return self._rw.read_self(self.n_elems, self.dtype)
+
+    def free(self):
+        self._rw.close()
+
+
+class _TcpTransport:
+    """Any-host rank processes: process-local windows served over TCP
+    (``runtime/window_server.py``) — the DCN shape of the one-sided path.
+    Addresses rendezvous through the barrier directory (one
+    ``winaddr.<rank>`` file per rank)."""
+
+    def __init__(self, bind_host: str = "0.0.0.0"):
+        from bluefog_tpu.runtime.window_server import WindowServer
+
+        self._server = WindowServer()
+        self._server.start(bind_host)
+        self._addrs: Dict[int, Tuple[str, int]] = {}
+
+    def create(self, wname: str, n_slots: int, n_elems: int) -> AsyncWindow:
+        return AsyncWindow(wname, n_slots, n_elems, np.float64)
+
+    def publish(self, barrier: FileBarrier, rank: int) -> None:
+        host, port = self._server.address
+        path = os.path.join(barrier.path, f"winaddr.{rank}")
+        with open(path + ".tmp", "w") as f:
+            f.write(f"{host}:{port}")
+        os.replace(path + ".tmp", path)
+
+    def collect(self, barrier: FileBarrier, n: int,
+                timeout_s: float = 60.0) -> None:
+        # the barrier dir may be NFS on the cross-host path: another
+        # host's winaddr file can lag the barrier (the same visibility
+        # delay FileBarrier.wait polls for), so poll here too
+        deadline = time.perf_counter() + timeout_s
+        for r in range(n):
+            path = os.path.join(barrier.path, f"winaddr.{r}")
+            while True:
+                try:
+                    with open(path) as f:
+                        host, port = f.read().strip().rsplit(":", 1)
+                    break
+                except (FileNotFoundError, ValueError):
+                    # ValueError: file visible but not fully written yet
+                    if time.perf_counter() > deadline:
+                        raise TimeoutError(
+                            f"rank {r}'s window address never appeared at "
+                            f"{path}")
+                    time.sleep(0.01)
+            self._addrs[r] = (host, int(port))
+
+    def open(self, owner: int, wname: str, n_slots: int, n_elems: int):
+        from bluefog_tpu.runtime.window_server import RemoteWindow
+
+        return _RemoteHandle(RemoteWindow(self._addrs[owner], wname),
+                             n_slots, n_elems)
+
+    def close(self) -> None:
+        self._server.stop()
+
+
 def run_async_dsgd_rank(
     topology: Topology,
     rank: int,
@@ -891,19 +993,26 @@ def run_async_dsgd_rank(
     skew_s: float = 0.0,
     name: str = "async_dsgd_mp",
     poll_interval_s: float = 0.0,
+    transport: str = "shm",
+    tcp_bind: str = "0.0.0.0",
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
     (``mpirun -np N``, one MPI rank per process; SURVEY §3.4) rather than
     :func:`run_async_dsgd`'s rank-thread model.
 
-    Each process creates its own landing window in named shared memory and
-    deposits into its out-neighbors' windows directly — cross-process
-    ``MPI_Put`` with no receiver involvement and NO barrier anywhere in the
-    training loop (``barrier`` fires exactly four times, all outside the
-    loop: windows created, deposits stopped, per-rank results published,
-    audit finished; the loop itself is rendezvous-free, which is the entire
-    point).
+    Each process creates its own landing window and deposits into its
+    out-neighbors' windows directly — cross-process ``MPI_Put`` with no
+    receiver involvement and NO barrier anywhere in the training loop
+    (``barrier`` fires exactly four times, all outside the loop: windows
+    created, deposits stopped, per-rank results published, audit finished;
+    the loop itself is rendezvous-free, which is the entire point).
+
+    ``transport`` selects the deposit fabric: ``"shm"`` (named shared
+    memory — same-host ranks) or ``"tcp"`` (each process serves its
+    process-local windows via :class:`~bluefog_tpu.runtime.window_server.
+    WindowServer`; ranks may live on DIFFERENT HOSTS as long as the
+    barrier directory is shared, e.g. NFS — the DCN deployment shape).
 
     The algorithm, mass-conservation invariant, and bias caveats are those
     of :func:`run_async_dsgd` (subgradient-push); ``skew_s`` is this rank's
@@ -914,40 +1023,54 @@ def run_async_dsgd_rank(
     ``rank`` — other ranks' loss curves stay in their processes), ``None``
     elsewhere.
     """
-    d = TreePacker(params0, np.float64).size
-    n_in = len(list(topology.in_neighbors(rank)))
-
-    # each rank owns its window name exclusively, so a leftover segment can
-    # only be stale (crashed previous run) — clean and recreate
-    shm_unlink_window(f"{name}:{rank}")
-    win = AsyncWindow(f"{name}:{rank}", max(n_in, 1), d + 1,
-                      np.float64, shm=True)
-    # every window this process opens, freed in the finally below — a
-    # mid-run exception (loss_and_grad raising, a peer dying at a barrier)
-    # must not leak named segments into /dev/shm
-    opened: List[AsyncWindow] = [win]
-
-    def _open(*args, **kwargs) -> AsyncWindow:
-        w = AsyncWindow(*args, **kwargs)
-        opened.append(w)
-        return w
-
+    if transport == "shm":
+        tx = _ShmTransport()
+    elif transport == "tcp":
+        tx = _TcpTransport(tcp_bind)
+    else:
+        raise ValueError(f"transport must be 'shm' or 'tcp', got {transport!r}")
+    # the transport may already hold live resources (the TCP server thread +
+    # socket start in its constructor): EVERYTHING from here on — including
+    # setup failures like a TreePacker TypeError or a window-name collision
+    # — must release them, so the try begins immediately
+    opened: List = []
     try:
+        d = TreePacker(params0, np.float64).size
+        n_in = len(list(topology.in_neighbors(rank)))
+
+        # every window/handle this process opens is freed in the finally —
+        # a mid-run exception (loss_and_grad raising, a peer dying at a
+        # barrier) must not leak shm segments or sockets
+        win = tx.create(f"{name}:{rank}", max(n_in, 1), d + 1)
+        opened.append(win)
+
+        def _create(wname, n_slots, n_elems):
+            w = tx.create(wname, n_slots, n_elems)
+            opened.append(w)
+            return w
+
+        def _open(owner, wname, n_slots, n_elems):
+            w = tx.open(owner, wname, n_slots, n_elems)
+            opened.append(w)
+            return w
+
         return _run_dsgd_rank_body(
             topology, rank, params0, loss_and_grad, barrier=barrier, lr=lr,
             duration_s=duration_s, skew_s=skew_s, name=name,
-            poll_interval_s=poll_interval_s, win=win, open_window=_open)
+            poll_interval_s=poll_interval_s, win=win, transport=tx,
+            create_window=_create, open_window=_open)
     finally:
         for w in opened:
             try:
                 w.free()
             except Exception:
                 pass
+        tx.close()
 
 
 def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         lr, duration_s, skew_s, name, poll_interval_s, win,
-                        open_window):
+                        transport, create_window, open_window):
     n = topology.size
     packer = TreePacker(params0, np.float64)
     d = packer.size
@@ -957,12 +1080,16 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     if rank == 0:
         # per-rank (steps, last_loss) land here so the report can carry
         # every rank's step count across the process boundary
-        shm_unlink_window(f"{name}:meta")
-        meta = open_window(f"{name}:meta", n, 2, np.float64, shm=True)
+        meta = create_window(f"{name}:meta", n, 2)
+    transport.publish(barrier, rank)
     barrier.wait("created")
+    transport.collect(barrier, n)
     if rank != 0:
-        meta = open_window(f"{name}:meta", attach=True)
-    peers = {j: open_window(f"{name}:{j}", attach=True) for j in out_nbrs}
+        meta = open_window(0, f"{name}:meta", n, 2)
+    peers = {j: open_window(
+        j, f"{name}:{j}",
+        max(len(list(topology.in_neighbors(j))), 1), d + 1)
+        for j in out_nbrs}
     peer_slot = {j: list(topology.in_neighbors(j)).index(rank)
                  for j in out_nbrs}
 
@@ -1015,7 +1142,9 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         wins.update(peers)
         for r in range(n):
             if r not in wins:
-                wins[r] = open_window(f"{name}:{r}", attach=True)
+                wins[r] = open_window(
+                    r, f"{name}:{r}",
+                    max(len(list(topology.in_neighbors(r))), 1), d + 1)
         total_mass = 0.0
         zs = np.empty((n, d))
         for r in range(n):

@@ -3,10 +3,13 @@
 ``examples/async_dsgd.py`` runs the reference's asynchronous execution model
 (``DistributedWinPutOptimizer``, SURVEY.md §3.4) with rank *threads*.  This
 example runs it the way the reference actually deploys — **one OS process
-per rank** (``mpirun -np N``): each process exposes its landing window in
-named POSIX shared memory and deposits into its neighbors' windows directly
-(``MPI_Put`` crossing a real process boundary, no receiver involvement, no
-barrier anywhere in the training loop).
+per rank** (``mpirun -np N``): each process exposes its landing window and
+deposits into its neighbors' windows directly (``MPI_Put`` crossing a real
+process boundary, no receiver involvement, no barrier anywhere in the
+training loop).  ``--transport shm`` (default) backs the windows with named
+POSIX shared memory (same-host ranks); ``--transport tcp`` serves each
+process's windows over the TCP window server — the cross-host/DCN shape,
+demoed here on loopback.
 
 Each rank-process trains a small MLP regressor on its own shard of a
 synthetic linear problem, with deliberately skewed step rates.  The parent
@@ -32,7 +35,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def worker(rank: int, n: int, bdir: str, duration_s: float, lr: float):
+def worker(rank: int, n: int, bdir: str, duration_s: float, lr: float,
+           transport: str):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -69,7 +73,8 @@ def worker(rank: int, n: int, bdir: str, duration_s: float, lr: float):
     report = run_async_dsgd_rank(
         RingGraph(n), rank, params0, loss_and_grad,
         barrier=FileBarrier(bdir, n, rank), lr=lr, duration_s=duration_s,
-        skew_s=skew_s, name=f"async_dsgd_mp_{os.path.basename(bdir)}")
+        skew_s=skew_s, name=f"async_dsgd_mp_{os.path.basename(bdir)}",
+        transport=transport, tcp_bind="127.0.0.1")
 
     if rank == 0:
         steps = report.steps_per_rank
@@ -86,7 +91,8 @@ def worker(rank: int, n: int, bdir: str, duration_s: float, lr: float):
         print(f"push-sum mass: {report.total_mass:.12f}  (== {n} exactly)")
         print(f"rank-0 loss: {l0[0]:.3f} -> {l0[-1]:.4f}")
         print(f"consensus gap: {report.consensus_gap:.2e}")
-        print("OK — async DSGD spanned real OS processes with no barrier")
+        print(f"OK — async DSGD spanned real OS processes over "
+              f"{transport} with no barrier")
     print(f"WORKER_DONE {rank}", flush=True)
 
 
@@ -95,12 +101,15 @@ def main():
     ap.add_argument("--ranks", type=int, default=2)
     ap.add_argument("--duration", type=float, default=3.0, metavar="SECONDS")
     ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--transport", choices=["shm", "tcp"], default="shm",
+                    help="deposit fabric: shm (same host) or tcp (the\n                    cross-host/DCN window server, demoed on loopback)")
     ap.add_argument("--worker", type=int, default=None, help=argparse.SUPPRESS)
     ap.add_argument("--bdir", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.worker is not None:
-        worker(args.worker, args.ranks, args.bdir, args.duration, args.lr)
+        worker(args.worker, args.ranks, args.bdir, args.duration, args.lr,
+               args.transport)
         return
 
     env = dict(os.environ)
@@ -112,7 +121,8 @@ def main():
             subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__),
                  "--ranks", str(args.ranks), "--duration", str(args.duration),
-                 "--lr", str(args.lr), "--worker", str(r), "--bdir", bdir],
+                 "--lr", str(args.lr), "--transport", args.transport,
+                 "--worker", str(r), "--bdir", bdir],
                 env=env, cwd=_REPO)
             for r in range(args.ranks)
         ]

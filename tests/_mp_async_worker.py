@@ -1,6 +1,6 @@
 """Async-DSGD multi-process test worker (one OS process per rank).
 
-argv: <rank> <nranks> <barrier_dir> <duration_s> <skew_ms>
+argv: <rank> <nranks> <barrier_dir> <duration_s> <skew_ms> [transport]
 
 Runs one rank of :func:`run_async_dsgd_rank` over a ring: cross-process
 ``MPI_Put``-style deposits through named-shm windows, NO barrier in the
@@ -33,6 +33,7 @@ def main():
     rank, nranks = int(sys.argv[1]), int(sys.argv[2])
     barrier_dir, duration_s = sys.argv[3], float(sys.argv[4])
     skew_ms = float(sys.argv[5])
+    transport = sys.argv[6] if len(sys.argv) > 6 else "shm"
 
     import jax
 
@@ -57,7 +58,8 @@ def main():
         topo, rank, params0, loss_and_grad,
         barrier=FileBarrier(barrier_dir, nranks, rank),
         lr=0.05, duration_s=duration_s, skew_s=skew_ms / 1000.0,
-        name=f"dsgd_mp_test_{os.path.basename(barrier_dir)}")
+        name=f"dsgd_mp_test_{os.path.basename(barrier_dir)}",
+        transport=transport, tcp_bind="127.0.0.1")
 
     if rank == 0:
         assert report is not None

@@ -184,11 +184,13 @@ def test_stale_segment_recovery():
     assert shm_unlink_window(name) is False  # free already unlinked
 
 
-def test_async_dsgd_two_skewed_processes():
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
+def test_async_dsgd_two_skewed_processes(transport):
     """End-to-end: 2 OS processes run skewed asynchronous DSGD through the
-    shm windows (VERDICT r3 directive #2).  Mass conservation, skew, and
-    rate-weighted convergence are asserted inside rank 0 (see
-    _mp_async_worker.py)."""
+    cross-process windows (VERDICT r3 directive #2) — over named shared
+    memory (same-host) AND over the TCP window server (the cross-host/DCN
+    shape, exercised here on loopback).  Mass conservation, skew, and
+    convergence are asserted inside rank 0 (see _mp_async_worker.py)."""
     import tempfile
 
     with tempfile.TemporaryDirectory() as bdir:
@@ -202,7 +204,7 @@ def test_async_dsgd_two_skewed_processes():
         procs = [
             subprocess.Popen(
                 [sys.executable, worker, str(r), str(nproc), bdir, "2.0",
-                 skews_ms[r]],
+                 skews_ms[r], transport],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
                 env=_clean_env(), cwd=_REPO)
             for r in range(nproc)
