@@ -55,11 +55,14 @@ V100_BASELINE_IMG_PER_SEC = 360.0
 # training step ~= 3x forward (fwd + grad wrt activations + grad wrt weights).
 RESNET50_TRAIN_FLOPS_PER_IMG_224 = 3 * 4.09e9
 
-# Last-good results cache: written after every successful run, emitted with
-# "stale": true when the TPU relay refuses device init (degraded mode) — a
-# capture must never end with *nothing* (VERDICT r3 missing #2).
-CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "BENCH_CACHE.json")
+# Last-good results cache: written after every successful TPU run, emitted
+# with "stale": true when the TPU relay refuses device init (degraded mode)
+# — a capture must never end with *nothing* (VERDICT r3 missing #2).
+# BFTPU_BENCH_CACHE overrides the location (tests).
+CACHE_PATH = os.environ.get(
+    "BFTPU_BENCH_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_CACHE.json"))
 
 # Nominal public spec sheets (bf16 dense peak TFLOP/s, HBM GB/s) keyed by
 # device_kind substring — the cross-check for the measured peak.  The relay
@@ -573,12 +576,23 @@ def main():
         devices, peak_flops, achieved_flops, best_mem, flops_per_step,
         best_batch, best_ips))
     print(json.dumps(out))
-    try:
-        with open(CACHE_PATH, "w") as f:
-            json.dump({**out, "cached_at": time.strftime(
-                "%Y-%m-%dT%H:%M:%S%z")}, f, indent=1)
-    except OSError as e:
-        print(f"bench: could not write {CACHE_PATH}: {e}", file=sys.stderr)
+    # cache ONLY real-TPU numbers: a CPU/test run must never replace the
+    # last-good on-chip value that degraded mode would later emit as stale.
+    # BFTPU_BENCH_CACHE only redirects the path; the platform gate stays
+    # authoritative unless BFTPU_BENCH_CACHE_FORCE=1 (tests).
+    platform = getattr(devices[0], "platform", "")
+    if (platform in ("tpu", "axon")
+            or os.environ.get("BFTPU_BENCH_CACHE_FORCE") == "1"):
+        try:
+            with open(CACHE_PATH, "w") as f:
+                json.dump({**out, "cached_at": time.strftime(
+                    "%Y-%m-%dT%H:%M:%S%z")}, f, indent=1)
+        except OSError as e:
+            print(f"bench: could not write {CACHE_PATH}: {e}",
+                  file=sys.stderr)
+    else:
+        print(f"bench: platform {platform!r} is not a TPU — not updating "
+              "the last-good cache", file=sys.stderr)
 
 
 if __name__ == "__main__":

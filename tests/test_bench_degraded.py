@@ -153,10 +153,61 @@ class TestDegradedMode:
         assert out["stale"] is True and out["value"] is None
         assert "cache_error" in out
 
-    def test_repo_cache_is_valid_seed(self, bench):
-        """The committed BENCH_CACHE.json must parse and carry a real
-        number, or degraded mode at the driver's capture emits nothing."""
-        with open(bench.CACHE_PATH) as f:
+    @staticmethod
+    def _run_tiny_bench(cache_path, *, force: bool):
+        import subprocess
+
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "BFTPU_BENCH_CACHE": str(cache_path),
+            "BFTPU_DEVICE_INIT_TIMEOUT_S": "120",
+        })
+        if force:
+            env["BFTPU_BENCH_CACHE_FORCE"] = "1"
+        else:
+            env.pop("BFTPU_BENCH_CACHE_FORCE", None)
+        return subprocess.run(
+            [sys.executable, "bench.py", "--batch", "2", "--image-size",
+             "32", "--steps", "2", "--warmup", "1", "--skip-peak"],
+            capture_output=True, text=True, env=env, cwd=_REPO, timeout=540)
+
+    def test_success_path_end_to_end_on_cpu_mesh(self, tmp_path):
+        """The driver's primary artifact is a SUCCESSFUL bench run; CI
+        covers that path too: a tiny pinned run on the 8-device CPU mesh
+        must emit the full JSON contract and (force-flagged) write the
+        redirected cache."""
+        cache = tmp_path / "cache.json"
+        proc = self._run_tiny_bench(cache, force=True)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["metric"] == "resnet50_images_per_sec_per_chip"
+        assert out["value"] > 0
+        assert out["batch"] == 2 and out["sweep"]
+        assert out["flops_source"] in ("xla_cost_analysis", "analytic")
+        cached = json.loads(cache.read_text())
+        assert cached["value"] == out["value"] and "cached_at" in cached
+
+    def test_cpu_platform_never_writes_the_cache(self, tmp_path):
+        """The platform gate is authoritative: without the force flag a CPU
+        run must NOT write even a redirected cache (and says so), so a
+        debug run can never replace the last-good on-chip numbers that
+        degraded mode later emits."""
+        cache = tmp_path / "cache.json"
+        proc = self._run_tiny_bench(cache, force=False)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert json.loads(proc.stdout.strip().splitlines()[-1])["value"] > 0
+        assert not cache.exists()
+        assert "not updating the last-good cache" in proc.stderr
+
+    def test_repo_cache_is_valid_seed(self):
+        """The COMMITTED BENCH_CACHE.json must parse and carry a real
+        number, or degraded mode at the driver's capture emits nothing.
+        (Deliberately not bench.CACHE_PATH: an ambient BFTPU_BENCH_CACHE
+        would redirect that away from the repo seed under test.)"""
+        with open(os.path.join(_REPO, "BENCH_CACHE.json")) as f:
             cached = json.load(f)
         assert cached["metric"] == "resnet50_images_per_sec_per_chip"
         assert cached["value"] > 0
