@@ -69,7 +69,9 @@ def worker(rank: int, n: int, bdir: str, duration_s: float, lr: float,
         loss, grads = lag(params)
         return float(loss), grads
 
-    skew_s = 0.0005 * (1.0 + 4.0 * rank / max(n - 1, 1))
+    # base sleep scales with rank count so the skew stays visible above
+    # scheduler contention when many rank processes share few cores
+    skew_s = 0.0005 * max(n - 1, 1) * (1.0 + 4.0 * rank / max(n - 1, 1))
     report = run_async_dsgd_rank(
         RingGraph(n), rank, params0, loss_and_grad,
         barrier=FileBarrier(bdir, n, rank), lr=lr, duration_s=duration_s,
