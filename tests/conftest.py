@@ -38,3 +38,25 @@ def devices8():
     d = jax.devices()
     assert len(d) == 8, f"expected 8 virtual devices, got {len(d)}"
     return d
+
+
+AOT_TOPO_NAME = "v5e:2x4"
+
+
+@pytest.fixture(scope="session")
+def tpu_aot_topology():
+    """AOT TPU topology for compile-only tests (overlap report, Pallas
+    kernel schedulability).  Skips when the topologies API or libtpu is
+    missing; anything else (e.g. a ValueError from a typo'd topology name)
+    must FAIL, not skip — PARITY.md advertises these tests as enforced
+    where libtpu exists.  Session-scoped: get_topology_desc loads the TPU
+    compiler, which is worth doing once, not per test."""
+    try:
+        from jax.experimental import topologies
+    except ImportError as e:  # API moved/removed in a jax upgrade
+        pytest.skip(f"jax topologies API unavailable: {e}")
+    try:
+        return topologies.get_topology_desc(platform="tpu",
+                                            topology_name=AOT_TOPO_NAME)
+    except RuntimeError as e:  # no libtpu on this machine
+        pytest.skip(f"TPU AOT topology unavailable: {e}")

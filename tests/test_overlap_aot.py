@@ -17,28 +17,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bluefog_tpu.utils.inspect import collective_overlap_report
 
 
-TOPO_NAME = "v5e:2x4"
-
-
-def _tpu_topology():
-    try:
-        from jax.experimental import topologies
-    except ImportError as e:  # API moved/removed in a jax upgrade
-        pytest.skip(f"jax topologies API unavailable: {e}")
-    try:
-        return topologies.get_topology_desc(platform="tpu",
-                                            topology_name=TOPO_NAME)
-    except RuntimeError as e:  # no libtpu on this machine
-        pytest.skip(f"TPU AOT topology unavailable: {e}")
-    # anything else (ValueError from a typo'd name, ...) must FAIL, not
-    # skip — PARITY.md advertises this test as enforced where libtpu exists
-
-
-def test_gossip_step_overlaps_in_compiled_tpu_schedule():
+def test_gossip_step_overlaps_in_compiled_tpu_schedule(tpu_aot_topology):
     # (benchmarks/overlap_report.py compiles the same harness shape with a
     # heavier model for the published numbers; this test stays small so the
     # suite remains fast)
-    topo = _tpu_topology()
+    topo = tpu_aot_topology
     n = len(topo.devices)  # single source for every shape below
     mesh = Mesh(np.array(topo.devices), ("bf",))
 

@@ -21,25 +21,10 @@ from bluefog_tpu.parallel.api import shard_map
 from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph
 from bluefog_tpu.topology.schedule import build_schedule
 
-TOPO_NAME = "v5e:2x4"
-
-
-def _tpu_topology():
-    try:
-        from jax.experimental import topologies
-    except ImportError as e:
-        pytest.skip(f"jax topologies API unavailable: {e}")
-    try:
-        return topologies.get_topology_desc(platform="tpu",
-                                            topology_name=TOPO_NAME)
-    except RuntimeError as e:  # no libtpu on this machine
-        pytest.skip(f"TPU AOT topology unavailable: {e}")
-
-
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
                          ids=["f32_wire", "bf16_wire"])
-def test_gossip_kernel_compiles_for_v5e(dtype):
-    topo = _tpu_topology()
+def test_gossip_kernel_compiles_for_v5e(dtype, tpu_aot_topology):
+    topo = tpu_aot_topology
     n = len(topo.devices)
     mesh = Mesh(np.array(topo.devices), ("bf",))
     sched = build_schedule(ExponentialTwoGraph(n))
@@ -55,8 +40,8 @@ def test_gossip_kernel_compiles_for_v5e(dtype):
 
 
 @pytest.mark.parametrize("accumulate", [False, True], ids=["put", "acc"])
-def test_deliver_kernel_compiles_for_v5e(accumulate):
-    topo = _tpu_topology()
+def test_deliver_kernel_compiles_for_v5e(accumulate, tpu_aot_topology):
+    topo = tpu_aot_topology
     n = len(topo.devices)
     mesh = Mesh(np.array(topo.devices), ("bf",))
     sched = build_schedule(RingGraph(n))
