@@ -133,6 +133,32 @@ def resolve_backend(backend: str, sched: GossipSchedule, x) -> str:
     return backend
 
 
+def interpret_requested() -> bool:
+    """``BLUEFOG_TPU_PALLAS_INTERPRET=1`` runs every pallas-backend op
+    through TPU-interpret emulation — the full op layers (gossip pytree
+    dispatch, window deliver with collective-id bases and masks) execute
+    their REAL pallas branch on a CPU mesh in CI, not just the bare
+    kernels the dedicated kernel tests cover.  Never set in production
+    (emulation is orders of magnitude slower).  Kernel entry points
+    resolve this themselves when ``interpret`` is left at None."""
+    import os
+
+    return os.environ.get("BLUEFOG_TPU_PALLAS_INTERPRET") == "1"
+
+
+# The interpret machinery models barrier semaphores with int16 ids; the
+# name-derived window bases (up to ~2^30) overflow it.  Under EMULATION
+# ONLY, ids are remapped through a trace-time table assigning compact
+# sequential ids — collision-free by construction (a raw modulo would fold
+# distinct windows onto one semaphore, the exact hazard the bases exist to
+# prevent).  Hardware keeps the full id space.
+_interpret_ids: dict = {}
+
+
+def _interpret_collective_id(cid: int) -> int:
+    return _interpret_ids.setdefault(cid, 1 + len(_interpret_ids))
+
+
 # CRC32 bucket -> window name that claimed it.  Two window names hashing to
 # the same bucket would silently share barrier semaphores inside one jitted
 # program — the exact hazard the name-derived base exists to prevent — so the
@@ -309,7 +335,7 @@ def neighbor_allreduce_pallas(
     self_weight=None,
     recv_weights=None,
     collective_id: int = 7,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ):
     """Fused RDMA gossip step for one array (any shape/dtype; internally a
     padded tile-aligned (R,128) block in the wire dtype — bf16 for bf16
@@ -322,6 +348,10 @@ def neighbor_allreduce_pallas(
     shifts = circulant_shifts(sched)
     if shifts is None:
         raise ValueError("pallas gossip requires a circulant schedule")
+    if interpret is None:
+        interpret = interpret_requested()
+    if interpret:
+        collective_id = _interpret_collective_id(collective_id)
     if not shifts:
         # 0-slot schedule (no edges — e.g. identity mixing): nothing to
         # exchange, and a grid-free kernel with zero receive buffers cannot
@@ -377,7 +407,7 @@ def deliver_pallas(
     *,
     accumulate: bool,
     collective_id: int = 8,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ):
     """RDMA transport for ``win_put``/``win_accumulate``: sends ``payload`` to
     every out-neighbor's landing slot; returns the updated ``(K, ...)`` slot
@@ -388,6 +418,10 @@ def deliver_pallas(
     shifts = circulant_shifts(sched)
     if shifts is None:
         raise ValueError("pallas deliver requires a circulant schedule")
+    if interpret is None:
+        interpret = interpret_requested()
+    if interpret:
+        collective_id = _interpret_collective_id(collective_id)
     if not shifts:
         # 0-slot schedule: no out-neighbors, nothing lands — the slot
         # buffers are unchanged (a zero-receive grid-free kernel cannot
