@@ -54,6 +54,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <cstdio>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -157,7 +158,11 @@ std::shared_ptr<Window> Find(const char* name) {
 // shm object name: namespaced by uid so two users on a host cannot collide,
 // '/'-free (POSIX requires exactly one leading slash).  The escape is
 // injective ('_' -> '_u', '/' -> '_s') so distinct window names can never
-// map to one shm object ("a/b" vs "a_b").
+// map to one shm object ("a/b" vs "a_b").  Names longer than NAME_MAX keep
+// a readable prefix and replace the tail with a 64-bit FNV-1a digest of the
+// FULL escaped name — a plain truncation would map every long per-rank
+// window ("<long job name>:0", ":1", ...) onto ONE segment, silently
+// crossing their deposits.
 std::string ShmName(const char* name) {
   std::string s = "/bfwin_" + std::to_string(getuid()) + "_";
   for (const char* p = name; *p; ++p) {
@@ -169,7 +174,17 @@ std::string ShmName(const char* name) {
       s.push_back(*p);
     }
   }
-  if (s.size() > 250) s.resize(250);  // NAME_MAX guard
+  if (s.size() > 250) {
+    unsigned long long h = 1469598103934665603ULL;  // FNV-1a 64
+    for (char c : s) {
+      h ^= static_cast<unsigned char>(c);
+      h *= 1099511628211ULL;
+    }
+    char digest[20];
+    snprintf(digest, sizeof(digest), "_h%016llx", h);
+    s.resize(250 - 18);
+    s += digest;
+  }
   return s;
 }
 

@@ -186,8 +186,22 @@ def window_collective_id_base(name: str) -> int:
             f"window name {name!r} collides with existing window {owner!r} "
             f"in collective-id bucket {bucket} (CRC32 % 2^20); the two would "
             "share barrier semaphores if delivered in one program — rename "
-            "one of them")
+            "one of them (or win_free the other first if it no longer "
+            "exists)")
     return 2048 + bucket * WINDOW_LEAF_CAP
+
+
+def release_window_collective_id(name: str) -> None:
+    """Release ``name``'s collective-id bucket (call when the window is
+    freed): the semaphore-sharing hazard only exists between windows
+    delivered in one program, so a FREED window must not poison its bucket
+    for the rest of a long-lived process (per-experiment window names would
+    otherwise accumulate spurious collisions)."""
+    import zlib
+
+    bucket = zlib.crc32(name.encode()) % (1 << 20)
+    if _claimed_bases.get(bucket) == name:
+        del _claimed_bases[bucket]
 
 
 def circulant_shifts(sched: GossipSchedule) -> Optional[Tuple[int, ...]]:

@@ -373,11 +373,18 @@ def win_create(x, name: str, *, topology=None, zero_init: bool = False) -> bool:
 
 def win_free(name: Optional[str] = None) -> bool:
     """Drop one window (or all, matching the reference's ``win_free()``)."""
+    from bluefog_tpu.ops import pallas_gossip as _pg
+
     ctx = get_context()
     if name is None:
+        for n in ctx.windows:
+            _pg.release_window_collective_id(n)
         ctx.windows.clear()
     else:
         ctx.windows.pop(name, None)
+        # a freed window must not poison its collective-id bucket for the
+        # rest of a long-lived process
+        _pg.release_window_collective_id(name)
     return True
 
 
@@ -477,7 +484,17 @@ def _coordination_client():
 
 
 _WIN_MUTEX_PREFIX = "bluefog_tpu/win_mutex/"
+# break subkeys live in a DISJOINT prefix: a lock key derived from a window
+# literally named "x.break" can never collide with window "x"'s break key
+_WIN_MUTEX_BREAK_PREFIX = "bluefog_tpu/win_mutex_break/"
 _LEASE_MARK = " lease_until="
+
+
+def _is_not_found(e: BaseException) -> bool:
+    """The coordination client raises (rather than returning None) for a
+    missing key; distinguish that definitive answer from transient RPC
+    failures."""
+    return "NOT_FOUND" in str(e)
 
 
 def _parse_lock_value(v: str):
@@ -613,13 +630,20 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
             # the lease period until release.  If the key is no longer ours
             # (stolen from a frozen incarnation of us), say so and STOP —
             # blindly re-stamping would silently overwrite the new holder.
+            # TRANSIENT RPC errors must NOT kill the heartbeat: the next
+            # beat is only lease_s/3 away and the lease survives two missed
+            # beats — exiting on the first blip would make a live holder
+            # silently stealable, the exact thing the lease forbids.
             from bluefog_tpu.utils import log
 
             while not stop_refresh.wait(lease_s / 3.0):
                 try:
                     cur = client.key_value_try_get(key)
-                except Exception:
-                    cur = None
+                except Exception as e:
+                    if _is_not_found(e):
+                        cur = None  # definitively gone: lost
+                    else:
+                        continue  # transient: retry next beat
                 if cur is None or _parse_lock_value(cur)[0] != owner:
                     log.error(
                         "win_mutex(%r): lease LOST (key now %r) — this "
@@ -631,7 +655,7 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
                     client.key_value_set(key, stamped(),
                                          allow_overwrite=True)
                 except Exception:
-                    return  # service gone — job is tearing down
+                    continue  # transient: the stamp retries next beat
         refresher = threading.Thread(target=refresh, daemon=True)
         refresher.start()
     try:
@@ -663,10 +687,13 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
                 if _parse_lock_value(cur)[0] == owner:
                     client.key_value_delete(key)
             except Exception as e:
-                from bluefog_tpu.utils import log
+                # a missing key is a CLEAN outcome (stolen and already
+                # released by the thief), not an RPC failure to warn about
+                if not _is_not_found(e):
+                    from bluefog_tpu.utils import log
 
-                log.warn("win_mutex(%r): release delete failed (%s); the "
-                         "lease will self-heal", name, e)
+                    log.warn("win_mutex(%r): release delete failed (%s); "
+                             "the lease will self-heal", name, e)
 
 
 class _StealTracker:
@@ -722,7 +749,8 @@ def _break_stale(client, key: str, breaker: str, observed: str) -> bool:
     import time as _time
 
     now = _time.time()
-    bkey = key + ".break"
+    assert key.startswith(_WIN_MUTEX_PREFIX), key
+    bkey = _WIN_MUTEX_BREAK_PREFIX + key[len(_WIN_MUTEX_PREFIX):]
     bval = f"{breaker}{_LEASE_MARK}{now + 10.0:.3f}/10.0"
     try:
         client.key_value_set(bkey, bval)  # atomic: one breaker at a time
@@ -785,8 +813,6 @@ def win_mutex_sweep(grace_s: float = 0.0) -> int:
     breaker = f"sweep:{_os.getpid()}:{threading.get_ident()}"
     for entry in entries:
         key = entry[0] if isinstance(entry, (tuple, list)) else entry
-        if key.endswith(".break"):
-            continue  # subkeys are owned by the break protocol itself
         try:
             value = client.key_value_try_get(key)  # fresh, never snapshot
         except Exception:

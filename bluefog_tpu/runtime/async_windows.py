@@ -173,8 +173,10 @@ class _PyWinTable:
 
 # One process-wide pool for TreePacker's parallel leaf casts (np.copyto /
 # astype release the GIL): shared across packer instances so N concurrent
-# rank loops cannot multiply idle worker threads, created under a lock,
-# daemon threads so it never blocks interpreter exit.
+# rank loops cannot multiply idle worker threads, created under a lock.
+# ThreadPoolExecutor workers are joined at interpreter exit (they are NOT
+# daemon threads); the casts are plain memory ops, so a wedged worker means
+# wedged memory — at which point exit semantics are moot.
 _CAST_WORKERS = min(8, os.cpu_count() or 1)
 _cast_pool_obj = None
 _cast_pool_mu = threading.Lock()

@@ -68,6 +68,31 @@ _ERR_NO_WINDOW = -3
 _ERR_BAD_OP = -100
 
 
+def _routable_host() -> str:
+    """Best-effort routable address of this host for wildcard binds.
+    ``gethostbyname(gethostname())`` alone is a trap: stock Debian/Ubuntu
+    /etc/hosts maps the hostname to 127.0.1.1, which would advertise a
+    loopback to remote peers.  The outbound-UDP trick (connect() sends no
+    packet; the kernel just picks the egress interface) gets the real
+    address; loopback-resolving fallbacks are rejected in favor of the
+    next method."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # no packet is sent
+            addr = s.getsockname()[0]
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    return "127.0.0.1"  # single-host fallback (tests, laptops)
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -225,10 +250,7 @@ class WindowServer:
         assert self._server is not None, "server not started"
         host, port = self._server.server_address[:2]
         if host in ("0.0.0.0", "::"):
-            try:
-                host = socket.gethostbyname(socket.gethostname())
-            except OSError:
-                host = "127.0.0.1"
+            host = _routable_host()
         return host, port
 
     def stop(self) -> None:

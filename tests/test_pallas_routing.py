@@ -152,6 +152,36 @@ def test_window_base_collision_raises(monkeypatch):
     assert pg.window_collective_id_base("stable_window") == base
 
 
+def test_window_base_released_on_free(monkeypatch):
+    """A freed window releases its bucket: per-experiment window names in a
+    long-lived process must not accumulate spurious collisions."""
+    import zlib
+
+    monkeypatch.setattr(pg, "_claimed_bases", dict(pg._claimed_bases))
+    pg.window_collective_id_base("ephemeral_win")
+    bucket = zlib.crc32(b"ephemeral_win") % (1 << 20)
+    monkeypatch.setitem(pg._claimed_bases, bucket, "ephemeral_win")
+    pg.release_window_collective_id("ephemeral_win")
+    assert bucket not in pg._claimed_bases
+    # releasing someone ELSE's bucket is a no-op
+    pg.window_collective_id_base("other_win")
+    pg.release_window_collective_id("not_the_owner")
+    assert zlib.crc32(b"other_win") % (1 << 20) in pg._claimed_bases
+
+    # end-to-end: bf.win_free releases, so re-creating under a name that
+    # shares the bucket (here: the same name) never raises
+    import bluefog_tpu as bf
+    from bluefog_tpu.topology import RingGraph
+    import jax.numpy as jnp
+
+    bf.init(topology=RingGraph(8))
+    x = jnp.ones((8, 4), jnp.float32)
+    for _ in range(3):
+        assert bf.win_create(x, "recycled_win")
+        bf.win_put(x, "recycled_win")
+        bf.win_free("recycled_win")
+
+
 def test_kill_switch(on_tpu, monkeypatch):
     sched = build_schedule(RingGraph(8))
     monkeypatch.setenv("BLUEFOG_TPU_PALLAS_GOSSIP", "0")

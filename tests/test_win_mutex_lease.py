@@ -111,9 +111,10 @@ class TestStealTracker:
 
     def test_break_subkey_held_blocks_second_breaker(self):
         c = FakeClient()
-        key = "bluefog_tpu/win_mutex/t"
+        key = A._WIN_MUTEX_PREFIX + "t"
+        bkey = A._WIN_MUTEX_BREAK_PREFIX + "t"
         c.kv[key] = stamp("0:1:1", time.time() - 5, 0.1)
-        c.kv[key + ".break"] = stamp("other", time.time() + 5)
+        c.kv[bkey] = stamp("other", time.time() + 5)
         t = self._tracker(c)
         t.poll()
         t.first_seen -= 2.0
@@ -123,11 +124,25 @@ class TestStealTracker:
 
     def test_stale_break_subkey_is_cleared(self):
         c = FakeClient()
-        key = "bluefog_tpu/win_mutex/t"
+        key = A._WIN_MUTEX_PREFIX + "t"
+        bkey = A._WIN_MUTEX_BREAK_PREFIX + "t"
         c.kv[key] = stamp("0:1:1", time.time() - 5, 0.1)
-        c.kv[key + ".break"] = stamp("dead_breaker", time.time() - 1)
+        c.kv[bkey] = stamp("dead_breaker", time.time() - 1)
         assert A._break_stale(c, key, "me", c.kv[key]) is False
-        assert key + ".break" not in c.kv  # cleared for the next attempt
+        assert bkey not in c.kv  # cleared for the next attempt
+
+    def test_break_subkey_never_collides_with_dotted_window_names(self):
+        """A lock on a window literally named 't.break' lives in the lock
+        namespace; breaking window 't' must touch only the DISJOINT break
+        prefix (a key+'.break' scheme deleted the live dotted lock)."""
+        c = FakeClient()
+        dotted = A._WIN_MUTEX_PREFIX + "t.break"
+        c.kv[dotted] = stamp("3:3:3", time.time() + 60, 30.0)  # live holder
+        key = A._WIN_MUTEX_PREFIX + "t"
+        v = stamp("0:1:1", time.time() - 5, 1.0)
+        c.kv[key] = v
+        assert A._break_stale(c, key, "me", v) is True
+        assert dotted in c.kv, "broke a live lock on a dotted window name"
 
 
 class TestBreakStale:
@@ -155,13 +170,19 @@ class TestSweep:
         c.kv[A._WIN_MUTEX_PREFIX + "dead"] = stamp("1:1:1", now - 60, 5.0)
         c.kv[A._WIN_MUTEX_PREFIX + "live"] = stamp("2:2:2", now + 60, 30.0)
         c.kv[A._WIN_MUTEX_PREFIX + "legacy"] = "3:3:3"
+        # a window LITERALLY NAMED "x.break": a normal lock (break subkeys
+        # live in a disjoint prefix and can never collide with it)
         c.kv[A._WIN_MUTEX_PREFIX + "x.break"] = stamp("b", now + 5)
         monkeypatch.setattr(A, "_coordination_client", lambda: c)
         assert A.win_mutex_sweep() == 1
         assert A._WIN_MUTEX_PREFIX + "dead" not in c.kv
         assert A._WIN_MUTEX_PREFIX + "live" in c.kv
         assert A._WIN_MUTEX_PREFIX + "legacy" in c.kv  # never auto-cleared
-        assert A._WIN_MUTEX_PREFIX + "x.break" in c.kv  # owned by breakers
+        assert A._WIN_MUTEX_PREFIX + "x.break" in c.kv  # unexpired: kept
+        # the sweep's break subkeys were cleaned up and never landed in
+        # the lock namespace
+        assert not [k for k in c.kv
+                    if k.startswith(A._WIN_MUTEX_BREAK_PREFIX)]
 
     def test_sweep_grace(self, monkeypatch):
         c = FakeClient()
