@@ -33,23 +33,26 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def run_stage(name, argv, timeout_s):
     t0 = time.time()
     stdout = ""
+    # Popen (not run): on timeout, subprocess.run's TimeoutExpired carries
+    # NO partial output on this Python — kill + drain explicitly, because
+    # for a stage that wedged the relay that partial output is the only
+    # diagnostic there will ever be.
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=_REPO)
     try:
-        proc = subprocess.run(argv, capture_output=True, text=True,
-                              timeout=timeout_s, cwd=_REPO)
+        stdout, stderr = proc.communicate(timeout=timeout_s)
         ok = proc.returncode == 0
-        stdout = proc.stdout or ""
-        tail = (stdout + (proc.stderr or ""))[-2000:]
-    except subprocess.TimeoutExpired as e:
-        # the child is killed by the timeout — this CAN wedge the relay, so
-        # budgets below are generous enough that only a truly hung child
-        # hits; keep the partial output, it is the only wedge diagnostic
+        tail = ((stdout or "") + (stderr or ""))[-2000:]
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        stdout, stderr = proc.communicate()
         ok = False
-        tail = (f"TIMEOUT after {e.timeout}s | " +
-                ((e.stdout or "") + (e.stderr or ""))[-2000:])
+        tail = (f"TIMEOUT after {timeout_s}s | " +
+                ((stdout or "") + (stderr or ""))[-2000:])
     result = {"stage": name, "ok": ok, "wall_s": round(time.time() - t0, 1),
               "tail": tail[-500:]}
     print(json.dumps(result), flush=True)
-    return ok, stdout
+    return ok, stdout or ""
 
 
 def main():

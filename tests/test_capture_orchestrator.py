@@ -1,0 +1,65 @@
+"""benchmarks/capture_onchip.py orchestration logic.
+
+The one-shot harvest runs unattended the moment a chip window opens, so
+its two guards are driver-critical: a DEGRADED bench (stale flag anywhere
+in full stdout) must stop the capture before later stages hang on the
+wedged relay, and a timed-out stage must preserve the child's partial
+output (the only wedge diagnostic there will ever be).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cap():
+    spec = importlib.util.spec_from_file_location(
+        "capture_onchip", os.path.join(_REPO, "benchmarks",
+                                       "capture_onchip.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_run_stage_success_returns_full_stdout(cap, capsys):
+    ok, stdout = cap.run_stage(
+        "probe", [sys.executable, "-c", "print('x' * 3000); print('MARK')"],
+        timeout_s=60)
+    assert ok is True
+    # FULL stdout comes back (the stale scan must not be limited to a
+    # tail: the marker can sit >2000 chars before the end)
+    assert "MARK" in stdout and len(stdout) > 3000
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["stage"] == "probe" and line["ok"] is True
+
+
+def test_run_stage_failure_and_stderr_tail(cap, capsys):
+    ok, _ = cap.run_stage(
+        "boom", [sys.executable, "-c",
+                 "import sys; print('partial'); sys.exit(3)"],
+        timeout_s=60)
+    assert ok is False
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "partial" in line["tail"]
+
+
+def test_run_stage_timeout_keeps_partial_output(cap, capsys):
+    # timeout must comfortably exceed interpreter startup on a loaded box,
+    # or the child is killed before it ever prints
+    ok, _ = cap.run_stage(
+        "hang", [sys.executable, "-u", "-c",
+                 "import time; print('got this far', flush=True); "
+                 "time.sleep(120)"],
+        timeout_s=15)
+    assert ok is False
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "TIMEOUT" in line["tail"]
+    assert "got this far" in line["tail"], (
+        "a timed-out stage must keep the child's partial output — it is "
+        "the only wedge diagnostic")
