@@ -31,22 +31,36 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_stage(name, argv, timeout_s):
+    import signal
+
     t0 = time.time()
     stdout = ""
     # Popen (not run): on timeout, subprocess.run's TimeoutExpired carries
     # NO partial output on this Python — kill + drain explicitly, because
     # for a stage that wedged the relay that partial output is the only
-    # diagnostic there will ever be.
+    # diagnostic there will ever be.  start_new_session: the kill must
+    # take the whole process GROUP — a wedged grandchild still holding the
+    # relay claim (or the pipe write-end, which would hang the drain)
+    # survives a plain proc.kill().
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True, cwd=_REPO)
+                            stderr=subprocess.PIPE, text=True, cwd=_REPO,
+                            start_new_session=True)
     try:
         stdout, stderr = proc.communicate(timeout=timeout_s)
         ok = proc.returncode == 0
         tail = ((stdout or "") + (stderr or ""))[-2000:]
     except subprocess.TimeoutExpired:
-        proc.kill()
-        stdout, stderr = proc.communicate()
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
         ok = False
+        try:
+            # bounded: a surviving pipe-holder must not convert a stage
+            # timeout into an orchestrator-wide hang
+            stdout, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            stdout, stderr = "", ""
         tail = (f"TIMEOUT after {timeout_s}s | " +
                 ((stdout or "") + (stderr or ""))[-2000:])
     result = {"stage": name, "ok": ok, "wall_s": round(time.time() - t0, 1),

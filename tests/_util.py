@@ -1,0 +1,18 @@
+"""Shared helpers for the test suite."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_script(relpath: str):
+    """Import a repo script (bench.py, benchmarks/*.py) as a module — these
+    live outside the package, so the ordinary import system can't see
+    them.  One canonical loader, not one copy per test file."""
+    path = os.path.join(REPO, relpath)
+    name = os.path.splitext(os.path.basename(relpath))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

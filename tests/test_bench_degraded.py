@@ -8,23 +8,18 @@ and perf numbers must carry plausibility gates (the relay has produced
 measured "peaks" off by >1000x from any physical chip).
 """
 
-import importlib.util
 import json
 import os
 import sys
 
 import pytest
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._util import REPO as _REPO, load_script
 
 
 @pytest.fixture(scope="module")
 def bench():
-    spec = importlib.util.spec_from_file_location(
-        "bench", os.path.join(_REPO, "bench.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return load_script("bench.py")
 
 
 class FakeDev:
