@@ -1,9 +1,9 @@
 """Pod-scale wire census: gossip vs allreduce, from compiled programs.
 
-No multi-chip hardware is needed for the SCALING story: compile the real
-gossip step on abstract meshes of growing size and read what actually goes
-on the wire (collective-permute count and payload bytes from the optimized
-HLO), next to the standard ring-allreduce cost model.  This is the
+No multi-chip hardware is needed for the SCALING story: lower the real
+gossip step on abstract meshes of growing size, read the collective-permute
+op count from the StableHLO, and put it next to the analytic byte model for
+each strategy (ring allreduce uses the standard cost model throughout).  This is the
 reference's core claim made concrete (neighbor_allreduce scales better at
 high node counts because its per-step wire cost and dependency depth do
 not grow with the mesh):
@@ -26,6 +26,10 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
+
+jax.config.update("jax_platforms", "cpu")  # compile-only analysis: never
+# touch an accelerator backend (the axon relay can hang device init)
+
 import jax.numpy as jnp
 from jax.sharding import AbstractMesh, PartitionSpec as P
 
@@ -41,7 +45,7 @@ def census(n: int, param_bytes: int):
     sched = build_schedule(ExponentialTwoGraph(n))
 
     fn = jax.jit(shard_map(
-        lambda v: C.neighbor_allreduce(v, sched, "bf"),
+        lambda v: C.neighbor_allreduce(v, sched, "bf", backend="xla"),
         mesh=mesh, in_specs=(P("bf"),), out_specs=P("bf"), check_vma=False))
     hlo = fn.lower(leaf).as_text()
     k = hlo.count("collective_permute") or hlo.count("collective-permute")
