@@ -178,6 +178,36 @@ class TestDegreeCapped:
         got = np.asarray(jitted(jnp.asarray(xs), jnp.asarray(w, jnp.float32)))
         assert np.isnan(got).all()
 
+    def test_fuzz_cap_vs_full_and_overflow(self):
+        """Randomized: for random circulant-sparse W, capped == full when
+        the cap covers the active rotations, NaN-poisoned when it cannot."""
+        rng = np.random.default_rng(11)
+        xs = rng.standard_normal((N, 4)).astype(np.float32)
+        jit_cache = {}
+
+        def run(cap):
+            if cap not in jit_cache:
+                jit_cache[cap] = self._jit(cap)
+            return jit_cache[cap]
+
+        for trial in range(8):
+            n_active = int(rng.integers(1, 5))
+            shifts = rng.choice(range(1, N), size=n_active, replace=False)
+            w = np.zeros((N, N))
+            for i in range(N):
+                w[i, i] = 0.5
+                for s in shifts:
+                    w[i, (i - s) % N] = 0.5 / n_active
+            got = run(4)(jnp.asarray(xs), jnp.asarray(w, jnp.float32))
+            np.testing.assert_allclose(np.asarray(got), w @ xs, rtol=1e-5,
+                                       atol=1e-5, err_msg=f"trial {trial}")
+            if n_active > 1:
+                under = run(n_active - 1)(jnp.asarray(xs),
+                                          jnp.asarray(w, jnp.float32))
+                assert np.isnan(np.asarray(under)).all(), (
+                    f"trial {trial}: cap {n_active - 1} < {n_active} active "
+                    "rotations must poison, not drop edges")
+
     def test_compile_census_n64(self):
         """Program-size census at n=64 (pod-scale proxy): the capped
         program must contain an order-of-magnitude fewer collective
