@@ -155,6 +155,41 @@ def test_concurrent_cross_process_accumulates_never_lose_updates():
         win.free()
 
 
+def test_fuzz_against_reference_model():
+    """Randomized op sequence vs a pure-Python model of the table: the
+    rewritten segment layout (csrc/windows.cc) must agree on every deposit
+    count, freshness counter, and buffer value."""
+    name = _uniq("shm_fuzz")
+    rng = np.random.default_rng(3)
+    k, n = 3, 5
+    win = AsyncWindow(name, n_slots=k, n_elems=n, dtype=np.float64, shm=True)
+    model = {s: {"buf": np.zeros(n), "dep": 0, "fresh": 0} for s in range(k)}
+    try:
+        for step in range(300):
+            slot = int(rng.integers(k))
+            if rng.random() < 0.6:
+                v = rng.standard_normal(n)
+                acc = bool(rng.random() < 0.7)
+                got = win.deposit(slot, v, accumulate=acc)
+                m = model[slot]
+                m["buf"] = m["buf"] + v if acc else v.copy()
+                m["dep"] += 1
+                m["fresh"] += 1
+                assert got == m["dep"], step
+            else:
+                consume = bool(rng.random() < 0.5)
+                buf, fresh = win.read(slot, consume=consume)
+                m = model[slot]
+                assert fresh == m["fresh"], step
+                np.testing.assert_allclose(buf, m["buf"], atol=1e-12,
+                                           err_msg=f"step {step}")
+                if consume:
+                    m["buf"] = np.zeros(n)
+                    m["fresh"] = 0
+    finally:
+        win.free()
+
+
 def test_attach_timeout_is_loud():
     with pytest.raises(RuntimeError, match="did not publish"):
         AsyncWindow(_uniq("shm_nobody"), attach=True, attach_timeout_s=0.05)
