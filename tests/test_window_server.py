@@ -122,6 +122,57 @@ def test_stop_quiesces_live_connections():
         win.free()
 
 
+def test_fuzz_protocol_against_reference_model():
+    """Randomized op stream over ONE persistent connection vs a Python
+    model: any framing/desync bug in the wire protocol shows up as a
+    mismatched counter or buffer within a few ops."""
+    from bluefog_tpu.runtime.async_windows import AsyncWindow
+    from bluefog_tpu.runtime.window_server import RemoteWindow, WindowServer
+
+    name = _uniq("ws_fuzz")
+    rng = np.random.default_rng(5)
+    k, n = 2, 4
+    win = AsyncWindow(name, n_slots=k, n_elems=n, dtype=np.float64)
+    srv = WindowServer()
+    _, port = srv.start("127.0.0.1")
+    model = {s: {"buf": np.zeros(n), "dep": 0, "fresh": 0} for s in range(k)}
+    self_model = np.zeros(n)
+    try:
+        rw = RemoteWindow(("127.0.0.1", port), name)
+        for step in range(200):
+            r = rng.random()
+            slot = int(rng.integers(k))
+            if r < 0.45:
+                v = rng.standard_normal(n)
+                acc = bool(rng.random() < 0.7)
+                got = rw.deposit(slot, v, accumulate=acc)
+                m = model[slot]
+                m["buf"] = m["buf"] + v if acc else v.copy()
+                m["dep"] += 1
+                m["fresh"] += 1
+                assert got == m["dep"], step
+            elif r < 0.8:
+                consume = bool(rng.random() < 0.5)
+                buf, fresh = rw.read(slot, n, np.float64, consume=consume)
+                m = model[slot]
+                assert fresh == m["fresh"], step
+                np.testing.assert_allclose(buf, m["buf"], atol=1e-12,
+                                           err_msg=f"step {step}")
+                if consume:
+                    m["buf"] = np.zeros(n)
+                    m["fresh"] = 0
+            elif r < 0.9:
+                self_model = rng.standard_normal(n)
+                win.set_self(self_model)  # owner-side publish
+            else:
+                np.testing.assert_allclose(rw.read_self(n, np.float64),
+                                           self_model, atol=1e-12)
+        rw.close()
+    finally:
+        srv.stop()
+        win.free()
+
+
 def test_deposit_crosses_host_boundary_processes():
     """Owner process (subprocess) exposes a window via WindowServer; this
     process deposits over TCP; the owner observes the mass with no
