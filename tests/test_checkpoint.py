@@ -147,6 +147,29 @@ def test_restart_counts_recovery_failures(tmp_path):
         run_with_restart(train, mgr, {"w": jnp.zeros((2,))}, max_restarts=1)
 
 
+def test_restart_sweeps_expired_win_mutex_leases(tmp_path, monkeypatch):
+    """The restart path is ALSO the lock janitor: between a failure and the
+    re-entry, expired win_mutex leases (e.g. held by a worker thread the
+    failure killed) are swept so the retry cannot deadlock on them."""
+    from bluefog_tpu.parallel import api as papi
+
+    calls = []
+    monkeypatch.setattr(papi, "win_mutex_sweep",
+                        lambda *a, **k: calls.append(1) or 2)
+    mgr = CheckpointManager(str(tmp_path))
+    attempts = []
+
+    def train(state, start):
+        attempts.append(start)
+        if len(attempts) == 1:
+            raise RuntimeError("first attempt dies holding locks")
+        return state
+
+    run_with_restart(train, mgr, {"w": jnp.zeros((2,))}, max_restarts=2)
+    assert len(attempts) == 2
+    assert calls, "win_mutex_sweep never ran between attempts"
+
+
 class TestElasticResume:
     """Re-topology: resume a checkpoint written at world N on M ranks."""
 
