@@ -11,10 +11,25 @@ that remain genuinely host-side are implemented natively in
   parity: ``operations.cc``/``tensor_queue.cc``/``handle_manager.cc``) for
   checkpoint IO, DCN staging, and other host work overlapped with the step;
 - chrome-trace timeline writer thread (parity: ``timeline.cc``);
-- leveled logging (parity: ``logging.cc``).
+- leveled logging (parity: ``logging.cc``);
+- passive-target window table (parity: ``mpi_win_ops.cc`` storage manager +
+  ``mpi_controller.cc`` Win*) with three transports: in-process
+  (``async_windows.AsyncWindow``), named shared memory (same-host
+  processes, ``shm=True``), and the TCP window server (cross-host/DCN,
+  ``window_server``).
 """
 
+from bluefog_tpu.runtime.async_windows import (AsyncWindow, FileBarrier,
+                                               TreePacker, run_async_dsgd,
+                                               run_async_dsgd_rank,
+                                               run_async_pushsum)
 from bluefog_tpu.runtime.launch import initialize_cluster
 from bluefog_tpu.runtime.native import Engine, PyEngine, engine
+from bluefog_tpu.runtime.window_server import RemoteWindow, WindowServer
 
-__all__ = ["initialize_cluster", "Engine", "PyEngine", "engine"]
+__all__ = [
+    "initialize_cluster", "Engine", "PyEngine", "engine",
+    "AsyncWindow", "TreePacker", "FileBarrier",
+    "run_async_pushsum", "run_async_dsgd", "run_async_dsgd_rank",
+    "WindowServer", "RemoteWindow",
+]
