@@ -1,0 +1,36 @@
+"""The profiler sink-table tooling, end to end on a CPU trace.
+
+PROFILE.md §4's per-op table waits on a live chip, but the TOOLING must
+not: jax.profiler traces capture on any backend, so CI proves the whole
+path (trace dir discovery → trace.json.gz parse → device-time aggregation
+→ table) works before the chip ever answers.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from tests._util import REPO
+
+
+def test_profile_summary_end_to_end(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((256, 256))
+    f(x).block_until_ready()  # compile outside the trace
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            f(x).block_until_ready()
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join("benchmarks", "profile_summary.py"),
+         trace_dir],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    # a real table came out: headered rows with durations and percentages
+    assert "%" in proc.stdout
+    assert any(ln.strip() for ln in proc.stdout.splitlines()[1:]), \
+        proc.stdout
