@@ -2,8 +2,29 @@
 
 import importlib.util
 import os
+import uuid
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def clean_env(*, cpu_pin: bool = True) -> dict:
+    """Subprocess environment for worker processes: repo importable, the
+    pytest process's 8-device XLA forcing dropped (workers set their own),
+    and — unless ``cpu_pin=False`` — pinned away from the TPU relay (a
+    plain ``python`` child would otherwise claim the chip)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if cpu_pin:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def uniq(tag: str) -> str:
+    """Collision-free resource name (shm segments, window names) so
+    parallel or crashed test runs cannot alias each other's state."""
+    return f"{tag}_{uuid.uuid4().hex[:8]}"
 
 
 def load_script(relpath: str):

@@ -17,7 +17,8 @@ import sys
 
 import pytest
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._util import REPO as _REPO, clean_env
+
 _WORKER = os.path.join(_REPO, "tests", "_mp_worker.py")
 
 
@@ -28,12 +29,9 @@ def _free_port() -> int:
 
 
 def _clean_env():
-    env = dict(os.environ)
-    # the workers configure their own platform/device-count; drop the pytest
-    # process's 8-device forcing so each worker gets exactly 2
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return env
+    # the workers configure their own platform/device-count (2 each) and
+    # pin cpu themselves before importing jax
+    return clean_env(cpu_pin=False)
 
 
 @pytest.mark.parametrize("nproc", [2])

@@ -12,7 +12,6 @@ convergence asserted inside the workers).
 import os
 import subprocess
 import sys
-import uuid
 
 import numpy as np
 import pytest
@@ -20,31 +19,17 @@ import pytest
 from bluefog_tpu.runtime import native
 from bluefog_tpu.runtime.async_windows import (AsyncWindow,
                                                shm_unlink_window)
-
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._util import REPO as _REPO, clean_env as _clean_env, uniq as _uniq
 
 pytestmark = pytest.mark.skipif(
     native.load() is None, reason="native runtime unavailable (shm windows "
     "require process-shared pthread mutexes)")
 
 
-def _clean_env():
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return env
-
-
 def _run(code: str, timeout=120) -> subprocess.CompletedProcess:
     return subprocess.run([sys.executable, "-c", code], capture_output=True,
                           text=True, env=_clean_env(), cwd=_REPO,
                           timeout=timeout)
-
-
-def _uniq(tag: str) -> str:
-    return f"{tag}_{uuid.uuid4().hex[:8]}"
 
 
 def test_deposit_crosses_process_boundary():

@@ -11,21 +11,15 @@ and loud errors for missing windows / size mismatches.
 import os
 import subprocess
 import sys
-import uuid
 
 import numpy as np
 import pytest
 
 from bluefog_tpu.runtime import native
-
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._util import REPO as _REPO, clean_env, uniq as _uniq
 
 pytestmark = pytest.mark.skipif(
     native.load() is None, reason="native runtime unavailable")
-
-
-def _uniq(tag):
-    return f"{tag}_{uuid.uuid4().hex[:8]}"
 
 
 def test_remote_deposit_roundtrip_same_process():
@@ -198,9 +192,7 @@ def test_deposit_crosses_host_boundary_processes():
         "srv.stop(); w.free()\n"
         "print('OWNER_OK', flush=True)\n"
     )
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = clean_env()
     proc = subprocess.Popen([sys.executable, "-c", code],
                             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env,
