@@ -10,20 +10,28 @@ import os
 import subprocess
 import sys
 
-import jax
-import jax.numpy as jnp
-
-from tests._util import REPO
+from tests._util import REPO, clean_env
 
 
 def test_profile_summary_end_to_end(tmp_path):
     trace_dir = str(tmp_path / "trace")
-    f = jax.jit(lambda x: (x @ x).sum())
-    x = jnp.ones((256, 256))
-    f(x).block_until_ready()  # compile outside the trace
-    with jax.profiler.trace(trace_dir):
-        for _ in range(3):
-            f(x).block_until_ready()
+    # capture in a FRESH process: the pytest process may already hold (or
+    # have torn down) a profiler session from other tests, and a second
+    # in-process jax.profiler.trace can fail order-dependently
+    capture = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda x: (x @ x).sum())\n"
+        "x = jnp.ones((256, 256))\n"
+        "f(x).block_until_ready()\n"
+        f"with jax.profiler.trace({trace_dir!r}):\n"
+        "    for _ in range(3):\n"
+        "        f(x).block_until_ready()\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", capture],
+                          capture_output=True, text=True, env=clean_env(),
+                          cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-1000:]
 
     proc = subprocess.run(
         [sys.executable, os.path.join("benchmarks", "profile_summary.py"),
