@@ -91,6 +91,7 @@ def decentralized_optimizer(
     local_size: int = 1,
     machine_topology=None,
     backend: str = "auto",
+    max_rotations: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap ``base`` so each update also performs decentralized averaging.
 
@@ -113,6 +114,11 @@ def decentralized_optimizer(
       backend: gossip transport — 'xla' (ppermute), 'pallas' (fused RDMA
         kernels), or 'auto' (per
         :func:`bluefog_tpu.ops.pallas_gossip.auto_gossip_backend`).
+      max_rotations: program-size cap for the CALLABLE-topology (aperiodic)
+        mode at pod scale — D runtime-shift rotation slots instead of the
+        full n-1 decomposition; exceeding D active rotations NaN-poisons
+        the output (see
+        :func:`bluefog_tpu.ops.collectives.neighbor_allreduce_aperiodic`).
 
     Returns an ``optax.GradientTransformation`` whose ``update`` REQUIRES
     ``params``; the returned updates fold the communication in, so plain
@@ -134,6 +140,13 @@ def decentralized_optimizer(
             matrix_fn = topology
         else:
             scheds = _as_schedules(topology)
+    if max_rotations is not None and matrix_fn is None:
+        # silently ignoring the cap would let the full uncapped program
+        # build at pod scale — the exact blowup the parameter exists to stop
+        raise ValueError(
+            "max_rotations applies only to the callable-topology "
+            "(aperiodic) mode; static topologies/schedules compile one "
+            "ppermute per edge slot already")
     mscheds = None
     if ct == CommunicationType.hierarchical_neighbor_allreduce:
         if machine_topology is None:
@@ -154,7 +167,8 @@ def decentralized_optimizer(
             if matrix_fn is not None:
                 return C.fuse_apply(
                     lambda t: C.neighbor_allreduce_aperiodic(
-                        t, matrix_fn(count), axis_name), params)
+                        t, matrix_fn(count), axis_name,
+                        max_rotations=max_rotations), params)
             return C.fuse_apply(
                 lambda t: _gossip(t, scheds, count, axis_name, backend),
                 params)
@@ -230,6 +244,7 @@ def DistributedNeighborAllreduceOptimizer(
     atc: bool = False,
     num_steps_per_communication: int = 1,
     backend: str = "auto",
+    max_rotations: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Reference ``bf.DistributedNeighborAllreduceOptimizer`` (confirmed in
     BASELINE.json): decentralized gossip averaging of parameters each step."""
@@ -237,7 +252,7 @@ def DistributedNeighborAllreduceOptimizer(
         base, topology, axis_name,
         communication_type=CommunicationType.neighbor_allreduce,
         atc=atc, num_steps_per_communication=num_steps_per_communication,
-        backend=backend,
+        backend=backend, max_rotations=max_rotations,
     )
 
 

@@ -239,6 +239,56 @@ class TestDegreeCapped:
         assert len(capped) < len(full), (len(capped), len(full))
 
 
+def _optimizer_harness(opt, mesh):
+    """(init, jitted_step) over the stacked rank representation for an
+    optimizer — shared by the callable-topology tests."""
+    init = jax.jit(shard_map(
+        lambda q: jax.tree_util.tree_map(
+            lambda t: jnp.asarray(t)[None], opt.init(q[0])),
+        mesh=mesh, in_specs=(P("bf"),), out_specs=P("bf"), check_vma=False))
+
+    def step_fn(p, st, g):
+        upd, st = opt.update(g, st, p)
+        return optax.apply_updates(p, upd), st
+
+    jitted = jax.jit(shard_map(
+        lambda q, s, g: jax.tree_util.tree_map(
+            lambda t: t[None],
+            step_fn(q[0], jax.tree_util.tree_map(lambda t: t[0], s), g[0])),
+        mesh=mesh, in_specs=(P("bf"),) * 3, out_specs=P("bf"),
+        check_vma=False))
+    return init, jitted
+
+
+def test_optimizer_callable_topology_respects_cap():
+    """max_rotations reaches the optimizer's aperiodic path: a capped
+    one-peer training run is bit-compatible with the uncapped one, and the
+    cap is rejected outside the aperiodic mode."""
+    mesh = _mesh()
+
+    def run(cap):
+        opt = DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), topology=functools.partial(
+                one_peer_exp2_mixing_matrix, N),
+            axis_name="bf", atc=True, max_rotations=cap)
+        init, jitted = _optimizer_harness(opt, mesh)
+        rng = np.random.default_rng(4)
+        p = jnp.asarray(rng.standard_normal((N, 6)), jnp.float32)
+        st = init(p)
+        for step in range(3):
+            g = jnp.asarray(rng.standard_normal((N, 6)), jnp.float32)
+            p, st = jitted(p, st, g)
+        return np.asarray(p)
+
+    np.testing.assert_allclose(run(1), run(None), rtol=1e-5, atol=1e-6)
+
+    from bluefog_tpu.topology import RingGraph
+    with pytest.raises(ValueError, match="callable-topology"):
+        DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), topology=RingGraph(N), axis_name="bf",
+            max_rotations=2)
+
+
 def test_optimizer_callable_topology_one_compile():
     """DistributedNeighborAllreduceOptimizer(topology=callable) gossips a
     different edge set every step inside ONE compiled train step, and the
