@@ -163,6 +163,48 @@ class TestBreakStale:
         assert key not in c.kv and key + ".break" not in c.kv
 
 
+class TestHeartbeat:
+    def test_transient_rpc_errors_do_not_kill_the_refresher(self, monkeypatch):
+        """One RPC blip must not stop the lease heartbeat — a live holder
+        would otherwise become silently stealable (round-4 review)."""
+        import threading
+
+        class FlakyClient(FakeClient):
+            def __init__(self):
+                super().__init__()
+                self.set_calls = 0
+                self.get_calls = 0
+
+            def key_value_try_get(self, key):
+                self.get_calls += 1
+                if self.get_calls == 2:
+                    raise RuntimeError("DEADLINE_EXCEEDED: service busy")
+                return super().key_value_try_get(key)
+
+            def key_value_set(self, key, value, allow_overwrite=False):
+                self.set_calls += 1
+                if self.set_calls == 2:
+                    raise RuntimeError("UNAVAILABLE: connection blip")
+                super().key_value_set(key, value, allow_overwrite)
+
+        c = FlakyClient()
+        monkeypatch.setattr(A, "_coordination_client", lambda: c)
+        # pretend multi-controller context state
+        monkeypatch.setattr(A, "_dist_held", threading.local(),
+                            raising=False)
+        key = A._WIN_MUTEX_PREFIX + "hb"
+        stamps = []
+        with A.win_mutex("hb", lease_s=0.3):
+            deadline = time.monotonic() + 1.2
+            while time.monotonic() < deadline:
+                if key in c.kv:
+                    stamps.append(c.kv[key])
+                time.sleep(0.05)
+        # the heartbeat survived both injected failures and kept re-stamping
+        assert len(set(stamps)) >= 3, set(stamps)
+        assert key not in c.kv  # released cleanly
+
+
 class TestSweep:
     def test_sweep_uses_fresh_reads_and_break_protocol(self, monkeypatch):
         c = FakeClient()
