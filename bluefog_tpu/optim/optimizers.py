@@ -332,7 +332,12 @@ def DistributedWinPutOptimizer(
       window table with no barrier anywhere (the reference MPI backend's
       actual execution model).  ``base`` is ignored in this mode (the
       subgradient-push update is plain SGD on the de-biased iterate); pass
-      the learning rate via ``lr``.
+      the learning rate via ``lr``.  The async mode's rank loops are
+      THREADS of this process; for the reference's literal deployment shape
+      — one OS process per rank, windows in shared memory or served over
+      TCP across hosts — drive
+      :func:`~bluefog_tpu.runtime.async_windows.run_async_dsgd_rank` from
+      your per-process launcher instead (``examples/async_dsgd_mp.py``).
     """
     if async_:
         from bluefog_tpu.runtime.async_windows import AsyncWinPutOptimizer
