@@ -167,6 +167,49 @@ def test_fuzz_protocol_against_reference_model():
         win.free()
 
 
+def test_concurrent_remote_writers_never_lose_updates():
+    """Two client connections (each its own server handler thread) hammer
+    one slot with accumulates while the owner occasionally peeks: the
+    native slot mutex serializes every read-modify-write end to end
+    through the TCP path."""
+    import threading
+
+    from bluefog_tpu.runtime.async_windows import AsyncWindow
+    from bluefog_tpu.runtime.window_server import RemoteWindow, WindowServer
+
+    name = _uniq("ws_race")
+    reps = 150
+    win = AsyncWindow(name, n_slots=1, n_elems=6, dtype=np.float64)
+    srv = WindowServer()
+    _, port = srv.start("127.0.0.1")
+    errors = []
+    try:
+        def writer(value):
+            try:
+                rw = RemoteWindow(("127.0.0.1", port), name)
+                p = np.full(6, value)
+                for _ in range(reps):
+                    rw.deposit(0, p, accumulate=True)
+                rw.close()
+            except BaseException as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=writer, args=(v,)) for v in (1.0, 5.0)]
+        for t in ts:
+            t.start()
+        for _ in range(20):
+            win.read(0, consume=False)  # owner peeks mid-race
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 2 * reps
+        np.testing.assert_allclose(buf, np.full(6, reps * 6.0))
+    finally:
+        srv.stop()
+        win.free()
+
+
 def test_deposit_crosses_host_boundary_processes():
     """Owner process (subprocess) exposes a window via WindowServer; this
     process deposits over TCP; the owner observes the mass with no
