@@ -70,3 +70,29 @@ def test_timeline_flag_writes_trace(tmp_path):
     assert r.returncode == 0, r.stderr
     events = json.loads(trace.read_text())
     assert any(e["name"] == "launcher_span" for e in events)
+
+
+def test_interactive_repl_smoke():
+    """ibfrun-tpu (the ibfrun analog) brings the framework up and serves a
+    REPL: pipe a command stream in, assert the banner, evaluated output,
+    and a clean exit."""
+    import subprocess
+    import sys
+
+    from tests._util import REPO, clean_env
+
+    code = "print('SIZE', bf.size(), ctx.axis_name)\n"
+    env = clean_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu')\n"
+         "from bluefog_tpu.runtime.launch import interactive_main\n"
+         "interactive_main(['--topology', 'ring'])"],
+        input=code, capture_output=True, text=True, env=env, cwd=REPO,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    banner_and_out = proc.stdout + proc.stderr  # code.interact banners -> stderr
+    assert "bluefog_tpu interactive" in banner_and_out
+    assert "topology=ring" in banner_and_out
+    assert "SIZE 8" in proc.stdout
