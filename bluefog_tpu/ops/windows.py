@@ -19,10 +19,14 @@ pytree) threaded through the training step.
   ``ncclSend``/``ncclRecv``; SURVEY.md §2.4), but the *semantics* are
   one-sided: the destination's values are not consumed until ``win_update``,
   and puts/accumulates from different steps interleave freely.
-- TPU backend (``bluefog_tpu.ops.pallas_windows``): within a slice the same
-  state transitions run as Pallas async remote DMA
-  (``pltpu.make_async_remote_copy``), making the transfer genuinely one-sided
-  at the hardware level.
+- TPU backend (``bluefog_tpu.ops.pallas_gossip.deliver_pallas``, routed by
+  ``backend='auto'|'pallas'``): within a slice the same state transitions
+  run as Pallas async remote DMA (``pltpu.make_async_remote_copy``),
+  making the transfer genuinely one-sided at the hardware level.
+- Host runtime (``bluefog_tpu.runtime.async_windows`` + the shm/TCP
+  transports): the genuinely *asynchronous* execution model — ranks at
+  independent rates, deposits crossing thread/process/host boundaries with
+  no receiver involvement.
 
 All ops are jit-compatible and pytree-polymorphic.
 """
