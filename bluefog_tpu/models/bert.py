@@ -5,7 +5,7 @@ with hierarchical_neighbor_allreduce).
 TPU-first: bf16 activations/matmuls with f32 layernorm + softmax, head and
 hidden dims multiples of 128 (MXU tiles), fused QKV projection, no dynamic
 shapes.  The attention core later swaps in the ring-attention layer
-(``bluefog_tpu.parallel.ring_attention``) for sequence parallelism.
+(``bluefog_tpu.ops.ring_attention``) for sequence parallelism.
 """
 
 from __future__ import annotations
