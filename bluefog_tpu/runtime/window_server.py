@@ -31,6 +31,15 @@ Connections are persistent (a peer ranks' deposit stream reuses one
 socket); the server is a daemon ``ThreadingTCPServer`` writing straight
 into the process's native window table, so owner threads never
 participate in a transfer — deposits land while the owner computes.
+
+Trust model, stated plainly: the protocol is UNAUTHENTICATED (a magic
+word rejects accidental cross-talk, nothing more) — the same posture as
+the MPI/NCCL transports it replaces, which also trust the cluster
+network.  Bind to a cluster-internal interface (``start(host=...)``);
+never expose the port beyond the training fabric.  Malformed requests
+cannot corrupt the owner (geometry is validated against the window's
+actual shape before any allocation or native call), but a network-level
+writer CAN deposit garbage values, as it can with MPI.
 """
 
 from __future__ import annotations
