@@ -28,13 +28,20 @@ def find_trace(root):
     raise SystemExit(f"no *.trace.json.gz under {root}")
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("trace_dir")
-    ap.add_argument("--top", type=int, default=15)
-    args = ap.parse_args()
+def device_op_totals(trace_dir):
+    """Per-op device time from the latest trace under ``trace_dir``.
 
-    path = find_trace(args.trace_dir)
+    Returns ``(path, by_op, total_us, n_lanes, device_events)``: the trace
+    file used, total duration (µs) per base op name, their sum across ALL
+    contributing lanes, the number of distinct event lanes (one "XLA Ops"
+    thread per local device — a per-chip figure must divide by this), and
+    whether the events actually came from a device-side lane rather than
+    host threads.  ``bench.py`` uses the total as ground truth for its
+    wall-clock timing (the device cannot lie about its own op durations the
+    way a remote relay's clock can); this CLI uses ``by_op`` for the sink
+    table.
+    """
+    path = find_trace(trace_dir)
     with gzip.open(path, "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", data if isinstance(data, list) else [])
@@ -66,6 +73,7 @@ def main():
 
     by_op = defaultdict(float)
     total = 0.0
+    lanes = set()
     for e in events:
         if e.get("ph") != "X" or "dur" not in e or not selected(e):
             continue
@@ -74,10 +82,27 @@ def main():
         base = re.sub(r"[.\d]+$", "", name) or name
         by_op[base] += e["dur"]
         total += e["dur"]
+        lanes.add((e.get("pid"), e.get("tid")))
 
+    # A lane count is only a chip count when the lanes are the labeled
+    # per-device "XLA Ops" threads; in the device-pid fallback a pid's
+    # extra streams (DMA etc.) would masquerade as chips and understate
+    # the per-chip time — report 0 so callers refuse to divide by it.
+    n_lanes = len(lanes) if op_tids else 0
+    return path, by_op, total, n_lanes, bool(op_tids or device_pids)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args()
+
+    path, by_op, total, _lanes, device_events = device_op_totals(
+        args.trace_dir)
     if not by_op:
         raise SystemExit("no device op events found in trace")
-    if not op_tids and not device_pids:
+    if not device_events:
         print("WARNING: no 'XLA Ops' thread or device pid in this trace — "
               "host-side events are being summed (CPU-only capture?); "
               "capture on a TPU for a meaningful sink table", file=sys.stderr)
