@@ -6,7 +6,13 @@ TPU-first: NHWC layouts, bf16-friendly, channel dims sized for the MXU.
 """
 
 from bluefog_tpu.models.lenet import LeNet5
-from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from bluefog_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet50,
+    s2d_stem_kernel_from_7x7,
+    space_to_depth,
+)
 from bluefog_tpu.models.bert import BertConfig, BertEncoder
 from bluefog_tpu.models.transformer import GPTConfig, TransformerLM
 from bluefog_tpu.models.moe import MoEConfig, MoETransformerLM

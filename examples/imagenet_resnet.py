@@ -123,6 +123,9 @@ def main():
     ap.add_argument("--checkpoint-every", type=int, default=1, metavar="EPOCHS")
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--eval-every", type=int, default=1, metavar="EPOCHS")
+    ap.add_argument("--stem", choices=["conv", "s2d"], default="conv",
+                    help="s2d = space-to-depth stem (same function class, "
+                         "4x MXU input-lane occupancy on the stem conv)")
     ap.add_argument("--fp32", action="store_true",
                     help="train in float32 (default bfloat16)")
     args = ap.parse_args()
@@ -139,7 +142,7 @@ def main():
     val_loader = DistributedLoader(val_src, args.batch_size, shuffle=False)
     steps_per_epoch = loader.steps_per_epoch
 
-    model = ResNet50(num_classes=args.num_classes, dtype=dtype)
+    model = ResNet50(num_classes=args.num_classes, dtype=dtype, stem=args.stem)
     sched = lr_schedule(args, steps_per_epoch)
     base_opt = optax.chain(
         optax.add_decayed_weights(args.weight_decay),

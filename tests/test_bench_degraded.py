@@ -209,6 +209,58 @@ class TestDegradedMode:
         assert "cached_at" in cached
 
 
+class TestBestCorroboratedWins:
+    """The cache holds the BEST credible number, not merely the latest: a
+    pinned A/B run at a deliberately suboptimal batch/stem must not clobber
+    the sweep optimum that degraded mode would later fall back to."""
+
+    GOOD = {"metric": "resnet50_images_per_sec_per_chip", "value": 2510.0,
+            "wall_clock_plausible": True, "batch": 256}
+
+    def test_worse_corroborated_run_keeps_cache(self, bench):
+        new = {"metric": "resnet50_images_per_sec_per_chip", "value": 2054.0,
+               "wall_clock_plausible": True, "batch": 1024}
+        assert bench._cached_beats(self.GOOD, new)
+
+    def test_better_run_replaces_cache(self, bench):
+        new = {"metric": "resnet50_images_per_sec_per_chip", "value": 2600.0,
+               "wall_clock_plausible": True, "batch": 256}
+        assert not bench._cached_beats(self.GOOD, new)
+
+    def test_suspect_cache_entry_never_survives(self, bench):
+        # cached value from a corrupt wall clock (uncorroborated) loses even
+        # to a slower — but real — new measurement
+        prev = dict(self.GOOD, value=284420.0, wall_clock_plausible=False)
+        new = {"metric": "resnet50_images_per_sec_per_chip", "value": 2510.0,
+               "wall_clock_plausible": True}
+        assert not bench._cached_beats(prev, new)
+
+    def test_trace_derived_cache_entry_is_credible(self, bench):
+        # a sweep whose wall clock was corrupt but whose VALUE was demoted
+        # to the trace-derived rate is ground truth, not suspect: a slower
+        # corroborated A/B run must not clobber it
+        prev = {"metric": "resnet50_images_per_sec_per_chip", "value": 2601.0,
+                "wall_clock_plausible": False,
+                "value_source": "profiler_trace"}
+        new = {"metric": "resnet50_images_per_sec_per_chip", "value": 2054.0,
+               "wall_clock_plausible": True, "batch": 1024}
+        assert bench._cached_beats(prev, new)
+
+    def test_traceless_tpu_run_never_clobbers_credible_cache(self, bench):
+        # the documented corrupt case: trace capture OOMed, wall clock
+        # claims 284k img/s — no wall_clock_plausible field at all.  The
+        # credible cache must survive regardless of the claimed value.
+        new = {"metric": "resnet50_images_per_sec_per_chip",
+               "value": 284420.0, "value_source": "wall_clock"}
+        assert bench._cached_beats(self.GOOD, new)
+
+    def test_different_metric_or_empty_cache_is_replaced(self, bench):
+        assert not bench._cached_beats(None, self.GOOD)
+        assert not bench._cached_beats({"metric": "other", "value": 1e9,
+                                        "wall_clock_plausible": True},
+                                       self.GOOD)
+
+
 class TestTraceCorroboration:
     """The profiler trace as timing ground truth (round-4 finding).
 

@@ -52,6 +52,53 @@ def test_resnet_batchnorm_mutable_update():
     assert any(jax.tree_util.tree_leaves(changed))
 
 
+def test_s2d_stem_exact_equivalence():
+    """The 4x4/s1 conv on space-to-depth input computes the IDENTICAL
+    function as the reference 7x7/s2 stem when its kernel is the
+    constructive embedding — the proof the "s2d" stem is the same model
+    family, not an approximation."""
+    from bluefog_tpu.models import s2d_stem_kernel_from_7x7, space_to_depth
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    w7 = jnp.asarray(rng.normal(size=(7, 7, 3, 16)) * 0.1, jnp.float32)
+
+    ref = jax.lax.conv_general_dilated(
+        x, w7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    w4 = jnp.asarray(s2d_stem_kernel_from_7x7(w7))
+    got = jax.lax.conv_general_dilated(
+        space_to_depth(x, 2), w4, window_strides=(1, 1),
+        padding=[(2, 1), (2, 1)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert ref.shape == got.shape == (2, 16, 16, 16)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_stem_model_shapes_and_prefolded_input():
+    """ResNet(stem="s2d") matches the reference stem's output shape and
+    accepts either raw [N,H,W,3] or pre-folded [N,H/2,W/2,12] input with
+    identical results (the data pipeline may fold on host)."""
+    from bluefog_tpu.models import space_to_depth
+
+    m = ResNet18(num_classes=10, dtype=jnp.float32, stem="s2d")
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    v = m.init(jax.random.PRNGKey(0), x, train=False)
+    out_raw = m.apply(v, x, train=False)
+    out_folded = m.apply(v, space_to_depth(x, 2), train=False)
+    assert out_raw.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(out_raw), np.asarray(out_folded),
+                               rtol=1e-6, atol=1e-6)
+    # same downstream trunk: non-stem param tree shapes match the 7x7 model
+    v7 = ResNet18(num_classes=10, dtype=jnp.float32).init(
+        jax.random.PRNGKey(0), x, train=False)
+    s2d_shapes = jax.tree_util.tree_map(lambda a: a.shape, v["params"])
+    ref_shapes = jax.tree_util.tree_map(lambda a: a.shape, v7["params"])
+    assert s2d_shapes["conv_init"]["kernel"] == (4, 4, 12, 64)
+    del s2d_shapes["conv_init"], ref_shapes["conv_init"]
+    assert s2d_shapes == ref_shapes
+
+
 def test_vit_tiny_forward_and_grad():
     from bluefog_tpu.models import ViT, ViTConfig
 
