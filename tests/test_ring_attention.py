@@ -270,3 +270,21 @@ def test_transformer_lm_ulysses_matches_single_device():
     ))(params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_block_sizes_clamp():
+    """Tile edges must exactly divide T (kernel requirement) and default to
+    512 — the edge the on-chip tune measured 3.5-5x faster than the
+    library's 128 default (PROFILE.md, flash_attention_bench --tune)."""
+    from bluefog_tpu.ops.ring_attention import _flash_block_sizes
+
+    assert _flash_block_sizes(1024).block_q == 512
+    assert _flash_block_sizes(4096).block_q == 512
+    assert _flash_block_sizes(384).block_q == 128   # 256 does not divide 384
+    assert _flash_block_sizes(128).block_q == 128
+    assert _flash_block_sizes(4096, 1024).block_q == 1024
+    assert _flash_block_sizes(2048, 128).block_q == 128
+    for t in (128, 384, 1024, 4096):
+        bs = _flash_block_sizes(t)
+        assert t % bs.block_q == 0 and t % bs.block_k == 0
+        assert bs.block_k <= bs.block_k_major
