@@ -1,0 +1,59 @@
+"""Shared device-trace timing for the single-chip micro-benchmarks.
+
+Through this environment's relay the host wall clock is unreliable at
+microbenchmark scale (PROFILE.md §1), so every benchmark times a
+``jax.profiler`` trace window and takes the device's own op-time total as
+the oracle (`profile_summary.device_op_totals`, the same parser bench.py
+uses for its corroboration check).
+"""
+
+import importlib.util
+import os
+import tempfile
+import time
+
+import jax
+
+
+def trace_step_ms(trace_dir, steps):
+    """Per-step per-chip device op time (ms), or None when the trace is
+    missing/host-only (CPU runs)."""
+    summary_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "profile_summary.py")
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "bftpu_profile_summary", summary_py)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        (_path, by_op, total_us, n_lanes,
+         device_events) = mod.device_op_totals(trace_dir)
+    except (Exception, SystemExit):
+        return None
+    if not by_op or not device_events or n_lanes <= 0:
+        return None
+    return total_us / 1e3 / steps / n_lanes
+
+
+def timed_trace(fn, args_, steps, trace_steps: int = 3):
+    """Time ``steps`` untraced calls, then trace ``trace_steps`` more.
+
+    bench.py's discipline: the wall clock is measured WITHOUT the profiler
+    running (host-side tracing overhead would land in it), and a separate
+    short traced window supplies the device op-time oracle.  Returns
+    ``(wall_ms_per_step, trace_ms_per_step | None)``; callers headline the
+    trace figure and report the wall clock alongside (plausible iff
+    wall >= 0.9 x trace).  Compile happens outside both clocks.
+    """
+    jax.tree_util.tree_leaves(fn(*args_))[0].block_until_ready()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(steps):
+        out = fn(*args_)
+    jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    wall_ms = (time.perf_counter() - t0) / steps * 1e3
+    trace_dir = tempfile.mkdtemp(prefix="bftpu_trace_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(trace_steps):
+            out = fn(*args_)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    return wall_ms, trace_step_ms(trace_dir, trace_steps)

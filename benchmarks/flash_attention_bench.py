@@ -26,7 +26,6 @@ import argparse
 import json
 import os
 import sys
-import tempfile
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -37,38 +36,7 @@ import jax.numpy as jnp
 from bluefog_tpu.ops.ring_attention import local_attention
 
 
-def _trace_step_ms(trace_dir, steps):
-    """Device op time per step (ms) from a jax.profiler trace, or None.
-    Shares bench.py's oracle (`profile_summary.device_op_totals`)."""
-    import importlib.util
-
-    summary_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "profile_summary.py")
-    try:
-        spec = importlib.util.spec_from_file_location(
-            "bftpu_profile_summary", summary_py)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        (_path, by_op, total_us, n_lanes,
-         device_events) = mod.device_op_totals(trace_dir)
-    except (Exception, SystemExit):
-        return None
-    if not by_op or not device_events or n_lanes <= 0:
-        return None
-    return total_us / 1e3 / steps / n_lanes
-
-
-def step_time(fn, args_, steps):
-    """(wall_ms_per_step, trace_ms_per_step | None) for `steps` calls."""
-    fn(*args_)[0].block_until_ready()  # compile outside the clock
-    trace_dir = tempfile.mkdtemp(prefix="bftpu_flashbench_")
-    t0 = time.perf_counter()
-    with jax.profiler.trace(trace_dir):
-        for _ in range(steps):
-            out = fn(*args_)
-        jax.tree_util.tree_leaves(out)[0].block_until_ready()
-    wall_ms = (time.perf_counter() - t0) / steps * 1e3
-    return wall_ms, _trace_step_ms(trace_dir, steps)
+from benchmarks._trace_util import timed_trace as step_time  # noqa: E402
 
 
 def make_step(backend, causal=True, flash_block=None):

@@ -1,0 +1,156 @@
+"""Transformer-LM training throughput on a single chip (tokens/sec/chip).
+
+The second model-family perf number next to bench.py's ResNet-50 headline:
+a GPT-style decoder (``models/transformer.py``) under the SAME decentralized
+training step the examples use — ``DistributedNeighborAllreduceOptimizer``
+over the exp2 schedule (identity gossip on one chip, real gossip on a mesh)
+— with the model layer's ``backend='auto'`` attention, i.e. the tuned-tile
+flash kernel on TPU (PROFILE.md §4a).
+
+Timing discipline: device-profiler-trace oracle via
+``benchmarks/_trace_util`` (the relay wall clock lies; PROFILE.md §1).
+MFU uses XLA's own flop count for the compiled step when available, else
+the analytic 6·N·T approximation.
+
+Run (real chip):  python benchmarks/transformer_bench.py --seq-len 2048
+Run (CPU smoke):  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python benchmarks/transformer_bench.py --config tiny --batch 2 \
+    --seq-len 256 --steps 2
+
+Prints one JSON line: tokens/sec/chip, per-step times, MFU.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from benchmarks._trace_util import timed_trace
+from bluefog_tpu.models import GPTConfig, TransformerLM
+from bluefog_tpu.optim import DistributedNeighborAllreduceOptimizer
+from bluefog_tpu.parallel.api import shard_map
+from bluefog_tpu.topology import ExponentialTwoGraph
+
+NOMINAL_TFLOPS = {"TPU v5 lite": 197.0, "TPU v5p": 459.0, "TPU v4": 275.0,
+                  "TPU v6 lite": 918.0}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=["tiny", "small", "large"],
+                    default="small")
+    ap.add_argument("--batch", type=int, default=8, help="per-chip batch")
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize blocks (long sequences)")
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    n = len(devices)
+    bf.init(topology=ExponentialTwoGraph(n))
+    ctx = bf.get_context()
+
+    cfg = getattr(GPTConfig, args.config)()
+    if args.remat:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, remat=True)
+    model = TransformerLM(cfg)
+    opt = DistributedNeighborAllreduceOptimizer(
+        optax.adamw(3e-4, weight_decay=0.01), topology=ctx.schedule,
+        axis_name=ctx.axis_name)
+
+    rng = jax.random.PRNGKey(0)
+    tok0 = jnp.zeros((args.batch, args.seq_len), jnp.int32)
+    params = model.init(rng, tok0)["params"]
+    params = bf.rank_shard(bf.rank_stack(params))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (n, args.batch, args.seq_len + 1), 0,
+        cfg.vocab_size, dtype=jnp.int32)
+    tokens = bf.rank_shard(tokens)
+
+    def init_opt(params_blk):
+        p = jax.tree_util.tree_map(lambda t: t[0], params_blk)
+        st = opt.init(p)
+        return jax.tree_util.tree_map(lambda t: jnp.asarray(t)[None], st)
+
+    opt_state = jax.jit(shard_map(
+        init_opt, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
+        out_specs=P(ctx.axis_name), check_vma=False))(params)
+
+    def train_step(params_blk, opt_blk, tok_blk):
+        p, st = jax.tree_util.tree_map(lambda t: t[0], (params_blk, opt_blk))
+        tok = tok_blk[0]
+        inp, tgt = tok[:, :-1], tok[:, 1:]
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, inp)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), tgt).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, st = opt.update(grads, st, p)
+        p = optax.apply_updates(p, updates)
+        return (jax.tree_util.tree_map(lambda t: t[None], (p, st))
+                + (loss[None],))
+
+    # AOT-compile once; the executable serves cost analysis + the timed loop
+    step_fn = jax.jit(shard_map(
+        train_step, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),) * 3,
+        out_specs=(P(ctx.axis_name),) * 3, check_vma=False,
+    ), donate_argnums=(0, 1)).lower(params, opt_state, tokens).compile()
+
+    try:
+        flops_per_step = float(step_fn.cost_analysis()["flops"])
+    except Exception:  # noqa: BLE001 — platform-dependent availability
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree_util.tree_leaves(params)) / n
+        flops_per_step = 6.0 * n_params * args.batch * args.seq_len
+
+    state = {"p": params, "o": opt_state}
+
+    def step(tokens):
+        state["p"], state["o"], loss = step_fn(state["p"], state["o"],
+                                               tokens)
+        return loss
+
+    wall_ms, trace_ms = timed_trace(step, (tokens,), args.steps)
+    headline_ms = trace_ms or wall_ms
+    tokens_per_step = args.batch * args.seq_len  # per chip
+    tps = tokens_per_step / (headline_ms / 1e3)
+    achieved = flops_per_step / (headline_ms / 1e3)
+    kind = getattr(devices[0], "device_kind", str(devices[0]))
+    spec = NOMINAL_TFLOPS.get(kind)
+    out = {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "config": args.config, "batch": args.batch, "seq_len": args.seq_len,
+        "remat": bool(args.remat), "dtype": str(cfg.dtype.__name__ if
+                                                hasattr(cfg.dtype, "__name__")
+                                                else cfg.dtype),
+        "wall_ms_per_step": round(wall_ms, 3),
+        "trace_ms_per_step": round(trace_ms, 3) if trace_ms else None,
+        "timing_source": "profiler_trace" if trace_ms else
+                         "wall_clock_uncorroborated",
+        "wall_plausible": (wall_ms >= 0.9 * trace_ms) if trace_ms else None,
+        "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
+        "device_kind": kind,
+        "mfu_vs_nominal": round(achieved / 1e12 / spec, 4) if spec else None,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
