@@ -47,10 +47,16 @@ NOMINAL_TFLOPS = {"TPU v5 lite": 197.0, "TPU v5p": 459.0, "TPU v4": 275.0,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", choices=["tiny", "small", "large"],
-                    default="small")
+    ap.add_argument("--model", choices=["lm", "vit"], default="lm",
+                    help="lm = GPT decoder (tokens/s); vit = ViT classifier "
+                         "(images/s) — the attention stack on the image side")
+    ap.add_argument("--config", choices=["tiny", "small", "large", "base"],
+                    default="small",
+                    help="GPTConfig preset for lm; ViTConfig preset for vit "
+                         "(tiny/base)")
     ap.add_argument("--batch", type=int, default=8, help="per-chip batch")
-    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--seq-len", type=int, default=2048,
+                    help="lm only; vit token count is set by image/patch")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize blocks (long sequences)")
@@ -61,24 +67,46 @@ def main():
     bf.init(topology=ExponentialTwoGraph(n))
     ctx = bf.get_context()
 
-    cfg = getattr(GPTConfig, args.config)()
-    if args.remat:
-        import dataclasses
+    import dataclasses
 
-        cfg = dataclasses.replace(cfg, remat=True)
-    model = TransformerLM(cfg)
+    if args.model == "vit":
+        from bluefog_tpu.models import ViT, ViTConfig
+
+        vcfg = getattr(ViTConfig, args.config)()
+        if args.remat:
+            vcfg = dataclasses.replace(vcfg, remat=True)
+        cfg = vcfg.trunk()  # dtype/report fields
+        model = ViT(vcfg)
+        rng_in = jnp.zeros((args.batch, vcfg.image_size, vcfg.image_size, 3),
+                           jnp.bfloat16)
+        data = (
+            jax.random.normal(jax.random.PRNGKey(1),
+                              (n, args.batch, vcfg.image_size,
+                               vcfg.image_size, 3)).astype(jnp.bfloat16),
+            jax.random.randint(jax.random.PRNGKey(2), (n, args.batch), 0,
+                               vcfg.num_classes, dtype=jnp.int32))
+        unit, per_step_items = "images/sec/chip", args.batch
+        metric = "vit_images_per_sec_per_chip"
+    else:
+        cfg = getattr(GPTConfig, args.config)()
+        if args.remat:
+            cfg = dataclasses.replace(cfg, remat=True)
+        model = TransformerLM(cfg)
+        rng_in = jnp.zeros((args.batch, args.seq_len), jnp.int32)
+        data = (jax.random.randint(
+            jax.random.PRNGKey(1), (n, args.batch, args.seq_len + 1), 0,
+            cfg.vocab_size, dtype=jnp.int32),)
+        unit, per_step_items = "tokens/sec/chip", args.batch * args.seq_len
+        metric = "transformer_lm_tokens_per_sec_per_chip"
+
     opt = DistributedNeighborAllreduceOptimizer(
         optax.adamw(3e-4, weight_decay=0.01), topology=ctx.schedule,
         axis_name=ctx.axis_name)
 
     rng = jax.random.PRNGKey(0)
-    tok0 = jnp.zeros((args.batch, args.seq_len), jnp.int32)
-    params = model.init(rng, tok0)["params"]
+    params = model.init(rng, rng_in)["params"]
     params = bf.rank_shard(bf.rank_stack(params))
-    tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (n, args.batch, args.seq_len + 1), 0,
-        cfg.vocab_size, dtype=jnp.int32)
-    tokens = bf.rank_shard(tokens)
+    data = tuple(bf.rank_shard(d) for d in data)
 
     def init_opt(params_blk):
         p = jax.tree_util.tree_map(lambda t: t[0], params_blk)
@@ -89,12 +117,18 @@ def main():
         init_opt, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),),
         out_specs=P(ctx.axis_name), check_vma=False))(params)
 
-    def train_step(params_blk, opt_blk, tok_blk):
+    def train_step(params_blk, opt_blk, *data_blks):
         p, st = jax.tree_util.tree_map(lambda t: t[0], (params_blk, opt_blk))
-        tok = tok_blk[0]
-        inp, tgt = tok[:, :-1], tok[:, 1:]
+        vals = [d[0] for d in data_blks]
 
         def loss_fn(p):
+            if args.model == "vit":
+                imgs, labels = vals
+                logits = model.apply({"params": p}, imgs, train=True)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), labels).mean()
+            (tok,) = vals
+            inp, tgt = tok[:, :-1], tok[:, 1:]
             logits = model.apply({"params": p}, inp)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), tgt).mean()
@@ -107,9 +141,10 @@ def main():
 
     # AOT-compile once; the executable serves cost analysis + the timed loop
     step_fn = jax.jit(shard_map(
-        train_step, mesh=ctx.mesh, in_specs=(P(ctx.axis_name),) * 3,
+        train_step, mesh=ctx.mesh,
+        in_specs=(P(ctx.axis_name),) * (2 + len(data)),
         out_specs=(P(ctx.axis_name),) * 3, check_vma=False,
-    ), donate_argnums=(0, 1)).lower(params, opt_state, tokens).compile()
+    ), donate_argnums=(0, 1)).lower(params, opt_state, *data).compile()
 
     try:
         flops_per_step = float(step_fn.cost_analysis()["flops"])
@@ -120,23 +155,24 @@ def main():
 
     state = {"p": params, "o": opt_state}
 
-    def step(tokens):
+    def step(*data_):
         state["p"], state["o"], loss = step_fn(state["p"], state["o"],
-                                               tokens)
+                                               *data_)
         return loss
 
-    wall_ms, trace_ms = timed_trace(step, (tokens,), args.steps)
+    wall_ms, trace_ms = timed_trace(step, data, args.steps)
     headline_ms = trace_ms or wall_ms
-    tokens_per_step = args.batch * args.seq_len  # per chip
-    tps = tokens_per_step / (headline_ms / 1e3)
+    tps = per_step_items / (headline_ms / 1e3)
     achieved = flops_per_step / (headline_ms / 1e3)
     kind = getattr(devices[0], "device_kind", str(devices[0]))
     spec = NOMINAL_TFLOPS.get(kind)
     out = {
-        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tps, 1),
-        "unit": "tokens/sec/chip",
-        "config": args.config, "batch": args.batch, "seq_len": args.seq_len,
+        "unit": unit,
+        "model": args.model,
+        "config": args.config, "batch": args.batch,
+        "seq_len": args.seq_len if args.model == "lm" else None,
         "remat": bool(args.remat), "dtype": str(cfg.dtype.__name__ if
                                                 hasattr(cfg.dtype, "__name__")
                                                 else cfg.dtype),
