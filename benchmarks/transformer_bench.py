@@ -47,13 +47,17 @@ NOMINAL_TFLOPS = {"TPU v5 lite": 197.0, "TPU v5p": 459.0, "TPU v4": 275.0,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=["lm", "vit"], default="lm",
+    ap.add_argument("--model", choices=["lm", "vit", "bert", "moe"],
+                    default="lm",
                     help="lm = GPT decoder (tokens/s); vit = ViT classifier "
-                         "(images/s) — the attention stack on the image side")
+                         "(images/s); bert = encoder fine-tune step "
+                         "(BASELINE config[4] flavor); moe = Switch-MoE "
+                         "decoder (routing kernels under the same step)")
     ap.add_argument("--config", choices=["tiny", "small", "large", "base"],
                     default="small",
-                    help="GPTConfig preset for lm; ViTConfig preset for vit "
-                         "(tiny/base)")
+                    help="GPTConfig preset for lm/moe; ViTConfig for vit "
+                         "(tiny/base); BertConfig for bert (tiny/base/large)")
+    ap.add_argument("--num-experts", type=int, default=8, help="moe only")
     ap.add_argument("--batch", type=int, default=8, help="per-chip batch")
     ap.add_argument("--seq-len", type=int, default=2048,
                     help="lm only; vit token count is set by image/patch")
@@ -87,6 +91,42 @@ def main():
                                vcfg.num_classes, dtype=jnp.int32))
         unit, per_step_items = "images/sec/chip", args.batch
         metric = "vit_images_per_sec_per_chip"
+    elif args.model == "bert":
+        from bluefog_tpu.models import BertConfig, BertEncoder
+
+        bcfg = getattr(BertConfig, args.config)()
+        if args.remat:
+            bcfg = dataclasses.replace(bcfg, remat=True)
+        cfg = bcfg  # report fields (dtype)
+        seq = min(args.seq_len, bcfg.max_position)
+        model = BertEncoder(bcfg, num_classes=2)  # fine-tune head
+        rng_in = jnp.zeros((args.batch, seq), jnp.int32)
+        data = (
+            jax.random.randint(jax.random.PRNGKey(1), (n, args.batch, seq),
+                               0, bcfg.vocab_size, dtype=jnp.int32),
+            jax.random.randint(jax.random.PRNGKey(2), (n, args.batch), 0, 2,
+                               dtype=jnp.int32))
+        unit, per_step_items = "tokens/sec/chip", args.batch * seq
+        metric = "bert_finetune_tokens_per_sec_per_chip"
+    elif args.model == "moe":
+        from bluefog_tpu.models import MoEConfig, MoETransformerLM
+
+        if args.config == "tiny":
+            mcfg = MoEConfig.tiny()
+        else:
+            gpt = getattr(GPTConfig, args.config)()
+            if args.remat:
+                gpt = dataclasses.replace(gpt, remat=True)
+            mcfg = MoEConfig(gpt=gpt, num_experts=args.num_experts)
+        cfg = mcfg.gpt
+        model = MoETransformerLM(mcfg)
+        moe_aux_weight = mcfg.aux_loss_weight
+        rng_in = jnp.zeros((args.batch, args.seq_len), jnp.int32)
+        data = (jax.random.randint(
+            jax.random.PRNGKey(1), (n, args.batch, args.seq_len + 1), 0,
+            cfg.vocab_size, dtype=jnp.int32),)
+        unit, per_step_items = "tokens/sec/chip", args.batch * args.seq_len
+        metric = "moe_lm_tokens_per_sec_per_chip"
     else:
         cfg = getattr(GPTConfig, args.config)()
         if args.remat:
@@ -127,8 +167,21 @@ def main():
                 logits = model.apply({"params": p}, imgs, train=True)
                 return optax.softmax_cross_entropy_with_integer_labels(
                     logits.astype(jnp.float32), labels).mean()
+            if args.model == "bert":
+                tok, labels = vals
+                logits = model.apply({"params": p}, tok, deterministic=True)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), labels).mean()
             (tok,) = vals
             inp, tgt = tok[:, :-1], tok[:, 1:]
+            if args.model == "moe":
+                logits, st_aux = model.apply({"params": p}, inp,
+                                             mutable=["aux_loss"])
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), tgt).mean()
+                aux = sum(jnp.sum(a) for a in
+                          jax.tree_util.tree_leaves(st_aux["aux_loss"]))
+                return ce + moe_aux_weight * aux
             logits = model.apply({"params": p}, inp)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), tgt).mean()
@@ -172,7 +225,9 @@ def main():
         "unit": unit,
         "model": args.model,
         "config": args.config, "batch": args.batch,
-        "seq_len": args.seq_len if args.model == "lm" else None,
+        "seq_len": (None if args.model == "vit"
+                    else min(args.seq_len, cfg.max_position)
+                    if args.model == "bert" else args.seq_len),
         "remat": bool(args.remat), "dtype": str(cfg.dtype.__name__ if
                                                 hasattr(cfg.dtype, "__name__")
                                                 else cfg.dtype),
