@@ -1,0 +1,210 @@
+"""Compressed decentralized gossip — CHOCO-Gossip on the ppermute fabric.
+
+The reference ships no communication compression (its wire is full-precision
+MPI/NCCL buffers; SURVEY.md §2.4), so this module is beyond-reference
+surface: CHOCO-Gossip/CHOCO-SGD (Koloskova, Stich & Jaggi, ICML 2019,
+arXiv:1902.00340) — the standard algorithm for *compressed* gossip
+averaging that still converges to exact consensus.  Plain gossip with
+naively compressed payloads does NOT converge (compression noise
+accumulates); CHOCO fixes that by gossiping compressed *innovations*
+against mirror copies every rank keeps of its neighbors' public state:
+
+    d_i      = x_i − x̂_i                 (innovation vs own public copy)
+    q_i      = C(d_i)                     (compressed; this rides the wire)
+    x̂_j     += q_j   for j ∈ {i} ∪ in-neighbors   (all mirrors advance)
+    x_i     += γ · Σ_j w_ij (x̂_j − x̂_i)          (mix the public copies)
+
+Exact consensus requires a SYMMETRIC doubly-stochastic mixing matrix (ring,
+grid, full — not the directed exp2 graph) and γ ∈ (0, 1] sized to the
+compression quality; the compressor must be a contraction in expectation:
+``E‖C(x) − x‖² ≤ (1 − δ)‖x‖²`` with δ = the kept fraction.
+
+TPU-first wire format: every payload has a STATIC shape (k values per
+leaf), so the whole round jits into the same ``lax.ppermute`` fabric as
+uncompressed gossip.  ``random_block_k`` uses a **shared-seed mask**: all
+ranks derive the same slice offset from the round counter, so the wire
+carries k values and ZERO index bytes — the receiver reconstructs placement
+from the seed.  ``top_k`` is data-dependent, so its payload ships indices
+alongside values (int32 per kept value).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bluefog_tpu.ops.collectives import _acc_dtype, _rank_weights
+from bluefog_tpu.topology.schedule import GossipSchedule
+
+__all__ = [
+    "Compressor", "identity", "random_block_k", "top_k",
+    "ChocoState", "choco_init", "choco_gossip",
+]
+
+
+class Compressor(NamedTuple):
+    """Leaf-wise compression operator with static-shape payloads.
+
+    ``compress(leaf, key) -> payload`` (a pytree of arrays whose shapes
+    depend only on ``leaf.shape``); ``decompress(payload, key, like) ->
+    dense array of like.shape``.  ``key`` is identical on every rank for a
+    given (round, leaf) — shared-seed compressors use it to avoid shipping
+    indices; data-dependent ones ignore it.  ``wire_ratio(leaf)`` estimates
+    payload bytes / dense bytes for the census.
+    """
+
+    name: str
+    compress: Callable[[jnp.ndarray, jnp.ndarray], Any]
+    decompress: Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+    wire_ratio: Callable[[Any], float]
+    delta: float = 1.0  # contraction quality: E||C(x)-x||^2 <= (1-delta)||x||^2
+
+
+def identity() -> Compressor:
+    """No compression (δ = 1): CHOCO degenerates to exact gossip in one
+    mirror round — the parity baseline for tests."""
+    return Compressor(
+        name="identity",
+        compress=lambda leaf, key: leaf,
+        decompress=lambda payload, key, like: payload,
+        wire_ratio=lambda leaf: 1.0,
+        delta=1.0,
+    )
+
+
+def _kept(n: int, ratio: float) -> int:
+    return max(1, min(n, int(round(ratio * n))))
+
+
+def random_block_k(ratio: float) -> Compressor:
+    """Keep a contiguous block of ⌈ratio·n⌉ coordinates at a shared-seed
+    random offset (wrap-around).
+
+    Every coordinate is kept with probability k/n over the random offset, so
+    the operator is a δ = k/n contraction in expectation — the CHOCO
+    requirement — at O(k) compute (one dynamic slice; no sort, unlike
+    coordinate-sampled random-k) and a wire of exactly k values, no indices
+    (both sides recompute the offset from the shared key).
+    """
+    if not 0.0 < ratio <= 1.0:
+        raise ValueError(f"ratio must be in (0, 1], got {ratio}")
+
+    def compress(leaf, key):
+        flat = leaf.reshape(-1)
+        n = flat.size
+        k = _kept(n, ratio)
+        start = jax.random.randint(key, (), 0, n)
+        # mod-index gather (the same indexing decompress scatters with):
+        # O(k) transient — doubling the leaf to express the wrap-around
+        # would allocate 2x the largest parameter every round
+        idx = (start + jnp.arange(k)) % n
+        return flat[idx]
+
+    def decompress(payload, key, like):
+        flat = jnp.zeros(int(np.prod(like.shape)), payload.dtype)
+        n = flat.size
+        k = payload.shape[0]
+        start = jax.random.randint(key, (), 0, n)
+        idx = (start + jnp.arange(k)) % n
+        return flat.at[idx].set(payload).reshape(like.shape)
+
+    return Compressor("random_block_k", compress, decompress,
+                      lambda leaf: _kept(leaf.size, ratio) / leaf.size,
+                      delta=ratio)
+
+
+def top_k(ratio: float) -> Compressor:
+    """Keep the ⌈ratio·n⌉ largest-magnitude coordinates (δ ≥ k/n — top-k is
+    at least as contractive as random-k).  Data-dependent, so the wire
+    carries int32 indices alongside the values."""
+    if not 0.0 < ratio <= 1.0:
+        raise ValueError(f"ratio must be in (0, 1], got {ratio}")
+
+    def compress(leaf, key):
+        flat = leaf.reshape(-1)
+        k = _kept(flat.size, ratio)
+        _, idx = lax.top_k(jnp.abs(flat.astype(jnp.float32)), k)
+        return {"vals": flat[idx], "idx": idx.astype(jnp.int32)}
+
+    def decompress(payload, key, like):
+        flat = jnp.zeros(int(np.prod(like.shape)), payload["vals"].dtype)
+        return (flat.at[payload["idx"]].set(payload["vals"])
+                .reshape(like.shape))
+
+    def ratio_fn(leaf):
+        k = _kept(leaf.size, ratio)
+        return k * (leaf.dtype.itemsize + 4) / (leaf.size * leaf.dtype.itemsize)
+
+    return Compressor("top_k", compress, decompress, ratio_fn, delta=ratio)
+
+
+class ChocoState(NamedTuple):
+    """Mirror copies + round counter, carried across gossip rounds."""
+
+    xhat_self: Any   # pytree like x: this rank's public copy
+    xhat_nbrs: Any   # pytree with leading dim K: mirror of slot k's source
+    round: jnp.ndarray  # int32: drives the shared-seed masks
+
+
+def choco_init(x, schedule: GossipSchedule) -> ChocoState:
+    """Zero mirrors (the algorithm's x̂⁰ = 0 initialization)."""
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, x)
+    k = schedule.num_slots
+    nbrs = jax.tree_util.tree_map(
+        lambda t: jnp.zeros((k,) + t.shape, t.dtype), x)
+    return ChocoState(zeros, nbrs, jnp.zeros((), jnp.int32))
+
+
+def choco_gossip(x, state: ChocoState, schedule: GossipSchedule,
+                 axis_name: str, *, compressor: Compressor,
+                 gamma: float = 1.0, key=None):
+    """One CHOCO-Gossip round.  Returns ``(x_new, state_new)``.
+
+    The mask key for (round, leaf) is identical on every rank —
+    ``fold_in(key, round)`` then ``fold_in(·, leaf_index)`` — which is what
+    lets shared-seed compressors ship value-only payloads.  Payload arrays
+    ride the same per-slot ``lax.ppermute`` as uncompressed gossip, so XLA
+    overlaps them with surrounding compute identically.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    key_r = jax.random.fold_in(key, state.round)
+
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    hat_self = jax.tree_util.tree_flatten(state.xhat_self)[0]
+    hat_nbrs = jax.tree_util.tree_flatten(state.xhat_nbrs)[0]
+
+    new_x, new_self, new_nbrs = [], [], []
+    for li, (leaf, hs, hn) in enumerate(zip(leaves, hat_self, hat_nbrs)):
+        lkey = jax.random.fold_in(key_r, li)
+        acc = _acc_dtype(leaf)
+        payload = compressor.compress((leaf - hs).astype(leaf.dtype), lkey)
+        hs2 = hs + compressor.decompress(payload, lkey, leaf)
+        # only the received weights enter the mixing term: under the
+        # required double stochasticity wsum == 1 - self_weight, so the
+        # self weight is implicit in `mix - wsum * hs2`
+        _self_w, recv_w = _rank_weights(schedule, axis_name, None, None, acc)
+        mix = jnp.zeros(leaf.shape, acc)
+        wsum = jnp.zeros((), acc)
+        hn2 = []
+        for k, perm in enumerate(schedule.perms):
+            with jax.named_scope(f"bf.choco.slot{k}"):
+                recv = jax.tree_util.tree_map(
+                    lambda t: lax.ppermute(t, axis_name, perm), payload)
+                hk = hn[k] + compressor.decompress(recv, lkey, leaf)
+                hn2.append(hk)
+                mix = mix + recv_w[k] * hk.astype(acc)
+                wsum = wsum + recv_w[k]
+        x2 = (leaf.astype(acc)
+              + gamma * (mix - wsum * hs2.astype(acc))).astype(leaf.dtype)
+        new_x.append(x2)
+        new_self.append(hs2)
+        new_nbrs.append(jnp.stack(hn2))
+
+    unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
+    return unf(new_x), ChocoState(unf(new_self), unf(new_nbrs),
+                                  state.round + 1)

@@ -15,4 +15,5 @@ from bluefog_tpu.optim.optimizers import (
     DistributedGradientAllreduceOptimizer,
     DistributedHierarchicalNeighborAllreduceOptimizer,
     DistributedWinPutOptimizer,
+    DistributedChocoSGDOptimizer,
 )

@@ -1,0 +1,240 @@
+"""Compressed gossip (CHOCO) — consensus despite 10x fewer wire bytes.
+
+The reference has no compression subsystem (upstream's wire is always
+full-precision MPI/NCCL buffers), so these tests pin beyond-reference
+surface: compressor contracts, exact-consensus convergence of CHOCO-Gossip
+on a symmetric ring, mean preservation, and the optimizer wrapper.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu.ops import compression as CP
+from bluefog_tpu.optim import DistributedChocoSGDOptimizer
+from bluefog_tpu.parallel.api import shard_map
+from bluefog_tpu.topology.graphs import ExponentialTwoGraph, RingGraph
+from bluefog_tpu.topology.schedule import build_schedule
+
+N = 8
+
+
+def mesh8():
+    return Mesh(np.array(jax.devices()[:N]), ("g",))
+
+
+class TestCompressors:
+    def test_identity_roundtrip(self):
+        c = CP.identity()
+        x = jnp.arange(12.0).reshape(3, 4)
+        key = jax.random.PRNGKey(0)
+        np.testing.assert_array_equal(
+            np.asarray(c.decompress(c.compress(x, key), key, x)),
+            np.asarray(x))
+        assert c.wire_ratio(x) == 1.0
+
+    @pytest.mark.parametrize("ratio", [0.1, 0.25, 1.0])
+    def test_random_block_k_is_a_projection(self, ratio):
+        """decompress(compress(x)) keeps exactly k coordinates of x
+        unchanged and zeroes the rest — and both sides agree on placement
+        from the shared key alone."""
+        c = CP.random_block_k(ratio)
+        x = jax.random.normal(jax.random.PRNGKey(1), (37,))
+        key = jax.random.PRNGKey(7)
+        payload = c.compress(x, key)
+        k = max(1, int(round(ratio * 37)))
+        assert payload.shape == (k,)  # k values, ZERO index bytes
+        y = c.decompress(payload, key, x)
+        xn, yn = np.asarray(x), np.asarray(y)
+        kept = yn != 0
+        assert kept.sum() == k
+        np.testing.assert_allclose(yn[kept], xn[kept])
+        assert abs(c.wire_ratio(x) - k / 37) < 1e-9
+
+    def test_random_block_k_offsets_vary_by_key(self):
+        c = CP.random_block_k(0.2)
+        x = jnp.arange(1.0, 51.0)
+        m1 = np.asarray(c.decompress(c.compress(x, jax.random.PRNGKey(0)),
+                                     jax.random.PRNGKey(0), x)) != 0
+        m2 = np.asarray(c.decompress(c.compress(x, jax.random.PRNGKey(3)),
+                                     jax.random.PRNGKey(3), x)) != 0
+        assert (m1 != m2).any()  # different rounds touch different blocks
+
+    def test_top_k_keeps_largest(self):
+        c = CP.top_k(0.25)
+        x = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.3, 0.0, 1.0, 0.05])
+        key = jax.random.PRNGKey(0)
+        y = np.asarray(c.decompress(c.compress(x, key), key, x))
+        np.testing.assert_allclose(y, [0, -5.0, 0, 3.0, 0, 0, 0, 0])
+        # wire carries values + int32 indices
+        assert c.wire_ratio(x) == pytest.approx(2 * (4 + 4) / (8 * 4))
+
+    @pytest.mark.parametrize("make", [lambda: CP.random_block_k(0.2),
+                                      lambda: CP.top_k(0.2)])
+    def test_contraction_property(self, make):
+        """E||C(x) - x||^2 <= (1 - k/n) ||x||^2 — the CHOCO requirement."""
+        c = make()
+        x = jax.random.normal(jax.random.PRNGKey(2), (200,))
+        errs = []
+        for s in range(30):
+            key = jax.random.PRNGKey(s)
+            y = c.decompress(c.compress(x, key), key, x)
+            errs.append(float(jnp.sum((y - x) ** 2)))
+        n, k = 200, max(1, int(round(0.2 * 200)))
+        bound = (1 - k / n) * float(jnp.sum(x ** 2))
+        assert np.mean(errs) <= bound * 1.05
+
+    @pytest.mark.parametrize("bad", [0.0, -0.5, 1.5])
+    def test_bad_ratio_raises(self, bad):
+        with pytest.raises(ValueError):
+            CP.random_block_k(bad)
+        with pytest.raises(ValueError):
+            CP.top_k(bad)
+
+
+def _run_choco(compressor, gamma, rounds, shape=(6,)):
+    """Run CHOCO-Gossip on the ring; returns (history of consensus error,
+    mean drift) as floats."""
+    sched = build_schedule(RingGraph(N))
+    mesh = mesh8()
+    x0 = jax.random.normal(jax.random.PRNGKey(0), (N,) + shape)
+    target = np.asarray(x0).mean(axis=0)
+
+    def step(x, state):
+        return CP.choco_gossip(x, state, sched, "g",
+                               compressor=compressor, gamma=gamma,
+                               key=jax.random.PRNGKey(42))
+
+    @functools.partial(jax.jit, static_argnums=())
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P("g"),),
+                       out_specs=P("g"), check_vma=False)
+    def run(x_blk):
+        x = x_blk[0]
+        state = CP.choco_init(x, sched)
+
+        def body(carry, _):
+            x, st = carry
+            x, st = step(x, st)
+            return (x, st), None
+
+        (x, _), _ = jax.lax.scan(body, (x, state), None, length=rounds)
+        return x[None]
+
+    out = np.asarray(run(x0))
+    err = np.abs(out - target).max()
+    drift = np.abs(out.mean(axis=0) - target).max()
+    return err, drift
+
+
+class TestChocoGossip:
+    def test_identity_compressor_converges_fast(self):
+        err, drift = _run_choco(CP.identity(), 1.0, rounds=60)
+        assert err < 1e-3
+        assert drift < 1e-5  # symmetric W: the mean is invariant
+
+    def test_random_block_k_reaches_consensus(self):
+        """10% of the wire bytes still contracts to consensus — the CHOCO
+        property naive compressed gossip does not have.  (gamma must shrink
+        with the compression ratio: 0.4 at ratio 0.1 diverges, 0.2
+        converges — the paper's stability condition, observed.)"""
+        err, drift = _run_choco(CP.random_block_k(0.1), 0.2, rounds=800)
+        assert err < 1e-4, err
+        assert drift < 1e-4
+
+    def test_top_k_reaches_consensus(self):
+        err, drift = _run_choco(CP.top_k(0.25), 0.6, rounds=200)
+        assert err < 5e-3, err
+        assert drift < 1e-4
+
+    def test_mirror_state_shapes(self):
+        sched = build_schedule(RingGraph(N))
+        x = {"a": jnp.zeros((3, 2)), "b": jnp.zeros((5,))}
+        st = CP.choco_init(x, sched)
+        assert st.xhat_nbrs["a"].shape == (sched.num_slots, 3, 2)
+        assert st.xhat_nbrs["b"].shape == (sched.num_slots, 5)
+        assert int(st.round) == 0
+
+
+class TestChocoOptimizer:
+    def test_asymmetric_topology_raises(self):
+        with pytest.raises(ValueError, match="symmetric"):
+            DistributedChocoSGDOptimizer(
+                optax.sgd(0.1), ExponentialTwoGraph(N), "g")
+
+    def test_training_converges_to_consensus_optimum(self):
+        """Least squares with per-rank data: CHOCO-SGD drives every rank to
+        the SHARED optimum despite 10x-compressed gossip — the
+        decentralized-optimization contract of the reference's examples,
+        now under a compressed wire."""
+        mesh = mesh8()
+        sched = build_schedule(RingGraph(N))
+        rng = np.random.default_rng(0)
+        A = jnp.asarray(rng.normal(size=(N, 16, 4)))
+        w_star = jnp.asarray(rng.normal(size=(4,)))
+        b = jnp.einsum("nij,j->ni", A, w_star)
+        opt = DistributedChocoSGDOptimizer(
+            optax.sgd(0.05), sched, "g",
+            compressor=CP.random_block_k(0.25), gamma=0.3)
+
+        @jax.jit
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P("g"), P("g")), out_specs=P("g"),
+                           check_vma=False)
+        def train(A_blk, b_blk):
+            Ai, bi = A_blk[0], b_blk[0]
+            params = jnp.zeros((4,))
+            state = opt.init(params)
+
+            def body(carry, _):
+                params, state = carry
+                g = jax.grad(
+                    lambda w: jnp.mean((Ai @ w - bi) ** 2))(params)
+                upd, state = opt.update(g, state, params)
+                return (optax.apply_updates(params, upd), state), None
+
+            (params, _), _ = jax.lax.scan(body, (params, state), None,
+                                          length=1000)
+            return params[None]
+
+        out = np.asarray(train(A, b))
+        # every rank near the shared optimum, and near each other
+        assert np.abs(out - np.asarray(w_star)).max() < 0.05, out
+        assert np.abs(out - out.mean(axis=0)).max() < 0.01
+
+    def test_default_gamma_is_compressor_delta(self):
+        """gamma=None must pick the stable default (the compressor's δ):
+        ratio-0.25 compression with the default converges where γ=0.5
+        diverges (measured)."""
+        mesh = mesh8()
+        sched = build_schedule(RingGraph(N))
+        opt = DistributedChocoSGDOptimizer(
+            optax.sgd(0.05), sched, "g",
+            compressor=CP.random_block_k(0.25))  # gamma defaults to 0.25
+
+        @jax.jit
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P("g"),),
+                           out_specs=P("g"), check_vma=False)
+        def consensus(x_blk):
+            params = x_blk[0]
+            state = opt.init(params)
+
+            def body(carry, _):
+                params, state = carry
+                upd, state = opt.update(
+                    jax.tree_util.tree_map(jnp.zeros_like, params),
+                    state, params)
+                return (optax.apply_updates(params, upd), state), None
+
+            (params, _), _ = jax.lax.scan(body, (params, state), None,
+                                          length=500)
+            return params[None]
+
+        x0 = jax.random.normal(jax.random.PRNGKey(5), (N, 6))
+        out = np.asarray(consensus(x0))
+        target = np.asarray(x0).mean(axis=0)
+        assert np.abs(out - target).max() < 1e-3
