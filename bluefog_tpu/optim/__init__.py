@@ -16,4 +16,5 @@ from bluefog_tpu.optim.optimizers import (
     DistributedHierarchicalNeighborAllreduceOptimizer,
     DistributedWinPutOptimizer,
     DistributedChocoSGDOptimizer,
+    DistributedGradientTrackingOptimizer,
 )

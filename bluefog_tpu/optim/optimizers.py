@@ -49,6 +49,7 @@ __all__ = [
     "DistributedHierarchicalNeighborAllreduceOptimizer",
     "DistributedWinPutOptimizer",
     "DistributedChocoSGDOptimizer",
+    "DistributedGradientTrackingOptimizer",
 ]
 
 
@@ -486,5 +487,86 @@ def DistributedChocoSGDOptimizer(
             new_p, params,
         )
         return new_updates, _ChocoState(base_state, choco)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Gradient tracking (DIGing) — beyond-reference optimizer surface
+# ---------------------------------------------------------------------------
+
+
+class _GTState(NamedTuple):
+    base_state: Any
+    y: Any        # tracking variable: running estimate of the GLOBAL avg grad
+    prev_g: Any   # last step's local (post-base-transform) update direction
+
+
+def DistributedGradientTrackingOptimizer(
+    base: optax.GradientTransformation,
+    topology: Union[Topology, GossipSchedule],
+    axis_name: str,
+    *,
+    backend: str = "auto",
+) -> optax.GradientTransformation:
+    """Gradient tracking (DIGing / Aug-DGM family): decentralized training
+    that converges to the GLOBAL optimum with a constant step size under
+    heterogeneous per-rank data, where plain decentralized SGD stalls at a
+    topology-dependent bias.
+
+    The recursion (W = the gossip mixing matrix):
+
+        x_{t+1} = W x_t − y_t                     (gossip params, step by y)
+        y_{t+1} = W y_t + u_{t+1} − u_t           (track the average update)
+
+    ``u`` is the base transform's update direction (so GT composes with
+    momentum/Adam: it tracks whatever ``base`` emits, scaled updates
+    included); y_0 = u_0 makes Σ_i y_i = Σ_i u_i invariant — y converges to
+    the average update across ranks, which is what kills the bias.
+
+    The reference ships gradient tracking only as a window-ops *example*
+    (`examples/pytorch_*` upstream; here
+    ``examples/decentralized_optimization.py``); this optimizer makes it a
+    first-class, jit-fused training surface like the other four.  Both
+    gossips ride the same fused ppermute fabric (``fuse_apply``) and
+    overlap with compute like every other collective here.
+    """
+    scheds = _as_schedules(topology)
+    if len(scheds) != 1:
+        raise ValueError("gradient tracking takes a single static topology "
+                         "(time-varying W breaks the tracking invariant)")
+    sched = scheds[0]
+
+    def _mix(tree):
+        return C.fuse_apply(
+            lambda t: C.neighbor_allreduce(t, sched, axis_name,
+                                           backend=backend), tree)
+
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # y_0 must equal u_0; signal "first step" with prev_g = None via a
+        # counter-free sentinel: an extra zeros tree plus a flag would cost
+        # a cond — instead initialize y = 0, prev_g = 0, and the first
+        # update's y_1 = W·0 + u_1 − 0 = u_1, which IS the correct y_0 = u_0
+        # start shifted by one mixing round (standard DIGing-ATC variant).
+        return _GTState(base.init(params), zeros, zeros)
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("DistributedGradientTrackingOptimizer requires "
+                             "params in update()")
+        u, base_state = base.update(grads, state.base_state, params)
+        # u is a DESCENT update (optax convention: apply_updates adds it),
+        # so the tracking recursion uses it directly
+        y = jax.tree_util.tree_map(
+            lambda ym, un, uo: ym + un - uo, _mix(state.y), u, state.prev_g)
+        new_p = jax.tree_util.tree_map(
+            lambda xm, yt: (xm.astype(jnp.float32)
+                            + yt.astype(jnp.float32)),
+            _mix(params), y)
+        new_updates = jax.tree_util.tree_map(
+            lambda np_, p: (np_ - p.astype(jnp.float32)).astype(p.dtype),
+            new_p, params)
+        return new_updates, _GTState(base_state, y, u)
 
     return optax.GradientTransformation(init_fn, update_fn)
