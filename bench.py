@@ -516,6 +516,18 @@ def _degraded_exit(reason: str, hard: bool = False):
     sys.exit(0)
 
 
+def _headline_provably_corrupt(out) -> bool:
+    """Corrupt beyond repair: the wall clock claims MORE than the chip's
+    physical peak (mfu vs the nominal spec > 1) AND no device trace exists
+    to demote the headline to (``wall_clock_plausible`` absent — observed:
+    the relay exported host-only traces during the same episode that
+    corrupted its clock).  CPU runs never trip this (no nominal spec)."""
+    return bool(
+        out.get("value_source") == "wall_clock"
+        and "wall_clock_plausible" not in out
+        and (out.get("mfu_vs_nominal") or 0) > 1.0)
+
+
 def _credible(entry) -> bool:
     """A bench result whose headline value is device-trace-backed: either
     its wall clock was corroborated by the trace, or the value itself was
@@ -691,30 +703,35 @@ def main():
 
     if profile_dir:
         # trace-only re-run: run() captures PROFILE_STEPS traced steps;
-        # steps=0 skips the (discarded) timing loop, warmup=1 covers compile
+        # steps=0 skips the (discarded) timing loop, warmup=1 covers compile.
+        # A "successful" capture can still come back with NO device lane
+        # (observed: the relay exported host-only events at batch 1024 while
+        # its wall clock was corrupt — the exact run that most needs the
+        # oracle), so validate the trace parses to device time before
+        # trusting it, and walk down the measured batches until one does.
         args.profile, args.steps, args.warmup = profile_dir, 0, 1
-        try:
-            run(args, best_batch)
-            traced_dir, traced_batch = profile_dir, best_batch
-            print(f"bench: profiler trace written to {profile_dir}",
-                  file=sys.stderr)
-        except Exception as e:  # noqa: BLE001 — the sweep result survives
-            # tracing at the best batch can RESOURCE_EXHAUST (profiler
-            # buffers ride on top of a near-full HBM); fall back to the
-            # next batch down so the capture still yields a device trace
-            print(f"bench: trace at batch {best_batch} failed "
-                  f"({type(e).__name__}: {str(e)[:120]})", file=sys.stderr)
-            smaller = [r[0] for r in results if r[0] < best_batch]
-            if smaller:
-                try:
-                    run(args, max(smaller))
-                    traced_dir, traced_batch = profile_dir, max(smaller)
-                    print(f"bench: profiler trace written to {profile_dir} "
-                          f"at fallback batch {max(smaller)}",
-                          file=sys.stderr)
-                except Exception as e2:  # noqa: BLE001
-                    print(f"bench: fallback trace failed too "
-                          f"({type(e2).__name__})", file=sys.stderr)
+        for try_batch in sorted((r[0] for r in results), reverse=True):
+            if try_batch > best_batch:
+                continue
+            try:
+                run(args, try_batch)
+            except Exception as e:  # noqa: BLE001 — the sweep result
+                # survives: tracing can RESOURCE_EXHAUST (profiler buffers
+                # ride on top of a near-full HBM)
+                print(f"bench: trace at batch {try_batch} failed "
+                      f"({type(e).__name__}: {str(e)[:120]})",
+                      file=sys.stderr)
+                continue
+            if _trace_device_step_ms(profile_dir) is None:
+                print(f"bench: trace at batch {try_batch} has no device "
+                      "events — retrying smaller", file=sys.stderr)
+                continue
+            traced_dir, traced_batch = profile_dir, try_batch
+            print(f"bench: profiler trace written to {profile_dir} "
+                  f"(batch {try_batch})", file=sys.stderr)
+            break
+        else:
+            print("bench: no batch yielded a device trace", file=sys.stderr)
 
     # Timing ground truth: the device's own per-op durations.  The trace
     # corroborates the batch it was captured at directly; when that batch
@@ -786,6 +803,14 @@ def main():
     out.update(perf_sanity_fields(
         devices, peak_flops, achieved_flops, best_mem, flops_per_step,
         best_batch, best_ips))
+    if _headline_provably_corrupt(out):
+        # The cache holds the last trace-corroborated truth; shipping this
+        # value as the headline would be worse than degrading.
+        _degraded_exit(
+            f"fresh sweep wall clock is non-physical (mfu "
+            f"{out['mfu_vs_nominal']:.1f} vs nominal spec) with no device-"
+            "trace corroboration; refusing to headline a provably corrupt "
+            "number")
     print(json.dumps(out))
     # cache ONLY real-TPU numbers: a CPU/test run must never replace the
     # last-good on-chip value that degraded mode would later emit as stale.

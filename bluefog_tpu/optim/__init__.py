@@ -17,4 +17,5 @@ from bluefog_tpu.optim.optimizers import (
     DistributedWinPutOptimizer,
     DistributedChocoSGDOptimizer,
     DistributedGradientTrackingOptimizer,
+    DistributedExactDiffusionOptimizer,
 )

@@ -50,6 +50,7 @@ __all__ = [
     "DistributedWinPutOptimizer",
     "DistributedChocoSGDOptimizer",
     "DistributedGradientTrackingOptimizer",
+    "DistributedExactDiffusionOptimizer",
 ]
 
 
@@ -568,5 +569,89 @@ def DistributedGradientTrackingOptimizer(
             lambda np_, p: (np_ - p.astype(jnp.float32)).astype(p.dtype),
             new_p, params)
         return new_updates, _GTState(base_state, y, u)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Exact diffusion (D2) — beyond-reference optimizer surface
+# ---------------------------------------------------------------------------
+
+
+class _EDState(NamedTuple):
+    base_state: Any
+    prev_psi: Any  # last step's psi = x + u (None-sentinel via first flag)
+    first: jnp.ndarray  # bool: no correction term on the first step
+
+
+def DistributedExactDiffusionOptimizer(
+    base: optax.GradientTransformation,
+    topology: Union[Topology, GossipSchedule],
+    axis_name: str,
+    *,
+    backend: str = "auto",
+) -> optax.GradientTransformation:
+    """Exact diffusion / D² (Yuan, Ying, Zhao & Sayed, 2017): bias-free
+    decentralized training with ONE gossip per step.
+
+    The recursion:
+
+        ψ_t = x_{t-1} + u_t                    (local step)
+        φ_t = ψ_t + x_{t-1} − ψ_{t-1}          (diffusion correction)
+        x_t = W φ_t                            (combine)
+
+    Like gradient tracking it removes plain DSGD's O(lr) heterogeneity
+    bias, but with HALF the communication (one gossip per step instead of
+    two) at the price of requiring a SYMMETRIC, positive-semidefinite-
+    friendly mixing matrix (ring/grid/full; checked at setup).  The first
+    step has no ψ_{t-1} — it runs plain ATC diffusion, which is the
+    standard initialization.
+
+    Upstream ships exact diffusion only inside the window-ops example
+    (`examples/decentralized_optimization.py` here); this makes it a
+    first-class jit-fused optimizer.
+    """
+    scheds = _as_schedules(topology)
+    if len(scheds) != 1:
+        raise ValueError("exact diffusion takes a single static topology")
+    sched = scheds[0]
+    mix_np = sched.mixing_matrix()
+    if not np.allclose(mix_np, mix_np.T, atol=1e-8):
+        raise ValueError(
+            "exact diffusion requires a symmetric mixing matrix "
+            "(ring/grid/full); got an asymmetric one (max |W - W^T| = "
+            f"{np.abs(mix_np - mix_np.T).max():.3g})")
+
+    def _mix(tree):
+        return C.fuse_apply(
+            lambda t: C.neighbor_allreduce(t, sched, axis_name,
+                                           backend=backend), tree)
+
+    def init_fn(params):
+        return _EDState(base.init(params),
+                        jax.tree_util.tree_map(jnp.zeros_like, params),
+                        jnp.ones((), jnp.bool_))
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("DistributedExactDiffusionOptimizer requires "
+                             "params in update()")
+        u, base_state = base.update(grads, state.base_state, params)
+        psi = jax.tree_util.tree_map(
+            lambda x, un: x.astype(jnp.float32) + un.astype(jnp.float32),
+            params, u)
+        # first step: phi = psi (no correction); after: psi + x - prev_psi
+        phi = jax.tree_util.tree_map(
+            lambda ps, x, pp: jnp.where(
+                state.first, ps,
+                ps + x.astype(jnp.float32) - pp),
+            psi, params, state.prev_psi)
+        new_p = _mix(phi)
+        new_updates = jax.tree_util.tree_map(
+            lambda np_, p: (np_.astype(jnp.float32)
+                            - p.astype(jnp.float32)).astype(p.dtype),
+            new_p, params)
+        return new_updates, _EDState(base_state, psi,
+                                     jnp.zeros((), jnp.bool_))
 
     return optax.GradientTransformation(init_fn, update_fn)
