@@ -43,6 +43,7 @@ from bluefog_tpu.topology.schedule import GossipSchedule
 __all__ = [
     "Compressor", "identity", "random_block_k", "top_k",
     "ChocoState", "choco_init", "choco_gossip",
+    "hierarchical_choco_gossip",
 ]
 
 
@@ -208,3 +209,24 @@ def choco_gossip(x, state: ChocoState, schedule: GossipSchedule,
     unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
     return unf(new_x), ChocoState(unf(new_self), unf(new_nbrs),
                                   state.round + 1)
+
+
+def hierarchical_choco_gossip(x, state: ChocoState, machine_schedule,
+                              machine_axis: str, local_axis: str, *,
+                              compressor: Compressor, gamma: float = 1.0,
+                              key=None):
+    """Hierarchical compressed gossip: EXACT average inside a machine
+    (``pmean`` over the local/ICI axis), CHOCO across machines.
+
+    This is where compression earns its keep: the cross-machine hop rides
+    DCN, whose bandwidth is a fraction of ICI's — the reference's
+    hierarchical mode (SURVEY.md §2.4) sends full-precision buffers there.
+    After the local pmean every rank of a machine holds the identical
+    value, so all local ranks advance identical mirror copies and the
+    machine behaves as one CHOCO node (no extra synchronization needed).
+    Returns ``(x_new, state_new)`` with ``x_new`` identical across each
+    machine's local ranks.
+    """
+    x = jax.tree_util.tree_map(lambda t: lax.pmean(t, local_axis), x)
+    return choco_gossip(x, state, machine_schedule, machine_axis,
+                        compressor=compressor, gamma=gamma, key=key)

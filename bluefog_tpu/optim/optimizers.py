@@ -429,7 +429,7 @@ class _ChocoState(NamedTuple):
 def DistributedChocoSGDOptimizer(
     base: optax.GradientTransformation,
     topology: Union[Topology, GossipSchedule],
-    axis_name: str,
+    axis_name: Union[str, Sequence[str]],
     *,
     compressor=None,
     gamma: Optional[float] = None,
@@ -453,6 +453,12 @@ def DistributedChocoSGDOptimizer(
     State carries mirror copies of each in-neighbor's public params (one per
     schedule slot), so memory is (num_slots + 1) × params — the standard
     CHOCO trade: memory for wire bytes.
+
+    Hierarchical (multi-slice/DCN) form: pass
+    ``axis_name=(machine_axis, local_axis)`` with ``topology`` = the
+    MACHINE topology — exact pmean inside each machine over ICI, compressed
+    CHOCO across machines where the wire is DCN and compression matters
+    most (:func:`bluefog_tpu.ops.compression.hierarchical_choco_gossip`).
     """
     from bluefog_tpu.ops import compression as CP
 
@@ -469,6 +475,10 @@ def DistributedChocoSGDOptimizer(
     comp = compressor if compressor is not None else CP.random_block_k(0.1)
     if gamma is None:
         gamma = float(comp.delta)
+    hier = isinstance(axis_name, (tuple, list))
+    if hier and len(axis_name) != 2:
+        raise ValueError("hierarchical axis_name must be "
+                         "(machine_axis, local_axis)")
 
     def init_fn(params):
         return _ChocoState(base.init(params), CP.choco_init(params, sched))
@@ -479,9 +489,15 @@ def DistributedChocoSGDOptimizer(
                              "in update()")
         updates, base_state = base.update(grads, state.base_state, params)
         stepped = optax.apply_updates(params, updates)
-        new_p, choco = CP.choco_gossip(
-            stepped, state.choco, sched, axis_name,
-            compressor=comp, gamma=gamma, key=key)
+        if hier:
+            m_ax, l_ax = axis_name
+            new_p, choco = CP.hierarchical_choco_gossip(
+                stepped, state.choco, sched, m_ax, l_ax,
+                compressor=comp, gamma=gamma, key=key)
+        else:
+            new_p, choco = CP.choco_gossip(
+                stepped, state.choco, sched, axis_name,
+                compressor=comp, gamma=gamma, key=key)
         new_updates = jax.tree_util.tree_map(
             lambda np_, p: (np_.astype(jnp.float32)
                             - p.astype(jnp.float32)).astype(p.dtype),
