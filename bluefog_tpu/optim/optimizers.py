@@ -547,6 +547,16 @@ def DistributedGradientTrackingOptimizer(
     first-class, jit-fused training surface like the other four.  Both
     gossips ride the same fused ppermute fabric (``fuse_apply``) and
     overlap with compute like every other collective here.
+
+    Applicability, measured honestly: GT's win is the smooth/(near-)convex
+    or low-noise regime, where it converges to the exact optimum while
+    DSGD stalls at its bias (the test gate shows >10x).  Under noisy
+    minibatch gradients on deep nets the tracked direction is a stale,
+    ring-mixed average that lags the fast-moving local gradients — short
+    LeNet runs measured it well BEHIND plain gossip at every lr/momentum
+    tried — so prefer ``DistributedNeighborAllreduceOptimizer`` for
+    stochastic deep training and reach for GT when heterogeneity bias, not
+    gradient noise, is the binding constraint.
     """
     scheds = _as_schedules(topology)
     if len(scheds) != 1:

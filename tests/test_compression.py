@@ -306,3 +306,86 @@ class TestHierarchicalChoco:
         with pytest.raises(ValueError, match="machine_axis, local_axis"):
             DistributedChocoSGDOptimizer(
                 optax.sgd(0.1), RingGraph(4), ("a", "b", "c"))
+
+
+class TestChocoEdgeCases:
+    def test_bf16_leaves_converge(self):
+        """Real model trees are bf16: mirrors/payloads in bf16 must still
+        contract (accumulation is f32 per _acc_dtype)."""
+        err, drift = _run_choco_dtype(jnp.bfloat16, CP.random_block_k(0.25),
+                                      0.3, rounds=300)
+        # mirrors/payloads live in bf16 (keeping the (K+1)x state memory
+        # overhead at bf16 size), so consensus bottoms out at the bf16
+        # quantization floor (~5x eps for unit-scale values: measured
+        # 0.038) instead of 1e-7 — bounded, not divergent, and far below
+        # gradient noise in real training
+        assert err < 0.06, err
+        assert drift < 0.06
+
+    def test_size_one_leaf(self):
+        """A scalar-ish leaf (k clamps to 1) must round-trip and gossip."""
+        c = CP.random_block_k(0.1)
+        x = jnp.asarray([3.0])
+        key = jax.random.PRNGKey(0)
+        payload = c.compress(x, key)
+        assert payload.shape == (1,)
+        np.testing.assert_allclose(
+            np.asarray(c.decompress(payload, key, x)), [3.0])
+
+    def test_mixed_tree_shapes(self):
+        """choco_init/gossip over a tree mixing matrices, vectors and a
+        scalar leaf — every leaf gets its own mask key."""
+        sched = build_schedule(RingGraph(N))
+        mesh = mesh8()
+        tree0 = {
+            "w": jax.random.normal(jax.random.PRNGKey(0), (N, 4, 3)),
+            "b": jax.random.normal(jax.random.PRNGKey(1), (N, 5)),
+            "s": jax.random.normal(jax.random.PRNGKey(2), (N, 1)),
+        }
+        comp = CP.random_block_k(0.5)
+
+        def run(blk):
+            x = jax.tree_util.tree_map(lambda t: t[0], blk)
+            st = CP.choco_init(x, sched)
+
+            def body(carry, _):
+                x, st = carry
+                x, st = CP.choco_gossip(x, st, sched, "g",
+                                        compressor=comp, gamma=0.5)
+                return (x, st), None
+
+            (x, _), _ = jax.lax.scan(body, (x, st), None, length=200)
+            return jax.tree_util.tree_map(lambda t: t[None], x)
+
+        out = jax.jit(shard_map(run, mesh=mesh, in_specs=(P("g"),),
+                                out_specs=P("g"), check_vma=False))(tree0)
+        for k in tree0:
+            target = np.asarray(tree0[k]).mean(axis=0)
+            got = np.asarray(out[k])
+            assert np.abs(got - target).max() < 1e-3, (k, got)
+
+
+def _run_choco_dtype(dtype, compressor, gamma, rounds):
+    sched = build_schedule(RingGraph(N))
+    mesh = mesh8()
+    x0 = jax.random.normal(jax.random.PRNGKey(0), (N, 6)).astype(dtype)
+    target = np.asarray(x0, np.float64).mean(axis=0)
+
+    def run(x_blk):
+        x = x_blk[0]
+        st = CP.choco_init(x, sched)
+
+        def body(carry, _):
+            x, st = carry
+            x, st = CP.choco_gossip(x, st, sched, "g",
+                                    compressor=compressor, gamma=gamma)
+            return (x, st), None
+
+        (x, _), _ = jax.lax.scan(body, (x, st), None, length=rounds)
+        return x[None]
+
+    out = np.asarray(jax.jit(shard_map(
+        run, mesh=mesh, in_specs=(P("g"),), out_specs=P("g"),
+        check_vma=False))(x0), np.float64)
+    return (np.abs(out - target).max(),
+            np.abs(out.mean(axis=0) - target).max())
