@@ -263,3 +263,40 @@ class TestElasticResume:
         np.testing.assert_allclose(np.asarray(got2["opt"].mu), 2.0)
         assert np.shape(got2["opt"].nu) == (2, 3)
         mgr.close()
+
+
+def test_new_optimizer_states_roundtrip(tmp_path):
+    """CHOCO / gradient-tracking / exact-diffusion optimizer states (nested
+    NamedTuples with mirror copies, tracking variables, bool flags) must
+    survive checkpoint/restore — supervised restart depends on it."""
+    import optax
+
+    from bluefog_tpu.ops import compression as CP
+    from bluefog_tpu.optim import (
+        DistributedChocoSGDOptimizer,
+        DistributedExactDiffusionOptimizer,
+        DistributedGradientTrackingOptimizer,
+    )
+    from bluefog_tpu.topology.graphs import RingGraph
+
+    params = {"w": jnp.ones((3, 2)), "b": jnp.zeros((4,), jnp.bfloat16)}
+    states = {
+        "choco": DistributedChocoSGDOptimizer(
+            optax.sgd(0.1), RingGraph(8), "bf",
+            compressor=CP.random_block_k(0.25)).init(params),
+        "gt": DistributedGradientTrackingOptimizer(
+            optax.sgd(0.1, momentum=0.9), RingGraph(8), "bf").init(params),
+        "ed": DistributedExactDiffusionOptimizer(
+            optax.sgd(0.1), RingGraph(8), "bf").init(params),
+    }
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(0, states)
+    got = mgr.restore(template=states)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32)),
+        got, states)
+    # structure survives too (NamedTuple classes, not bare tuples)
+    assert got["choco"].choco.xhat_nbrs["w"].shape == (2, 3, 2)
+    assert bool(got["ed"].first) is True
+    mgr.close()
