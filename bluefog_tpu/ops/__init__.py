@@ -47,3 +47,13 @@ from bluefog_tpu.ops.moe import (
     expert_parallel_ffn,
     moe_ffn_reference,
 )
+from bluefog_tpu.ops.compression import (
+    Compressor,
+    identity,
+    random_block_k,
+    top_k,
+    ChocoState,
+    choco_init,
+    choco_gossip,
+    hierarchical_choco_gossip,
+)
