@@ -83,6 +83,11 @@ def main():
                         make_step("flash", flash_block=blk), (q, k, v),
                         args.steps)
                     row[f"block{blk}_ms"] = round(trace_ms or wall_ms, 3)
+                    if trace_ms is None:
+                        # same contract as the main path: a relay wall clock
+                        # with no device trace behind it is not a result
+                        row[f"block{blk}_timing_source"] = (
+                            "wall_clock_uncorroborated")
                 except Exception as e:  # noqa: BLE001
                     row[f"block{blk}_error"] = (
                         f"{type(e).__name__}: {str(e)[:100]}")

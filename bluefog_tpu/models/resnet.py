@@ -146,7 +146,18 @@ class ResNet(nn.Module):
             # Accepts raw [N,H,W,3] (folds here; XLA fuses the reshape) or
             # pre-folded [N,H/2,W/2,12] from the data pipeline.
             if x.shape[-1] == 3:
+                if x.shape[1] % 2 or x.shape[2] % 2:
+                    raise ValueError(
+                        "s2d stem needs even H and W to fold 2x2 tiles; got "
+                        f"{x.shape[1]}x{x.shape[2]}")
                 x = space_to_depth(x, 2)
+            elif x.shape[-1] != 12:
+                # any other channel count would silently skip folding and run
+                # the 4x4/s1 conv at full resolution — different stride and
+                # receptive field than the 7x7/s2 stem it stands in for
+                raise ValueError(
+                    "s2d stem accepts raw [N,H,W,3] or pre-folded "
+                    f"[N,H/2,W/2,12] input; got C={x.shape[-1]}")
             x = conv(self.num_filters, (4, 4), (1, 1),
                      padding=[(2, 1), (2, 1)], name="conv_init")(x)
         else:

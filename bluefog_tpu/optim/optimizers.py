@@ -607,6 +607,7 @@ def DistributedGradientTrackingOptimizer(
 class _EDState(NamedTuple):
     base_state: Any
     prev_psi: Any  # last step's psi = x + u (None-sentinel via first flag)
+    master: Any  # float32 master copy of params — see dtype note below
     first: jnp.ndarray  # bool: no correction term on the first step
 
 
@@ -636,6 +637,17 @@ def DistributedExactDiffusionOptimizer(
     Upstream ships exact diffusion only inside the window-ops example
     (`examples/decentralized_optimization.py` here); this makes it a
     first-class jit-fused optimizer.
+
+    Precision note: unlike DSGD/GT/CHOCO, exact diffusion's dual variable
+    is *implicit* in the difference of consecutive ψ iterates, so
+    quantizing x to bf16 every combine step destroys the conservation law
+    the "exact" in the name depends on (measured: bf16 runs freeze at a
+    spurious consensus once per-step corrections round to zero).  The
+    state therefore carries a float32 master copy of the parameters; the
+    whole recursion runs in f32 and the returned updates merely move the
+    (possibly low-precision) visible params to the cast of the master.
+    Consequence: params must be updated ONLY through this transform's
+    updates, or the master desyncs.
     """
     scheds = _as_schedules(topology)
     if len(scheds) != 1:
@@ -654,8 +666,16 @@ def DistributedExactDiffusionOptimizer(
                                            backend=backend), tree)
 
     def init_fn(params):
+        # prev_psi and master live in float32 regardless of param dtype:
+        # (a) state dtypes must be step-invariant (lax.scan carries,
+        # checkpoint templates from opt.init), (b) the recursion's implicit
+        # dual only survives in f32 — see the docstring's precision note.
+        f32 = lambda t: jnp.asarray(t, jnp.float32)
         return _EDState(base.init(params),
-                        jax.tree_util.tree_map(jnp.zeros_like, params),
+                        jax.tree_util.tree_map(
+                            lambda t: jnp.zeros(t.shape, jnp.float32),
+                            params),
+                        jax.tree_util.tree_map(f32, params),
                         jnp.ones((), jnp.bool_))
 
     def update_fn(grads, state, params=None):
@@ -663,21 +683,18 @@ def DistributedExactDiffusionOptimizer(
             raise ValueError("DistributedExactDiffusionOptimizer requires "
                              "params in update()")
         u, base_state = base.update(grads, state.base_state, params)
+        # x is the f32 master, NOT the visible (possibly bf16) params
         psi = jax.tree_util.tree_map(
-            lambda x, un: x.astype(jnp.float32) + un.astype(jnp.float32),
-            params, u)
+            lambda x, un: x + un.astype(jnp.float32), state.master, u)
         # first step: phi = psi (no correction); after: psi + x - prev_psi
         phi = jax.tree_util.tree_map(
-            lambda ps, x, pp: jnp.where(
-                state.first, ps,
-                ps + x.astype(jnp.float32) - pp),
-            psi, params, state.prev_psi)
-        new_p = _mix(phi)
+            lambda ps, x, pp: jnp.where(state.first, ps, ps + x - pp),
+            psi, state.master, state.prev_psi)
+        new_x = _mix(phi)
         new_updates = jax.tree_util.tree_map(
-            lambda np_, p: (np_.astype(jnp.float32)
-                            - p.astype(jnp.float32)).astype(p.dtype),
-            new_p, params)
-        return new_updates, _EDState(base_state, psi,
+            lambda nx, p: (nx - p.astype(jnp.float32)).astype(p.dtype),
+            new_x, params)
+        return new_updates, _EDState(base_state, psi, new_x,
                                      jnp.zeros((), jnp.bool_))
 
     return optax.GradientTransformation(init_fn, update_fn)
