@@ -1,8 +1,10 @@
 """Gossip transport microbenchmark: Pallas RDMA kernels vs XLA ppermute.
 
 On a real multi-chip TPU slice, times one fused-RDMA gossip step vs the XLA
-lowering across payload sizes (the data behind `auto_gossip_backend`'s size
-cutoff) and reports where `auto` flips.  On a single chip only the XLA path
+lowering across payload sizes.  Per size it reports the gossip chunk plan
+(auto always picks pallas there, splitting oversized payloads into
+VMEM-cap-sized kernels) and where the non-chunkable WINDOW transport's
+size cutoff flips its routing.  On a single chip only the XLA path
 is timed (a shift-0 self-RDMA wedges the axon relay — see the inline note);
 on a CPU mesh (no real kernel execution possible) it instead validates the
 kernel under TPU-interpret emulation against the XLA path and times only the
@@ -81,8 +83,17 @@ def main():
             mesh=mesh, in_specs=(P("bf"),), out_specs=P("bf"),
             check_vma=False))
         row = {"kib": kib, "xla_ms": round(_time(xla_fn, x, args.steps), 3)}
-        auto_choice[kib] = pallas_gossip.auto_gossip_backend(
-            sched, jnp.zeros((elems,), jnp.float32))
+        probe = jnp.zeros((elems,), jnp.float32)
+        auto_choice[kib] = {
+            "gossip": pallas_gossip.auto_gossip_backend(sched, probe),
+            # chunk plan is undefined under a non-positive cap (the
+            # "never use the kernels" override; leaf_chunk_count raises)
+            "gossip_chunks": (pallas_gossip.leaf_chunk_count(probe)
+                              if pallas_gossip.auto_max_bytes() > 0
+                              else None),
+            "window": pallas_gossip.auto_gossip_backend(
+                sched, probe, chunkable=False),
+        }
 
         if on_tpu and n > 1 and pallas_gossip.circulant_shifts(sched):
             pl_fn = jax.jit(shard_map(

@@ -192,8 +192,10 @@ def neighbor_allreduce(
     reduction into the arrival path.  ``backend``: ``'xla'`` and
     ``'pallas'`` force a path; ``'auto'`` selects per call under the stated
     conditions of :func:`bluefog_tpu.ops.pallas_gossip.auto_gossip_backend`
-    (real TPU slice, multi-device, circulant schedule, every leaf within the
-    size cutoff — else XLA).
+    (real TPU slice, multi-device, circulant schedule — else XLA).  On the
+    pallas path, leaves beyond the per-invocation VMEM cap are split into
+    cap-sized chunks (one kernel each), so fused optimizer buffers ride the
+    RDMA kernels by default.
     """
     sched = _as_schedule(schedule)
 
@@ -217,25 +219,54 @@ def neighbor_allreduce(
                          axis_name=axis_name)
 
     if backend == "pallas":
-        # distinct collective_id per leaf: leaf kernels have no mutual data
-        # dependencies, so XLA may overlap them — each needs its own global
-        # barrier semaphore or one kernel's handshake absorbs another's.
-        # Gossip owns ids [1024, 2048); the window transport owns [2048, ...)
+        # distinct collective_id per kernel invocation: DEVICES may be
+        # skewed in time (device A already in chunk k+1's kernel while B is
+        # still in chunk k), so sharing one barrier semaphore would let one
+        # kernel's handshake absorb another's signals.  Gossip owns ids
+        # [1024, 2048); the window transport owns [2048, ...)
         # (ops/windows.py), so the two kernel families can never share a
-        # barrier semaphore inside one program.
+        # barrier semaphore inside one program.  Aggregate VMEM stays
+        # bounded regardless of chunk count: a TensorCore executes one
+        # Mosaic kernel at a time, so at most (num_slots+2) cap-sized
+        # copies are ever resident.
+        #
+        # Leaves larger than the per-invocation cap (the kernel keeps
+        # (num_slots+2) whole-payload copies resident in VMEM) are CHUNKED
+        # into cap-sized pieces rather than routed to XLA: this is what
+        # makes the RDMA kernels the real default under fuse_apply's
+        # one-flat-buffer-per-dtype optimizer trees, and it preserves the
+        # kernel's advantage — every received chunk accumulates in VMEM on
+        # arrival instead of materializing in HBM like a ppermute output.
         leaves, treedef = jax.tree_util.tree_flatten(x)
-        if len(leaves) > 1024:
+        limit = pallas_gossip.auto_max_bytes()
+        n_invocations = sum(
+            pallas_gossip.leaf_chunk_count(leaf, limit) for leaf in leaves)
+        if n_invocations > 1024:
             raise ValueError(
-                f"pallas gossip over {len(leaves)} leaves exceeds the "
-                "collective-id range; fuse the tree first (fuse_apply)")
-        outs = [
-            pallas_gossip.neighbor_allreduce_pallas(
-                leaf, sched, axis_name,
-                self_weight=self_weight, recv_weights=recv_weights,
-                collective_id=1024 + idx,
-            )
-            for idx, leaf in enumerate(leaves)
-        ]
+                f"pallas gossip needs {n_invocations} kernel invocations "
+                f"({len(leaves)} leaves after chunking), exceeding the "
+                "collective-id range; fuse the tree first (fuse_apply) or "
+                "raise BLUEFOG_TPU_PALLAS_MAX_BYTES")
+        cid = 1024
+        outs = []
+        for leaf in leaves:
+            n_chunks = pallas_gossip.leaf_chunk_count(leaf, limit)
+            if n_chunks == 1:
+                outs.append(pallas_gossip.neighbor_allreduce_pallas(
+                    leaf, sched, axis_name,
+                    self_weight=self_weight, recv_weights=recv_weights,
+                    collective_id=cid))
+                cid += 1
+                continue
+            flat = leaf.reshape(-1)
+            chunk_outs = []
+            for piece in jnp.array_split(flat, n_chunks):
+                chunk_outs.append(pallas_gossip.neighbor_allreduce_pallas(
+                    piece, sched, axis_name,
+                    self_weight=self_weight, recv_weights=recv_weights,
+                    collective_id=cid))
+                cid += 1
+            outs.append(jnp.concatenate(chunk_outs).reshape(leaf.shape))
         out = jax.tree_util.tree_unflatten(treedef, outs)
         return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                                 axis_name=axis_name)

@@ -49,6 +49,9 @@ __all__ = [
     "is_pallas_supported",
     "circulant_shifts",
     "auto_gossip_backend",
+    "auto_max_bytes",
+    "leaf_wire_bytes",
+    "leaf_chunk_count",
     "neighbor_allreduce_pallas",
     "deliver_pallas",
     "DEFAULT_AUTO_MAX_BYTES",
@@ -57,16 +60,50 @@ __all__ = [
 _LANES = 128
 _SUBLANES = 8
 
-# 'auto' routes a gossip leaf through the RDMA kernel only up to this many
-# bytes (counted at the on-wire width: bf16 leaves ship as bf16, the rest
-# as f32).  Rationale: the fused
-# kernel wins by folding the weighted reduction into the arrival path (one
-# VMEM pass, no ppermute materialization) — a latency/working-set effect that
-# matters for small and medium tensors; a large tensor is one bandwidth-bound
-# ICI transfer either way, while the kernel's whole-leaf VMEM residency
-# ((num_slots+2) copies live at once) stops paying for itself and risks VMEM
-# pressure.  Override with BLUEFOG_TPU_PALLAS_MAX_BYTES.
+# Per-kernel-invocation payload cap in on-wire bytes (bf16 leaves ship as
+# bf16, the rest as f32).  The kernel holds (num_slots+2) whole-payload
+# copies in VMEM at once, so a single invocation must stay small; the GOSSIP
+# op layer CHUNKS any larger leaf into <=cap pieces (one kernel per chunk,
+# distinct collective ids) instead of falling back to XLA — that keeps every
+# received payload accumulating in VMEM on arrival, never landing in HBM,
+# which is the kernel's whole advantage over ppermute-materialize-then-add
+# (saves ~2*num_slots HBM passes over the buffer per gossip; the per-chunk
+# barrier handshake costs microseconds against that).  The WINDOW deliver
+# path cannot chunk (its landing buffers are persistent window state), so
+# for it this value remains a routing cutoff: bigger payloads take XLA.
+# Override with BLUEFOG_TPU_PALLAS_MAX_BYTES.
 DEFAULT_AUTO_MAX_BYTES = 4 << 20
+
+
+def auto_max_bytes() -> int:
+    """The effective per-invocation payload cap (env-overridable).  A
+    non-positive override means "never use the kernels": auto routes to
+    XLA (the pre-chunking de facto meaning of ``MAX_BYTES=0``), and a
+    *forced* ``backend='pallas'`` raises in :func:`leaf_chunk_count`."""
+    import os
+
+    return int(os.environ.get("BLUEFOG_TPU_PALLAS_MAX_BYTES",
+                              DEFAULT_AUTO_MAX_BYTES))
+
+
+def leaf_wire_bytes(leaf) -> int:
+    """On-wire byte size of one leaf (bf16 ships as bf16, the rest as f32)."""
+    dt = _wire_dtype(getattr(leaf, "dtype", jnp.float32))
+    return (int(np.prod(jnp.shape(leaf), dtype=np.int64))
+            * np.dtype(dt).itemsize)
+
+
+def leaf_chunk_count(leaf, limit: Optional[int] = None) -> int:
+    """How many kernel invocations the gossip op layer will split ``leaf``
+    into (1 = unchunked)."""
+    limit = auto_max_bytes() if limit is None else limit
+    if limit <= 0:
+        raise ValueError(
+            "BLUEFOG_TPU_PALLAS_MAX_BYTES must be positive to run the "
+            f"pallas backend (got {limit}); a non-positive cap only makes "
+            "sense as 'never use the kernels', which backend='auto' "
+            "honors by routing to XLA")
+    return max(1, -(-leaf_wire_bytes(leaf) // limit))
 
 
 def on_tpu_platform() -> bool:
@@ -83,7 +120,8 @@ def on_tpu_platform() -> bool:
     return bool(names & {"tpu", "axon"})
 
 
-def auto_gossip_backend(sched: GossipSchedule, x) -> str:
+def auto_gossip_backend(sched: GossipSchedule, x, *,
+                        chunkable: bool = True) -> str:
     """Resolve ``backend='auto'`` for a gossip call: ``'pallas'`` or ``'xla'``.
 
     The stated conditions under which auto selects the RDMA kernels — ALL
@@ -95,8 +133,13 @@ def auto_gossip_backend(sched: GossipSchedule, x) -> str:
        chip;
     3. a circulant schedule (every slot one uniform ICI rotation — all
        standard topologies; irregular graphs take XLA);
-    4. every leaf at most the size cutoff (see
-       :data:`DEFAULT_AUTO_MAX_BYTES`);
+    4. ``chunkable=False`` only (the window deliver path): every leaf at
+       most the size cutoff (see :data:`DEFAULT_AUTO_MAX_BYTES`).  Gossip
+       callers (``chunkable=True``, the default) have no size condition —
+       the op layer splits oversized leaves into cutoff-sized chunks, so
+       the fused-optimizer buffers (``fuse_apply``'s one-flat-buffer-per-
+       dtype trees, far beyond the cutoff for any real model) ride the
+       RDMA kernels BY DEFAULT rather than quietly falling back to XLA;
     5. not disabled via ``BLUEFOG_TPU_PALLAS_GOSSIP=0`` (the kill switch if
        a deployment's kernels misbehave).
     """
@@ -111,25 +154,26 @@ def auto_gossip_backend(sched: GossipSchedule, x) -> str:
     leaves = jax.tree_util.tree_leaves(x)
     if not leaves:
         return "xla"
-    limit = int(os.environ.get("BLUEFOG_TPU_PALLAS_MAX_BYTES",
-                               DEFAULT_AUTO_MAX_BYTES))
-    biggest = max(
-        int(np.prod(jnp.shape(l), dtype=np.int64)) *
-        np.dtype(_wire_dtype(getattr(l, "dtype", jnp.float32))).itemsize
-        for l in leaves)  # wire width: bf16 leaves ship as bf16, rest f32
-    return "pallas" if biggest <= limit else "xla"
+    limit = auto_max_bytes()
+    if limit <= 0:
+        return "xla"  # explicit "never use the kernels" override
+    if not chunkable and max(leaf_wire_bytes(l) for l in leaves) > limit:
+        return "xla"
+    return "pallas"
 
 
-def resolve_backend(backend: str, sched: GossipSchedule, x) -> str:
+def resolve_backend(backend: str, sched: GossipSchedule, x, *,
+                    chunkable: bool = True) -> str:
     """Shared backend resolution for every transport that can ride the RDMA
     kernels (gossip and the window deliver path): validate the name and
-    resolve ``'auto'`` through :func:`auto_gossip_backend`."""
+    resolve ``'auto'`` through :func:`auto_gossip_backend`.  Window callers
+    pass ``chunkable=False`` (persistent landing buffers cannot chunk)."""
     if backend not in ("auto", "xla", "pallas"):
         raise ValueError(
             f"unknown backend {backend!r}; expected 'auto', 'xla', or "
             "'pallas'")
     if backend == "auto":
-        return auto_gossip_backend(sched, x)
+        return auto_gossip_backend(sched, x, chunkable=chunkable)
     return backend
 
 

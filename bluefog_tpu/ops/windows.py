@@ -164,10 +164,13 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
                                category="window", axis_name=axis_name)
     # same routing policy as gossip (auto_gossip_backend's stated
     # conditions) — the window transport is the same fused RDMA kernel
-    # family in 'put'/'acc' mode
+    # family in 'put'/'acc' mode.  chunkable=False: the landing buffers are
+    # persistent window state, so oversized payloads route to XLA here
+    # instead of chunking (the gossip path chunks).
     from bluefog_tpu.ops import pallas_gossip
 
-    backend = pallas_gossip.resolve_backend(backend, sched, payload)
+    backend = pallas_gossip.resolve_backend(backend, sched, payload,
+                                            chunkable=False)
     mask = _slot_mask(sched, axis_name)
 
     def per_leaf(peers, leaf):

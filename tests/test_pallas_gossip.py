@@ -128,9 +128,10 @@ def test_pallas_deliver_bf16_wire():
                                        rtol=1e-2, atol=1e-2)
 
 
-def test_wire_dtype_selection_and_auto_cutoff():
-    """bf16 leaves are counted at 2 bytes by the auto policy (the wire is
-    bf16), so a bf16 leaf up to 2x the f32 cutoff still routes pallas."""
+def test_wire_dtype_selection_and_chunk_accounting():
+    """bf16 leaves are counted at 2 bytes (the wire is bf16): half the
+    chunks on the gossip path, and up to 2x the f32 cutoff still unchunked /
+    within the window transport's routing cutoff."""
     import jax as _jax
 
     assert pallas_gossip._wire_dtype(jnp.bfloat16) == jnp.bfloat16
@@ -141,11 +142,18 @@ def test_wire_dtype_selection_and_auto_cutoff():
     cutoff_elems = pallas_gossip.DEFAULT_AUTO_MAX_BYTES // 4
     f32_big = jnp.zeros((cutoff_elems + 1,), jnp.float32)
     bf16_same = jnp.zeros((cutoff_elems + 1,), jnp.bfloat16)
+    assert pallas_gossip.leaf_chunk_count(f32_big) == 2
+    assert pallas_gossip.leaf_chunk_count(bf16_same) == 1
     try:
         orig = _jax.default_backend
         _jax.default_backend = lambda: "tpu"
-        assert pallas_gossip.auto_gossip_backend(sched, f32_big) == "xla"
-        assert pallas_gossip.auto_gossip_backend(sched, bf16_same) == "pallas"
+        # gossip: chunking means no size-based fallback either way
+        assert pallas_gossip.auto_gossip_backend(sched, f32_big) == "pallas"
+        # window transport (non-chunkable): the wire width decides
+        assert pallas_gossip.auto_gossip_backend(
+            sched, f32_big, chunkable=False) == "xla"
+        assert pallas_gossip.auto_gossip_backend(
+            sched, bf16_same, chunkable=False) == "pallas"
     finally:
         _jax.default_backend = orig
 
