@@ -163,6 +163,7 @@ def neighbor_allreduce(
     recv_weights=None,
     send_weights=None,
     backend: str = "auto",
+    collective_id_base: int = 1024,
 ):
     """Weighted average with in-neighbors: ``out_i = w_ii x_i + sum_k w_ik x_k``.
 
@@ -196,6 +197,14 @@ def neighbor_allreduce(
     pallas path, leaves beyond the per-invocation VMEM cap are split into
     cap-sized chunks (one kernel each), so fused optimizer buffers ride the
     RDMA kernels by default.
+
+    ``collective_id_base``: where this call's pallas kernels start
+    enumerating barrier-semaphore ids (gossip owns [1024, 2048)).  A
+    program that issues SEVERAL pallas gossip calls over trees with no
+    data dependency between them (e.g. gradient tracking's y-mix and
+    params-mix) must give each call a distinct base — devices may be
+    skewed across the calls' kernels, and sharing a barrier semaphore
+    would let one call's handshake absorb another's signals.
     """
     sched = _as_schedule(schedule)
 
@@ -241,13 +250,18 @@ def neighbor_allreduce(
         limit = pallas_gossip.auto_max_bytes()
         n_invocations = sum(
             pallas_gossip.leaf_chunk_count(leaf, limit) for leaf in leaves)
-        if n_invocations > 1024:
+        if not 1024 <= collective_id_base < 2048:
+            raise ValueError(
+                f"collective_id_base {collective_id_base} outside the "
+                "gossip id range [1024, 2048)")
+        if collective_id_base + n_invocations > 2048:
             raise ValueError(
                 f"pallas gossip needs {n_invocations} kernel invocations "
-                f"({len(leaves)} leaves after chunking), exceeding the "
-                "collective-id range; fuse the tree first (fuse_apply) or "
-                "raise BLUEFOG_TPU_PALLAS_MAX_BYTES")
-        cid = 1024
+                f"({len(leaves)} leaves after chunking) from base "
+                f"{collective_id_base}, exceeding the collective-id range; "
+                "fuse the tree first (fuse_apply) or raise "
+                "BLUEFOG_TPU_PALLAS_MAX_BYTES")
+        cid = collective_id_base
         outs = []
         for leaf in leaves:
             n_chunks = pallas_gossip.leaf_chunk_count(leaf, limit)
@@ -304,20 +318,24 @@ def neighbor_allreduce_dynamic(
     axis_name: str,
     *,
     backend: str = "auto",
+    collective_id_base: int = 1024,
 ):
     """Time-varying gossip: applies ``schedules[step % len(schedules)]``.
 
     ``step`` may be a traced integer (e.g. the optimizer step counter): the
     period's schedules are compiled once into a ``lax.switch`` — this is the
     recompilation-free answer to the reference's per-call ``src_weights``
-    dynamic-topology API (SURVEY.md §7 hard-part #2).
+    dynamic-topology API (SURVEY.md §7 hard-part #2).  The switch branches
+    are mutually exclusive, so they may share ``collective_id_base``.
     """
     scheds = [_as_schedule(s) for s in schedules]
     if len(scheds) == 1:
-        return neighbor_allreduce(x, scheds[0], axis_name, backend=backend)
+        return neighbor_allreduce(x, scheds[0], axis_name, backend=backend,
+                                  collective_id_base=collective_id_base)
     branches = [
         functools.partial(neighbor_allreduce, schedule=s, axis_name=axis_name,
-                          backend=backend)
+                          backend=backend,
+                          collective_id_base=collective_id_base)
         for s in scheds
     ]
     return lax.switch(jnp.asarray(step) % len(scheds), branches, x)

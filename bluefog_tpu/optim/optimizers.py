@@ -564,10 +564,15 @@ def DistributedGradientTrackingOptimizer(
                          "(time-varying W breaks the tracking invariant)")
     sched = scheds[0]
 
-    def _mix(tree):
+    def _mix(tree, cid_base=1024):
+        # the y-mix and the params-mix in one update are data-INDEPENDENT
+        # gossips — on the pallas backend each needs its own barrier-
+        # semaphore id range (devices may skew across the two kernels)
         return C.fuse_apply(
             lambda t: C.neighbor_allreduce(t, sched, axis_name,
-                                           backend=backend), tree)
+                                           backend=backend,
+                                           collective_id_base=cid_base),
+            tree)
 
     def init_fn(params):
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -590,7 +595,7 @@ def DistributedGradientTrackingOptimizer(
         new_p = jax.tree_util.tree_map(
             lambda xm, yt: (xm.astype(jnp.float32)
                             + yt.astype(jnp.float32)),
-            _mix(params), y)
+            _mix(params, cid_base=1536), y)
         new_updates = jax.tree_util.tree_map(
             lambda np_, p: (np_ - p.astype(jnp.float32)).astype(p.dtype),
             new_p, params)

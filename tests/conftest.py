@@ -6,6 +6,7 @@ pytest, no launcher.  Env vars must be set before jax initializes a backend,
 hence at module import time here.
 """
 
+import functools
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env may point at a TPU
@@ -43,20 +44,25 @@ def devices8():
 AOT_TOPO_NAME = "v5e:2x4"
 
 
-@pytest.fixture(scope="session")
-def tpu_aot_topology():
+@functools.lru_cache(maxsize=None)
+def aot_topology(name: str):
     """AOT TPU topology for compile-only tests (overlap report, Pallas
-    kernel schedulability).  Skips when the topologies API or libtpu is
-    missing; anything else (e.g. a ValueError from a typo'd topology name)
-    must FAIL, not skip — PARITY.md advertises these tests as enforced
-    where libtpu exists.  Session-scoped: get_topology_desc loads the TPU
-    compiler, which is worth doing once, not per test."""
+    kernel schedulability).  ONE skip policy for every AOT test: skips when
+    the topologies API or libtpu is missing; anything else (e.g. a
+    ValueError from a typo'd topology name) must FAIL, not skip — PARITY.md
+    advertises these tests as enforced where libtpu exists.  lru_cached:
+    get_topology_desc loads the TPU compiler, worth doing once per name."""
     try:
         from jax.experimental import topologies
     except ImportError as e:  # API moved/removed in a jax upgrade
         pytest.skip(f"jax topologies API unavailable: {e}")
     try:
         return topologies.get_topology_desc(platform="tpu",
-                                            topology_name=AOT_TOPO_NAME)
+                                            topology_name=name)
     except RuntimeError as e:  # no libtpu on this machine
         pytest.skip(f"TPU AOT topology unavailable: {e}")
+
+
+@pytest.fixture(scope="session")
+def tpu_aot_topology():
+    return aot_topology(AOT_TOPO_NAME)

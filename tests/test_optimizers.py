@@ -365,3 +365,25 @@ class TestExactDiffusion:
         w = np.asarray(w, np.float32)
         # bf16 ulp at 3.5 is 0.03125; allow a few ulps of combine rounding
         assert np.abs(w - 3.5).max() < 0.1, w
+
+
+def test_gradient_tracking_mixes_use_distinct_collective_id_bases(monkeypatch):
+    """GT issues TWO data-independent gossips per update (y-mix and
+    params-mix); on the pallas backend each must claim its own barrier-
+    semaphore id range or one kernel's handshake could absorb the
+    other's signals (r5 review finding)."""
+    from bluefog_tpu.optim import DistributedGradientTrackingOptimizer
+    from bluefog_tpu.ops import collectives as C
+
+    bases = []
+    real = C.neighbor_allreduce
+
+    def spy(x, sched, axis_name, **kw):
+        bases.append(kw.get("collective_id_base", 1024))
+        return real(x, sched, axis_name, **kw)
+
+    monkeypatch.setattr(C, "neighbor_allreduce", spy)
+    opt = DistributedGradientTrackingOptimizer(
+        optax.sgd(0.05), RingGraph(N), "bf")
+    run_quadratic(opt, steps=2)
+    assert len(set(bases)) == 2, bases
