@@ -1,0 +1,137 @@
+"""Pipeline schedule comparison: GPipe vs 1F1B on the 8-virtual-device mesh.
+
+What can be measured honestly in this environment (no multi-chip TPU):
+
+- **Activation memory** — THE 1F1B claim.  `compiled.memory_analysis()` for
+  the pp=4 training step at growing microbatch counts M: GPipe's temp
+  allocation grows with M (all-M activation tape), 1F1B's stays flat (its
+  stash is a min(S, M)-slot ring).  This is a compiled-program property of
+  the real XLA pipeline, not a simulation.
+- **Bubble accounting** — both schedules have the same analytic bubble
+  fraction (S-1)/(M+S-1) (non-interleaved schedules; 1F1B's win is memory,
+  not bubble).  Reported per M so the table shows the bubble shrinking as
+  M grows — the knob 1F1B makes affordable.
+- **CPU wall clock** — informational only (8 virtual CPU devices share one
+  host; not TPU-representative), flagged as such.
+
+Run: python benchmarks/pipeline_bench.py [--micros 4 8 16 32]
+Prints one JSON line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu.parallel.api import shard_map
+from bluefog_tpu.parallel.pipeline import (
+    pipeline_train_step_1f1b,
+    pipeline_train_step_gpipe,
+    stack_stage_params,
+)
+
+S = 4      # stages
+D = 256    # width
+L = 8      # layers
+MB = 8     # microbatch size
+
+
+def stage_fn(sp, x):
+    def body(x, w):
+        return jnp.tanh(x @ w), None
+    out, _ = lax.scan(body, x, sp["w"])
+    return out
+
+
+def loss_fn(head, y, t):
+    del head
+    return jnp.sum((y - t) ** 2)
+
+
+def build(step, mesh, M, **kw):
+    layers = {"w": jax.random.normal(jax.random.PRNGKey(0), (L, D, D))
+              / np.sqrt(D)}
+    staged = stack_stage_params(layers, S)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (M, MB, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (M, MB, D))
+
+    def body(staged_local, xs):
+        sp = jax.tree_util.tree_map(lambda t: t[0], staged_local)
+        loss, g, _, _ = step(stage_fn, sp, xs, tgt, loss_fn,
+                             pp_axis="pp", num_stages=S, **kw)
+        return lax.psum(loss, "pp"), jax.tree_util.tree_map(
+            lambda t: t[None], g)
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P()),
+        out_specs=(P(), P("pp")), check_vma=False))
+    staged = jax.device_put(staged, NamedSharding(mesh, P("pp")))
+    xs = jax.device_put(xs, NamedSharding(mesh, P()))
+    return fn, staged, xs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--micros", type=int, nargs="+", default=[4, 8, 16, 32])
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+
+    devs = jax.devices()
+    if len(devs) < S:
+        print(json.dumps({"metric": "pipeline_gpipe_vs_1f1b",
+                          "error": f"needs {S} devices, got {len(devs)}"}))
+        return
+    mesh = Mesh(np.array(devs[:S]), ("pp",))
+
+    rows = []
+    for M in args.micros:
+        row = {"micros": M, "bubble_fraction": round((S - 1) / (M + S - 1), 4)}
+        for name, step, kw in [
+            ("gpipe", pipeline_train_step_gpipe, {}),
+            ("gpipe_remat", pipeline_train_step_gpipe, {"remat": True}),
+            ("1f1b", pipeline_train_step_1f1b, {}),
+        ]:
+            fn, staged, xs = build(step, mesh, M, **kw)
+            compiled = fn.lower(staged, xs).compile()
+            mem = compiled.memory_analysis()
+            temp = getattr(mem, "temp_size_in_bytes", None)
+            # None would silently read as 0.0 and vacuously "confirm" the
+            # flat-memory claim — report unavailability explicitly
+            row[f"{name}_temp_mib"] = (round(temp / (1 << 20), 2)
+                                       if temp is not None else None)
+            # wall (CPU, informational)
+            out = fn(staged, xs)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                out = fn(staged, xs)
+            jax.block_until_ready(out)
+            row[f"{name}_wall_ms"] = round(
+                (time.perf_counter() - t0) / args.steps * 1e3, 1)
+        rows.append(row)
+        print(f"M={M}: {row}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "pipeline_gpipe_vs_1f1b",
+        "platform": devs[0].platform,
+        "stages": S, "layers": L, "width": D, "micro_batch": MB,
+        "rows": rows,
+        "note": ("temp_mib is compiled XLA memory analysis (real pipeline "
+                 "program); wall is CPU-mesh-only, not TPU-representative. "
+                 "Non-interleaved schedules share the analytic bubble "
+                 "(S-1)/(M+S-1); 1F1B's win is the flat activation memory "
+                 "as M grows."),
+    }))
+
+
+if __name__ == "__main__":
+    main()

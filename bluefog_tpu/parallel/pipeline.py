@@ -14,10 +14,34 @@ Design (TPU-first, the scaling-book shard_map pipelining recipe):
   ICI).  No data-dependent control flow — stage 0's input injection and the
   last stage's output collection are ``jnp.where`` selects on the tick index,
   so XLA compiles one static program.
-- Backward is plain ``jax.grad`` through the scan: ``ppermute`` transposes to
-  the reverse permute, giving the mirrored backward pipeline for free — no
-  hand-written 1F1B schedule.  Combine with ``jax.checkpoint`` on ``stage_fn``
-  to keep activation memory at GPipe levels.
+Two schedules:
+
+- **GPipe** (:func:`pipeline_apply` + ``jax.grad``): backward is plain
+  autodiff through the scan — ``ppermute`` transposes to the reverse
+  permute, giving the mirrored backward pipeline for free.  Activation
+  stash grows with ``num_micro`` (every microbatch's activations live
+  until its backward).
+- **1F1B** (:func:`pipeline_train_step_1f1b`): a hand-rolled
+  one-forward-one-backward schedule with activation-checkpointed
+  backward.  Stage ``s`` runs the forward of microbatch ``m`` at global
+  tick ``s + 2m`` and its backward at tick ``2S - 1 - s + 2m``; the two
+  families land on opposite tick parities per stage, both the forward
+  activation and the backward cotangent arrive exactly one tick after
+  they are sent (one ``ppermute`` per rail per tick), and the whole
+  schedule closes in the canonical ``2(M + S - 1)`` ticks — the same
+  bubble fraction as GPipe, ``(S-1)/(M+S-1)``.  The win is MEMORY: each
+  stage stashes only its in-flight microbatch inputs (``<= S - s``
+  slots, a ring buffer of ``min(S, M)``) instead of all ``M``, so
+  ``num_micro`` can scale without activation memory scaling with it
+  (measured in ``benchmarks/pipeline_bench.py``).
+
+Non-shape-preserving embed/head stages: the pipeline carries ONE static
+inter-stage activation shape (SPMD: all stages execute the same program),
+so token->embedding and head->loss live at the rim: embed the raw
+microbatches BEFORE injection (``input_grads`` from the 1F1B step give
+the cotangents to continue into the embed's backward), and fold the head
+into ``loss_fn(head_params, y, target)``, whose parameter gradients the
+1F1B step accumulates alongside the stage gradients.
 """
 
 from __future__ import annotations
@@ -32,6 +56,8 @@ __all__ = [
     "stack_stage_params",
     "pipeline_apply",
     "pipeline_spmd_axis_perm",
+    "pipeline_train_step_1f1b",
+    "pipeline_train_step_gpipe",
 ]
 
 
@@ -109,3 +135,204 @@ def pipeline_apply(
     # last stage emitted microbatch m at tick m + num_stages - 1
     out = lax.dynamic_slice_in_dim(ys, num_stages - 1, num_micro, axis=0)
     return out
+
+
+def pipeline_train_step_1f1b(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    targets,
+    loss_fn: Callable,
+    *,
+    pp_axis: str = "pp",
+    num_stages: int,
+    head_params=None,
+    collect_input_grads: bool = False,
+):
+    """One 1F1B training step; call inside ``shard_map``.
+
+    Schedule (see the module docstring): stage ``s`` forwards microbatch
+    ``m`` at tick ``s + 2m`` and backwards it at ``2S - 1 - s + 2m``; the
+    backward RECOMPUTES the stage forward from the stashed input
+    (activation checkpointing — the standard 1F1B memory discipline), so
+    per-stage stash is a ``min(S, M)``-slot ring of microbatch inputs
+    rather than GPipe's all-``M`` activation tape.
+
+    Args:
+      stage_fn: ``(stage_params_local, activation) -> activation`` (shape-
+        preserving, as in :func:`pipeline_apply`).
+      stage_params: this rank's stage block.
+      microbatches: ``(M, micro_batch, ...)`` INTER-STAGE-shaped inputs —
+        already embedded if the model has a token embedding (see
+        ``collect_input_grads``).
+      targets: ``(M, ...)`` per-microbatch loss targets (consumed by the
+        last stage only).
+      loss_fn: ``(head_params, y, target) -> scalar`` — the model head
+        folded into the loss.  Evaluated (cheaply, masked) on every stage;
+        only the last stage's value and gradients are accumulated.
+      head_params: parameters of ``loss_fn``'s head; ``None`` for a bare
+        loss.
+      collect_input_grads: also return ``(M, ...)`` cotangents of the
+        microbatch inputs (valid on stage 0) — chain these into the
+        embedding's backward outside the pipeline.
+
+    Returns:
+      ``(loss_sum, stage_grads, head_grads, input_grads)`` — all LOCAL to
+      this stage: ``loss_sum``/``head_grads`` are nonzero on the last
+      stage, ``input_grads`` (or ``None``) on stage 0, ``stage_grads``
+      are this stage's own block gradients.  Not psum'd: per-stage
+      ownership is the natural sharding for the optimizer step.
+    """
+    S = num_stages
+    M = microbatches.shape[0]
+    K = min(S, M)  # stash depth: stage s holds <= S - s in-flight micros
+    stage = lax.axis_index(pp_axis)
+    is_last = stage == S - 1
+    total_ticks = 2 * (M + S - 1)
+    fwd_perm = pipeline_spmd_axis_perm(S)
+    bwd_perm = [(i, i - 1) for i in range(1, S)]
+    if head_params is None:
+        head_params = {}
+
+    act0 = jnp.zeros_like(microbatches[0])
+    f32 = lambda t: jnp.zeros(jnp.shape(t), jnp.float32)
+    g_acc0 = jax.tree_util.tree_map(f32, stage_params)
+    h_acc0 = jax.tree_util.tree_map(f32, head_params)
+    dx_buf0 = (jnp.zeros_like(microbatches) if collect_input_grads else
+               jnp.zeros((), act0.dtype))
+
+    def tick(carry, t):
+        fwd_msg, bwd_msg, stash, g_acc, h_acc, loss_acc, dx_buf = carry
+
+        # Each tick is on exactly ONE rail for a given stage (the two
+        # families have opposite tick parities), so a real runtime
+        # conditional — lax.cond on the scalar per-device predicate, not a
+        # both-branches select — runs one stage_fn application on forward
+        # ticks and one recompute+vjp on backward ticks.  Without it every
+        # tick would execute both rails and the schedule would cost 2x
+        # GPipe's compute.
+        diff_f = t - stage
+        on_fwd_rail = diff_f % 2 == 0
+        is_f = (diff_f >= 0) & on_fwd_rail & (diff_f // 2 < M)
+        m_f = jnp.clip(diff_f // 2, 0, M - 1)
+        diff_b = t - (2 * S - 1 - stage)
+        is_b = (diff_b >= 0) & (diff_b % 2 == 0) & (diff_b // 2 < M)
+        m_b = jnp.clip(diff_b // 2, 0, M - 1)
+
+        zero_g = lambda tree: jax.tree_util.tree_map(
+            lambda r: jnp.zeros(jnp.shape(r), jnp.asarray(r).dtype), tree)
+
+        def fwd_branch(stash):
+            inject = lax.dynamic_index_in_dim(microbatches, m_f, 0,
+                                              keepdims=False)
+            x_in = jnp.where(stage == 0, inject.astype(act0.dtype), fwd_msg)
+            y = stage_fn(stage_params, x_in)
+            stash = jnp.where(
+                is_f,
+                lax.dynamic_update_index_in_dim(stash, x_in, m_f % K, 0),
+                stash)
+            return (y, jnp.zeros_like(act0), stash,
+                    zero_g(stage_params), zero_g(head_params),
+                    jnp.zeros((), jnp.float32))
+
+        def bwd_branch(stash):
+            x_saved = lax.dynamic_index_in_dim(stash, m_b % K, 0,
+                                               keepdims=False)
+            yb, vjp_fn = jax.vjp(stage_fn, stage_params, x_saved)
+            tgt = lax.dynamic_index_in_dim(targets, m_b, 0, keepdims=False)
+            # last stage seeds the cotangent from the loss; others use the
+            # message from stage s+1.  The head runs ONLY on the last
+            # stage (nested runtime cond): with an LM-sized head its
+            # forward+backward rivals a thin stage's flops, so evaluating
+            # it masked on every stage would waste (S-1)x that compute.
+            def head_branch(yb):
+                loss_m, (dh, dy_loss) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1))(head_params, yb, tgt)
+                return (loss_m.astype(jnp.float32), dh,
+                        dy_loss.astype(act0.dtype))
+
+            def no_head(yb):
+                return (jnp.zeros((), jnp.float32), zero_g(head_params),
+                        bwd_msg)
+
+            loss_m, dh, dy = lax.cond(is_last, head_branch, no_head, yb)
+            dp, dx = vjp_fn(dy)
+            return (jnp.zeros_like(act0), dx, stash, dp, dh, loss_m)
+
+        y, dx, stash, dp, dh, loss_m = lax.cond(
+            on_fwd_rail, fwd_branch, bwd_branch, stash)
+
+        take_b = is_b
+        take_h = is_b & is_last
+        g_acc = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(take_b, g.astype(jnp.float32), 0.0),
+            g_acc, dp)
+        h_acc = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(take_h, g.astype(jnp.float32), 0.0),
+            h_acc, dh)
+        loss_acc = loss_acc + jnp.where(take_h, loss_m, 0.0)
+        if collect_input_grads:
+            dx_buf = jnp.where(
+                take_b & (stage == 0),
+                lax.dynamic_update_index_in_dim(dx_buf, dx, m_b, 0),
+                dx_buf)
+        fwd_out = lax.ppermute(y, pp_axis, fwd_perm)
+        bwd_out = lax.ppermute(dx, pp_axis, bwd_perm)
+
+        return ((fwd_out, bwd_out, stash, g_acc, h_acc, loss_acc, dx_buf),
+                None)
+
+    carry0 = (act0, act0, jnp.zeros((K,) + act0.shape, act0.dtype),
+              g_acc0, h_acc0, jnp.zeros((), jnp.float32), dx_buf0)
+    (_, _, _, g_acc, h_acc, loss_acc, dx_buf), _ = lax.scan(
+        tick, carry0, jnp.arange(total_ticks))
+
+    cast = lambda acc, ref: jax.tree_util.tree_map(
+        lambda a, r: a.astype(jnp.asarray(r).dtype), acc, ref)
+    return (loss_acc, cast(g_acc, stage_params), cast(h_acc, head_params),
+            dx_buf if collect_input_grads else None)
+
+
+def pipeline_train_step_gpipe(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    targets,
+    loss_fn: Callable,
+    *,
+    pp_axis: str = "pp",
+    num_stages: int,
+    head_params=None,
+    collect_input_grads: bool = False,
+    remat: bool = False,
+):
+    """GPipe counterpart of :func:`pipeline_train_step_1f1b` — same
+    signature and return contract, backward via ``jax.grad`` through the
+    forward scan (optionally with ``jax.checkpoint`` on ``stage_fn``:
+    recompute-in-backward like 1F1B, but still an all-``M`` stash of
+    STAGE INPUTS in the scan's saved residuals)."""
+    S = num_stages
+    if head_params is None:
+        head_params = {}
+    sfn = jax.checkpoint(stage_fn) if remat else stage_fn
+    is_last = lax.axis_index(pp_axis) == S - 1
+
+    def local_loss(stage_params, head_params, microbatches):
+        outs = pipeline_apply(sfn, stage_params, microbatches,
+                              pp_axis=pp_axis, num_stages=S)
+        losses = jax.vmap(loss_fn, in_axes=(None, 0, 0))(head_params, outs,
+                                                         targets)
+        # masked LOCAL loss: non-last stages contribute 0; the last
+        # stage's gradient flows back through the ppermute transposes
+        return jnp.sum(jnp.where(is_last, losses.astype(jnp.float32), 0.0))
+
+    if collect_input_grads:
+        loss, (g, h, dxs) = jax.value_and_grad(local_loss, argnums=(0, 1, 2))(
+            stage_params, head_params, microbatches)
+    else:
+        # don't differentiate wrt the inputs when unused: the (M, ...)
+        # cotangent buffer would inflate temp memory for nothing
+        loss, (g, h) = jax.value_and_grad(local_loss, argnums=(0, 1))(
+            stage_params, head_params, microbatches)
+        dxs = None
+    return loss, g, h, dxs

@@ -148,3 +148,150 @@ def test_pipeline_with_tp_inner_axis(devices8):
         body, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
         check_vma=False))(staged, xs)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (round-5): same math as GPipe, S-deep stash instead of M
+# ---------------------------------------------------------------------------
+
+from bluefog_tpu.parallel.pipeline import (  # noqa: E402
+    pipeline_train_step_1f1b,
+    pipeline_train_step_gpipe,
+)
+
+
+def _staged_grad_ref(layers, xs, tgt):
+    """Sequential autodiff reference, regrouped per stage."""
+    def ref_loss(layers):
+        out = sequential_ref(layers, xs)
+        return jnp.sum((out - tgt) ** 2)
+    loss, g = jax.value_and_grad(ref_loss)(layers)
+    return float(loss), stack_stage_params(g, PP)
+
+
+def _sq_loss(head_params, y, t):
+    del head_params
+    return jnp.sum((y - t) ** 2)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe", "gpipe_remat"])
+def test_train_step_grads_match_sequential(devices8, schedule):
+    """Both pipeline training schedules must reproduce the sequential
+    model's loss and per-stage gradients exactly (f32 tolerance)."""
+    mesh = make_hybrid_mesh({"pp": PP}, devices=devices8[:PP])
+    layers = make_layers(jax.random.PRNGKey(0))
+    staged = stack_stage_params(layers, PP)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (MICRO, MB, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (MICRO, MB, D))
+    ref_loss, gref = _staged_grad_ref(layers, xs, tgt)
+
+    step = (pipeline_train_step_1f1b if schedule == "1f1b"
+            else pipeline_train_step_gpipe)
+    kw = {"remat": True} if schedule == "gpipe_remat" else {}
+
+    def body(staged_local, xs):
+        sp = jax.tree_util.tree_map(lambda t: t[0], staged_local)
+        loss, g, _, _ = step(stage_fn, sp, xs, tgt, _sq_loss,
+                             pp_axis="pp", num_stages=PP, **kw)
+        loss = lax.psum(loss, "pp")  # nonzero on last stage only
+        return loss[None], jax.tree_util.tree_map(lambda t: t[None], g)
+
+    loss, g = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P()),
+        out_specs=(P("pp"), P("pp")), check_vma=False))(staged, xs)
+
+    np.testing.assert_allclose(np.asarray(loss), ref_loss, rtol=1e-5)
+    for name in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(g[name]), np.asarray(gref[name]), atol=1e-4,
+            err_msg=f"{schedule} grad mismatch for {name}")
+
+
+def test_1f1b_matches_gpipe_bitwise_shapes(devices8):
+    """The two schedules agree with each other on loss + grads + input
+    cotangents (embed-chaining contract) for M not a multiple of S."""
+    M = 7  # exercises uneven drain
+    mesh = make_hybrid_mesh({"pp": PP}, devices=devices8[:PP])
+    layers = make_layers(jax.random.PRNGKey(3))
+    staged = stack_stage_params(layers, PP)
+    xs = jax.random.normal(jax.random.PRNGKey(4), (M, MB, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(5), (M, MB, D))
+
+    def run(step, **kw):
+        def body(staged_local, xs):
+            sp = jax.tree_util.tree_map(lambda t: t[0], staged_local)
+            loss, g, _, dxs = step(stage_fn, sp, xs, tgt, _sq_loss,
+                                   pp_axis="pp", num_stages=PP,
+                                   collect_input_grads=True, **kw)
+            first = lax.axis_index("pp") == 0
+            dxs = lax.psum(jnp.where(first, dxs, 0.0), "pp")
+            return (lax.psum(loss, "pp")[None],
+                    jax.tree_util.tree_map(lambda t: t[None], g), dxs)
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("pp"), P()),
+            out_specs=(P("pp"), P("pp"), P()), check_vma=False))(staged, xs)
+
+    l1, g1, dx1 = run(pipeline_train_step_1f1b)
+    l2, g2, dx2 = run(pipeline_train_step_gpipe)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-6)
+    for name in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g1[name]),
+                                   np.asarray(g2[name]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx1), np.asarray(dx2), atol=1e-5)
+
+
+def test_1f1b_embed_and_head_stages(devices8):
+    """Non-shape-preserving rim stages: int tokens -> embedding outside the
+    pipeline (backward chained through input_grads) and a projection head
+    inside loss_fn (its grads accumulated by the step).  Must match the
+    sequential embed->layers->head model's autodiff end-to-end."""
+    V, M = 11, 6
+    mesh = make_hybrid_mesh({"pp": PP}, devices=devices8[:PP])
+    layers = make_layers(jax.random.PRNGKey(6))
+    staged = stack_stage_params(layers, PP)
+    emb = jax.random.normal(jax.random.PRNGKey(7), (V, D)) / np.sqrt(D)
+    head = {"w": jax.random.normal(jax.random.PRNGKey(8), (D, V)) / np.sqrt(D)}
+    toks = jax.random.randint(jax.random.PRNGKey(9), (M, MB), 0, V)
+    tgt = jax.random.randint(jax.random.PRNGKey(10), (M, MB), 0, V)
+
+    def head_loss(head_params, y, t):
+        logits = y @ head_params["w"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.sum(jnp.take_along_axis(logp, t[..., None], -1))
+
+    def ref_loss(emb, layers, head):
+        x = emb[toks]
+        out = sequential_ref(layers, x)
+        return sum(head_loss(head, out[m], tgt[m]) for m in range(M))
+
+    rl, (ge_ref, gl_ref, gh_ref) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(emb, layers, head)
+    gl_ref = stack_stage_params(gl_ref, PP)
+
+    def body(staged_local, emb, head, toks):
+        sp = jax.tree_util.tree_map(lambda t: t[0], staged_local)
+        xs = emb[toks]  # embed at the rim, on every stage (replicated)
+        loss, g, gh, dxs = pipeline_train_step_1f1b(
+            stage_fn, sp, xs, tgt, head_loss, pp_axis="pp", num_stages=PP,
+            head_params=head, collect_input_grads=True)
+        # chain the input cotangents through the embedding's backward
+        first = lax.axis_index("pp") == 0
+        dxs = lax.psum(jnp.where(first, dxs, 0.0), "pp")
+        _, emb_vjp = jax.vjp(lambda e: e[toks], emb)
+        (ge,) = emb_vjp(dxs)
+        return (lax.psum(loss, "pp")[None],
+                jax.tree_util.tree_map(lambda t: t[None], g),
+                lax.psum(gh["w"], "pp"), ge)
+
+    loss, g, gh, ge = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P(), P(), P()),
+        out_specs=(P("pp"), P("pp"), P(), P()), check_vma=False))(
+            staged, emb, head, toks)
+
+    np.testing.assert_allclose(np.asarray(loss), float(rl), rtol=1e-5)
+    for name in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g[name]),
+                                   np.asarray(gl_ref[name]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(gh_ref["w"]),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ge), np.asarray(ge_ref), atol=1e-4)
