@@ -47,12 +47,13 @@ NOMINAL_TFLOPS = {"TPU v5 lite": 197.0, "TPU v5p": 459.0, "TPU v4": 275.0,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=["lm", "vit", "bert", "moe"],
+    ap.add_argument("--model", choices=["lm", "vit", "bert", "moe", "moe2"],
                     default="lm",
                     help="lm = GPT decoder (tokens/s); vit = ViT classifier "
                          "(images/s); bert = encoder fine-tune step "
                          "(BASELINE config[4] flavor); moe = Switch-MoE "
-                         "decoder (routing kernels under the same step)")
+                         "decoder (top-1 routing); moe2 = GShard top-2 "
+                         "routing under the same step")
     ap.add_argument("--config", choices=["tiny", "small", "large", "base"],
                     default="small",
                     help="GPTConfig preset for lm/moe; ViTConfig for vit "
@@ -70,7 +71,8 @@ def main():
     valid_configs = {"lm": ("tiny", "small", "large"),
                      "vit": ("tiny", "base"),
                      "bert": ("tiny", "base", "large"),
-                     "moe": ("tiny", "small", "large")}[args.model]
+                     "moe": ("tiny", "small", "large"),
+                     "moe2": ("tiny", "small", "large")}[args.model]
     if args.config not in valid_configs:
         raise SystemExit(
             f"--model {args.model} has no '{args.config}' preset; "
@@ -122,7 +124,7 @@ def main():
         unit, per_step_items = "tokens/sec/chip", args.batch * seq
         fallback_tokens = args.batch * seq  # the CAPPED seq, not --seq-len
         metric = "bert_finetune_tokens_per_sec_per_chip"
-    elif args.model == "moe":
+    elif args.model in ("moe", "moe2"):
         from bluefog_tpu.models import MoEConfig, MoETransformerLM
 
         if args.config == "tiny":
@@ -139,6 +141,8 @@ def main():
             mcfg = dataclasses.replace(mcfg, num_experts=args.num_experts)
         elif args.config != "tiny":
             mcfg = dataclasses.replace(mcfg, num_experts=8)
+        if args.model == "moe2":
+            mcfg = dataclasses.replace(mcfg, router="top2")
         cfg = mcfg.gpt
         model = MoETransformerLM(mcfg)
         moe_aux_weight = mcfg.aux_loss_weight
@@ -150,7 +154,7 @@ def main():
         # 6*N*T over ALL params would count every expert as active though
         # top-1 routing executes one -- no honest analytic fallback exists
         fallback_tokens = None
-        metric = "moe_lm_tokens_per_sec_per_chip"
+        metric = f"{args.model}_lm_tokens_per_sec_per_chip"
     else:
         cfg = getattr(GPTConfig, args.config)()
         if args.remat:
@@ -199,7 +203,7 @@ def main():
                     logits.astype(jnp.float32), labels).mean()
             (tok,) = vals
             inp, tgt = tok[:, :-1], tok[:, 1:]
-            if args.model == "moe":
+            if args.model in ("moe", "moe2"):
                 logits, st_aux = model.apply({"params": p}, inp,
                                              mutable=["aux_loss"])
                 ce = optax.softmax_cross_entropy_with_integer_labels(
@@ -249,6 +253,29 @@ def main():
     achieved = flops_per_step / (headline_ms / 1e3)
     kind = getattr(devices[0], "device_kind", str(devices[0]))
     spec = NOMINAL_TFLOPS.get(kind)
+
+    # dropped-token accounting (moe/moe2): one untimed forward with the
+    # metrics collection mutable; reported so a capacity_factor that
+    # silently drops tokens is visible in every bench row
+    moe_metrics = None
+    if args.model in ("moe", "moe2"):
+        p0 = jax.tree_util.tree_map(lambda t: t[0], state["p"])
+        tok0 = np.asarray(data[0])[0, 0][None]
+        _, mstate = model.apply({"params": p0}, jnp.asarray(tok0[:, :-1]),
+                                mutable=["aux_loss", "moe_metrics"])
+        flat = jax.tree_util.tree_flatten_with_path(mstate["moe_metrics"])[0]
+        # exact key segment: 'dropped_frac' is a substring of
+        # 'fully_dropped_frac', so match the quoted dict key
+        pick = lambda key: [float(jnp.mean(v)) for path, v in flat
+                            if f"'{key}'" in jax.tree_util.keystr(path)]
+        moe_metrics = {
+            "router": mcfg.router,
+            "dropped_frac": round(float(np.mean(pick("dropped_frac"))), 4),
+            "fully_dropped_frac": round(
+                float(np.mean(pick("fully_dropped_frac"))), 4),
+            "capacity_factor": mcfg.capacity_factor,
+        }
+
     out = {
         "metric": metric,
         "value": round(tps, 1),
@@ -272,6 +299,7 @@ def main():
         "device_kind": kind,
         "mfu_vs_nominal": (round(achieved / 1e12 / spec, 4)
                            if spec and flops_per_step > 0 else None),
+        "moe": moe_metrics,
     }
     print(json.dumps(out))
 

@@ -35,14 +35,19 @@ class MoEConfig:
     ep_axis: str = "ep"
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    router: str = "top1"  # 'top1' (Switch) or 'top2' (GShard)
 
     @staticmethod
-    def tiny(ep_size: int = 1) -> "MoEConfig":
+    def tiny(ep_size: int = 1, router: str = "top1") -> "MoEConfig":
         return MoEConfig(gpt=GPTConfig.tiny(), num_experts=4,
-                         ep_size=ep_size, capacity_factor=2.0)
+                         ep_size=ep_size, capacity_factor=2.0, router=router)
 
     def capacity(self, tokens_per_shard: int) -> int:
-        c = int(self.capacity_factor * tokens_per_shard / self.num_experts)
+        # top-2 makes two assignments per token: scale capacity with k so
+        # capacity_factor keeps meaning "headroom over a perfect balance"
+        k = 2 if self.router == "top2" else 1
+        c = int(self.capacity_factor * k * tokens_per_shard
+                / self.num_experts)
         return max(c, 1)
 
 
@@ -79,15 +84,21 @@ class MoEMLP(nn.Module):
         flat = x.reshape(B * T, D)
         cap = cfg.capacity(B * T)
         if cfg.ep_size == 1:
-            y, aux = moe_ffn_reference(
+            y, aux, metrics = moe_ffn_reference(
                 flat, router, wi.astype(gpt.dtype), wo.astype(gpt.dtype),
-                num_experts=cfg.num_experts, capacity=cap)
+                num_experts=cfg.num_experts, capacity=cap,
+                router=cfg.router)
         else:
-            y, aux = expert_parallel_ffn(
+            y, aux, metrics = expert_parallel_ffn(
                 flat, router, wi.astype(gpt.dtype), wo.astype(gpt.dtype),
                 ep_axis=cfg.ep_axis, num_experts=cfg.num_experts,
-                capacity=cap)
+                capacity=cap, router=cfg.router)
         self.sow("aux_loss", "moe", aux)
+        # drop/load accounting (stop-gradiented in the router): collect
+        # with mutable=["moe_metrics"] — the bench surfaces dropped_frac
+        self.sow("moe_metrics", "dropped_frac", metrics["dropped_frac"])
+        self.sow("moe_metrics", "fully_dropped_frac",
+                 metrics["fully_dropped_frac"])
         return y.reshape(B, T, D)
 
 

@@ -43,7 +43,10 @@ from bluefog_tpu.ops.ring_attention import (
     zigzag_unshard,
 )
 from bluefog_tpu.ops.moe import (
+    RouterOutput,
     switch_router,
+    top2_router,
+    get_router,
     expert_parallel_ffn,
     moe_ffn_reference,
 )

@@ -27,50 +27,92 @@ tests/test_moe.py::test_expert_parallel_grads_match_reference and the
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 __all__ = [
+    "RouterOutput",
     "switch_router",
+    "top2_router",
+    "get_router",
     "expert_parallel_ffn",
     "moe_ffn_reference",
 ]
 
 
+class RouterOutput(NamedTuple):
+    """dispatch/combine: ``(T, E, C)``; aux: scalar load-balance loss;
+    metrics: non-differentiated accounting dict —
+
+    - ``dropped_frac``: fraction of routing ASSIGNMENTS (token-choice
+      pairs; a top-2 token makes two) past expert capacity, hence dropped;
+    - ``fully_dropped_frac``: fraction of TOKENS with every assignment
+      dropped (the residual connection alone carries them);
+    - ``expert_load``: ``(E,)`` fraction of assignments per expert.
+    """
+
+    dispatch: jnp.ndarray
+    combine: jnp.ndarray
+    aux: jnp.ndarray
+    metrics: dict
+
+
+def _router_probs(x, router_kernel, noise_rng, noise_scale):
+    """Shared preamble: f32 logits (+ optional exploration noise) -> probs."""
+    logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    if noise_rng is not None and noise_scale > 0:
+        logits = logits + noise_scale * jax.random.normal(noise_rng,
+                                                          logits.shape)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def _assign_slots(onehot, capacity: int, base=0.0):
+    """Queue one routing choice into expert slots.
+
+    ``base`` (scalar or ``(1, E)``) offsets each expert's queue start —
+    top-2's second choices pass the expert's first-choice count so they
+    queue behind ALL first choices.  Returns ``(keep, slot)``: the
+    surviving ``(T, E)`` mask and the ``(T, E, C)`` dispatch one-hots.
+    """
+    pos = (base + jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    keep = (pos < capacity) * onehot
+    slot = keep[..., None] * jax.nn.one_hot(pos.astype(jnp.int32), capacity)
+    return keep, slot
+
+
+def _router_metrics(assigned, kept):
+    """assigned/kept: (T, E) 0/1 masks of routed vs surviving slots."""
+    total = jnp.maximum(jnp.sum(assigned), 1.0)
+    kept_per_token = jnp.sum(kept, axis=-1)
+    routed_per_token = jnp.sum(assigned, axis=-1)
+    fully_dropped = (routed_per_token > 0) & (kept_per_token == 0)
+    return {
+        "dropped_frac": lax.stop_gradient(1.0 - jnp.sum(kept) / total),
+        "fully_dropped_frac": lax.stop_gradient(
+            jnp.mean(fully_dropped.astype(jnp.float32))),
+        "expert_load": lax.stop_gradient(jnp.sum(assigned, axis=0) / total),
+    }
+
+
 def switch_router(x, router_kernel, *, num_experts: int, capacity: int,
-                  noise_rng=None, noise_scale: float = 0.0):
-    """Top-1 routing with static capacity.
+                  noise_rng=None, noise_scale: float = 0.0) -> RouterOutput:
+    """Top-1 (Switch) routing with static capacity.
 
     Args:
       x: ``(T, D)`` tokens (local shard).
       router_kernel: ``(D, E)`` router weights (replicated).
       capacity: max tokens per expert **per shard**; overflow tokens are
         dropped (their combine weights are zero — the residual connection
-        carries them, as in Switch).
+        carries them, as in Switch) and counted in ``metrics``.
       noise_rng/noise_scale: optional jitter for load-balancing exploration.
-
-    Returns:
-      ``(dispatch, combine, aux)`` — dispatch ``(T, E, C)`` one-hot float,
-      combine ``(T, E, C)`` = dispatch * router prob, and ``aux`` the Switch
-      load-balancing loss (scalar, local shard).
     """
-    T = x.shape[0]
-    logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
-    if noise_rng is not None and noise_scale > 0:
-        logits = logits + noise_scale * jax.random.normal(noise_rng,
-                                                          logits.shape)
-    probs = jax.nn.softmax(logits, axis=-1)               # (T, E)
+    probs = _router_probs(x, router_kernel, noise_rng, noise_scale)  # (T, E)
     expert = jnp.argmax(probs, axis=-1)                   # (T,)
     onehot = jax.nn.one_hot(expert, num_experts)          # (T, E)
-
-    # position of each token within its expert's queue (0-indexed)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot    # (T, E)
-    keep = (pos < capacity) * onehot                      # (T, E)
-    dispatch = keep[..., None] * jax.nn.one_hot(
-        pos.astype(jnp.int32), capacity)                  # (T, E, C)
+    keep, dispatch = _assign_slots(onehot, capacity)      # (T,E), (T,E,C)
     gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)      # (T, 1)
     combine = dispatch * gate[..., None]
 
@@ -78,7 +120,55 @@ def switch_router(x, router_kernel, *, num_experts: int, capacity: int,
     frac = jnp.mean(onehot, axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = num_experts * jnp.sum(frac * mean_prob)
-    return dispatch, combine, aux
+    return RouterOutput(dispatch, combine, aux,
+                        _router_metrics(onehot, keep))
+
+
+def top2_router(x, router_kernel, *, num_experts: int, capacity: int,
+                noise_rng=None, noise_scale: float = 0.0) -> RouterOutput:
+    """Top-2 (GShard) routing with static capacity.
+
+    Each token is routed to its two highest-probability experts with gates
+    renormalized over the pair (``g_i = p_i / (p_1 + p_2)``).  Capacity
+    accounting is GShard's: an expert's second-choice tokens queue BEHIND
+    all of its first-choice tokens, so second choices are the first to drop
+    under pressure.  The aux loss is the standard Switch/GShard
+    load-balance term over FIRST choices (``E * sum_e frac1_e *
+    mean_prob_e`` — differentiable through ``mean_prob``).
+    """
+    probs = _router_probs(x, router_kernel, noise_rng, noise_scale)  # (T, E)
+    e1 = jnp.argmax(probs, axis=-1)
+    oh1 = jax.nn.one_hot(e1, num_experts)
+    e2 = jnp.argmax(probs * (1.0 - oh1), axis=-1)
+    oh2 = jax.nn.one_hot(e2, num_experts)
+    g1 = jnp.sum(probs * oh1, axis=-1)
+    g2 = jnp.sum(probs * oh2, axis=-1)
+    denom = g1 + g2 + 1e-9
+    g1n, g2n = g1 / denom, g2 / denom
+
+    keep1, slot1 = _assign_slots(oh1, capacity)
+    count1 = jnp.sum(oh1, axis=0, keepdims=True)                # (1, E)
+    # second choices queue behind ALL first choices of that expert (when
+    # first choices overflow, no slots remain for seconds — exact either way)
+    keep2, slot2 = _assign_slots(oh2, capacity, base=count1)
+    dispatch = slot1 + slot2                                    # (T, E, C)
+    combine = (slot1 * g1n[:, None, None] + slot2 * g2n[:, None, None])
+
+    frac1 = jnp.mean(oh1, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac1 * mean_prob)
+    return RouterOutput(dispatch, combine, aux,
+                        _router_metrics(oh1 + oh2, keep1 + keep2))
+
+
+def get_router(name: str):
+    """``'top1'`` -> :func:`switch_router`, ``'top2'`` ->
+    :func:`top2_router`."""
+    try:
+        return {"top1": switch_router, "top2": top2_router}[name]
+    except KeyError:
+        raise ValueError(f"unknown router {name!r}; expected 'top1' or "
+                         "'top2'") from None
 
 
 def _local_ffn(expert_inputs, wi, wo):
@@ -90,23 +180,26 @@ def _local_ffn(expert_inputs, wi, wo):
 
 def expert_parallel_ffn(x, router_kernel, wi_local, wo_local, *,
                         ep_axis: str = "ep", num_experts: int,
-                        capacity: int, noise_rng=None,
-                        noise_scale: float = 0.0):
-    """Switch-MoE FFN with experts sharded over ``ep_axis``; call inside
+                        capacity: int, router: str = "top1",
+                        noise_rng=None, noise_scale: float = 0.0):
+    """MoE FFN with experts sharded over ``ep_axis``; call inside
     ``shard_map`` with tokens batch-sharded over the same axis.
 
     Args:
       x: ``(T_local, D)`` this shard's tokens.
       wi_local / wo_local: ``(E // ep, D, H)`` / ``(E // ep, H, D)`` — this
         shard's experts.
+      router: ``'top1'`` (Switch) or ``'top2'`` (GShard; remember to size
+        ``capacity`` for two assignments per token).
 
     Returns:
-      ``(y, aux)``: ``(T_local, D)`` expert outputs (zero for dropped
-      tokens — add the residual outside) and the local aux loss.
+      ``(y, aux, metrics)``: ``(T_local, D)`` expert outputs (zero for
+      dropped tokens — add the residual outside), the local aux loss, and
+      the router's drop/load accounting (:class:`RouterOutput` metrics).
     """
     ep = lax.psum(1, ep_axis)
     local_e = wi_local.shape[0]
-    dispatch, combine, aux = switch_router(
+    dispatch, combine, aux, metrics = get_router(router)(
         x, router_kernel, num_experts=num_experts, capacity=capacity,
         noise_rng=noise_rng, noise_scale=noise_scale)
 
@@ -129,15 +222,15 @@ def expert_parallel_ffn(x, router_kernel, wi_local, wo_local, *,
     expert_outputs = back.reshape(num_experts, capacity, x.shape[-1])
 
     y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_outputs)
-    return y, aux
+    return y, aux, metrics
 
 
 def moe_ffn_reference(x, router_kernel, wi, wo, *, num_experts: int,
-                      capacity: int):
+                      capacity: int, router: str = "top1"):
     """Unsharded reference: all experts local (for tests and 1-chip runs)."""
-    dispatch, combine, aux = switch_router(
+    dispatch, combine, aux, metrics = get_router(router)(
         x, router_kernel, num_experts=num_experts, capacity=capacity)
     inputs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
     outputs = _local_ffn(inputs, wi, wo)
     y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), outputs)
-    return y, aux
+    return y, aux, metrics

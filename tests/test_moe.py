@@ -15,6 +15,7 @@ from bluefog_tpu.ops.moe import (
     expert_parallel_ffn,
     moe_ffn_reference,
     switch_router,
+    top2_router,
 )
 from bluefog_tpu.parallel.api import shard_map
 from bluefog_tpu.parallel.tensor import make_hybrid_mesh
@@ -36,12 +37,17 @@ def make_weights(key):
 def test_switch_router_capacity_drops():
     x = jnp.ones((4, D))  # identical tokens -> all to the same expert
     rk = jax.random.normal(jax.random.PRNGKey(0), (D, E))
-    dispatch, combine, _ = switch_router(x, rk, num_experts=E, capacity=2)
+    dispatch, combine, _, metrics = switch_router(x, rk, num_experts=E,
+                                                  capacity=2)
     # only the first 2 of the 4 colliding tokens keep a slot
     kept = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
     np.testing.assert_array_equal(kept, [1, 1, 0, 0])
     # combine carries the router prob for kept tokens only
     assert float(jnp.sum(combine[2:])) == 0.0
+    # drop accounting: 2 of 4 assignments dropped, both fully dropped
+    assert float(metrics["dropped_frac"]) == 0.5
+    assert float(metrics["fully_dropped_frac"]) == 0.5
+    assert float(jnp.sum(metrics["expert_load"])) == 1.0
 
 
 def test_expert_parallel_matches_reference(devices8):
@@ -50,12 +56,13 @@ def test_expert_parallel_matches_reference(devices8):
     x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
     # ample capacity so sharded (per-shard cumsum) and global routing agree
     cap = T_LOCAL
-    ref, _ = moe_ffn_reference(x, w["router"], w["wi"], w["wo"],
-                               num_experts=E, capacity=T)
+    ref, _, _ = moe_ffn_reference(x, w["router"], w["wi"], w["wo"],
+                                  num_experts=E, capacity=T)
 
     def body(xl, wi_l, wo_l):
-        y, _ = expert_parallel_ffn(xl, w["router"], wi_l, wo_l, ep_axis="ep",
-                                   num_experts=E, capacity=cap)
+        y, _, _ = expert_parallel_ffn(xl, w["router"], wi_l, wo_l,
+                                      ep_axis="ep", num_experts=E,
+                                      capacity=cap)
         return y
 
     out = jax.jit(shard_map(
@@ -73,17 +80,17 @@ def test_expert_parallel_grads_match_reference(devices8):
     cap = T_LOCAL
 
     def ref_loss(w):
-        y, _ = moe_ffn_reference(x, w["router"], w["wi"], w["wo"],
-                                 num_experts=E, capacity=T)
+        y, _, _ = moe_ffn_reference(x, w["router"], w["wi"], w["wo"],
+                                    num_experts=E, capacity=T)
         return jnp.sum(y ** 2) / T
 
     gref = jax.grad(ref_loss)(w)
 
     def body(xl, wi_l, wo_l, router):
         def loss_fn(p):
-            y, _ = expert_parallel_ffn(xl, p["router"], p["wi"], p["wo"],
-                                       ep_axis="ep", num_experts=E,
-                                       capacity=cap)
+            y, _, _ = expert_parallel_ffn(xl, p["router"], p["wi"], p["wo"],
+                                          ep_axis="ep", num_experts=E,
+                                          capacity=cap)
             return jnp.sum(y ** 2) / T  # GLOBAL token count
 
         g = jax.grad(loss_fn)({"router": router, "wi": wi_l, "wo": wo_l})
@@ -111,7 +118,7 @@ def test_moe_lm_unsharded_forward():
     variables = model.init(jax.random.PRNGKey(1), tokens)
     # init itself sows into aux_loss; keep only params so apply starts fresh
     logits, state = model.apply({"params": variables["params"]}, tokens,
-                                mutable=["aux_loss"])
+                                mutable=["aux_loss", "moe_metrics"])
     assert logits.shape == (2, 16, cfg.gpt.vocab_size)
     assert np.all(np.isfinite(np.asarray(logits)))
     aux = jax.tree_util.tree_leaves(state["aux_loss"])
@@ -129,7 +136,7 @@ def test_moe_lm_expert_parallel_forward(devices8):
     def body(toks_blk):
         toks = toks_blk[0]
         variables = model.init(jax.random.PRNGKey(1), toks)
-        logits, state = model.apply(variables, toks, mutable=["aux_loss"])
+        logits, state = model.apply(variables, toks, mutable=["aux_loss", "moe_metrics"])
         aux = sum(jnp.sum(a) for a in
                   jax.tree_util.tree_leaves(state["aux_loss"]))
         return logits[None], aux[None]
@@ -140,3 +147,120 @@ def test_moe_lm_expert_parallel_forward(devices8):
     assert logits.shape == (2, 2, 16, cfg.gpt.vocab_size)
     assert np.all(np.isfinite(np.asarray(logits)))
     assert np.all(np.isfinite(np.asarray(aux)))
+
+
+# ---------------------------------------------------------------------------
+# Top-2 (GShard) routing — round-5 additions
+# ---------------------------------------------------------------------------
+
+
+def test_top2_router_gates_and_queueing():
+    """Each token reaches its two top experts with pair-normalized gates;
+    second choices queue behind ALL first choices of that expert."""
+    T_, cap = 12, T_LOCAL
+    x = jax.random.normal(jax.random.PRNGKey(3), (T_, D))
+    rk = jax.random.normal(jax.random.PRNGKey(4), (D, E))
+    dispatch, combine, aux, metrics = top2_router(
+        x, rk, num_experts=E, capacity=cap)
+
+    probs = np.asarray(jax.nn.softmax(x.astype(jnp.float32) @ rk, axis=-1))
+    order = np.argsort(-probs, axis=-1)
+    for t in range(T_):
+        e1, e2 = order[t, 0], order[t, 1]
+        # ample capacity: both choices must hold exactly one slot each
+        assert np.asarray(dispatch[t, e1]).sum() == 1.0
+        assert np.asarray(dispatch[t, e2]).sum() == 1.0
+        g1 = probs[t, e1] / (probs[t, e1] + probs[t, e2])
+        g2 = probs[t, e2] / (probs[t, e1] + probs[t, e2])
+        np.testing.assert_allclose(np.asarray(combine[t, e1]).sum(), g1,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(combine[t, e2]).sum(), g2,
+                                   rtol=1e-5)
+    assert float(metrics["dropped_frac"]) == 0.0
+    np.testing.assert_allclose(float(np.asarray(
+        metrics["expert_load"]).sum()), 1.0, rtol=1e-6)
+    assert np.isfinite(float(aux))
+
+    # queueing: identical tokens all pick the same (e1, e2) pair; with
+    # capacity 3, three first choices survive at e1 and three SECOND
+    # choices at e2 (they queue behind zero first-choices there)
+    xi = jnp.ones((5, D))
+    dispatch, combine, _, m = top2_router(xi, rk, num_experts=E, capacity=3)
+    e1 = int(np.argmax(np.asarray(jax.nn.softmax(
+        xi.astype(jnp.float32) @ rk, axis=-1))[0]))
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    np.testing.assert_array_equal(per_token, [2, 2, 2, 0, 0])
+    assert np.asarray(dispatch[:, e1]).sum() == 3  # e1 capped at 3
+
+
+def test_top2_capacity_sweep_drop_accounting():
+    """dropped_frac is monotone non-increasing in capacity and exactly zero
+    once capacity covers the worst-loaded expert."""
+    T_ = 64
+    x = jax.random.normal(jax.random.PRNGKey(5), (T_, D))
+    rk = jax.random.normal(jax.random.PRNGKey(6), (D, E))
+    drops = []
+    for cap in (1, 2, 4, 8, 16, 32, 64, 2 * T_):
+        _, _, _, m = top2_router(x, rk, num_experts=E, capacity=cap)
+        drops.append(float(m["dropped_frac"]))
+        assert 0.0 <= drops[-1] <= 1.0
+    assert all(a >= b - 1e-9 for a, b in zip(drops, drops[1:])), drops
+    assert drops[-1] == 0.0
+    assert drops[0] > 0.0  # capacity 1 must drop under any realistic load
+
+
+def test_top2_expert_parallel_matches_reference(devices8):
+    """The sharded top-2 FFN (same all_to_all fabric) matches the dense
+    reference, forward and backward."""
+    mesh = make_hybrid_mesh({"ep": EP}, devices=devices8[:EP])
+    w = make_weights(jax.random.PRNGKey(7))
+    x = jax.random.normal(jax.random.PRNGKey(8), (T, D))
+    cap = 2 * T_LOCAL  # two assignments per token
+
+    def ref_loss(w):
+        y, _, _ = moe_ffn_reference(x, w["router"], w["wi"], w["wo"],
+                                    num_experts=E, capacity=2 * T,
+                                    router="top2")
+        return jnp.sum(y ** 2) / T
+
+    gref = jax.grad(ref_loss)(w)
+
+    def body(xl, wi_l, wo_l, router):
+        def loss_fn(p):
+            y, _, _ = expert_parallel_ffn(
+                xl, p["router"], p["wi"], p["wo"], ep_axis="ep",
+                num_experts=E, capacity=cap, router="top2")
+            return jnp.sum(y ** 2) / T
+
+        g = jax.grad(loss_fn)({"router": router, "wi": wi_l, "wo": wo_l})
+        return (g["wi"], g["wo"], lax.psum(g["router"], "ep"))
+
+    gwi, gwo, grouter = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P("ep"), P("ep"), P("ep"), P()),
+        out_specs=(P("ep"), P("ep"), P()), check_vma=False))(
+            x, w["wi"], w["wo"], w["router"])
+
+    np.testing.assert_allclose(np.asarray(gwi), np.asarray(gref["wi"]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gwo), np.asarray(gref["wo"]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grouter),
+                               np.asarray(gref["router"]), atol=1e-4)
+
+
+def test_moe_lm_top2_forward_and_metrics():
+    """The LM surface with router='top2': logits finite, metrics sown per
+    layer and bounded."""
+    cfg = MoEConfig.tiny(router="top2")
+    model = MoETransformerLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0,
+                                cfg.gpt.vocab_size)
+    variables = model.init(jax.random.PRNGKey(1), tokens)
+    logits, state = model.apply({"params": variables["params"]}, tokens,
+                                mutable=["aux_loss", "moe_metrics"])
+    assert np.all(np.isfinite(np.asarray(logits)))
+    dropped = jax.tree_util.tree_leaves(
+        state["moe_metrics"])
+    assert len(dropped) == 2 * cfg.gpt.num_layers  # 2 metrics per layer
+    assert all(0.0 <= float(d) <= 1.0 for d in dropped)
