@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-5 on-chip session: serialized, harvest-as-you-go (axon playbook).
+# Run detached: setsid nohup bash benchmarks/r5_onchip.sh > /tmp/r5_onchip.log 2>&1 &
+set -x
+cd /root/repo
+echo "=== PHASE 1: fresh bench sweep (new trace-first + rescue path) ==="
+python bench.py 2>&1
+echo "=== PHASE 1 done, rc=$? ==="
+echo "=== PHASE 2: conv roofline, top 6 FLOP-heavy shapes ==="
+python benchmarks/conv_roofline.py --batch 128 --top 6 2>&1
+echo "=== PHASE 2 done, rc=$? ==="
+echo "=== PHASE 3: conv roofline, remaining shapes ==="
+python benchmarks/conv_roofline.py --batch 128 2>&1
+echo "=== PHASE 3 done, rc=$? ==="
+echo "=== PHASE 4: knee refinement: pinned 96 and 160 ==="
+python bench.py --batch 96 2>&1
+python bench.py --batch 160 2>&1
+echo "=== PHASE 4 done, rc=$? ==="
+echo "=== ALL DONE ==="

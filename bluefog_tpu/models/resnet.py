@@ -125,7 +125,12 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
-    stem: str = "conv"  # "conv" = reference 7x7/s2; "s2d" = space-to-depth 4x4/s1
+    # "conv" = reference 7x7/s2 + maxpool (ImageNet); "s2d" = space-to-depth
+    # 4x4/s1 MXU-friendly equivalent; "cifar" = 3x3/s1, no maxpool (the
+    # standard small-image stem — 32x32 inputs keep a 4x4 final map after
+    # the three stage strides instead of collapsing to 1x1 under the
+    # ImageNet stem's extra /4)
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -160,11 +165,15 @@ class ResNet(nn.Module):
                     f"[N,H/2,W/2,12] input; got C={x.shape[-1]}")
             x = conv(self.num_filters, (4, 4), (1, 1),
                      padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        elif self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3), (1, 1),
+                     padding=[(1, 1), (1, 1)], name="conv_init")(x)
         else:
             x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        if self.stem != "cifar":
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, block_size in enumerate(self.stage_sizes):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
