@@ -93,7 +93,10 @@ def conv_fn(B, H, W, Cin, Cout, k, s):
                 x, ww, (s, s), pad,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 preferred_element_type=jnp.float32)
-            return acc + jnp.float32(jnp.sum(y[0, 0, 0, :1])), None
+            # consume EVERY output element: slicing one element would let
+            # XLA push the slice through the conv and compute a single
+            # output position (measured: 70x non-physical rates)
+            return acc + jnp.sum(y), None
 
         acc, _ = lax.scan(body, jnp.float32(0), None, length=REPEATS)
         return acc
@@ -106,7 +109,7 @@ def gemm_fn(M, K, N):
         def body(acc, _):
             bb = (b.astype(jnp.float32) * (1.0 + acc * 1e-30)).astype(b.dtype)
             y = jnp.dot(a, bb, preferred_element_type=jnp.float32)
-            return acc + y[0, 0].astype(jnp.float32), None
+            return acc + jnp.sum(y), None  # full consumption — see conv_fn
 
         acc, _ = lax.scan(body, jnp.float32(0), None, length=REPEATS)
         return acc
@@ -138,8 +141,12 @@ def main():
         Ho, Wo = H // s, W // s
         M, K, N = B * Ho * Wo, k * k * Cin, Cout
         flops = 2.0 * M * K * N
-        bytes_min = 2.0 * (B * H * W * Cin + k * k * Cin * Cout
-                           + B * Ho * Wo * Cout)
+        # TRUE lower bound on HBM traffic: bf16 input + weights only.  The
+        # output is deliberately excluded — the timed kernel's jnp.sum
+        # consumer fuses into the conv epilogue, so the f32 output need
+        # never materialize in HBM; counting it would overstate the floor
+        # (and in-model the next layer often fuses the same way).
+        bytes_min = 2.0 * (B * H * W * Cin + k * k * Cin * Cout)
 
         x = jax.random.normal(key, (B, H, W, Cin), jnp.bfloat16)
         w = jax.random.normal(key, (k, k, Cin, Cout), jnp.bfloat16)

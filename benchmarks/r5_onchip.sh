@@ -6,12 +6,9 @@ cd /root/repo
 echo "=== PHASE 1: fresh bench sweep (new trace-first + rescue path) ==="
 python bench.py 2>&1
 echo "=== PHASE 1 done, rc=$? ==="
-echo "=== PHASE 2: conv roofline, top 6 FLOP-heavy shapes ==="
-python benchmarks/conv_roofline.py --batch 128 --top 6 2>&1
-echo "=== PHASE 2 done, rc=$? ==="
-echo "=== PHASE 3: conv roofline, remaining shapes ==="
+echo "=== PHASE 2: conv roofline, ALL shapes (one pass; --top exists for time-boxed partial harvests) ==="
 python benchmarks/conv_roofline.py --batch 128 2>&1
-echo "=== PHASE 3 done, rc=$? ==="
+echo "=== PHASE 2 done, rc=$? ==="
 echo "=== PHASE 4: knee refinement: pinned 96 and 160 ==="
 python bench.py --batch 96 2>&1
 python bench.py --batch 160 2>&1

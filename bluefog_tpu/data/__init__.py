@@ -6,6 +6,7 @@ The reference's examples use torch DataLoader + DistributedSampler (SURVEY.md
 """
 
 from bluefog_tpu.data.loader import (
+    Subset,
     ArraySource,
     DistributedLoader,
     SyntheticClassificationSource,
@@ -23,6 +24,7 @@ from bluefog_tpu.data.tfrecord import (
 
 __all__ = [
     "ArraySource",
+    "Subset",
     "DistributedLoader",
     "SyntheticClassificationSource",
     "prefetch_to_device",

@@ -31,10 +31,33 @@ import numpy as np
 
 __all__ = [
     "ArraySource",
+    "Subset",
     "SyntheticClassificationSource",
     "DistributedLoader",
     "prefetch_to_device",
 ]
+
+
+class Subset:
+    """Index-range view over a source — the train/test split of one dataset
+    (used by the convergence-gate examples; a test tail held out of a
+    TFRecordSource without copying it)."""
+
+    def __init__(self, source, lo: int, hi: int):
+        if not 0 <= lo <= hi <= len(source):
+            raise ValueError(
+                f"bad subset [{lo}, {hi}) of a {len(source)}-example source")
+        self.source, self.lo = source, lo
+        self.n = hi - lo
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        idx = np.asarray(idx)
+        if np.any(idx < 0) or np.any(idx >= self.n):
+            raise IndexError(f"index out of range for {self.n}-example subset")
+        return self.source[idx + self.lo]
 
 
 class ArraySource:

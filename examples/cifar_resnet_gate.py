@@ -45,7 +45,8 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 import bluefog_tpu as bf
-from bluefog_tpu.data import DistributedLoader, TFRecordSource
+from bluefog_tpu.data import (DistributedLoader, Subset,
+                              TFRecordSource)
 from bluefog_tpu.data.tfrecord import write_image_classification_shards
 from bluefog_tpu.models.resnet import ResNet18
 from bluefog_tpu.optim import (DistributedGradientAllreduceOptimizer,
@@ -89,20 +90,6 @@ def synth_cifar(n: int, seed: int, noise: float = 0.5):
     lo, hi = imgs.min(), imgs.max()
     return (((imgs - lo) / (hi - lo)) * 255).astype(np.uint8), (
         labels.astype(np.int64))
-
-
-class _Subset:
-    """Index-range view over a source (train/test split of one dataset)."""
-
-    def __init__(self, source, lo: int, hi: int):
-        self.source, self.lo = source, lo
-        self.n = hi - lo
-
-    def __len__(self):
-        return self.n
-
-    def __getitem__(self, idx):
-        return self.source[np.asarray(idx) + self.lo]
 
 
 def train(loader, model, opt, init_vars, epochs, ctx):
@@ -181,6 +168,11 @@ def main():
     ap.add_argument("--weight-decay", type=float, default=1e-4)
     ap.add_argument("--filters", type=int, default=16,
                     help="ResNet-18 width (16 = CI budget; 64 = full)")
+    ap.add_argument("--noise", type=float, default=0.5,
+                    help="pixel-noise scale of the stand-in (0.5 saturates "
+                         "both arms under the default budget; ~0.8 lands "
+                         "them below ceiling, making the parity comparison "
+                         "discriminative — pair with --target 0.85)")
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--data-dir", default=None,
                     help="existing TFRecord dir of real CIFAR shards")
@@ -210,10 +202,12 @@ def main():
             test_imgs, test_labels = full[np.arange(split, len(full))]
             # train strictly excludes the held-out tail (mnist gate's
             # _Subset pattern): accuracy on trained-on data is no gate
-            train_src = _Subset(full, 0, split)
+            train_src = Subset(full, 0, split)
         else:
-            imgs, labels = synth_cifar(args.train_size, seed=1)
-            test_imgs, test_labels = synth_cifar(args.test_size, seed=999)
+            imgs, labels = synth_cifar(args.train_size, seed=1,
+                                       noise=args.noise)
+            test_imgs, test_labels = synth_cifar(args.test_size, seed=999,
+                                                 noise=args.noise)
             shard_size = (len(labels) + args.shards - 1) // args.shards
             paths = write_image_classification_shards(
                 tmp, imgs, labels, shard_size=shard_size)

@@ -42,7 +42,8 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 import bluefog_tpu as bf
-from bluefog_tpu.data import DistributedLoader, TFRecordSource
+from bluefog_tpu.data import (DistributedLoader, Subset,
+                              TFRecordSource)
 from bluefog_tpu.data.tfrecord import (decode_example, read_records,
                                        write_image_classification_shards)
 from bluefog_tpu.models import LeNet5
@@ -67,20 +68,6 @@ def synth_mnist(n: int, seed: int, noise: float = 0.5):
     lo, hi = imgs.min(), imgs.max()
     u8 = ((imgs - lo) / (hi - lo) * 255).astype(np.uint8)
     return u8[..., None], labels.astype(np.int64)
-
-
-class _Subset:
-    """Index-range view over a source (train/test split of one dataset)."""
-
-    def __init__(self, source, lo: int, hi: int):
-        self.source, self.lo = source, lo
-        self.n = hi - lo
-
-    def __len__(self):
-        return self.n
-
-    def __getitem__(self, idx):
-        return self.source[np.asarray(idx) + self.lo]
 
 
 def train(loader, model, opt, init_params, epochs, ctx):
@@ -172,7 +159,7 @@ def main():
                 raise SystemExit(
                     f"--data-dir holds {len(full)} examples <= test split "
                     f"{args.test_size}")
-            train_src = _Subset(full, 0, len(full) - args.test_size)
+            train_src = Subset(full, 0, len(full) - args.test_size)
             test_imgs, test_labels = full[np.arange(
                 len(full) - args.test_size, len(full))]
         else:

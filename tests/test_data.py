@@ -153,3 +153,25 @@ class TestPrefetch:
 
     def test_size_zero_passthrough(self):
         assert list(prefetch_to_device(iter([1, 2]), size=0)) == [1, 2]
+
+
+def test_subset_view_bounds_and_indexing():
+    """Subset (the gates' train/test splitter): correct window, validated
+    bounds, no negative-index wraparound."""
+    import numpy as np
+    import pytest
+    from bluefog_tpu.data import ArraySource, Subset
+
+    src = ArraySource(np.arange(100), np.arange(100) * 2)
+    sub = Subset(src, 10, 30)
+    assert len(sub) == 20
+    a, b = sub[np.array([0, 19])]
+    assert list(a) == [10, 29] and list(b) == [20, 58]
+    with pytest.raises(IndexError):
+        sub[np.array([20])]
+    with pytest.raises(IndexError):
+        sub[np.array([-1])]
+    with pytest.raises(ValueError):
+        Subset(src, 50, 40)
+    with pytest.raises(ValueError):
+        Subset(src, 0, 101)
