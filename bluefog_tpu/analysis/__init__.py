@@ -1,0 +1,68 @@
+"""Static verifier + lint passes for decentralized-communication programs.
+
+Production decentralized training rests on invariants that, when violated,
+surface as a hung barrier on a 128-chip job rather than a stack trace:
+gossip weight matrices must be (doubly-)stochastic for decentralized SGD
+to converge, ``collective_permute`` source/target pairs must form partial
+permutations per step or programs deadlock, and Pallas collective-id
+ranges must stay disjoint across concurrently-issued kernel families.
+This package checks all of that *before* anything runs:
+
+- :mod:`~bluefog_tpu.analysis.registry` — collective-id allocator /
+  auditor: declarative id-range families (gossip [1024, 2048), windows
+  [2048, ...)), per-caller ``(base, limit)`` leases, and an audit pass
+  that reports overlap between concurrent leases.
+- :mod:`~bluefog_tpu.analysis.topology_check` — topology verifier:
+  row/column stochasticity, self-loop sanity, strong connectivity,
+  spectral gap, and period-union connectivity for time-varying schedules.
+- :mod:`~bluefog_tpu.analysis.jaxpr_lint` — jaxpr comm-lint: traces a
+  step function and walks the closed jaxpr for ``ppermute``/``psum``
+  equations, verifying permutation bijectivity (deadlock-freedom), axis
+  hygiene, host callbacks on the hot path, and buffer donation.
+- :mod:`~bluefog_tpu.analysis.lint` — the CLI
+  (``python -m bluefog_tpu.analysis.lint``) running every pass over the
+  repo's own topologies, optimizers, and examples; exits nonzero on
+  violations.
+"""
+
+from bluefog_tpu.analysis.report import Diagnostic, LintError, LintReport
+from bluefog_tpu.analysis.registry import (
+    ID_FAMILIES,
+    GLOBAL_LEASES,
+    CollectiveIdLease,
+    LeaseRegistry,
+    plan_gossip_leases,
+)
+from bluefog_tpu.analysis.topology_check import (
+    check_dynamic_schedules,
+    check_mixing_matrix,
+    check_schedule,
+    check_topology,
+    spectral_gap,
+)
+from bluefog_tpu.analysis.jaxpr_lint import (
+    check_donation,
+    check_permutation,
+    lint_jaxpr,
+    lint_step_fn,
+)
+
+__all__ = [
+    "Diagnostic",
+    "LintError",
+    "LintReport",
+    "ID_FAMILIES",
+    "GLOBAL_LEASES",
+    "CollectiveIdLease",
+    "LeaseRegistry",
+    "plan_gossip_leases",
+    "check_dynamic_schedules",
+    "check_mixing_matrix",
+    "check_schedule",
+    "check_topology",
+    "spectral_gap",
+    "check_donation",
+    "check_permutation",
+    "lint_jaxpr",
+    "lint_step_fn",
+]

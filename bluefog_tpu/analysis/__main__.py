@@ -1,0 +1,7 @@
+"""``python -m bluefog_tpu.analysis`` — alias for the lint CLI."""
+
+import sys
+
+from bluefog_tpu.analysis.lint import main
+
+sys.exit(main())

@@ -1,0 +1,369 @@
+"""Lint CLI: run every static-analysis pass over the repo's own programs.
+
+::
+
+    python -m bluefog_tpu.analysis.lint [--size N] [--verbose] [--no-trace]
+
+Exits nonzero iff any pass reports an error-severity diagnostic, so CI
+(and the tier-1 suite, via ``tests/test_analysis.py``) fails fast when a
+change breaks a communication invariant.
+
+What it covers, deliberately the same surfaces the examples exercise:
+
+1. **topology** — every built-in constructor (exp2, exp, symmetric-exp,
+   ring x3 styles, grid, star, fully-connected) at the mesh size, plus
+   the lowered :class:`GossipSchedule` of each.
+2. **dynamic** — the one-peer exponential-2 and ring periods, the
+   generator-materialized dynamic topologies, and the jittable aperiodic
+   mixing matrices: per-phase stochasticity + period-union connectivity.
+3. **collective-ids** — the gradient-tracking optimizer's declared
+   id split (``GT_COLLECTIVE_ID_RANGES``) audited against a
+   production-scale fused parameter buffer's chunk plan, and the window
+   family's bucket arithmetic.
+4. **comm-lint** — traces gossip collectives and both distributed
+   optimizers' update steps (``jax.make_jaxpr`` under ``shard_map``) and
+   walks the jaxprs for permutation/axis/callback hazards; checks buffer
+   donation on a jitted train step.
+5. **examples** — scans ``examples/*.py`` for the topology constructors
+   and dynamic schedules they reference and verifies each one it finds.
+
+All passes run on CPU (the CLI forces an 8-virtual-device host mesh when
+no accelerator is configured) — nothing here needs a TPU, which is the
+point: the invariants are checked before the 128-chip job is submitted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from bluefog_tpu.analysis.report import Diagnostic, LintReport
+
+__all__ = ["main", "run_all"]
+
+_AXIS = "bf"
+
+
+def _ensure_host_devices(n: int) -> None:
+    """Force an ``n``-virtual-device CPU mesh unless the environment
+    already configured a platform.  Must run before jax initializes a
+    backend — callers go through :func:`main`/:func:`run_all`, which do."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+# ---------------------------------------------------------------------------
+# Passes
+# ---------------------------------------------------------------------------
+
+
+def _builtin_topologies(size: int):
+    from bluefog_tpu import topology as T
+
+    topos = [
+        T.ExponentialTwoGraph(size),
+        T.ExponentialGraph(size, base=2),
+        T.SymmetricExponentialGraph(size, base=4),
+        T.RingGraph(size, 0),
+        T.RingGraph(size, 1),
+        T.RingGraph(size, 2),
+        T.MeshGrid2DGraph(size),
+        T.StarGraph(size, center_rank=0),
+        T.FullyConnectedGraph(size),
+    ]
+    return topos
+
+
+def topology_pass(report: LintReport, size: int) -> None:
+    from bluefog_tpu.analysis.topology_check import (check_schedule,
+                                                     check_topology)
+    from bluefog_tpu.topology import build_schedule
+
+    for topo in _builtin_topologies(size):
+        report.extend(check_topology(topo))
+        report.extend(check_schedule(build_schedule(topo)))
+
+
+def dynamic_pass(report: LintReport, size: int) -> None:
+    import numpy as np
+
+    from bluefog_tpu.analysis.topology_check import check_dynamic_schedules
+    from bluefog_tpu import topology as T
+
+    report.extend(check_dynamic_schedules(
+        T.one_peer_exponential_two_schedules(size), name="one_peer_exp2"))
+    report.extend(check_dynamic_schedules(
+        T.one_peer_ring_schedules(size), name="one_peer_ring"))
+
+    base = T.ExponentialTwoGraph(size)
+    period = max(1, base.max_in_degree)
+    topos = T.dynamic_topologies_from_generator(
+        size, lambda r: T.GetDynamicOnePeerSendRecvRanks(base, r),
+        num_steps=period, name="one_peer_gen")
+    report.extend(check_dynamic_schedules(topos, name="one_peer_gen"))
+
+    # the jittable aperiodic form: one period of step -> W matrices
+    import math
+
+    phases = max(1, math.ceil(math.log2(size))) if size > 1 else 1
+    mats = [np.asarray(T.one_peer_exp2_mixing_matrix(size, s))
+            for s in range(phases)]
+    report.extend(check_dynamic_schedules(mats, name="one_peer_exp2_matrix"))
+
+
+def collective_id_pass(report: LintReport, size: int) -> None:
+    import jax.numpy as jnp
+
+    from bluefog_tpu.analysis.registry import (GLOBAL_LEASES,
+                                               plan_gossip_leases)
+    from bluefog_tpu.optim.optimizers import GT_COLLECTIVE_ID_RANGES
+    from bluefog_tpu.ops import pallas_gossip
+
+    # gradient tracking's declared split, audited against the chunk plan
+    # of a production-scale fused buffer (ResNet-18-sized: ~11M f32
+    # params fused into one flat leaf -> ~11 kernel invocations at the
+    # default 4 MiB cap).  This is the exact configuration ADVICE.md's
+    # medium finding showed could silently overlap before the per-call
+    # limit existed.
+    fused = {"fused_f32": jnp.zeros((11_000_000,), jnp.float32)}
+    with GLOBAL_LEASES.scope() as reg:
+        plan_gossip_leases(
+            [("gradient_tracking/y_mix", fused,
+              GT_COLLECTIVE_ID_RANGES["y_mix"]),
+             ("gradient_tracking/params_mix", fused,
+              GT_COLLECTIVE_ID_RANGES["params_mix"])],
+            registry=reg)
+        # a window delivered in the same program must stay in its family
+        win_base = pallas_gossip.window_collective_id_base(
+            "lint_winput_probe")
+        pallas_gossip.release_window_collective_id("lint_winput_probe")
+        reg.lease("window:winput_opt", base=win_base, used=4,
+                  limit=win_base + pallas_gossip.WINDOW_LEAF_CAP,
+                  family="windows")
+        diags = reg.audit()
+    report.extend(diags)
+    if not any(d.severity == "error" for d in diags):
+        report.add(Diagnostic(
+            "info", "BF-ID100",
+            "gradient-tracking id split "
+            f"{GT_COLLECTIVE_ID_RANGES} is disjoint and fits the fused "
+            "chunk plan; window bucket stays in its family",
+            pass_name="collective-ids", subject="optimizers"))
+
+
+def comm_lint_pass(report: LintReport, size: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bluefog_tpu.analysis.jaxpr_lint import check_donation, lint_step_fn
+    from bluefog_tpu.ops import collectives as C
+    from bluefog_tpu.optim import (DistributedGradientTrackingOptimizer,
+                                   DistributedNeighborAllreduceOptimizer)
+    from bluefog_tpu.parallel.api import shard_map
+    from bluefog_tpu import topology as T
+
+    n_dev = len(jax.devices())
+    if n_dev < size:
+        # A backend initialized before _ensure_host_devices ran (jax was
+        # imported and used earlier in this process) ignores the virtual-
+        # device request; tracing a size-N schedule over a smaller mesh
+        # would report false out-of-range errors (BF-COMM003), so skip
+        # with a visible reason instead.
+        report.add(Diagnostic(
+            "warning", "BF-COMM030",
+            f"comm-lint trace pass skipped: jax exposes {n_dev} device(s) "
+            f"but the lint mesh needs {size}; run in a fresh process or "
+            "pre-set XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{size} before jax initializes",
+            pass_name="comm-lint", subject="environment"))
+        return
+
+    mesh = Mesh(np.array(jax.devices()[:size]), (_AXIS,))
+    x = jnp.zeros((size, 4), jnp.float32)
+
+    def smap(body, n_in=1):
+        return shard_map(body, mesh=mesh,
+                         in_specs=(P(_AXIS),) * n_in,
+                         out_specs=P(_AXIS), check_vma=False)
+
+    # 1) plain gossip over a circulant, an irregular, and a dynamic graph
+    gossip_targets = [
+        ("neighbor_allreduce[exp2]",
+         T.ExponentialTwoGraph(size), None),
+        ("neighbor_allreduce[star]",
+         T.StarGraph(size, center_rank=0), None),
+    ]
+    for name, topo, _ in gossip_targets:
+        sched = T.build_schedule(topo)
+        report.extend(lint_step_fn(
+            smap(lambda v, s=sched: C.neighbor_allreduce(v, s, _AXIS)),
+            x, name=name))
+
+    dyn = [T.build_schedule(t)
+           for t in T.one_peer_exponential_two_schedules(size)]
+    report.extend(lint_step_fn(
+        smap(lambda v: C.neighbor_allreduce_dynamic(v, dyn, 3, _AXIS)),
+        x, name="neighbor_allreduce_dynamic[one_peer_exp2]"))
+
+    # 2) both distributed optimizers' jitted update step
+    def optimizer_body(opt):
+        def body(c):
+            w0 = jnp.zeros_like(c)
+            st = opt.init(w0)
+
+            def step(carry, _):
+                w, s = carry
+                upd, s = opt.update(w - c, s, w)
+                return (optax.apply_updates(w, upd), s), None
+
+            (w, _), _ = lax.scan(step, (w0, st), None, length=2)
+            return w
+
+        return body
+
+    dsgd = DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.05), topology=T.ExponentialTwoGraph(size),
+        axis_name=_AXIS)
+    gt = DistributedGradientTrackingOptimizer(
+        optax.sgd(0.05), T.MeshGrid2DGraph(size), _AXIS)
+    report.extend(lint_step_fn(
+        smap(optimizer_body(dsgd)), x,
+        name="DistributedNeighborAllreduceOptimizer.update"))
+    report.extend(lint_step_fn(
+        smap(optimizer_body(gt)), x,
+        name="DistributedGradientTrackingOptimizer.update"))
+
+    # 3) buffer donation on the jitted hot path: the gossip train step
+    # donates its parameter buffer, and the lowered StableHLO must show
+    # the aliasing (this is the check that flags un-donated state)
+    sched = T.build_schedule(T.ExponentialTwoGraph(size))
+
+    def train_step(w, g):
+        w = smap(lambda v, s=sched: C.neighbor_allreduce(v, s, _AXIS))(w)
+        return w - 0.05 * g
+
+    report.extend(check_donation(
+        jax.jit(train_step, donate_argnums=(0,)), x, x,
+        name="gossip_train_step"))
+
+
+_EXAMPLE_CONSTRUCTORS = (
+    "ExponentialTwoGraph",
+    "ExponentialGraph",
+    "SymmetricExponentialGraph",
+    "RingGraph",
+    "MeshGrid2DGraph",
+    "StarGraph",
+    "FullyConnectedGraph",
+)
+_EXAMPLE_DYNAMIC = (
+    "one_peer_exponential_two_schedules",
+    "one_peer_ring_schedules",
+    "one_peer_exp2_mixing_matrix",
+)
+
+
+def examples_pass(report: LintReport, size: int,
+                  examples_dir: Optional[str] = None) -> None:
+    """Scan the repo's examples for the topologies they construct and
+    verify each referenced constructor/schedule at the lint mesh size —
+    so a constructor regression fails the lint exactly when an example
+    would train on a broken graph."""
+    import glob
+
+    from bluefog_tpu.analysis.topology_check import (
+        check_dynamic_schedules, check_topology)
+    from bluefog_tpu import topology as T
+
+    if examples_dir is None:
+        examples_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "examples")
+    files = sorted(glob.glob(os.path.join(examples_dir, "*.py")))
+    if not files:
+        report.add(Diagnostic(
+            "warning", "BF-EX001",
+            f"no examples found under {examples_dir}",
+            pass_name="examples", subject="examples"))
+        return
+
+    used_ctors, used_dyn, n_files = set(), set(), 0
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        n_files += 1
+        used_ctors.update(c for c in _EXAMPLE_CONSTRUCTORS if c in src)
+        used_dyn.update(d for d in _EXAMPLE_DYNAMIC if d in src)
+
+    for ctor in sorted(used_ctors):
+        topo = getattr(T, ctor)(size)
+        report.extend(check_topology(topo, name=f"examples/{ctor}"))
+    if "one_peer_exponential_two_schedules" in used_dyn:
+        report.extend(check_dynamic_schedules(
+            T.one_peer_exponential_two_schedules(size),
+            name="examples/one_peer_exp2"))
+    if "one_peer_ring_schedules" in used_dyn:
+        report.extend(check_dynamic_schedules(
+            T.one_peer_ring_schedules(size), name="examples/one_peer_ring"))
+    report.add(Diagnostic(
+        "info", "BF-EX100",
+        f"scanned {n_files} example(s); verified constructors "
+        f"{sorted(used_ctors)} and schedules {sorted(used_dyn)}",
+        pass_name="examples", subject="examples"))
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
+    """Run every pass; importable entry point for tests."""
+    _ensure_host_devices(size)
+    report = LintReport()
+    topology_pass(report, size)
+    dynamic_pass(report, size)
+    collective_id_pass(report, size)
+    examples_pass(report, size)
+    if trace:
+        comm_lint_pass(report, size)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bluefog_tpu.analysis.lint",
+        description="Statically verify bluefog_tpu communication programs "
+                    "(topologies, collective-id leases, jaxpr comm-lint).")
+    ap.add_argument("--size", type=int, default=8,
+                    help="mesh size to verify at (default 8)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print info-severity diagnostics")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the jaxpr comm-lint pass (no jax tracing; "
+                    "topology/id passes only)")
+    args = ap.parse_args(argv)
+
+    report = run_all(size=args.size, trace=not args.no_trace)
+    print(report.format(verbose=args.verbose))
+    if report.ok:
+        print("lint: OK")
+        return 0
+    print("lint: FAILED")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Diagnostics core for the static analysis passes.
+
+Every pass in :mod:`bluefog_tpu.analysis` emits :class:`Diagnostic` records
+into a :class:`LintReport` rather than raising on first failure: a
+communication program usually violates several invariants at once (a
+non-stochastic weight matrix *and* the disconnected graph it induces), and
+a 128-chip job owner wants the full list before resubmitting, not one error
+per wedged run.
+
+Severities:
+
+- ``error``   — the program will deadlock, diverge, or corrupt results
+                (non-bijective permutation, overlapping collective-id
+                leases, non-stochastic mixing rows, disconnected graph).
+- ``warning`` — the program runs but converges to something weaker than
+                intended or leaves performance on the table (row-only
+                stochasticity -> biased consensus, un-donated hot-path
+                buffers, host callbacks inside the step).
+- ``info``    — measured facts worth surfacing (spectral gap, slot counts).
+
+Diagnostic codes are stable strings (``BF-ID...``, ``BF-TOPO...``,
+``BF-COMM...``) so CI greps and suppressions survive message rewording.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional
+
+__all__ = ["Diagnostic", "LintReport", "LintError"]
+
+_SEVERITIES = ("error", "warning", "info")
+
+
+class LintError(Exception):
+    """Raised by :meth:`LintReport.raise_if_errors` with the formatted
+    error diagnostics as the message."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding from one analysis pass.
+
+    Attributes:
+      severity: ``'error'`` / ``'warning'`` / ``'info'`` (see module doc).
+      code: stable machine-readable code, e.g. ``'BF-ID001'``.
+      message: human-readable explanation, self-contained (names the
+        subject — a rank, a lease owner, a slot index — inline).
+      pass_name: which pass produced it (``'collective-ids'``,
+        ``'topology'``, ``'comm-lint'``).
+      subject: what was analyzed (topology name, function name, lease
+        owner) — used for grouping in the CLI output.
+    """
+
+    severity: str
+    code: str
+    message: str
+    pass_name: str = ""
+    subject: str = ""
+
+    def __post_init__(self):
+        if self.severity not in _SEVERITIES:
+            raise ValueError(
+                f"severity must be one of {_SEVERITIES}, got "
+                f"{self.severity!r}")
+
+    def format(self) -> str:
+        where = f" [{self.subject}]" if self.subject else ""
+        origin = f" ({self.pass_name})" if self.pass_name else ""
+        return f"{self.severity}: {self.code}{where} {self.message}{origin}"
+
+
+class LintReport:
+    """Accumulates diagnostics across passes; knows how to summarize."""
+
+    def __init__(self, diagnostics: Optional[Iterable[Diagnostic]] = None):
+        self.diagnostics: List[Diagnostic] = list(diagnostics or ())
+
+    def add(self, diag: Diagnostic) -> None:
+        self.diagnostics.append(diag)
+
+    def extend(self, diags: Iterable[Diagnostic]) -> None:
+        self.diagnostics.extend(diags)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def infos(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "info"]
+
+    @property
+    def ok(self) -> bool:
+        """True iff no error-severity diagnostics were recorded."""
+        return not self.errors
+
+    def codes(self) -> List[str]:
+        return [d.code for d in self.diagnostics]
+
+    def has(self, code: str) -> bool:
+        return any(d.code == code for d in self.diagnostics)
+
+    def raise_if_errors(self) -> None:
+        if self.errors:
+            raise LintError(
+                "\n".join(d.format() for d in self.errors))
+
+    def format(self, *, verbose: bool = False) -> str:
+        """Multi-line report: errors, warnings, then (verbose) infos,
+        ending with a one-line summary."""
+        lines = [d.format() for d in self.errors]
+        lines += [d.format() for d in self.warnings]
+        if verbose:
+            lines += [d.format() for d in self.infos]
+        lines.append(
+            f"{len(self.errors)} error(s), {len(self.warnings)} "
+            f"warning(s), {len(self.infos)} info(s)")
+        return "\n".join(lines)

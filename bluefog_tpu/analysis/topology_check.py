@@ -1,0 +1,295 @@
+"""Topology verifier: mixing-matrix and schedule invariants.
+
+What decentralized SGD actually requires of its communication graph
+(Bluefog paper, arXiv:2111.04287; PAPER.md §0):
+
+- **Row stochasticity** — every gossip step must compute a convex
+  combination; a row summing to != 1 scales that rank's parameters every
+  round (exponential blowup or decay).  Error.
+- **Column stochasticity** — needed on top of row stochasticity for the
+  consensus fixed point to be the *uniform* average.  A row-only matrix
+  (e.g. the star graph) converges to a non-uniformly-weighted consensus:
+  legitimate for some algorithms (push-sum de-biases it), a silent bias
+  for plain DSGD.  Warning.
+- **Self-loop sanity** — ``W[i,i] > 0`` somewhere breaks periodicity
+  (a bipartite-like gossip with zero diagonal can oscillate instead of
+  contracting); per-rank zero self-weight is reported as a warning, an
+  all-zero diagonal as an error.
+- **Strong connectivity** — information from every rank must reach every
+  other rank or consensus splits into per-component values.  Error for a
+  static topology; for a time-varying schedule the requirement weakens to
+  *period-union* connectivity (B-connectivity): the union of edges over
+  one period must be strongly connected, even though every individual
+  phase (e.g. one-peer pairings) is wildly disconnected.
+- **Spectral gap** — ``1 - |lambda_2(W)|`` drives the consensus rate; a
+  gap of 0 means no contraction at all (always co-occurs with one of the
+  structural failures above — reported as an error with the measured
+  eigenvalue), and the measured value is surfaced as an info diagnostic
+  for capacity planning either way.
+
+All checks accept either a :class:`~bluefog_tpu.topology.graphs.Topology`
+or a raw ``(n, n)`` array — the raw form exists so the verifier can judge
+matrices the ``Topology`` constructor would reject outright (a lint pass
+must be able to *describe* an invalid input, not crash on it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from bluefog_tpu.analysis.report import Diagnostic
+from bluefog_tpu.topology.graphs import Topology
+from bluefog_tpu.topology.schedule import GossipSchedule
+
+__all__ = [
+    "spectral_gap",
+    "check_mixing_matrix",
+    "check_topology",
+    "check_schedule",
+    "check_dynamic_schedules",
+]
+
+_ATOL = 1e-8
+
+
+def _as_matrix(topo: Union[Topology, np.ndarray]) -> np.ndarray:
+    if isinstance(topo, Topology):
+        return np.asarray(topo.weights, dtype=np.float64)
+    return np.asarray(topo, dtype=np.float64)
+
+
+def _name_of(topo: Union[Topology, np.ndarray], name: Optional[str]) -> str:
+    if name is not None:
+        return name
+    if isinstance(topo, Topology):
+        return topo.name
+    return "matrix"
+
+
+def _strongly_connected(adj: np.ndarray) -> bool:
+    """Strong connectivity of the digraph with adjacency ``adj`` (bool
+    (n, n), ``adj[i, j]`` = edge j -> i exists): every node reachable from
+    node 0 following edges forward AND backward (sufficient when combined:
+    0 reaches all and all reach 0)."""
+    n = adj.shape[0]
+    if n == 0:
+        return True
+
+    def _reach(a: np.ndarray) -> bool:
+        seen = np.zeros(n, dtype=bool)
+        seen[0] = True
+        frontier = [0]
+        while frontier:
+            nxt = []
+            for u in frontier:
+                for v in np.nonzero(a[:, u])[0]:
+                    if not seen[v]:
+                        seen[v] = True
+                        nxt.append(int(v))
+            frontier = nxt
+        return bool(seen.all())
+
+    return _reach(adj) and _reach(adj.T)
+
+
+def spectral_gap(topo: Union[Topology, np.ndarray]) -> float:
+    """``1 - |lambda_2|`` of the mixing matrix (second-largest eigenvalue
+    modulus).  1.0 for a one-step exact averager (fully connected), 0.0
+    when the matrix does not contract (disconnected or periodic)."""
+    w = _as_matrix(topo)
+    if w.shape[0] <= 1:
+        return 1.0
+    mods = np.sort(np.abs(np.linalg.eigvals(w)))[::-1]
+    return float(1.0 - mods[1])
+
+
+def check_mixing_matrix(
+    topo: Union[Topology, np.ndarray],
+    *,
+    name: Optional[str] = None,
+    require_doubly_stochastic: bool = False,
+    require_connected: bool = True,
+) -> List[Diagnostic]:
+    """Verify one static mixing matrix; see the module docstring for the
+    invariant-to-severity mapping."""
+    w = _as_matrix(topo)
+    subject = _name_of(topo, name)
+    diags: List[Diagnostic] = []
+
+    if w.ndim != 2 or w.shape[0] != w.shape[1]:
+        diags.append(Diagnostic(
+            "error", "BF-TOPO001",
+            f"mixing matrix must be square, got shape {w.shape}",
+            pass_name="topology", subject=subject))
+        return diags
+    n = w.shape[0]
+
+    if (w < -_ATOL).any():
+        i, j = np.unravel_index(int(np.argmin(w)), w.shape)
+        diags.append(Diagnostic(
+            "error", "BF-TOPO002",
+            f"negative weight W[{i}, {j}] = {w[i, j]:.3g}; gossip weights "
+            "are convex-combination coefficients",
+            pass_name="topology", subject=subject))
+
+    rows = w.sum(axis=1)
+    bad_rows = np.nonzero(~np.isclose(rows, 1.0, atol=1e-6))[0]
+    if bad_rows.size:
+        r = int(bad_rows[0])
+        diags.append(Diagnostic(
+            "error", "BF-TOPO003",
+            f"{bad_rows.size} row(s) not stochastic (first: row {r} sums "
+            f"to {rows[r]:.6g}); every gossip step would rescale those "
+            "ranks' parameters",
+            pass_name="topology", subject=subject))
+
+    cols = w.sum(axis=0)
+    bad_cols = np.nonzero(~np.isclose(cols, 1.0, atol=1e-6))[0]
+    if bad_cols.size:
+        c = int(bad_cols[0])
+        sev = "error" if require_doubly_stochastic else "warning"
+        diags.append(Diagnostic(
+            sev, "BF-TOPO004",
+            f"not column-stochastic ({bad_cols.size} column(s); first: "
+            f"column {c} sums to {cols[c]:.6g}): consensus converges to a "
+            "non-uniformly-weighted average (biased for plain DSGD; fine "
+            "for push-sum-corrected algorithms)",
+            pass_name="topology", subject=subject))
+
+    diag_w = np.diag(w)
+    if n > 1 and (diag_w <= _ATOL).all():
+        diags.append(Diagnostic(
+            "error", "BF-TOPO005",
+            "zero self-weight on every rank: the gossip operator has no "
+            "lazy component and can be periodic (oscillation instead of "
+            "contraction)",
+            pass_name="topology", subject=subject))
+    else:
+        zero_self = np.nonzero(diag_w <= _ATOL)[0]
+        if zero_self.size:
+            diags.append(Diagnostic(
+                "warning", "BF-TOPO006",
+                f"rank(s) {zero_self.tolist()[:8]} have zero self-weight "
+                "(their post-gossip value ignores their own iterate)",
+                pass_name="topology", subject=subject))
+
+    adj = (np.abs(w) > _ATOL) & ~np.eye(n, dtype=bool)
+    if require_connected and not _strongly_connected(adj):
+        diags.append(Diagnostic(
+            "error", "BF-TOPO007",
+            "digraph is not strongly connected: consensus splits into "
+            "independent per-component values",
+            pass_name="topology", subject=subject))
+
+    # spectral gap only means "consensus rate" for a valid stochastic
+    # matrix; skip the measurement when the structure is already broken
+    if not any(d.severity == "error" for d in diags):
+        gap = spectral_gap(w)
+        if gap <= 1e-9 and n > 1:
+            diags.append(Diagnostic(
+                "error", "BF-TOPO008",
+                f"spectral gap is {gap:.3g} (|lambda_2| ~= 1): the mixing "
+                "matrix does not contract disagreement",
+                pass_name="topology", subject=subject))
+        else:
+            diags.append(Diagnostic(
+                "info", "BF-TOPO100",
+                f"spectral gap 1 - |lambda_2| = {gap:.4f} "
+                f"(consensus error contracts ~{gap:.2%} per round)",
+                pass_name="topology", subject=subject))
+    return diags
+
+
+def check_topology(topo: Topology, **kwargs) -> List[Diagnostic]:
+    """Alias of :func:`check_mixing_matrix` for :class:`Topology` inputs."""
+    return check_mixing_matrix(topo, **kwargs)
+
+
+def check_schedule(
+    sched: GossipSchedule, *, name: Optional[str] = None
+) -> List[Diagnostic]:
+    """Verify a lowered :class:`GossipSchedule`: every slot must be a
+    partial permutation (distinct sources, distinct destinations, ranks in
+    range) — the deadlock-freedom condition for its ``ppermute`` — and the
+    reconstructed mixing matrix must satisfy the static invariants."""
+    # one partial-permutation implementation for the whole package:
+    # check_permutation is also what the jaxpr walker applies to traced
+    # ppermute equations — here its findings are re-coded into the
+    # topology pass's stable BF-TOPO010/011
+    from bluefog_tpu.analysis.jaxpr_lint import check_permutation
+
+    subject = name or sched.name
+    diags: List[Diagnostic] = []
+    n = sched.size
+    _RECODE = {"BF-COMM001": "BF-TOPO010", "BF-COMM003": "BF-TOPO011"}
+    for k, perm in enumerate(sched.perms):
+        for d in check_permutation(perm, n, name=f"slot {k}"):
+            diags.append(dataclasses.replace(
+                d, code=_RECODE.get(d.code, d.code),
+                message=f"slot {k}: {d.message}",
+                pass_name="topology", subject=subject))
+    if not diags:
+        diags.extend(check_mixing_matrix(sched.mixing_matrix(),
+                                         name=subject))
+    return diags
+
+
+def check_dynamic_schedules(
+    topos: Sequence[Union[Topology, np.ndarray]],
+    *,
+    name: str = "dynamic",
+) -> List[Diagnostic]:
+    """Verify a time-varying (periodic) schedule.
+
+    Per phase: stochasticity and weight sanity only — one-peer phases are
+    *supposed* to be disconnected, so per-phase connectivity is not
+    required.  Across the period: the edge union must be strongly
+    connected (B-connectivity), or some pair of ranks never exchanges
+    information no matter how long training runs.
+    """
+    diags: List[Diagnostic] = []
+    if not topos:
+        diags.append(Diagnostic(
+            "error", "BF-TOPO020",
+            "empty dynamic schedule (no phases)",
+            pass_name="topology", subject=name))
+        return diags
+    mats = [_as_matrix(t) for t in topos]
+    n = mats[0].shape[0]
+    for p, (t, w) in enumerate(zip(topos, mats)):
+        phase_name = _name_of(t, None) if isinstance(t, Topology) \
+            else f"{name}[{p}]"
+        if w.shape != (n, n):
+            diags.append(Diagnostic(
+                "error", "BF-TOPO021",
+                f"phase {p} has shape {w.shape}, expected ({n}, {n})",
+                pass_name="topology", subject=name))
+            continue
+        diags.extend(check_mixing_matrix(
+            w, name=f"{name}/{phase_name}", require_connected=False))
+    # drop per-phase spectral-gap infos/errors: a single one-peer phase
+    # contracts almost nothing by design; the union is what matters
+    diags = [d for d in diags if d.code not in ("BF-TOPO008", "BF-TOPO100")]
+
+    union = np.zeros((n, n), dtype=bool)
+    for w in mats:
+        if w.shape == (n, n):
+            union |= (np.abs(w) > _ATOL)
+    np.fill_diagonal(union, False)
+    if not _strongly_connected(union):
+        diags.append(Diagnostic(
+            "error", "BF-TOPO022",
+            f"period-union of {len(topos)} phase(s) is not strongly "
+            "connected: some rank pair never exchanges information in any "
+            "phase",
+            pass_name="topology", subject=name))
+    else:
+        diags.append(Diagnostic(
+            "info", "BF-TOPO101",
+            f"period-union over {len(topos)} phase(s) is strongly "
+            "connected",
+            pass_name="topology", subject=name))
+    return diags

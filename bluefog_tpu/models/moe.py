@@ -37,6 +37,16 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     router: str = "top1"  # 'top1' (Switch) or 'top2' (GShard)
 
+    def __post_init__(self):
+        if self.router not in ("top1", "top2"):
+            raise ValueError(
+                f"unknown router {self.router!r}; expected 'top1' or 'top2'")
+        if self.router == "top2" and self.num_experts < 2:
+            raise ValueError(
+                f"router='top2' requires num_experts >= 2, got "
+                f"{self.num_experts} (the second choice would duplicate "
+                "the first and silently halve capacity)")
+
     @staticmethod
     def tiny(ep_size: int = 1, router: str = "top1") -> "MoEConfig":
         return MoEConfig(gpt=GPTConfig.tiny(), num_experts=4,

@@ -34,6 +34,8 @@ identical order.
 from __future__ import annotations
 
 import functools
+import itertools
+import warnings
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -117,6 +119,26 @@ def fuse_apply(fn, x, *, threshold_bytes: int = 8 << 20):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def axis_size(axis_name) -> int:
+    """Size of a named mesh axis, as a trace-time Python int.
+
+    ``jax.lax.axis_size`` only exists in newer jax releases; on older ones
+    the pre-API idiom ``psum(1, axis)`` folds to the same constant at
+    trace time.
+    """
+    size = getattr(lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+# one group token per neighbor_allreduce_dynamic call site: the switch's
+# branches are mutually exclusive at runtime, so their (identical) id
+# leases must not be audited against each other — but two DIFFERENT
+# dynamic calls in one program still must not share ids
+_dynamic_group_counter = itertools.count()
+
+
 def _as_schedule(s) -> GossipSchedule:
     if isinstance(s, GossipSchedule):
         return s
@@ -164,6 +186,8 @@ def neighbor_allreduce(
     send_weights=None,
     backend: str = "auto",
     collective_id_base: int = 1024,
+    collective_id_limit: Optional[int] = None,
+    collective_id_group: Optional[str] = None,
 ):
     """Weighted average with in-neighbors: ``out_i = w_ii x_i + sum_k w_ik x_k``.
 
@@ -198,18 +222,37 @@ def neighbor_allreduce(
     cap-sized chunks (one kernel each), so fused optimizer buffers ride the
     RDMA kernels by default.
 
-    ``collective_id_base``: where this call's pallas kernels start
-    enumerating barrier-semaphore ids (gossip owns [1024, 2048)).  A
-    program that issues SEVERAL pallas gossip calls over trees with no
-    data dependency between them (e.g. gradient tracking's y-mix and
-    params-mix) must give each call a distinct base — devices may be
-    skewed across the calls' kernels, and sharing a barrier semaphore
-    would let one call's handshake absorb another's signals.
+    ``collective_id_base`` / ``collective_id_limit``: the half-open id
+    range ``[base, limit)`` this call's pallas kernels enumerate
+    barrier-semaphore ids from (gossip owns [1024, 2048); ``limit=None``
+    declares the whole tail up to 2048).  A program that issues SEVERAL
+    pallas gossip calls over trees with no data dependency between them
+    (e.g. gradient tracking's y-mix and params-mix) must give each call a
+    DISJOINT range — devices may be skewed across the calls' kernels, and
+    sharing a barrier semaphore would let one call's handshake absorb
+    another's signals.  The chunk plan is validated against the CALLER'S
+    ``limit``, not just the family bound, so an oversized tree cannot
+    silently bleed into a sibling's ids; on ``backend='auto'`` an
+    over-limit plan falls back to XLA (slower, correct) while a forced
+    ``'pallas'`` raises.  Each pallas call records a
+    :class:`~bluefog_tpu.analysis.registry.CollectiveIdLease` at trace
+    time, so ``bluefog_tpu.analysis`` can audit the traced program for
+    overlaps.  The audit is CONSERVATIVE — it sees leases, not data
+    dependence, so it flags every same-family overlap as if the kernels
+    could run concurrently.  ``collective_id_group`` is the sanctioned
+    suppression: give the same group string to call sites that can never
+    be in flight together — the branches of one ``lax.switch``
+    (``neighbor_allreduce_dynamic`` does this itself), or sequential
+    calls chained by data dependence (the output of one feeding the
+    input of the next) — and the audit will not flag them against each
+    other.  Calls with NO data dependency between them (e.g. gradient
+    tracking's y-mix and params-mix) must instead use disjoint ranges.
     """
     sched = _as_schedule(schedule)
 
     from bluefog_tpu.ops import pallas_gossip
 
+    requested_backend = backend
     if send_weights is not None and backend == "pallas":
         raise NotImplementedError(
             "backend='pallas' cannot honor send_weights: the fused RDMA "
@@ -250,17 +293,50 @@ def neighbor_allreduce(
         limit = pallas_gossip.auto_max_bytes()
         n_invocations = sum(
             pallas_gossip.leaf_chunk_count(leaf, limit) for leaf in leaves)
+        id_limit = 2048 if collective_id_limit is None else collective_id_limit
         if not 1024 <= collective_id_base < 2048:
             raise ValueError(
                 f"collective_id_base {collective_id_base} outside the "
                 "gossip id range [1024, 2048)")
-        if collective_id_base + n_invocations > 2048:
+        if not collective_id_base < id_limit <= 2048:
             raise ValueError(
-                f"pallas gossip needs {n_invocations} kernel invocations "
-                f"({len(leaves)} leaves after chunking) from base "
-                f"{collective_id_base}, exceeding the collective-id range; "
-                "fuse the tree first (fuse_apply) or raise "
-                "BLUEFOG_TPU_PALLAS_MAX_BYTES")
+                f"collective_id_limit {id_limit} must lie in "
+                f"({collective_id_base}, 2048]")
+        if collective_id_base + n_invocations > id_limit:
+            if requested_backend == "pallas":
+                raise ValueError(
+                    f"pallas gossip needs {n_invocations} kernel "
+                    f"invocations ({len(leaves)} leaves after chunking) "
+                    f"from base {collective_id_base}, exceeding this "
+                    f"call's collective-id limit {id_limit}; fuse the "
+                    "tree first (fuse_apply), raise "
+                    "BLUEFOG_TPU_PALLAS_MAX_BYTES, or widen the caller's "
+                    "id lease")
+            # backend='auto': an over-limit chunk plan takes the (slower,
+            # always-correct) XLA path instead of hard-failing a run that
+            # the pre-chunking code would have completed — but audibly:
+            # the performance cliff must be visible to the user (warning
+            # dedup keeps this to once per call site)
+            warnings.warn(
+                f"neighbor_allreduce backend='auto': chunk plan needs "
+                f"{n_invocations} pallas kernel ids from base "
+                f"{collective_id_base}, exceeding the call's id limit "
+                f"{id_limit}; falling back to the XLA path (correct but "
+                "slower — no fused RDMA kernels). Fuse the tree "
+                "(fuse_apply), raise BLUEFOG_TPU_PALLAS_MAX_BYTES, or "
+                "widen the caller's id lease.",
+                stacklevel=3)
+            backend = "xla"
+        else:
+            from bluefog_tpu.analysis.registry import GLOBAL_LEASES
+
+            GLOBAL_LEASES.lease(
+                f"neighbor_allreduce[{sched.name}]@{collective_id_base}",
+                base=collective_id_base, used=n_invocations,
+                limit=id_limit, family="gossip",
+                exclusive_group=collective_id_group)
+
+    if backend == "pallas":
         cid = collective_id_base
         outs = []
         for leaf in leaves:
@@ -319,6 +395,7 @@ def neighbor_allreduce_dynamic(
     *,
     backend: str = "auto",
     collective_id_base: int = 1024,
+    collective_id_limit: Optional[int] = None,
 ):
     """Time-varying gossip: applies ``schedules[step % len(schedules)]``.
 
@@ -326,19 +403,35 @@ def neighbor_allreduce_dynamic(
     period's schedules are compiled once into a ``lax.switch`` — this is the
     recompilation-free answer to the reference's per-call ``src_weights``
     dynamic-topology API (SURVEY.md §7 hard-part #2).  The switch branches
-    are mutually exclusive, so they may share ``collective_id_base``.
+    are mutually exclusive, so they may share ``collective_id_base``; their
+    id leases carry a shared ``collective_id_group`` so the analysis audit
+    knows not to flag them against each other.
     """
     scheds = [_as_schedule(s) for s in schedules]
     if len(scheds) == 1:
         return neighbor_allreduce(x, scheds[0], axis_name, backend=backend,
-                                  collective_id_base=collective_id_base)
+                                  collective_id_base=collective_id_base,
+                                  collective_id_limit=collective_id_limit)
+    group = f"bf.dynamic_switch.{next(_dynamic_group_counter)}"
     branches = [
         functools.partial(neighbor_allreduce, schedule=s, axis_name=axis_name,
                           backend=backend,
-                          collective_id_base=collective_id_base)
+                          collective_id_base=collective_id_base,
+                          collective_id_limit=collective_id_limit,
+                          collective_id_group=group)
         for s in scheds
     ]
-    return lax.switch(jnp.asarray(step) % len(scheds), branches, x)
+    # Timeline spans are hoisted OUTSIDE the switch: an ordered io_callback
+    # inside a branch threads an effect token through the branch signature
+    # and XLA's sharding propagation CHECK-fails (hard process abort) on the
+    # extra entry parameter.  Exactly one branch runs per step, so one outer
+    # B/E pair carries the same information.
+    x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
+                         axis_name=axis_name)
+    with _tl.suppress_device_stage():
+        out = lax.switch(jnp.asarray(step) % len(scheds), branches, x)
+    return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
+                            axis_name=axis_name)
 
 
 def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str,
@@ -388,7 +481,7 @@ def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str,
     See :func:`bluefog_tpu.topology.dynamic.one_peer_exp2_mixing_matrix` for
     a jittable step->W builder.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     i = lax.axis_index(axis_name)
     W = jnp.asarray(mixing_matrix, jnp.float32)
     if W.shape != (n, n):
@@ -503,7 +596,7 @@ def allreduce(x, axis_name: str, *, average: bool = True):
     def one(leaf):
         s = lax.psum(leaf, axis_name)
         if average:
-            n = lax.axis_size(axis_name)
+            n = axis_size(axis_name)
             s = (s.astype(_acc_dtype(leaf)) / n).astype(leaf.dtype)
         return s
 

@@ -136,6 +136,14 @@ def top2_router(x, router_kernel, *, num_experts: int, capacity: int,
     load-balance term over FIRST choices (``E * sum_e frac1_e *
     mean_prob_e`` — differentiable through ``mean_prob``).
     """
+    if num_experts < 2:
+        # with E=1 the second argmax collapses onto the first: every token
+        # is dispatched twice to the same expert, consuming two capacity
+        # slots and silently halving effective capacity — reject loudly
+        raise ValueError(
+            f"top2_router requires num_experts >= 2, got {num_experts}; "
+            "with a single expert the second choice duplicates the first "
+            "(capacity silently halves) — use switch_router / router='top1'")
     probs = _router_probs(x, router_kernel, noise_rng, noise_scale)  # (T, E)
     e1 = jnp.argmax(probs, axis=-1)
     oh1 = jax.nn.one_hot(e1, num_experts)

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bluefog_tpu.ops.collectives import axis_size as _axis_size
+
 __all__ = [
     "ring_attention",
     "all_to_all_attention",
@@ -289,7 +291,7 @@ def ring_attention(
       becomes wall-clock on a lock-stepped slice.  (Non-causal math is
       position-independent, so ``layout`` only matters for ``causal=True``.)
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     b, t_q, h, d = q.shape
     t_k = k.shape[1]
@@ -312,9 +314,13 @@ def ring_attention(
     try:
         _mark_varying = lambda t: lax.pcast(t, axis_name, to="varying")
         state = jax.tree_util.tree_map(_mark_varying, state)
-    except (AttributeError, TypeError):  # older jax: pvary
-        state = jax.tree_util.tree_map(
-            lambda t: lax.pvary(t, axis_name), state)
+    except (AttributeError, TypeError):
+        try:  # older jax: pvary
+            state = jax.tree_util.tree_map(
+                lambda t: lax.pvary(t, axis_name), state)
+        except (AttributeError, TypeError):
+            pass  # pre-VMA jax: branch output types carry no varying-axes
+            # annotation, so the carry needs no marking at all
 
     shift = [(i, (i + 1) % n) for i in range(n)]
 
@@ -446,7 +452,7 @@ def all_to_all_attention(
     cheaper at moderate sequence lengths, while :func:`ring_attention` wins
     when T is huge or H < n.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(f"num_heads={h} not divisible by axis size {n}; "

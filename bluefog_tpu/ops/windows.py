@@ -205,6 +205,15 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
                 "(collective ids would bleed into the next window's bucket); "
                 "use backend='xla' or fuse leaves")
         payload_leaves = treedef.flatten_up_to(payload)
+        # trace-time lease record: the analysis audit sees this window's
+        # id bucket next to every concurrent gossip/window lease in the
+        # program (window buckets are disjoint by construction via the
+        # CRC32 claim table; the lease makes that checkable, not assumed)
+        from bluefog_tpu.analysis.registry import GLOBAL_LEASES
+
+        GLOBAL_LEASES.lease(
+            f"window:{state.spec.name}", base=base, used=len(peer_leaves),
+            limit=base + pallas_gossip.WINDOW_LEAF_CAP, family="windows")
         outs = [
             pallas_gossip.deliver_pallas(
                 leaf, peers, sched, axis_name, accumulate=accumulate,

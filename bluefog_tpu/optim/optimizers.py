@@ -42,6 +42,7 @@ from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
 
 __all__ = [
+    "GT_COLLECTIVE_ID_RANGES",
     "CommunicationType",
     "decentralized_optimizer",
     "DistributedNeighborAllreduceOptimizer",
@@ -519,6 +520,19 @@ class _GTState(NamedTuple):
     prev_g: Any   # last step's local (post-base-transform) update direction
 
 
+# Gradient tracking issues TWO data-independent gossips per update (y-mix
+# and params-mix); on the pallas backend each needs its own DISJOINT
+# barrier-semaphore id range — devices may be skewed across the two kernel
+# families, and a shared id would let one family's handshake absorb the
+# other's signals.  Declared here (not inlined) so
+# ``bluefog_tpu.analysis`` can statically audit the split against a
+# parameter tree's chunk plan before a job launches.
+GT_COLLECTIVE_ID_RANGES = {
+    "y_mix": (1024, 1536),
+    "params_mix": (1536, 2048),
+}
+
+
 def DistributedGradientTrackingOptimizer(
     base: optax.GradientTransformation,
     topology: Union[Topology, GossipSchedule],
@@ -564,14 +578,18 @@ def DistributedGradientTrackingOptimizer(
                          "(time-varying W breaks the tracking invariant)")
     sched = scheds[0]
 
-    def _mix(tree, cid_base=1024):
+    def _mix(tree, which="y_mix"):
         # the y-mix and the params-mix in one update are data-INDEPENDENT
-        # gossips — on the pallas backend each needs its own barrier-
-        # semaphore id range (devices may skew across the two kernels)
+        # gossips — each gets its own declared id lease
+        # (GT_COLLECTIVE_ID_RANGES) and neighbor_allreduce validates its
+        # chunk plan against the lease's LIMIT, not the family bound, so
+        # a huge fused buffer cannot silently bleed into the sibling's ids
+        base, id_limit = GT_COLLECTIVE_ID_RANGES[which]
         return C.fuse_apply(
             lambda t: C.neighbor_allreduce(t, sched, axis_name,
                                            backend=backend,
-                                           collective_id_base=cid_base),
+                                           collective_id_base=base,
+                                           collective_id_limit=id_limit),
             tree)
 
     def init_fn(params):
@@ -595,7 +613,7 @@ def DistributedGradientTrackingOptimizer(
         new_p = jax.tree_util.tree_map(
             lambda xm, yt: (xm.astype(jnp.float32)
                             + yt.astype(jnp.float32)),
-            _mix(params, cid_base=1536), y)
+            _mix(params, which="params_mix"), y)
         new_updates = jax.tree_util.tree_map(
             lambda np_, p: (np_ - p.astype(jnp.float32)).astype(p.dtype),
             new_p, params)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import inspect
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -40,9 +41,32 @@ from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
 try:  # JAX >= 0.4.35
     from jax import shard_map as _shard_map_mod  # type: ignore
 
-    shard_map = _shard_map_mod.shard_map if hasattr(_shard_map_mod, "shard_map") else _shard_map_mod
+    _shard_map_impl = (_shard_map_mod.shard_map
+                       if hasattr(_shard_map_mod, "shard_map")
+                       else _shard_map_mod)
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map_impl  # type: ignore
+
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters)
+
+
+@functools.wraps(_shard_map_impl)
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` with version-portable kwargs.
+
+    The replication-check flag was renamed ``check_rep`` -> ``check_vma``
+    across jax releases; every call site here (and the test suite) uses
+    the new name, so translate to whatever the installed jax accepts —
+    the same boolean under either name — and drop flags it lacks
+    entirely.
+    """
+    for new, old in (("check_vma", "check_rep"), ("check_rep", "check_vma")):
+        if new in kwargs and new not in _SHARD_MAP_PARAMS:
+            val = kwargs.pop(new)
+            if old in _SHARD_MAP_PARAMS:
+                kwargs[old] = val
+    return _shard_map_impl(*args, **kwargs)
 
 __all__ = [
     "allreduce",

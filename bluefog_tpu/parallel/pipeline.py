@@ -168,8 +168,11 @@ def pipeline_train_step_1f1b(
       targets: ``(M, ...)`` per-microbatch loss targets (consumed by the
         last stage only).
       loss_fn: ``(head_params, y, target) -> scalar`` — the model head
-        folded into the loss.  Evaluated (cheaply, masked) on every stage;
-        only the last stage's value and gradients are accumulated.
+        folded into the loss.  Runs only on the LAST stage, selected by a
+        runtime ``lax.cond`` (non-last stages take the identity branch;
+        see the inline comment in the backward tick for why
+        masked-everywhere evaluation was rejected), so only the last
+        stage's value and gradients are ever computed or accumulated.
       head_params: parameters of ``loss_fn``'s head; ``None`` for a bare
         loss.
       collect_input_grads: also return ``(M, ...)`` cotangents of the

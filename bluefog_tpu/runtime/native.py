@@ -448,7 +448,10 @@ class PyEngine:
         t.join(timeout=5)
 
 
-_engine_lock = threading.Lock()
+# RLock: engine() holds this while Engine.__init__ runs, and the fallback
+# path re-enters it through _py_engine() — a plain Lock self-deadlocks
+# whenever the native .so is unavailable
+_engine_lock = threading.RLock()
 _PY_ENGINE: Optional[PyEngine] = None
 
 

@@ -36,6 +36,7 @@ __all__ = [
     "timeline_context",
     "timeline_active",
     "device_stage",
+    "suppress_device_stage",
 ]
 
 
@@ -234,6 +235,27 @@ def timeline_context(name: str, category: str = "activity"):
         timeline_end_activity(name, category)
 
 
+_suppress_stage = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_device_stage():
+    """Trace-time escape hatch: :func:`device_stage` is the identity inside
+    this block.  Control-flow wrappers that compile sub-computations into
+    ``lax.switch``/``lax.cond`` branches use it to hoist the span OUTSIDE
+    the branch: an ordered ``io_callback`` inside a branch threads an
+    effect token through the branch signature, and XLA's sharding
+    propagation CHECK-fails on the extra entry parameter
+    (``allow-spmd-sharding-propagation-to-parameters-vector's size``) —
+    a process-killing abort, not a Python exception."""
+    prev = getattr(_suppress_stage, "on", False)
+    _suppress_stage.on = True
+    try:
+        yield
+    finally:
+        _suppress_stage.on = prev
+
+
 def device_stage(x, name: str, *, phase: str = "B",
                  category: str = "gossip", axis_name: Optional[str] = None):
     """Emit a timeline event from INSIDE a jitted program at **runtime** —
@@ -251,9 +273,22 @@ def device_stage(x, name: str, *, phase: str = "B",
     leaf (cheap — one element per leaf), so the event observes each leaf's
     computation producing data, not just the first leaf's; it remains an
     approximation of "fully materialized" (XLA may still be finishing the
-    leaves' tails).  Callbacks are ``ordered=True`` so B/E pairs in a lane
-    cannot invert or interleave across in-flight steps — Chrome-trace B/E
-    matching relies on per-lane nesting.
+    leaves' tails).  B/E ordering is enforced by DATAFLOW, not by ordered
+    effects: the callback returns a zero scalar that is folded back into
+    the result, so everything downstream of a span — its own E, and any
+    later span whose operand consumes this result — depends on its
+    callback having fired.  That orders each B before its E and chains
+    spans along a data-dependence path, but it does NOT order two
+    data-INDEPENDENT instrumented collectives in one step (e.g. gradient
+    tracking's y-mix and params-mix) against each other: their same-name
+    B/E pairs may interleave in a lane, which Chrome-trace B/E matching
+    renders with crossed durations.  ``ordered=True`` would serialize
+    those too, but its runtime token is threaded through the compiled
+    program as an extra entry parameter and XLA's sharding propagation
+    CHECK-fails (hard process abort, not an exception) whenever the
+    jitted step takes more than one argument
+    (``allow-spmd-sharding-propagation-to-parameters-vector's size``) —
+    a mis-nested trace beats a dead process.
 
     Trace-time gated: when no timeline is active at *trace* time this is the
     identity with zero HLO footprint (enable the timeline before building
@@ -266,7 +301,7 @@ def device_stage(x, name: str, *, phase: str = "B",
     if phase not in ("B", "E"):
         raise ValueError(f"phase must be 'B' or 'E', got {phase!r}")
     tl = _get()
-    if tl is None:
+    if tl is None or getattr(_suppress_stage, "on", False):
         return x
     import jax
     from jax import lax
@@ -274,8 +309,11 @@ def device_stage(x, name: str, *, phase: str = "B",
 
     rank = lax.axis_index(axis_name) if axis_name is not None else 0
 
+    import numpy as np
+
     def cb(_tok, r):
         (tl.begin if phase == "B" else tl.end)(name, category, tid=int(r))
+        return np.float32(0.0)
 
     # custom_jvp shell: io_callback has no JVP rule, so without this a
     # timeline-active trace would make every instrumented collective
@@ -287,8 +325,25 @@ def device_stage(x, name: str, *, phase: str = "B",
                   if hasattr(l, "ravel")]
         token = sum((l.ravel()[0].astype("float32") for l in leaves),
                     start=jax.numpy.float32(0)) if leaves else 0
-        io_callback(cb, None, token, rank, ordered=True)
-        return y
+        zero = io_callback(cb, jax.ShapeDtypeStruct((), jax.numpy.float32),
+                           token, rank, ordered=False)
+        # Fold the callback's zero result into one arithmetic leaf: the
+        # dataflow edge orders the span before everything that consumes
+        # this result (see the ordering note and its limits in the
+        # docstring) and pins the callback against DCE by construction.
+        def fold(tree):
+            folded = [False]
+
+            def one(l):
+                if (not folded[0] and hasattr(l, "dtype")
+                        and jax.numpy.issubdtype(l.dtype, jax.numpy.number)):
+                    folded[0] = True
+                    return l + zero.astype(l.dtype)
+                return l
+
+            return jax.tree_util.tree_map(one, tree)
+
+        return fold(y)
 
     @stamped.defjvp
     def _stamped_jvp(primals, tangents):

@@ -1,0 +1,472 @@
+"""Static-analysis suite: each pass must CATCH its seeded violation and
+stay quiet on every healthy built-in program.
+
+The violations seeded here are the exact failure classes ISSUE/ADVICE
+identified as silent at runtime: overlapping collective-id leases
+(skewed-kernel handshake absorption), non-stochastic mixing rows
+(per-round parameter rescaling), a disconnected period-union schedule
+(rank pairs that never exchange information), and a non-bijective
+ppermute (deadlock / double-delivery on a real mesh).
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu import topology as T
+from bluefog_tpu.analysis import (
+    GLOBAL_LEASES,
+    LeaseRegistry,
+    LintError,
+    LintReport,
+    check_dynamic_schedules,
+    check_mixing_matrix,
+    check_permutation,
+    check_schedule,
+    check_topology,
+    lint_step_fn,
+    plan_gossip_leases,
+    spectral_gap,
+)
+from bluefog_tpu.analysis.lint import run_all
+from bluefog_tpu.ops import collectives as C
+from bluefog_tpu.ops import pallas_gossip
+from bluefog_tpu.optim import (
+    GT_COLLECTIVE_ID_RANGES,
+    DistributedGradientTrackingOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+)
+from bluefog_tpu.parallel.api import shard_map
+from tests._util import REPO, clean_env
+
+AXIS = "bf"
+
+
+def _codes(diags):
+    return {d.code for d in diags}
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == "error"]
+
+
+# ---------------------------------------------------------------------------
+# collective-id allocator / auditor
+# ---------------------------------------------------------------------------
+
+
+class TestLeaseRegistry:
+    def test_overlapping_leases_caught(self):
+        reg = LeaseRegistry()
+        reg.lease("y_mix", base=1024, used=10, limit=1600)
+        reg.lease("params_mix", base=1536, used=10, limit=2048)
+        diags = reg.audit()
+        assert "BF-ID010" in _codes(_errors(diags))
+
+    def test_disjoint_leases_clean(self):
+        reg = LeaseRegistry()
+        reg.lease("y_mix", base=1024, used=10, limit=1536)
+        reg.lease("params_mix", base=1536, used=10, limit=2048)
+        assert not _errors(reg.audit())
+
+    def test_exclusive_group_exempts_switch_branches(self):
+        # the branches of one lax.switch are mutually exclusive at runtime
+        # and legitimately share a base — same group, no overlap report
+        reg = LeaseRegistry()
+        reg.lease("dyn[0]", base=1024, used=4, limit=1536,
+                  exclusive_group="switch0")
+        reg.lease("dyn[1]", base=1024, used=4, limit=1536,
+                  exclusive_group="switch0")
+        assert not _errors(reg.audit())
+        # ...but a DIFFERENT dynamic call sharing the base is still flagged
+        reg.lease("dyn2[0]", base=1024, used=4, limit=1536,
+                  exclusive_group="switch1")
+        assert "BF-ID010" in _codes(_errors(reg.audit()))
+
+    def test_used_overrunning_limit_caught(self):
+        reg = LeaseRegistry()
+        reg.lease("greedy", base=1024, used=600, limit=1536)
+        assert "BF-ID005" in _codes(_errors(reg.audit()))
+
+    def test_base_outside_family_caught(self):
+        reg = LeaseRegistry()
+        reg.lease("stray", base=100, used=1, limit=2048)
+        assert "BF-ID002" in _codes(_errors(reg.audit()))
+
+    def test_window_family_disjoint_from_gossip(self):
+        reg = LeaseRegistry()
+        reg.lease("gossip", base=1024, used=1024, limit=2048)
+        reg.lease("window:w0", base=2048, used=4, limit=3072,
+                  family="windows")
+        assert not _errors(reg.audit())
+
+    def test_scope_isolates_and_restores(self):
+        reg = LeaseRegistry()
+        reg.lease("outer", base=1024, used=1, limit=2048)
+        with reg.scope():
+            assert reg.leases == []
+            reg.lease("inner", base=1024, used=1, limit=2048)
+            assert [r.owner for r in reg.leases] == ["inner"]
+        assert [r.owner for r in reg.leases] == ["outer"]
+
+    def test_plan_gossip_leases_matches_chunk_plan(self):
+        tree = {"w": jnp.zeros((1 << 20,), jnp.float32)}  # 4 MiB on wire
+        expected = sum(pallas_gossip.leaf_chunk_count(l)
+                       for l in jax.tree_util.tree_leaves(tree))
+        reg = LeaseRegistry()
+        (rec,) = plan_gossip_leases([("opt", tree, (1024, 1536))],
+                                    registry=reg)
+        assert rec.used == expected
+        assert not _errors(reg.audit())
+
+
+class TestOptimizerLeases:
+    def test_gt_declared_ranges_disjoint(self):
+        (alo, ahi) = GT_COLLECTIVE_ID_RANGES["y_mix"]
+        (blo, bhi) = GT_COLLECTIVE_ID_RANGES["params_mix"]
+        assert min(ahi, bhi) <= max(alo, blo)  # no overlap
+        assert alo >= 1024 and bhi <= 2048
+
+    def test_gt_split_audits_clean_at_scale(self):
+        # ResNet-18-sized fused buffer: the configuration ADVICE.md's
+        # medium finding showed could silently overlap pre-limit
+        fused = {"p": jnp.zeros((11_000_000,), jnp.float32)}
+        with GLOBAL_LEASES.scope() as reg:
+            plan_gossip_leases(
+                [("gt/y_mix", fused, GT_COLLECTIVE_ID_RANGES["y_mix"]),
+                 ("gt/params_mix", fused,
+                  GT_COLLECTIVE_ID_RANGES["params_mix"])],
+                registry=reg)
+            assert not _errors(reg.audit())
+
+
+# ---------------------------------------------------------------------------
+# topology verifier
+# ---------------------------------------------------------------------------
+
+
+class TestTopologyChecks:
+    def test_non_stochastic_matrix_caught(self):
+        w = np.full((4, 4), 0.5)  # rows sum to 2
+        diags = check_mixing_matrix(w, name="bad_rows")
+        assert "BF-TOPO003" in _codes(_errors(diags))
+
+    def test_negative_weight_caught(self):
+        w = np.eye(4)
+        w[0, 0], w[0, 1] = 1.5, -0.5
+        assert "BF-TOPO002" in _codes(_errors(check_mixing_matrix(w)))
+
+    def test_disconnected_graph_caught(self):
+        # two isolated 2-cliques: stochastic but consensus splits
+        block = np.full((2, 2), 0.5)
+        w = np.block([[block, np.zeros((2, 2))],
+                      [np.zeros((2, 2)), block]])
+        diags = check_mixing_matrix(w, name="split")
+        assert "BF-TOPO007" in _codes(_errors(diags))
+
+    def test_zero_diagonal_caught(self):
+        w = np.array([[0.0, 1.0], [1.0, 0.0]])  # periodic: oscillates
+        assert "BF-TOPO005" in _codes(_errors(check_mixing_matrix(w)))
+
+    def test_row_only_stochastic_warns_not_errors(self):
+        star = T.StarGraph(8, center_rank=0)
+        diags = check_topology(star)
+        assert not _errors(diags)
+        assert "BF-TOPO004" in {d.code for d in diags
+                                if d.severity == "warning"}
+
+    def test_require_doubly_stochastic_promotes_to_error(self):
+        star = T.StarGraph(8, center_rank=0)
+        diags = check_topology(star, require_doubly_stochastic=True)
+        assert "BF-TOPO004" in _codes(_errors(diags))
+
+    @pytest.mark.parametrize("size", [2, 4, 8])
+    def test_all_builtin_topologies_clean(self, size):
+        for topo in [
+            T.ExponentialTwoGraph(size),
+            T.ExponentialGraph(size, base=2),
+            T.SymmetricExponentialGraph(size),
+            T.RingGraph(size, 0),
+            T.RingGraph(size, 1),
+            T.RingGraph(size, 2),
+            T.MeshGrid2DGraph(size),
+            T.StarGraph(size),
+            T.FullyConnectedGraph(size),
+        ]:
+            assert not _errors(check_topology(topo)), topo.name
+            assert not _errors(check_schedule(T.build_schedule(topo))), \
+                topo.name
+
+    def test_spectral_gap_extremes(self):
+        assert spectral_gap(T.FullyConnectedGraph(8)) == pytest.approx(1.0)
+        block = np.full((2, 2), 0.5)
+        split = np.block([[block, np.zeros((2, 2))],
+                          [np.zeros((2, 2)), block]])
+        assert spectral_gap(split) == pytest.approx(0.0, abs=1e-9)
+
+    def test_non_permutation_schedule_slot_caught(self):
+        good = T.build_schedule(T.RingGraph(8, 1))
+        bad = T.GossipSchedule(
+            size=8,
+            perms=(((0, 1), (0, 2)),),  # rank 0 sends twice in one slot
+            self_weights=good.self_weights,
+            recv_weights=good.recv_weights,
+            recv_src=good.recv_src,
+            is_circulant=False,
+            name="bad")
+        assert "BF-TOPO010" in _codes(_errors(check_schedule(bad)))
+
+
+class TestDynamicSchedules:
+    def test_builtin_one_peer_periods_clean(self):
+        for name, topos in [
+            ("one_peer_exp2", T.one_peer_exponential_two_schedules(8)),
+            ("one_peer_ring", T.one_peer_ring_schedules(8)),
+        ]:
+            diags = check_dynamic_schedules(topos, name=name)
+            assert not _errors(diags), name
+            assert "BF-TOPO101" in _codes(diags)
+
+    def test_disconnected_period_union_caught(self):
+        # every phase only pairs (0,1) and (2,3): ranks {0,1} and {2,3}
+        # never exchange information no matter how long training runs
+        pair = np.block([[np.full((2, 2), 0.5), np.zeros((2, 2))],
+                         [np.zeros((2, 2)), np.full((2, 2), 0.5)]])
+        diags = check_dynamic_schedules([pair, pair], name="never_crosses")
+        assert "BF-TOPO022" in _codes(_errors(diags))
+
+    def test_empty_schedule_caught(self):
+        assert "BF-TOPO020" in _codes(_errors(check_dynamic_schedules([])))
+
+    def test_per_phase_disconnection_allowed(self):
+        # one-peer phases are individually disconnected BY DESIGN; only
+        # the union matters — no BF-TOPO007 from any phase
+        topos = T.one_peer_exponential_two_schedules(8)
+        diags = check_dynamic_schedules(topos, name="one_peer")
+        assert "BF-TOPO007" not in _codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr comm-lint
+# ---------------------------------------------------------------------------
+
+
+def _mesh(devices8):
+    return Mesh(np.array(devices8), (AXIS,))
+
+
+def _smap(mesh, body):
+    return shard_map(body, mesh=mesh, in_specs=(P(AXIS),),
+                     out_specs=P(AXIS), check_vma=False)
+
+
+class TestJaxprLint:
+    def test_check_permutation_duplicates(self):
+        diags = check_permutation([(0, 1), (0, 2)], 4)
+        assert "BF-COMM001" in _codes(_errors(diags))
+        diags = check_permutation([(0, 2), (1, 2)], 4)
+        assert "BF-COMM001" in _codes(_errors(diags))
+        assert not _errors(check_permutation([(0, 1), (1, 0)], 4))
+
+    def test_check_permutation_out_of_range(self):
+        assert "BF-COMM003" in _codes(
+            _errors(check_permutation([(0, 9)], 8)))
+
+    def test_non_bijective_ppermute_in_traced_step_caught(self, devices8):
+        # jax traces a duplicate-destination perm cleanly — the lint is
+        # the only pre-run check (module docstring's motivating case)
+        mesh = _mesh(devices8)
+
+        def bad_step(x):
+            return lax.ppermute(x, AXIS, [(0, 3), (1, 3), (2, 4)])
+
+        diags = lint_step_fn(_smap(mesh, bad_step),
+                             jnp.zeros((8, 4)), name="bad_step")
+        assert "BF-COMM001" in _codes(_errors(diags))
+
+    def test_gossip_step_clean(self, devices8):
+        mesh = _mesh(devices8)
+        sched = T.build_schedule(T.ExponentialTwoGraph(8))
+
+        def step(x):
+            return C.neighbor_allreduce(x, sched, AXIS)
+
+        diags = lint_step_fn(_smap(mesh, step), jnp.zeros((8, 4)),
+                             name="gossip")
+        assert not _errors(diags)
+        assert "BF-COMM100" in _codes(diags)
+
+    def test_host_callback_warned(self, devices8):
+        mesh = _mesh(devices8)
+
+        def chatty(x):
+            jax.debug.callback(lambda v: None, x)
+            return x
+
+        diags = lint_step_fn(_smap(mesh, chatty), jnp.zeros((8, 4)),
+                             name="chatty")
+        assert "BF-COMM010" in {d.code for d in diags
+                                if d.severity == "warning"}
+
+    def test_trace_failure_is_a_diagnostic_not_a_crash(self):
+        def broken(x):
+            raise RuntimeError("boom")
+
+        diags = lint_step_fn(broken, jnp.zeros(4), name="broken")
+        assert "BF-COMM020" in _codes(_errors(diags))
+
+    @pytest.mark.parametrize("make_opt", [
+        lambda: DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.05), topology=T.ExponentialTwoGraph(8),
+            axis_name=AXIS),
+        lambda: DistributedGradientTrackingOptimizer(
+            optax.sgd(0.05), T.MeshGrid2DGraph(8), AXIS),
+    ], ids=["dsgd", "gradient_tracking"])
+    def test_distributed_optimizers_lint_clean(self, devices8, make_opt):
+        mesh = _mesh(devices8)
+        opt = make_opt()
+
+        def body(c):
+            w0 = jnp.zeros_like(c)
+            st = opt.init(w0)
+
+            def step(carry, _):
+                w, s = carry
+                upd, s = opt.update(w - c, s, w)
+                return (optax.apply_updates(w, upd), s), None
+
+            (w, _), _ = lax.scan(step, (w0, st), None, length=2)
+            return w
+
+        diags = lint_step_fn(_smap(mesh, body), jnp.zeros((8, 4)),
+                             name="opt_step")
+        assert not _errors(diags)
+
+
+# ---------------------------------------------------------------------------
+# op-layer integration: collective_id_limit (the ADVICE fixes)
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveIdLimit:
+    def test_forced_pallas_over_limit_raises(self, monkeypatch):
+        # a 2 KiB cap makes an 8K-float leaf need >1024 invocations: the
+        # plan can NEVER fit the gossip family, so forced pallas must
+        # refuse at trace time rather than bleed into sibling ids
+        monkeypatch.setenv("BLUEFOG_TPU_PALLAS_MAX_BYTES", "2048")
+        sched = T.build_schedule(T.RingGraph(8, 1))
+        x = jnp.zeros((1 << 20,), jnp.float32)
+        with pytest.raises(ValueError, match="collective-id limit"):
+            C.neighbor_allreduce(x, sched, AXIS, backend="pallas")
+
+    def test_forced_pallas_respects_caller_limit(self, monkeypatch):
+        # fits the family bound [1024, 2048) but NOT the caller's
+        # [1024, 1040) lease — the pre-fix code would accept this and
+        # overlap the sibling's ids (ADVICE medium)
+        monkeypatch.setenv("BLUEFOG_TPU_PALLAS_MAX_BYTES", str(64 << 10))
+        sched = T.build_schedule(T.RingGraph(8, 1))
+        x = jnp.zeros((1 << 20,), jnp.float32)  # 4 MiB -> 64 invocations
+        with pytest.raises(ValueError, match="collective-id limit"):
+            C.neighbor_allreduce(x, sched, AXIS, backend="pallas",
+                                 collective_id_base=1024,
+                                 collective_id_limit=1040)
+
+    def test_auto_over_limit_falls_back_to_xla(self, devices8, monkeypatch):
+        # on backend='auto' an over-limit chunk plan must take the
+        # (slower, correct) XLA path instead of hard-failing the run
+        # (ADVICE low).  CPU auto-resolves to XLA before the chunk plan,
+        # so force the pallas resolution to reach the fallback branch.
+        monkeypatch.setattr(pallas_gossip, "on_tpu_platform", lambda: True)
+        monkeypatch.setenv("BLUEFOG_TPU_PALLAS_MAX_BYTES", "2048")
+        mesh = _mesh(devices8)
+        sched = T.build_schedule(T.RingGraph(8, 1))
+        x = jnp.arange(8 * (1 << 20), dtype=jnp.float32)
+        x = x.reshape(8, -1) / x.size
+
+        out = _smap(mesh, lambda v: C.neighbor_allreduce(
+            v, sched, AXIS, backend="auto"))(x)
+        ref = _smap(mesh, lambda v: C.neighbor_allreduce(
+            v, sched, AXIS, backend="xla"))(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6)
+
+    def test_bad_limit_rejected(self, monkeypatch):
+        monkeypatch.setenv("BLUEFOG_TPU_PALLAS_MAX_BYTES", str(4 << 20))
+        sched = T.build_schedule(T.RingGraph(8, 1))
+        with pytest.raises(ValueError, match="must lie in"):
+            C.neighbor_allreduce(jnp.zeros(16), sched, AXIS,
+                                 backend="pallas",
+                                 collective_id_base=1536,
+                                 collective_id_limit=1536)
+
+
+# ---------------------------------------------------------------------------
+# report plumbing + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestReport:
+    def test_raise_if_errors(self):
+        from bluefog_tpu.analysis import Diagnostic
+
+        rep = LintReport([Diagnostic("error", "BF-ID010", "overlap")])
+        assert not rep.ok
+        with pytest.raises(LintError, match="BF-ID010"):
+            rep.raise_if_errors()
+        assert LintReport([Diagnostic("info", "BF-ID100", "fine")]).ok
+
+    def test_invalid_severity_rejected(self):
+        from bluefog_tpu.analysis import Diagnostic
+
+        with pytest.raises(ValueError):
+            Diagnostic("fatal", "BF-X", "nope")
+
+
+class TestLintCli:
+    def test_run_all_clean_on_own_programs(self):
+        # the acceptance bar: every pass green over the repo's own
+        # topologies, optimizers, and examples (trace pass included)
+        report = run_all(size=8)
+        assert report.ok, report.format()
+
+    def test_cli_exits_zero(self):
+        # the tier-1/CI hook: the module CLI itself (subprocess, fresh
+        # interpreter) must exit 0 on the repo as committed.  --no-trace
+        # keeps it to seconds; the traced passes run in-process above.
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.analysis.lint",
+             "--no-trace", "--size", "8"],
+            capture_output=True, text=True, timeout=300,
+            cwd=REPO, env=clean_env())
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "lint: OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# satellite guards (ADVICE lows)
+# ---------------------------------------------------------------------------
+
+
+class TestMoEGuards:
+    def test_top2_router_rejects_single_expert(self):
+        from bluefog_tpu.ops.moe import top2_router
+
+        with pytest.raises(ValueError, match="num_experts >= 2"):
+            top2_router(jnp.zeros((4, 8)), jnp.zeros((8, 1)),
+                        num_experts=1, capacity=4)
+
+    def test_moe_config_rejects_top2_single_expert(self):
+        from bluefog_tpu.models.moe import GPTConfig, MoEConfig
+
+        with pytest.raises(ValueError, match="num_experts >= 2"):
+            MoEConfig(gpt=GPTConfig.tiny(), num_experts=1, router="top2")
+        with pytest.raises(ValueError, match="unknown router"):
+            MoEConfig(gpt=GPTConfig.tiny(), num_experts=4, router="top3")
