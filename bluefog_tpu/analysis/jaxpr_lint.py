@@ -151,12 +151,33 @@ def _walk(jaxpr, axis_sizes: Dict[str, int], name: str,
                     pass_name="comm-lint", subject=name))
 
         if any(cb in prim for cb in _CALLBACK_PRIMS):
-            diags.append(Diagnostic(
-                "warning", "BF-COMM010",
-                f"host callback ({prim}) inside the step: forces a "
-                "device->host sync every iteration; keep it off the "
-                "production hot path",
-                pass_name="comm-lint", subject=name))
+            if params.get("ordered"):
+                # the PR-1 abort class: an ordered io_callback threads an
+                # effect token through the compiled program as an extra
+                # entry parameter, and this environment's XLA sharding
+                # propagation CHECK-fails on it (hard process abort, not
+                # an exception) whenever the jitted step takes >= 2
+                # arguments.  The timeline and metrics subsystems use
+                # unordered callbacks with dataflow-enforced ordering for
+                # exactly this reason — flag any reintroduction as an
+                # error before it kills a job.
+                diags.append(Diagnostic(
+                    "error", "BF-COMM012",
+                    f"ORDERED host callback ({prim}, ordered=True) inside "
+                    "the step: the threaded effect token becomes an extra "
+                    "entry parameter and XLA sharding propagation "
+                    "CHECK-fails (process abort) on multi-argument jitted "
+                    "steps — use ordered=False and enforce ordering by "
+                    "dataflow (fold the callback result into the output), "
+                    "as utils/timeline.device_stage and metrics.comm do",
+                    pass_name="comm-lint", subject=name))
+            else:
+                diags.append(Diagnostic(
+                    "warning", "BF-COMM010",
+                    f"host callback ({prim}) inside the step: forces a "
+                    "device->host sync every iteration; keep it off the "
+                    "production hot path",
+                    pass_name="comm-lint", subject=name))
 
         # descend: shard_map binds its mesh's axes, pmap binds its single
         # named axis — both are containers, not collectives

@@ -1,0 +1,62 @@
+"""Metrics & health observability subsystem.
+
+The third leg of the observability story (ROADMAP north star: production
+serving needs to *see* the gossip trade-off the paper argues for):
+
+- ``utils/timeline.py`` answers **when** (chrome-trace spans);
+- ``bluefog_tpu.analysis`` answers **whether it can work at all**
+  (static verification before the job launches);
+- this package answers **how much** at runtime: bytes gossiped, messages
+  per window op, achieved compression ratio, consensus distance
+  ``||x_i - x_bar||``, measured per-step mixing contraction vs the
+  spectral-gap prediction, deposit staleness, heartbeat age.
+
+Reference analog: Horovod/Bluefog shipped a timeline; a production
+deployment also needs counters an operator can scrape.  Design rules:
+
+- **Off by default, zero cost when off.**  Every hook checks
+  :func:`current` (a None test) at *trace* time; with no registry active
+  the instrumented jitted programs contain zero extra HLO and host paths
+  pay one attribute load (asserted in ``tests/test_metrics.py``).
+- **Enable** via ``BLUEFOG_TPU_METRICS=<file.jsonl>`` (auto-start, JSONL
+  per-step export, atexit summary) or programmatically with
+  :func:`metrics_start`.
+- **No ordered io_callbacks on jitted paths** — this environment's XLA
+  CHECK-fails on the threaded effect token (the PR-1 abort class; the
+  analysis lint now flags it as BF-COMM012).  Jitted instrumentation is
+  either a trace-time record (static costs: pipeline bubble fraction,
+  compression ratio) or an *unordered* callback whose zero result is
+  folded into the dataflow (the proven ``device_stage`` pattern), with
+  per-execution increments carried as traced operands.
+
+Consume the output with ``python -m bluefog_tpu.metrics.dash m.jsonl``
+(console script ``bfmetrics-tpu``) or scrape
+:func:`~bluefog_tpu.metrics.export.prometheus_text`.
+"""
+
+from bluefog_tpu.metrics import comm, health
+from bluefog_tpu.metrics.registry import (
+    MetricsRegistry,
+    current,
+    metrics_active,
+    metrics_start,
+    metrics_stop,
+)
+from bluefog_tpu.metrics.export import (
+    MetricsWriter,
+    prometheus_text,
+    step,
+    write_prometheus,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "MetricsWriter",
+    "current",
+    "metrics_active",
+    "metrics_start",
+    "metrics_stop",
+    "prometheus_text",
+    "step",
+    "write_prometheus",
+]

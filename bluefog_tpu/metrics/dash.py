@@ -1,0 +1,183 @@
+"""Metrics dashboard CLI: summarize a JSONL metrics file.
+
+::
+
+    python -m bluefog_tpu.metrics.dash /tmp/m.jsonl
+    bfmetrics-tpu /tmp/m.jsonl --match bytes
+
+Reads the per-step snapshot lines :func:`bluefog_tpu.metrics.export.step`
+appends (plus the atexit summary line) and prints one row per series:
+
+- counters (``*_total``): the cumulative total, per-step delta mean /
+  p50 / p99, and — for byte counters — bytes/step;
+- gauges: last value plus per-step mean / p50 / p99;
+- histogram expansions (``*_count`` / ``_sum`` / ``_p50`` / ...): shown
+  as gauges of their per-step values.
+
+Percentiles are over the per-step series, which is what an operator
+asking "what does a bad step cost" wants — the registry's own
+reservoir quantiles (the ``_p50``/``_p99`` series) answer the
+per-*observation* version of the question.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Dict, List, Optional
+
+from bluefog_tpu.metrics.registry import quantile
+
+__all__ = ["main", "load_series", "summarize"]
+
+
+def load_series(path: str):
+    """Parse a metrics JSONL file into ``(steps, series, summary)``:
+    ``steps`` the step indices, ``series`` ``{name: [value per line]}``
+    (missing values forward-filled with NaN), ``summary`` the final
+    summary snapshot (or None)."""
+    steps: List[int] = []
+    rows: List[Dict[str, float]] = []
+    summary: Optional[Dict[str, float]] = None
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{ln}: not JSON ({e})")
+            if rec.get("summary"):
+                summary = rec.get("metrics", {})
+                continue
+            rows.append(rec.get("metrics", {}))
+            steps.append(int(rec.get("step", len(steps))))
+    names = sorted({n for row in rows for n in row})
+    series = {n: [row.get(n, math.nan) for row in rows] for n in names}
+    return steps, series, summary
+
+
+def _is_counter(name: str) -> bool:
+    base = name.split("{", 1)[0]
+    return base.endswith("_total")
+
+
+def _deltas(values: List[float]) -> List[float]:
+    out = []
+    prev = 0.0
+    for v in values:
+        if math.isnan(v):
+            continue
+        out.append(max(0.0, v - prev))
+        prev = v
+    return out
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return "-"
+    if v and (abs(v) >= 1e6 or abs(v) < 1e-3):
+        return f"{v:.3e}"
+    if float(v).is_integer():
+        return f"{int(v)}"
+    return f"{v:.4g}"
+
+
+def summarize(steps, series, summary=None, *, match: str = "") -> List[dict]:
+    """One summary record per series (the dash table's rows)."""
+    out = []
+    final = summary or {}
+    # a run that never called step() still writes the atexit summary —
+    # its series must appear (with zero per-step points), not vanish
+    series = dict(series)
+    for name in final:
+        series.setdefault(name, [])
+    for name, values in series.items():
+        if match and match not in name:
+            continue
+        clean = [v for v in values if not math.isnan(v)]
+        if not clean and name not in final:
+            continue
+        if _is_counter(name):
+            total = final.get(name, clean[-1] if clean else 0.0)
+            per_step = _deltas(values)
+            s = sorted(per_step)
+            row = {
+                "series": name, "type": "counter", "points": len(clean),
+                "total": total,
+                "per_step_mean": (sum(per_step) / len(per_step)
+                                  if per_step else math.nan),
+                "p50": quantile(s, 0.50), "p99": quantile(s, 0.99),
+            }
+        else:
+            s = sorted(clean)
+            row = {
+                "series": name, "type": "gauge", "points": len(clean),
+                "total": final.get(name, clean[-1] if clean else math.nan),
+                "per_step_mean": (sum(clean) / len(clean)
+                                  if clean else math.nan),
+                "p50": quantile(s, 0.50), "p99": quantile(s, 0.99),
+            }
+        out.append(row)
+    return out
+
+
+def format_table(rows: List[dict]) -> str:
+    headers = ("series", "type", "points", "total/last", "per-step mean",
+               "p50", "p99")
+    table = [headers]
+    for r in rows:
+        table.append((r["series"], r["type"], str(r["points"]),
+                      _fmt(r["total"]), _fmt(r["per_step_mean"]),
+                      _fmt(r["p50"]), _fmt(r["p99"])))
+    widths = [max(len(row[i]) for row in table) for i in range(len(headers))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bfmetrics-tpu",
+        description="Summarize a bluefog_tpu metrics JSONL file "
+                    "(per-metric totals, per-step p50/p99).")
+    ap.add_argument("path", help="JSONL file written via "
+                    "BLUEFOG_TPU_METRICS=<path> / metrics.export.step()")
+    ap.add_argument("--match", default="",
+                    help="only show series containing this substring")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary rows as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    try:
+        steps, series, summary = load_series(args.path)
+    except OSError as e:
+        print(f"bfmetrics-tpu: {e}", file=sys.stderr)
+        return 2
+    if not steps and summary is None:
+        print(f"bfmetrics-tpu: {args.path} has no metric records "
+              "(did the run call bluefog_tpu.metrics.step()?)",
+              file=sys.stderr)
+        return 1
+    rows = summarize(steps, series, summary, match=args.match)
+    if args.json:
+        # strict JSON for machine consumers (jq chokes on bare NaN)
+        clean = [{k: (None if isinstance(v, float) and math.isnan(v) else v)
+                  for k, v in r.items()} for r in rows]
+        print(json.dumps(clean, indent=2, allow_nan=False))
+        return 0
+    n_steps = len(steps)
+    print(f"{args.path}: {n_steps} step record(s), {len(rows)} series"
+          + (" (summary line present)" if summary is not None else ""))
+    print(format_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

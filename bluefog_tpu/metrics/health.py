@@ -1,0 +1,221 @@
+"""Decentralization-specific health gauges.
+
+The paper's trade (arXiv:2111.04287) is wall-clock speed against a
+bounded consensus error; these are the gauges that make both sides of
+the trade observable at runtime:
+
+- **Consensus distance** ``||x_i - x_bar||_2`` — how far ranks have
+  drifted apart.  :func:`consensus_distance` is the in-SPMD (traced)
+  form: a pure-dataflow scalar the host fetches *outside* jit (no
+  callback involved — the jitted-path constraint).
+  :func:`consensus_distance_stacked` is the host/numpy form over the
+  framework's rank-stacked representation.
+- **Mixing contraction** — :class:`MixingTracker` compares the measured
+  per-round contraction ``d_t / d_{t-1}`` against the static
+  spectral-gap prediction ``|lambda_2(W)|`` from
+  :mod:`bluefog_tpu.analysis.topology_check`: a measured rate
+  persistently ABOVE the prediction means gossip is not delivering the
+  contraction the topology was provisioned for (skew, drops, a wedged
+  transport) — the runtime symptom the static verifier cannot see.
+- **Heartbeat age** — seconds since the training loop last beat the
+  :class:`bluefog_tpu.utils.failure.Heartbeat`, exported as a callback
+  gauge (evaluated at snapshot time) so a scrape sees staleness grow
+  *during* a hang, before the watchdog fires.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+import numpy as np
+
+from bluefog_tpu.metrics import registry as _reg
+
+__all__ = [
+    "consensus_distance",
+    "consensus_distance_stacked",
+    "record_consensus",
+    "MixingTracker",
+    "watch_heartbeat",
+    "unwatch_heartbeat",
+]
+
+
+def consensus_distance(x, axis_name: str):
+    """Traced per-rank consensus distance: ``||x_i - x_bar||_2`` over the
+    full tree, where ``x_bar`` is the mean over ``axis_name``.
+
+    Call inside ``shard_map`` and return it from the jitted step (or
+    ``lax.pmean`` it first for the global RMS) — the host records it with
+    :func:`record_consensus` after fetching, keeping the jitted program
+    free of host callbacks for this gauge.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    sq = jnp.float32(0)
+    for leaf in jax.tree_util.tree_leaves(x):
+        if not hasattr(leaf, "dtype"):
+            continue
+        lf = leaf.astype(jnp.float32)
+        mean = lax.pmean(lf, axis_name)
+        sq = sq + jnp.sum((lf - mean) ** 2)
+    return jnp.sqrt(sq)
+
+
+def consensus_distance_stacked(tree) -> float:
+    """Host-side max-over-ranks consensus distance of a rank-stacked tree
+    (every array leaf carries a leading rank axis, the
+    ``bf.rank_stack`` convention)."""
+    sq: Optional[np.ndarray] = None
+    for leaf in _leaves(tree):
+        arr = np.asarray(leaf, dtype=np.float64)
+        if arr.ndim < 1:
+            continue
+        n = arr.shape[0]
+        flat = arr.reshape(n, -1)
+        d = flat - flat.mean(axis=0, keepdims=True)
+        contrib = np.sum(d * d, axis=1)
+        sq = contrib if sq is None else sq + contrib
+    if sq is None:
+        return 0.0
+    return float(np.sqrt(sq).max())
+
+
+def _leaves(tree):
+    import jax
+
+    return [l for l in jax.tree_util.tree_leaves(tree)
+            if hasattr(l, "dtype") or isinstance(l, np.ndarray)]
+
+
+def record_consensus(value: float, **labels) -> float:
+    """Record a consensus-distance sample (gauge holds the latest value;
+    a histogram keeps the distribution for p50/p99).  Returns ``value``
+    so it chains inside expressions; no-op when metrics are off."""
+    reg = _reg.current()
+    v = float(value)
+    if reg is not None:
+        reg.gauge(
+            "bf_consensus_distance",
+            "max over ranks of ||x_i - mean(x)||_2").set(v, **labels)
+        reg.histogram("bf_consensus_distance_hist").observe(v, **labels)
+    return v
+
+
+class MixingTracker:
+    """Measured vs predicted mixing contraction.
+
+    Feed it the consensus distance once per gossip round
+    (:meth:`update`); it records
+
+    - ``bf_mixing_contraction_measured`` — ``d_t / d_{t-1}`` (gauge);
+    - ``bf_mixing_contraction_predicted`` — ``|lambda_2(W)|`` from the
+      schedule's mixing matrix via
+      :func:`bluefog_tpu.analysis.topology_check.spectral_gap` (set
+      once, at construction);
+    - ``bf_mixing_excess`` — measured minus predicted: persistently
+      positive means consensus is contracting slower than the topology's
+      spectral gap promises.
+
+    ``rounds_per_update``: feed cadence, in gossip rounds.  An epoch-level
+    caller (e.g. ``examples/mnist_decentralized.py``, whose jitted epoch
+    scans R gossip rounds) passes R and the prediction becomes
+    ``|lambda_2|^R`` so measured and predicted stay on the same scale —
+    comparing an epoch ratio against a per-round bound would make the
+    ``bf_mixing_excess`` alarm systematically wrong.
+
+    SGD caveat, stated plainly: during *training* the gradient step
+    re-injects disagreement every round, so the measured ratio hovers at
+    the gossip/gradient equilibrium rather than decaying at
+    ``|lambda_2|``; the predicted line is the floor, and the gauge pair
+    is still the right alarm (measured >> predicted + noise = gossip is
+    broken).  Pure averaging runs (``average_consensus.py``) track the
+    prediction tightly.
+    """
+
+    def __init__(self, schedule=None, *, rounds_per_update: int = 1,
+                 **labels):
+        self.labels = {str(k): str(v) for k, v in labels.items()}
+        self.predicted: Optional[float] = None
+        self._prev: Optional[float] = None
+        if rounds_per_update < 1:
+            raise ValueError(
+                f"rounds_per_update must be >= 1, got {rounds_per_update}")
+        if schedule is not None:
+            per_round = self._predict(schedule)
+            if per_round is not None:
+                self.predicted = per_round ** rounds_per_update
+            reg = _reg.current()
+            if reg is not None and self.predicted is not None:
+                reg.gauge(
+                    "bf_mixing_contraction_predicted",
+                    "|lambda_2(W)|^rounds_per_update — static "
+                    "spectral-gap bound at the feed cadence",
+                ).set(self.predicted, **self.labels)
+
+    @staticmethod
+    def _predict(schedule) -> Optional[float]:
+        try:
+            from bluefog_tpu.analysis.topology_check import spectral_gap
+
+            matrix = (schedule.mixing_matrix()
+                      if hasattr(schedule, "mixing_matrix") else schedule)
+            return float(1.0 - spectral_gap(matrix))
+        except Exception:
+            return None
+
+    def update(self, distance: float) -> Optional[float]:
+        """Record one round's consensus distance; returns the measured
+        contraction ratio (None on the first sample or a zero
+        predecessor)."""
+        d = float(distance)
+        record_consensus(d, **self.labels)
+        measured: Optional[float] = None
+        prev, self._prev = self._prev, d
+        if prev is not None and prev > 0 and math.isfinite(prev):
+            measured = d / prev
+            reg = _reg.current()
+            if reg is not None:
+                reg.gauge(
+                    "bf_mixing_contraction_measured",
+                    "per-round consensus-distance ratio d_t / d_{t-1}",
+                ).set(measured, **self.labels)
+                if self.predicted is not None:
+                    # (re-)export the baseline here too: metrics may have
+                    # been enabled AFTER construction, and an excess alarm
+                    # without its predicted companion reads as noise
+                    reg.gauge(
+                        "bf_mixing_contraction_predicted",
+                        "|lambda_2(W)|^rounds_per_update — static "
+                        "spectral-gap bound at the feed cadence",
+                    ).set(self.predicted, **self.labels)
+                    reg.gauge(
+                        "bf_mixing_excess",
+                        "measured minus predicted contraction",
+                    ).set(measured - self.predicted, **self.labels)
+        return measured
+
+
+def watch_heartbeat(heartbeat, name: str = "train") -> None:
+    """Export ``bf_heartbeat_age_seconds{thread=<name>}`` as a callback
+    gauge reading the heartbeat's last-beat monotonic stamp at snapshot
+    time.  No-op when metrics are off; safe to call again after a
+    restart (same label set re-registers the callback)."""
+    reg = _reg.current()
+    if reg is None:
+        return
+    reg.gauge_fn(
+        "bf_heartbeat_age_seconds",
+        lambda: time.monotonic() - heartbeat._last,
+        help="seconds since the training loop last beat the watchdog",
+        thread=name)
+
+
+def unwatch_heartbeat(name: str = "train") -> None:
+    reg = _reg.current()
+    if reg is not None:
+        reg.remove_gauge_fn("bf_heartbeat_age_seconds", thread=name)

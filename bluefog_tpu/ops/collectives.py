@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.metrics import registry as _mreg
 from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
 from bluefog_tpu.utils import timeline as _tl
@@ -358,6 +360,14 @@ def neighbor_allreduce(
                 cid += 1
             outs.append(jnp.concatenate(chunk_outs).reshape(leaf.shape))
         out = jax.tree_util.tree_unflatten(treedef, outs)
+        # per-round wire accounting (identity when metrics are off): each
+        # kernel invocation performs one transfer per schedule slot of its
+        # chunk; bytes = what this rank ships per round
+        out = _mt.record_collective(
+            out, op="neighbor_allreduce",
+            bytes_per_round=_mt.tree_bytes(x) * sched.num_slots,
+            messages_per_round=n_invocations * sched.num_slots,
+            schedule=sched.name, backend="pallas", chunks=n_invocations)
         return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                                 axis_name=axis_name)
 
@@ -383,6 +393,12 @@ def neighbor_allreduce(
         return out.astype(leaf.dtype)
 
     out = jax.tree_util.tree_map(one, x)
+    # one ppermute per slot per leaf; every slot ships the full tree
+    out = _mt.record_collective(
+        out, op="neighbor_allreduce",
+        bytes_per_round=_mt.tree_bytes(x) * sched.num_slots,
+        messages_per_round=_mt.tree_leaf_count(x) * sched.num_slots,
+        schedule=sched.name, backend="xla")
     return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                             axis_name=axis_name)
 
@@ -428,8 +444,33 @@ def neighbor_allreduce_dynamic(
     # B/E pair carries the same information.
     x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
                          axis_name=axis_name)
-    with _tl.suppress_device_stage():
-        out = lax.switch(jnp.asarray(step) % len(scheds), branches, x)
+    # Metrics follow the same hoisting rule as timeline spans: the inner
+    # neighbor_allreduce records are suppressed inside the switch (exactly
+    # one branch runs per step) and ONE outer record carries the taken
+    # branch's cost, selected by the traced phase index — so the counter
+    # reflects the actual schedule of every step without per-branch
+    # callbacks.
+    idx = jnp.asarray(step) % len(scheds)
+    with _tl.suppress_device_stage(), _mt.suppress_comm_metrics():
+        out = lax.switch(idx, branches, x)
+    if _mreg.current() is not None:
+        from bluefog_tpu.ops import pallas_gossip
+
+        payload = _mt.tree_bytes(x)
+        leaves = _mt.tree_leaf_count(x)
+        # label the RESOLVED transport, not the literal 'auto' (which is
+        # never an actual wire) — resolution depends only on environment
+        # + schedule shape, and a dynamic period's schedules resolve
+        # uniformly in practice, so the first phase's answer stands for
+        # the period
+        resolved = pallas_gossip.resolve_backend(backend, scheds[0], x)
+        out = _mt.record_collective(
+            out, op="neighbor_allreduce_dynamic",
+            bytes_per_round=jnp.asarray(
+                [payload * s.num_slots for s in scheds], jnp.float32)[idx],
+            messages_per_round=jnp.asarray(
+                [leaves * s.num_slots for s in scheds], jnp.float32)[idx],
+            schedule=f"dynamic[{len(scheds)}]", backend=resolved)
     return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                             axis_name=axis_name)
 
@@ -508,7 +549,21 @@ def neighbor_allreduce_aperiodic(x, mixing_matrix, axis_name: str,
             out = lax.cond(used, fold, lambda o: o, out)
         return out.astype(leaf.dtype)
 
-    return jax.tree_util.tree_map(one, x)
+    out = jax.tree_util.tree_map(one, x)
+    if _mreg.current() is not None:
+        # data-dependent cost: only ACTIVE rotations run their ppermute —
+        # the traced active count rides the record as an operand, so the
+        # counter reflects each call's actual edge set
+        shifts_all = jnp.arange(1, n)
+        srcs_all = (rows[None, :] - shifts_all[:, None]) % n
+        active = jnp.sum(jnp.any(W[rows[None, :], srcs_all] != 0.0,
+                                 axis=1)).astype(jnp.float32)
+        out = _mt.record_collective(
+            out, op="neighbor_allreduce_aperiodic",
+            bytes_per_round=active * _mt.tree_bytes(x),
+            messages_per_round=active * _mt.tree_leaf_count(x),
+            schedule=f"aperiodic[n={n}]", backend="xla")
+    return out
 
 
 def _aperiodic_capped(x, W, axis_name: str, n: int, i, rows, cap: int):
@@ -563,7 +618,19 @@ def _aperiodic_capped(x, W, axis_name: str, n: int, i, rows, cap: int):
         out = jnp.where(overflow, jnp.full_like(out, jnp.nan), out)
         return out.astype(leaf.dtype)
 
-    return jax.tree_util.tree_map(one, x)
+    out = jax.tree_util.tree_map(one, x)
+    if _mreg.current() is not None:
+        # each active slot hops once per SET BIT of its runtime shift
+        popcount = sum(((sel_shift // p) % 2 for p in pows),
+                       start=jnp.zeros_like(sel_shift))
+        hops = jnp.sum(jnp.where(sel_active, popcount, 0)).astype(
+            jnp.float32)
+        out = _mt.record_collective(
+            out, op="neighbor_allreduce_aperiodic",
+            bytes_per_round=hops * _mt.tree_bytes(x),
+            messages_per_round=hops * _mt.tree_leaf_count(x),
+            schedule=f"aperiodic[n={n},cap={cap}]", backend="xla")
+    return out
 
 
 def neighbor_allgather(x, schedule, axis_name: str):
@@ -600,7 +667,10 @@ def allreduce(x, axis_name: str, *, average: bool = True):
             s = (s.astype(_acc_dtype(leaf)) / n).astype(leaf.dtype)
         return s
 
-    return jax.tree_util.tree_map(one, x)
+    out = jax.tree_util.tree_map(one, x)
+    return _mt.record_collective(
+        out, op="allreduce", bytes_per_round=_mt.tree_bytes(x),
+        messages_per_round=_mt.tree_leaf_count(x), backend="xla")
 
 
 def allgather(x, axis_name: str, *, axis: int = 0, tiled: bool = False):
@@ -717,6 +787,13 @@ def hierarchical_neighbor_allreduce(
         return out.astype(leaf.dtype)
 
     out = jax.tree_util.tree_map(one, x)
+    # accounted: the machine-hop ppermutes (every local lane ships the
+    # local average per machine slot); the intra-machine psum is ICI-local
+    out = _mt.record_collective(
+        out, op="hierarchical_neighbor_allreduce",
+        bytes_per_round=_mt.tree_bytes(x) * len(rank_perms),
+        messages_per_round=_mt.tree_leaf_count(x) * len(rank_perms),
+        schedule=msched.name, backend="xla")
     return _tl.device_stage(out, "bf.hierarchical_neighbor_allreduce",
                             phase="E", axis_name=axis_name)
 
@@ -769,5 +846,10 @@ def hierarchical_neighbor_allreduce_2d(
         return out.astype(leaf.dtype)
 
     out = jax.tree_util.tree_map(one, x)
+    out = _mt.record_collective(
+        out, op="hierarchical_neighbor_allreduce_2d",
+        bytes_per_round=_mt.tree_bytes(x) * len(msched.perms),
+        messages_per_round=_mt.tree_leaf_count(x) * len(msched.perms),
+        schedule=msched.name, backend="xla")
     return _tl.device_stage(out, "bf.hierarchical_neighbor_allreduce_2d",
                             phase="E", axis_name=(machine_axis, local_axis))

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.ops.collectives import _acc_dtype, _rank_weights
 from bluefog_tpu.topology.schedule import GossipSchedule
 
@@ -207,8 +208,25 @@ def choco_gossip(x, state: ChocoState, schedule: GossipSchedule,
         new_nbrs.append(jnp.stack(hn2))
 
     unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
-    return unf(new_x), ChocoState(unf(new_self), unf(new_nbrs),
-                                  state.round + 1)
+    x_out = unf(new_x)
+    # wire accounting: the payload per slot is each leaf's COMPRESSED
+    # innovation (wire_ratio x dense bytes — static per trace); the
+    # achieved compression ratio is exported as a gauge so the operator
+    # sees what fraction of the dense volume actually hits the wire
+    dense = sum(l.size * l.dtype.itemsize for l in leaves)
+    wire = sum(compressor.wire_ratio(l) * l.size * l.dtype.itemsize
+               for l in leaves)
+    if dense:
+        _mt.set("bf_compression_ratio", wire / dense,
+                compressor=compressor.name)
+    x_out = _mt.record_collective(
+        x_out, op="choco_gossip",
+        bytes_per_round=wire * len(schedule.perms),
+        messages_per_round=len(leaves) * len(schedule.perms),
+        schedule=schedule.name, backend="xla",
+        extra={"compressor": compressor.name})
+    return x_out, ChocoState(unf(new_self), unf(new_nbrs),
+                             state.round + 1)
 
 
 def hierarchical_choco_gossip(x, state: ChocoState, machine_schedule,

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from flax import struct
 from jax import lax
 
+from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
 from bluefog_tpu.utils import timeline as _tl
@@ -224,6 +225,14 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
         new_peers = jax.tree_util.tree_unflatten(treedef, outs)
     else:
         new_peers = jax.tree_util.tree_map(per_leaf, state.peer_bufs, payload)
+    # wire accounting for the window family: every slot ships the full
+    # payload tree (identity when metrics are off)
+    new_peers = _mt.record_collective(
+        new_peers, op=op_name.replace("bf.", ""),
+        bytes_per_round=_mt.tree_bytes(payload) * sched.num_slots,
+        messages_per_round=_mt.tree_leaf_count(payload) * sched.num_slots,
+        schedule=sched.name, backend=backend,
+        extra={"window": state.spec.name})
     new_peers = _tl.device_stage(new_peers, op_name, phase="E",
                                  category="window", axis_name=axis_name)
     return state.replace(peer_bufs=new_peers, assoc_peers=new_assoc)
@@ -353,6 +362,10 @@ def win_update(
         return out.astype(self_leaf.dtype)
 
     out = jax.tree_util.tree_map(one, state.self_buf, state.peer_bufs)
+    # no wire transfer — count the merge rounds so deposit volume can be
+    # read per consume (bytes/update = deposit bytes / update rounds)
+    out = _mt.count(out, [("bf_window_update_rounds_total", 1.0)],
+                    {"op": "win_update", "window": state.spec.name})
     out = _tl.device_stage(out, "bf.win_update", phase="E",
                            category="window", axis_name=axis_name)
     new_state = state.replace(self_buf=out)

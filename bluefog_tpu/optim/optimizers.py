@@ -36,6 +36,8 @@ import numpy as np
 import optax
 from jax import lax
 
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.metrics import registry as _mreg
 from bluefog_tpu.ops import collectives as C
 from bluefog_tpu.ops import windows as W
 from bluefog_tpu.topology.graphs import Topology
@@ -220,10 +222,12 @@ def decentralized_optimizer(
         if k <= 1 or ct in (CommunicationType.allreduce, CommunicationType.empty):
             new_params = comm_step(params)
             new_comm_count = state.comm_count + 1
+            comm_inc = 1.0
         else:
             do_comm = (state.count + 1) % k == 0
             new_params = lax.cond(do_comm, comm_step, local_step, params)
             new_comm_count = state.comm_count + do_comm.astype(jnp.int32)
+            comm_inc = do_comm.astype(jnp.float32)
         new_count = state.count + 1
 
         # express as optax updates so callers use apply_updates as usual
@@ -231,6 +235,15 @@ def decentralized_optimizer(
             lambda np_, p: (np_.astype(jnp.float32) - p.astype(jnp.float32)).astype(p.dtype),
             new_params, params,
         )
+        if _mreg.current() is not None:
+            # per-execution step / communication-round counters (comm_inc
+            # is the traced local-SGD gate, so skipped rounds don't count);
+            # trace-time gated — zero HLO when metrics are off
+            new_updates = _mt.count(
+                new_updates,
+                [("bf_optimizer_steps_total", 1.0),
+                 ("bf_optimizer_comm_rounds_total", comm_inc)],
+                {"opt": ct.value, "atc": str(bool(atc)).lower()})
         return new_updates, _DecentralizedState(base_state, new_count, new_comm_count)
 
     return optax.GradientTransformation(init_fn, update_fn)

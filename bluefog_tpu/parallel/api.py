@@ -68,7 +68,29 @@ def shard_map(*args, **kwargs):
                 kwargs[old] = val
     return _shard_map_impl(*args, **kwargs)
 
+
+def abstract_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str]):
+    """``jax.sharding.AbstractMesh`` with version-portable construction
+    (same compat pattern as :func:`shard_map` above).
+
+    Newer jax takes ``AbstractMesh(axis_sizes, axis_names)``; older
+    releases take a single ``shape_tuple`` of ``(name, size)`` pairs.
+    Device-free lowering (program-size censuses, pod-scale compile
+    checks) should come through here so a jax upgrade changes one line.
+    """
+    from jax.sharding import AbstractMesh
+
+    sizes = tuple(int(s) for s in axis_sizes)
+    names = tuple(axis_names)
+    if len(sizes) != len(names):
+        raise ValueError(f"{len(sizes)} axis sizes vs {len(names)} names")
+    try:
+        return AbstractMesh(sizes, names)
+    except TypeError:
+        return AbstractMesh(tuple(zip(names, sizes)))
+
 __all__ = [
+    "abstract_mesh",
     "allreduce",
     "allgather",
     "broadcast",

@@ -52,6 +52,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bluefog_tpu.metrics import comm as _mt
+
 __all__ = [
     "stack_stage_params",
     "pipeline_apply",
@@ -188,6 +190,11 @@ def pipeline_train_step_1f1b(
     """
     S = num_stages
     M = microbatches.shape[0]
+    # the schedule's idle fraction is a static property of (S, M): export
+    # it at trace time so capacity planning sees how much of the pipeline
+    # budget microbatching actually recovers (no-op when metrics are off)
+    _mt.set("bf_pipeline_bubble_fraction", (S - 1) / (M + S - 1),
+            schedule="1f1b", stages=S, micro=M)
     K = min(S, M)  # stash depth: stage s holds <= S - s in-flight micros
     stage = lax.axis_index(pp_axis)
     is_last = stage == S - 1
@@ -315,6 +322,9 @@ def pipeline_train_step_gpipe(
     recompute-in-backward like 1F1B, but still an all-``M`` stash of
     STAGE INPUTS in the scan's saved residuals)."""
     S = num_stages
+    M = microbatches.shape[0]
+    _mt.set("bf_pipeline_bubble_fraction", (S - 1) / (M + S - 1),
+            schedule="gpipe", stages=S, micro=M)
     if head_params is None:
         head_params = {}
     sfn = jax.checkpoint(stage_fn) if remat else stage_fn

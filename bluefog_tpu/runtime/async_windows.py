@@ -57,6 +57,7 @@ import contextlib
 
 import numpy as np
 
+from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import native
 from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.utils import timeline as _timeline
@@ -309,6 +310,14 @@ class AsyncWindow:
                     1 if accumulate else 0)
         if v < 0:
             raise RuntimeError(f"deposit into {self.name!r}[{slot}] failed")
+        # host-path metrics (guarded no-ops when disabled): per-window
+        # deposit volume and count — this is the "bytes gossiped" of the
+        # asynchronous execution model
+        _mt.inc("bf_window_deposit_bytes_total",
+                a.size * a.dtype.itemsize, window=self.name,
+                transport="shm" if self.shm else "local")
+        _mt.inc("bf_window_deposits_total", 1.0, window=self.name,
+                op=op)
         return int(v)
 
     def read(self, slot: int, *, consume: bool = True
@@ -320,15 +329,21 @@ class AsyncWindow:
             if self._lib is None:
                 out, fresh = _fallback().read(self.name, slot, consume)
                 if out is None:
-                    raise RuntimeError(
-                        f"read of {self.name!r}[{slot}] failed")
-                return out, int(fresh)
-            out = np.empty(self.n_elems, self.dtype)
-            fresh = self._lib.bf_win_read(
-                self.name.encode(), slot, out.ctypes.data, self.n_elems,
-                1 if consume else 0)
+                    fresh = -1
+            else:
+                out = np.empty(self.n_elems, self.dtype)
+                fresh = self._lib.bf_win_read(
+                    self.name.encode(), slot, out.ctypes.data, self.n_elems,
+                    1 if consume else 0)
         if fresh < 0:
             raise RuntimeError(f"read of {self.name!r}[{slot}] failed")
+        # deposit staleness: fresh-count distribution per consume, plus an
+        # explicit stale-read counter (0 fresh deposits = the content was
+        # already consumed — the rank is outrunning its in-neighbors)
+        _mt.observe("bf_window_fresh_per_read", float(fresh),
+                    window=self.name)
+        if consume and fresh == 0:
+            _mt.inc("bf_window_stale_reads_total", 1.0, window=self.name)
         return out, int(fresh)
 
     def set_self(self, arr: np.ndarray) -> None:

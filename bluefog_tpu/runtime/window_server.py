@@ -53,6 +53,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import native
 from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS
 
@@ -172,6 +173,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     rc = lib.bf_win_deposit(name, slot, arr.ctypes.data,
                                             n_elems, flags & 1)
                     sock.sendall(_STATUS.pack(rc))
+                    if rc >= 0:
+                        # per-peer DCN deposit volume, recorded on the
+                        # daemon thread (the registry is thread-safe);
+                        # no-op when metrics are disabled
+                        _mt.inc("bf_tcp_deposit_bytes_total", nbytes,
+                                window=name.decode("utf-8", "replace"),
+                                peer=self.client_address[0])
+                        _mt.inc("bf_tcp_deposits_total", 1.0,
+                                peer=self.client_address[0])
                     continue
                 if err:
                     sock.sendall(_STATUS.pack(err))

@@ -101,6 +101,15 @@ class CheckpointManager:
         host_state = jax.tree_util.tree_map(np.asarray, jax.device_get(state))
         if mode == "consensus":
             host_state = _consensus(host_state)
+        # Orbax version guard: newer StandardCheckpointHandler's
+        # _supported_types is (int, float, np.ndarray, jax.Array) — numpy
+        # SCALARS (np.generic, e.g. the np.int32 that indexing a stacked
+        # int leaf yields in consensus mode) raise ValueError at save.
+        # 0-d ndarrays are accepted by every version and restore with the
+        # same dtype, so normalize scalars up front.
+        host_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            host_state)
 
         def do_save():
             with timeline_context(f"checkpoint.save/{step}", "io"):

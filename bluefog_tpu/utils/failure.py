@@ -138,6 +138,15 @@ class Heartbeat:
         self._monitor = threading.Thread(
             target=self._run, name="bf-heartbeat", daemon=True)
         self._monitor.start()
+        # export heartbeat age as a callback gauge (evaluated at metrics
+        # snapshot time, so a scrape watches staleness GROW during a hang
+        # before the watchdog fires); no-op when metrics are disabled
+        try:
+            from bluefog_tpu.metrics import health as _health
+
+            _health.watch_heartbeat(self, name=self._target.name)
+        except Exception:
+            pass
         return self
 
     def stop(self) -> None:
@@ -145,6 +154,12 @@ class Heartbeat:
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
             self._monitor = None
+        try:
+            from bluefog_tpu.metrics import health as _health
+
+            _health.unwatch_heartbeat(name=self._target.name)
+        except Exception:
+            pass
 
     def __enter__(self):
         return self.start()

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 import bluefog_tpu as bf
+from bluefog_tpu.metrics import health as bf_health
 from bluefog_tpu.models import LeNet5
 from bluefog_tpu.optim import DistributedNeighborAllreduceOptimizer
 from bluefog_tpu.parallel.api import shard_map
@@ -119,8 +120,21 @@ def main():
         check_vma=False,
     ))
 
+    # observability (active only under BLUEFOG_TPU_METRICS=<file.jsonl> or
+    # bf.metrics_start()): the instrumented collectives count gossip bytes
+    # from inside the jitted epoch; the health gauges below add consensus
+    # distance and measured-vs-predicted mixing contraction per epoch
+    # fed once per EPOCH while each jitted epoch runs steps_per_epoch
+    # gossip rounds — rounds_per_update scales the spectral-gap
+    # prediction to the same cadence (|lambda_2|^R)
+    mixing = bf_health.MixingTracker(ctx.schedule,
+                                     rounds_per_update=steps_per_epoch)
     for epoch in range(args.epochs):
         params, opt_state, losses, accs = train_epoch(params, opt_state, imgs, labels)
+        if bf.metrics_active():
+            mixing.update(bf_health.consensus_distance_stacked(
+                jax.device_get(params)))
+            bf.metrics.step(epoch)
         print(f"epoch {epoch}: mean loss {np.asarray(losses).mean():.4f}  "
               f"mean local acc {np.asarray(accs).mean():.3f}")
 

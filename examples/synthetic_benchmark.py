@@ -261,6 +261,12 @@ def main():
         dt = time.perf_counter() - t0
         rate = args.inner * args.batch_size * n / dt / n  # per chip
         rates.append(rate)
+        if bf.metrics_active():
+            # one JSONL snapshot per timed iteration: gossip byte counters
+            # (from the instrumented collectives) plus throughput
+            bf.metrics.comm.set("bf_bench_examples_per_sec_per_chip", rate,
+                                model=args.model, comm=args.comm)
+            bf.metrics.step(it)
         print(f"iter {it:3d}: {rate:,.1f} ex/s/chip")
 
     unit = "img" if args.model in ("lenet", "resnet18", "resnet50") else "seq"

@@ -1,0 +1,508 @@
+"""Metrics & health observability subsystem tests.
+
+Covers the acceptance surface of the subsystem:
+
+1. registry semantics (labelled counters/gauges/histograms, kind
+   conflicts, callback gauges, thread safety);
+2. comm-hook byte accounting with CLOSED-FORM expected bytes for a known
+   topology (ring: every rank ships its shard once per slot);
+3. consensus-distance / mixing-rate health gauges on a toy mesh;
+4. JSONL round-trip through the dash CLI (subprocess, the operator
+   path);
+5. the zero-overhead contract: with metrics disabled the hooks are the
+   IDENTITY (same object back) and instrumented jitted programs contain
+   no host callbacks; with metrics enabled the callbacks are unordered
+   (the analysis lint's BF-COMM012 regression guard for the PR-1 XLA
+   abort class fires on ordered ones).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu.metrics import comm as mcomm
+from bluefog_tpu.metrics import export as mexp
+from bluefog_tpu.metrics import health as mhealth
+from bluefog_tpu.metrics import registry as mreg
+from bluefog_tpu.parallel.api import shard_map
+from bluefog_tpu.topology import FullyConnectedGraph, RingGraph, build_schedule
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _metrics_clean():
+    """Every test starts and ends with metrics OFF (no env leak, no
+    registry leak into later tests' trace-time gates).  The sticky-stop
+    flag is reset so each test sees the subsystem's pristine state —
+    stop-stickiness is itself under test below."""
+    os.environ.pop("BLUEFOG_TPU_METRICS", None)
+    mreg.metrics_stop()
+    mreg._STOPPED = False
+    yield
+    os.environ.pop("BLUEFOG_TPU_METRICS", None)
+    mreg.metrics_stop()
+    mreg._STOPPED = False
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("bf",))
+
+
+def _smap(fn, n_in=1):
+    return shard_map(fn, mesh=_mesh(), in_specs=(P("bf"),) * n_in,
+                     out_specs=P("bf"), check_vma=False)
+
+
+# ---------------------------------------------------------------------------
+# 1. registry semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_accumulates_per_label_set(self):
+        reg = mreg.MetricsRegistry()
+        c = reg.counter("bytes_total")
+        c.inc(10, op="a")
+        c.inc(5, op="a")
+        c.inc(1, op="b")
+        c.inc(2)  # empty label set is its own series
+        snap = reg.snapshot()
+        assert snap['bytes_total{op="a"}'] == 15
+        assert snap['bytes_total{op="b"}'] == 1
+        assert snap["bytes_total"] == 2
+
+    def test_label_order_is_irrelevant(self):
+        reg = mreg.MetricsRegistry()
+        reg.counter("c").inc(1, a="1", b="2")
+        reg.counter("c").inc(1, b="2", a="1")
+        (value,) = [v for k, v in reg.snapshot().items() if k.startswith("c")]
+        assert value == 2
+
+    def test_counter_rejects_decrease(self):
+        reg = mreg.MetricsRegistry()
+        with pytest.raises(ValueError, match="cannot decrease"):
+            reg.counter("c").inc(-1)
+
+    def test_kind_conflict_raises(self):
+        reg = mreg.MetricsRegistry()
+        reg.counter("m")
+        with pytest.raises(TypeError, match="already registered as counter"):
+            reg.gauge("m")
+
+    def test_gauge_holds_last_value(self):
+        reg = mreg.MetricsRegistry()
+        reg.gauge("g").set(1.0)
+        reg.gauge("g").set(4.5)
+        assert reg.snapshot()["g"] == 4.5
+
+    def test_histogram_aggregates_and_quantiles(self):
+        reg = mreg.MetricsRegistry()
+        h = reg.histogram("h")
+        for v in range(1, 101):
+            h.observe(float(v))
+        snap = reg.snapshot()
+        assert snap["h_count"] == 100
+        assert snap["h_sum"] == 5050
+        assert snap["h_min"] == 1 and snap["h_max"] == 100
+        assert snap["h_p50"] == 50
+        assert snap["h_p99"] == 99
+
+    def test_gauge_fn_evaluated_at_snapshot(self):
+        reg = mreg.MetricsRegistry()
+        box = {"v": 1.0}
+        reg.gauge_fn("age", lambda: box["v"])
+        assert reg.snapshot()["age"] == 1.0
+        box["v"] = 7.0
+        assert reg.snapshot()["age"] == 7.0
+        reg.gauge_fn("boom", lambda: 1 / 0)
+        assert np.isnan(reg.snapshot()["boom"])  # raising fn -> NaN
+
+    def test_thread_safety_exact_total(self):
+        reg = mreg.MetricsRegistry()
+        c = reg.counter("c")
+
+        def worker():
+            for _ in range(1000):
+                c.inc(1, t="x")
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.snapshot()['c{t="x"}'] == 8000
+
+    def test_off_by_default(self):
+        assert mreg.current() is None
+        assert not mreg.metrics_active()
+
+    def test_env_var_lazily_activates(self, tmp_path):
+        os.environ["BLUEFOG_TPU_METRICS"] = str(tmp_path / "m.jsonl")
+        assert mreg.current() is not None
+
+    def test_stop_is_sticky_under_env_var(self, tmp_path):
+        """metrics_stop() must stick even with BLUEFOG_TPU_METRICS set:
+        a later instrumented call lazily resurrecting the subsystem
+        would re-attach the writer over the finalized JSONL."""
+        path = tmp_path / "m.jsonl"
+        os.environ["BLUEFOG_TPU_METRICS"] = str(path)
+        reg = mreg.current()
+        reg.counter("c").inc(1)
+        mexp.step(0)
+        mreg.metrics_stop()
+        size_after_stop = path.stat().st_size
+        assert size_after_stop > 0  # step line + summary line survive
+        mcomm.inc("c", 1)  # instrumented host path must NOT resurrect
+        assert mreg.current() is None
+        assert path.stat().st_size == size_after_stop
+        # explicit restart in the same process APPENDS (no truncation)
+        mreg.metrics_start(str(path))
+        mexp.step(1)
+        assert path.stat().st_size > size_after_stop
+
+    def test_remove_gauge_fn_drops_stale_value(self):
+        reg = mreg.metrics_start()
+        reg.gauge_fn("age", lambda: 3.0)
+        assert reg.snapshot()["age"] == 3.0
+        reg.remove_gauge_fn("age")
+        assert "age" not in reg.snapshot()  # no frozen last reading
+
+
+# ---------------------------------------------------------------------------
+# 2. comm-hook byte accounting (closed form for a known topology)
+# ---------------------------------------------------------------------------
+
+
+class TestCommAccounting:
+    def test_neighbor_allreduce_ring_closed_form(self):
+        """Ring, one f32 leaf of 16 elements per rank: every rank ships
+        its 64-byte shard once per schedule slot per round, and the
+        callback fires once per rank — so after R rounds the counter
+        must read exactly N * slots * 64 * R."""
+        from bluefog_tpu.ops.collectives import neighbor_allreduce
+
+        sched = build_schedule(RingGraph(N))
+        reg = mreg.metrics_start()
+        fn = jax.jit(_smap(lambda v: neighbor_allreduce(v, sched, "bf")))
+        x = jnp.ones((N, 16), jnp.float32)
+        fn(x)
+        jax.effects_barrier()
+        per_rank = 16 * 4  # bytes of one rank's shard
+        key = (f'bf_comm_bytes_total{{backend="xla",'
+               f'op="neighbor_allreduce",schedule="{sched.name}"}}')
+        snap = reg.snapshot()
+        assert snap[key] == N * sched.num_slots * per_rank
+        rkey = key.replace("bf_comm_bytes_total", "bf_comm_rounds_total")
+        mkey = key.replace("bf_comm_bytes_total", "bf_comm_messages_total")
+        assert snap[rkey] == N
+        assert snap[mkey] == N * sched.num_slots  # one leaf
+        fn(x)  # second round doubles everything
+        jax.effects_barrier()
+        assert reg.snapshot()[key] == 2 * N * sched.num_slots * per_rank
+
+    def test_dynamic_records_taken_branch_cost(self):
+        """The dynamic switch records ONE round per step with the taken
+        branch's cost selected by the traced phase index: ring (2 slots)
+        and fully-connected (7 slots) phases must account differently."""
+        from bluefog_tpu.ops.collectives import neighbor_allreduce_dynamic
+
+        scheds = [build_schedule(RingGraph(N)),
+                  build_schedule(FullyConnectedGraph(N))]
+        reg = mreg.metrics_start()
+
+        def run(step):
+            jax.jit(_smap(
+                lambda v: neighbor_allreduce_dynamic(
+                    v, scheds, step, "bf")))(jnp.ones((N, 4), jnp.float32))
+            jax.effects_barrier()
+
+        # backend label carries the RESOLVED transport (xla on this CPU
+        # mesh), never the literal 'auto'
+        key = ('bf_comm_bytes_total{backend="xla",'
+               'op="neighbor_allreduce_dynamic",schedule="dynamic[2]"}')
+        run(0)
+        after_ring = reg.snapshot()[key]
+        assert after_ring == N * scheds[0].num_slots * 16
+        run(1)
+        assert (reg.snapshot()[key] - after_ring
+                == N * scheds[1].num_slots * 16)
+
+    def test_window_deliver_accounts_bytes(self):
+        from bluefog_tpu.ops import windows as W
+
+        sched = build_schedule(RingGraph(N))
+        reg = mreg.metrics_start()
+
+        def body(xs):
+            st = W.win_create(xs, sched, "bf", name="mwin")
+            st = W.win_put(st, xs, "bf")
+            out, _ = W.win_update(st, "bf")
+            return out
+
+        jax.jit(_smap(body))(jnp.ones((N, 8), jnp.float32))
+        jax.effects_barrier()
+        snap = reg.snapshot()
+        (bkey,) = [k for k in snap if k.startswith("bf_comm_bytes_total")
+                   and 'op="win_put"' in k]
+        assert snap[bkey] == N * sched.num_slots * 8 * 4
+        (ukey,) = [k for k in snap
+                   if k.startswith("bf_window_update_rounds_total")]
+        assert snap[ukey] == N
+
+    def test_choco_records_compression_ratio(self):
+        from bluefog_tpu.ops import compression as CP
+
+        sched = build_schedule(RingGraph(N))
+        comp = CP.random_block_k(0.25)
+        reg = mreg.metrics_start()
+
+        def body(xs):
+            st = CP.choco_init(xs, sched)
+            out, _ = CP.choco_gossip(xs, st, sched, "bf", compressor=comp)
+            return out
+
+        jax.jit(_smap(body))(jnp.ones((N, 64), jnp.float32))
+        jax.effects_barrier()
+        snap = reg.snapshot()
+        assert snap['bf_compression_ratio{compressor="random_block_k"}'] \
+            == pytest.approx(0.25)
+        (bkey,) = [k for k in snap if k.startswith("bf_comm_bytes_total")]
+        # wire = 25% of the dense 64*4 bytes, per slot, per rank
+        assert snap[bkey] == pytest.approx(N * sched.num_slots * 0.25 * 256)
+
+    def test_async_window_staleness_metrics(self):
+        from bluefog_tpu.runtime.async_windows import AsyncWindow
+
+        reg = mreg.metrics_start()
+        win = AsyncWindow("metrics_test_win", 2, 4, np.float64)
+        try:
+            win.deposit(0, np.ones(4))
+            win.read(0, consume=True)   # 1 fresh
+            win.read(0, consume=True)   # stale
+            snap = reg.snapshot()
+            assert snap['bf_window_deposit_bytes_total{transport="local",'
+                        'window="metrics_test_win"}'] == 32
+            assert snap['bf_window_stale_reads_total'
+                        '{window="metrics_test_win"}'] == 1
+            assert snap['bf_window_fresh_per_read_count'
+                        '{window="metrics_test_win"}'] == 2
+        finally:
+            win.free()
+
+
+# ---------------------------------------------------------------------------
+# 3. health gauges on a toy mesh
+# ---------------------------------------------------------------------------
+
+
+class TestHealth:
+    def test_consensus_distance_traced_matches_oracle(self):
+        fn = jax.jit(_smap(
+            lambda v: mhealth.consensus_distance(v, "bf")[None]))
+        xs = jnp.arange(N, dtype=jnp.float32)[:, None] * jnp.ones((N, 2))
+        got = np.asarray(fn(xs))
+        want = np.abs(np.arange(N) - 3.5) * np.sqrt(2)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_consensus_distance_stacked_matches_traced(self):
+        xs = np.random.default_rng(0).standard_normal((N, 5)).astype(
+            np.float32)
+        host = mhealth.consensus_distance_stacked({"w": xs})
+        fn = jax.jit(_smap(
+            lambda v: mhealth.consensus_distance(v, "bf")[None]))
+        dev = np.asarray(fn(jnp.asarray(xs))).max()
+        assert host == pytest.approx(float(dev), rel=1e-5)
+
+    def test_mixing_tracker_measured_vs_predicted(self):
+        from bluefog_tpu.analysis.topology_check import spectral_gap
+
+        sched = build_schedule(RingGraph(N))
+        reg = mreg.metrics_start()
+        tracker = mhealth.MixingTracker(sched)
+        lam2 = 1.0 - spectral_gap(sched.mixing_matrix())
+        assert tracker.predicted == pytest.approx(lam2)
+        assert tracker.update(10.0) is None  # first sample: no ratio yet
+        assert tracker.update(6.0) == pytest.approx(0.6)
+        snap = reg.snapshot()
+        assert snap["bf_mixing_contraction_measured"] == pytest.approx(0.6)
+        assert snap["bf_mixing_contraction_predicted"] == pytest.approx(lam2)
+        assert snap["bf_mixing_excess"] == pytest.approx(0.6 - lam2)
+        assert snap["bf_consensus_distance"] == 6.0
+
+    def test_mixing_tracker_scales_prediction_to_feed_cadence(self):
+        """An epoch-level feeder passes rounds_per_update=R and the
+        prediction becomes |lambda_2|^R — same scale as the measured
+        epoch ratio."""
+        from bluefog_tpu.analysis.topology_check import spectral_gap
+
+        sched = build_schedule(RingGraph(N))
+        lam2 = 1.0 - spectral_gap(sched.mixing_matrix())
+        t = mhealth.MixingTracker(sched, rounds_per_update=5)
+        assert t.predicted == pytest.approx(lam2 ** 5)
+        with pytest.raises(ValueError, match="rounds_per_update"):
+            mhealth.MixingTracker(sched, rounds_per_update=0)
+
+    def test_heartbeat_age_gauge(self):
+        from bluefog_tpu.utils.failure import Heartbeat
+
+        reg = mreg.metrics_start()
+        hb = Heartbeat(timeout_s=60, action="callback")
+        with hb:
+            hb.beat(0)
+            (key,) = [k for k in reg.snapshot()
+                      if k.startswith("bf_heartbeat_age_seconds")]
+            age = reg.snapshot()[key]
+            assert 0.0 <= age < 60.0
+
+
+# ---------------------------------------------------------------------------
+# 4. JSONL round-trip through the dash CLI
+# ---------------------------------------------------------------------------
+
+
+class TestExportAndDash:
+    def test_jsonl_round_trip_through_dash_cli(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        reg = mreg.metrics_start(path)
+        for s in range(4):
+            reg.counter("bf_comm_bytes_total").inc(256, op="na")
+            reg.gauge("bf_consensus_distance").set(8.0 / (s + 1))
+            mexp.step(s)
+        mexp.detach_writer()  # flush + summary line
+
+        with open(path) as f:
+            lines = [json.loads(l) for l in f if l.strip()]
+        assert len(lines) == 5 and lines[-1].get("summary") is True
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.metrics.dash", path],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        assert 'bf_comm_bytes_total{op="na"}' in proc.stdout
+        assert "1024" in proc.stdout  # cumulative total
+        assert "256" in proc.stdout   # per-step delta
+        assert "bf_consensus_distance" in proc.stdout
+
+    def test_dash_counter_deltas_and_percentiles(self):
+        from bluefog_tpu.metrics.dash import summarize
+
+        series = {"x_total": [100.0, 300.0, 600.0]}
+        (row,) = summarize([0, 1, 2], series)
+        assert row["type"] == "counter"
+        assert row["total"] == 600
+        assert row["per_step_mean"] == pytest.approx(200.0)
+        assert row["p50"] == 200 and row["p99"] == 300
+
+    def test_dash_rejects_empty_file(self, tmp_path):
+        from bluefog_tpu.metrics.dash import main
+
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        assert main([str(p)]) == 1
+
+    def test_prometheus_text_format(self):
+        reg = mreg.metrics_start()
+        reg.counter("bf_comm_bytes_total", "bytes shipped").inc(64, op="x")
+        reg.gauge("bf_consensus_distance").set(1.5)
+        text = mexp.prometheus_text(reg)
+        assert "# TYPE bf_comm_bytes_total counter" in text
+        assert 'bf_comm_bytes_total{op="x"} 64.0' in text
+        assert "# TYPE bf_consensus_distance gauge" in text
+        assert "# HELP bf_comm_bytes_total bytes shipped" in text
+
+    def test_step_is_noop_when_disabled(self, tmp_path):
+        assert mexp.step(0) is None
+
+
+# ---------------------------------------------------------------------------
+# 5. zero overhead when disabled + no-ordered-callback guard
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledOverheadAndLint:
+    def test_hooks_are_identity_when_disabled(self):
+        x = jnp.ones((4,))
+        assert mcomm.record_collective(
+            x, op="o", bytes_per_round=1, messages_per_round=1) is x
+        assert mcomm.count(x, [("c", 1.0)]) is x
+
+    def test_disabled_jaxpr_has_no_callbacks(self):
+        """The acceptance gate: instrumented collective + optimizer paths
+        traced with metrics OFF must contain zero host callbacks."""
+        import optax
+
+        from bluefog_tpu.optim import DistributedNeighborAllreduceOptimizer
+
+        opt = DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), topology=RingGraph(N), axis_name="bf")
+
+        def body(xs):
+            st = opt.init(xs)
+            upd, _ = opt.update(xs, st, xs)
+            return optax.apply_updates(xs, upd)
+
+        text = str(jax.make_jaxpr(_smap(body))(jnp.ones((N, 4))))
+        assert "callback" not in text
+
+    def test_enabled_jaxpr_uses_only_unordered_callbacks(self):
+        from bluefog_tpu.analysis.jaxpr_lint import lint_jaxpr
+        from bluefog_tpu.ops.collectives import neighbor_allreduce
+
+        sched = build_schedule(RingGraph(N))
+        mreg.metrics_start()
+        closed = jax.make_jaxpr(_smap(
+            lambda v: neighbor_allreduce(v, sched, "bf")))(jnp.ones((N, 4)))
+        text = str(closed)
+        assert "io_callback" in text  # instrumentation is present...
+        diags = lint_jaxpr(closed, name="instrumented_gossip")
+        codes = [d.code for d in diags]
+        assert "BF-COMM012" not in codes      # ...and is NOT ordered
+        assert "BF-COMM010" in codes          # plain callback warning only
+        assert not any(d.severity == "error" for d in diags)
+
+    def test_lint_flags_ordered_io_callback_as_error(self):
+        """Seeded violation for the PR-1 abort class: an ordered
+        io_callback on a jitted path must be an ERROR (BF-COMM012), not
+        the generic callback warning."""
+        from jax.experimental import io_callback
+
+        from bluefog_tpu.analysis.jaxpr_lint import lint_jaxpr
+
+        def bad(x):
+            z = io_callback(lambda v: np.float32(0.0),
+                            jax.ShapeDtypeStruct((), jnp.float32), x,
+                            ordered=True)
+            return x + z
+
+        closed = jax.make_jaxpr(bad)(jnp.float32(1.0))
+        diags = lint_jaxpr(closed, name="seeded_ordered_callback")
+        bad_diags = [d for d in diags if d.code == "BF-COMM012"]
+        assert bad_diags and bad_diags[0].severity == "error"
+        assert "ordered" in bad_diags[0].message
+
+    def test_instrumented_program_differentiable(self):
+        """The custom_jvp shell: metrics-instrumented collectives must
+        still trace under jax.grad."""
+        from bluefog_tpu.ops.collectives import neighbor_allreduce
+
+        sched = build_schedule(RingGraph(N))
+        mreg.metrics_start()
+
+        def body(xs):
+            loss = jnp.sum(neighbor_allreduce(xs, sched, "bf") ** 2)
+            return jax.grad(lambda v: jnp.sum(
+                neighbor_allreduce(v, sched, "bf") ** 2))(xs) * 0 + loss[None]
+
+        out = jax.jit(_smap(body))(jnp.ones((N, 4)))
+        jax.effects_barrier()
+        assert np.isfinite(np.asarray(out)).all()
