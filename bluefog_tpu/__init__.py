@@ -95,5 +95,6 @@ from bluefog_tpu.utils import (
 from bluefog_tpu.utils.checkpoint import CheckpointManager, run_with_restart
 from bluefog_tpu import metrics
 from bluefog_tpu.metrics import metrics_active, metrics_start, metrics_stop
+from bluefog_tpu import blackbox
 
 __version__ = "0.1.0"

@@ -169,7 +169,8 @@ def _walk(jaxpr, axis_sizes: Dict[str, int], name: str,
                     "CHECK-fails (process abort) on multi-argument jitted "
                     "steps — use ordered=False and enforce ordering by "
                     "dataflow (fold the callback result into the output), "
-                    "as utils/timeline.device_stage and metrics.comm do",
+                    "as utils/timeline.device_stage, metrics.comm, and "
+                    "blackbox.recorder.traced_event do",
                     pass_name="comm-lint", subject=name))
             else:
                 diags.append(Diagnostic(

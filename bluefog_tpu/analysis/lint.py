@@ -214,6 +214,24 @@ def comm_lint_pass(report: LintReport, size: int) -> None:
         smap(lambda v: C.neighbor_allreduce_dynamic(v, dyn, 3, _AXIS)),
         x, name="neighbor_allreduce_dynamic[one_peer_exp2]"))
 
+    # 1b) the blackbox flight recorder's jitted-path hooks: trace one
+    # gossip step with BLUEFOG_TPU_BLACKBOX=jit so the recorder's
+    # io_callbacks go through the same BF-COMM012 ordered-callback gate
+    # as the timeline/metrics hooks (an ordered one is a process abort
+    # on this XLA; the hooks must always be unordered + dataflow-folded)
+    prev_mode = os.environ.get("BLUEFOG_TPU_BLACKBOX")
+    os.environ["BLUEFOG_TPU_BLACKBOX"] = "jit"
+    try:
+        bb_sched = T.build_schedule(T.ExponentialTwoGraph(size))
+        report.extend(lint_step_fn(
+            smap(lambda v: C.neighbor_allreduce(v, bb_sched, _AXIS)),
+            x, name="neighbor_allreduce[blackbox=jit]"))
+    finally:
+        if prev_mode is None:
+            os.environ.pop("BLUEFOG_TPU_BLACKBOX", None)
+        else:
+            os.environ["BLUEFOG_TPU_BLACKBOX"] = prev_mode
+
     # 2) both distributed optimizers' jitted update step
     def optimizer_body(opt):
         def body(c):

@@ -1,0 +1,63 @@
+"""Flight recorder & cross-rank hang forensics — the fourth observability
+leg (metrics say *how much*, the timeline says *when*, analysis says
+*whether it can work at all*; the blackbox says **what happened when it
+didn't**).
+
+The reference has no failure story: a wedged MPI rank silently stalls the
+whole decentralized job (SURVEY §5), and an aggregate watchdog can only
+say "no beat arrived" — not which rank, which collective, which step.
+Production systems treat this as a first-class problem (MegaScale,
+arXiv:2402.15627: an always-on per-rank flight recorder dumped on
+failure).  Three pieces:
+
+- :mod:`~bluefog_tpu.blackbox.recorder` — always-on bounded ring buffer
+  of structured events (collective begin/end with collective-id + step +
+  bytes, window deposits/reads, optimizer steps, heartbeat beats).
+  Off-able via ``BLUEFOG_TPU_BLACKBOX=0``; jitted-path hooks are opt-in
+  (``=jit``), trace-time gated and unordered-io_callback-only.
+- :mod:`~bluefog_tpu.blackbox.dump` — on heartbeat timeout, uncaught
+  exception/``HangError``, fatal signal, or atexit-after-exception,
+  write ``blackbox-rank<k>.jsonl`` (ring + thread stacks + open spans +
+  metrics snapshot) into ``BLUEFOG_TPU_BLACKBOX_DIR``.
+- :mod:`~bluefog_tpu.blackbox.merge` — ``bfblackbox-tpu <incident-dir>``
+  aligns per-rank recorders by (step, collective-id), reports rounds
+  entered-but-never-exited, names the suspect rank/edges, and exports a
+  merged per-rank-pid chrome trace.
+
+See ``docs/blackbox.md``.
+"""
+
+from bluefog_tpu.blackbox.dump import collect_attempt, dump, incident_dir, install
+from bluefog_tpu.blackbox.recorder import (
+    FlightRecorder,
+    begin,
+    configure,
+    enabled,
+    end,
+    get,
+    jit_enabled,
+    next_collective_id,
+    record,
+    reset,
+    suppress_blackbox,
+    traced_event,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "begin",
+    "collect_attempt",
+    "configure",
+    "dump",
+    "enabled",
+    "end",
+    "get",
+    "incident_dir",
+    "install",
+    "jit_enabled",
+    "next_collective_id",
+    "record",
+    "reset",
+    "suppress_blackbox",
+    "traced_event",
+]

@@ -1,0 +1,6 @@
+"""``python -m bluefog_tpu.blackbox`` — the merge/diagnosis CLI
+(console script ``bfblackbox-tpu``)."""
+
+from bluefog_tpu.blackbox.merge import main
+
+raise SystemExit(main())

@@ -1,0 +1,394 @@
+"""Per-rank flight recorder: a bounded ring buffer of structured events.
+
+MegaScale (arXiv:2402.15627) attributes most lost training hours to hangs
+and stragglers, and diagnoses them with an always-on per-rank flight
+recorder that is *dumped on failure* rather than streamed: the recorder
+must be cheap enough to leave on, bounded so a week-long run cannot leak,
+and crash-consistent so the last events before a wedge survive.  This
+module is that recorder for bluefog_tpu; :mod:`bluefog_tpu.blackbox.dump`
+writes it out on failure and :mod:`bluefog_tpu.blackbox.merge` aligns the
+per-rank files into a cross-rank diagnosis.
+
+Event kinds recorded by the framework (callers may add their own):
+
+==================  ========================================================
+``collective_begin``  a gossip/window round became runnable on this rank
+``collective_end``    the round's outputs materialized (begin without a
+                      matching end in a dump = the round this rank is
+                      stuck in)
+``window_deposit``    one-sided deposit into a landing slot (host path)
+``window_read``       landing-slot consume (carries the fresh count)
+``tcp_*``             window-server per-connection op records
+``optimizer_step``    one optimizer update completed
+``heartbeat_beat``    the training loop beat the watchdog
+``device_stage``      a jitted-path timeline span callback fired
+==================  ========================================================
+
+(Supervisor restarts are durable markers in the incident directory —
+``supervisor.jsonl``, written by ``run_supervised`` — not ring events:
+the supervisor's own in-memory recorder is never dumped.)
+
+Modes, via ``BLUEFOG_TPU_BLACKBOX`` (read lazily, like the timeline and
+metrics env vars):
+
+- unset / ``1`` (default): **host-path recording on** — deque appends
+  under one uncontended lock, no jax involvement, no extra HLO anywhere.
+- ``0`` / ``off``: everything off; every hook is a no-op / the identity.
+- ``jit`` (also ``full``): additionally arm the **jitted-path hooks**
+  (:func:`traced_event`): collectives/optimizers then emit begin/end
+  events from inside the compiled step via *unordered* ``io_callback``
+  with dataflow-enforced ordering + a ``custom_jvp`` shell — exactly the
+  proven ``device_stage`` / ``metrics.comm`` pattern (ordered callbacks
+  abort this environment's XLA; the analysis lint flags them as
+  BF-COMM012).  Trace-time gated: programs traced outside ``jit`` mode
+  lower to identical HLO as uninstrumented ones (asserted in tests).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "FlightRecorder",
+    "begin",
+    "configure",
+    "enabled",
+    "end",
+    "get",
+    "jit_enabled",
+    "next_collective_id",
+    "record",
+    "reset",
+    "suppress_blackbox",
+    "traced_event",
+]
+
+DEFAULT_CAPACITY = 4096
+#: open-span table bound: a caller that begins rounds it never ends must
+#: not leak memory faster than the ring itself
+_MAX_OPEN = 1024
+
+
+def _mode() -> str:
+    v = os.environ.get("BLUEFOG_TPU_BLACKBOX", "1").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v in ("jit", "full", "deep", "2"):
+        return "jit"
+    return "host"
+
+
+def enabled() -> bool:
+    """Host-path recording active (the default)."""
+    return _mode() != "off"
+
+
+def jit_enabled() -> bool:
+    """Jitted-path hooks armed (``BLUEFOG_TPU_BLACKBOX=jit``)."""
+    return _mode() == "jit"
+
+
+class FlightRecorder:
+    """Fixed-size ring of structured events + an open-span table.
+
+    Lock-light: one plain mutex held only for the deque append / the
+    open-table update — recorders include io_callback runners, the window
+    server's daemon threads and N rank loops, and an event is a dict
+    build plus an append, so contention is negligible at any realistic
+    rate (same reasoning as the metrics registry's single lock).
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
+                 rank: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.environ.get(
+                "BLUEFOG_TPU_BLACKBOX_CAPACITY", DEFAULT_CAPACITY))
+        self.capacity = int(capacity)
+        self.rank = rank
+        self.created_at = time.time()
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._events: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        # (name, key) -> the begin event still waiting for its end
+        self._open: "collections.OrderedDict[Tuple, dict]" = \
+            collections.OrderedDict()
+        # FIFO occurrence pairing for begin/end pairs with no natural key
+        # (stepless jitted rounds): begins enqueue a fresh occurrence id,
+        # ends dequeue the oldest — the timeline's async-span policy
+        self._occ_seq = itertools.count()
+        self._occ_open: Dict[Tuple, "collections.deque"] = {}
+        self.dropped = 0  # events evicted by the ring bound
+
+    # ------------------------------------------------------------- recording
+    def record(self, kind: str, **fields) -> dict:
+        ev = {"seq": next(self._seq), "t": time.time(), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+        return ev
+
+    def begin(self, name: str, key=None, **fields) -> dict:
+        """Record ``<name>_begin`` and track it as open until
+        :meth:`end` with the same ``(name, key)`` — a dump lists what is
+        still open, which is exactly the round a wedged rank is stuck
+        in."""
+        ev = {"seq": next(self._seq), "t": time.time(),
+              "kind": f"{name}_begin"}
+        ev.update(fields)
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+            self._open[(name, key)] = ev
+            while len(self._open) > _MAX_OPEN:
+                self._open.popitem(last=False)
+        return ev
+
+    def end(self, name: str, key=None, **fields) -> dict:
+        ev = {"seq": next(self._seq), "t": time.time(),
+              "kind": f"{name}_end"}
+        ev.update(fields)
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+            self._open.pop((name, key), None)
+        return ev
+
+    def begin_occurrence(self, fifo_key: Tuple) -> int:
+        """Fresh occurrence id for a stepless begin (paired FIFO)."""
+        with self._lock:
+            n = next(self._occ_seq)
+            self._occ_open.setdefault(fifo_key, collections.deque()).append(n)
+            return n
+
+    def end_occurrence(self, fifo_key: Tuple) -> int:
+        """Oldest open occurrence id for ``fifo_key`` (fresh if none)."""
+        with self._lock:
+            q = self._occ_open.get(fifo_key)
+            if q:
+                n = q.popleft()
+                if not q:
+                    self._occ_open.pop(fifo_key, None)
+                return n
+            return next(self._occ_seq)
+
+    # ------------------------------------------------------------- snapshots
+    def _snapshot(self, pull):
+        # Timeout acquire, NOT a plain `with`: the dump path runs from
+        # fatal-SIGNAL handlers, which execute on the very thread they
+        # interrupt — if that thread was mid-record() holding this
+        # non-reentrant lock, a blocking acquire would deadlock the
+        # process the forensics exist to diagnose.  On timeout, read
+        # unlocked: the interrupted mutator is SUSPENDED (same thread),
+        # and a retry loop absorbs any other thread's concurrent append.
+        if self._lock.acquire(timeout=1.0):
+            try:
+                return pull()
+            finally:
+                self._lock.release()
+        for _ in range(3):
+            try:
+                return pull()
+            except RuntimeError:  # deque mutated during iteration
+                continue
+        return []
+
+    def events(self) -> List[dict]:
+        return self._snapshot(lambda: [dict(e) for e in self._events])
+
+    def open_spans(self) -> List[dict]:
+        return self._snapshot(
+            lambda: [dict(e) for e in self._open.values()])
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._open.clear()
+            self._occ_open.clear()
+            self.dropped = 0
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_state_lock = threading.Lock()
+
+
+def get() -> Optional[FlightRecorder]:
+    """The process flight recorder, or None when recording is off.
+    Created lazily on first use (env read per call, matching the metrics
+    registry's lazy activation)."""
+    global _RECORDER
+    if not enabled():
+        return None
+    if _RECORDER is None:
+        with _state_lock:
+            if _RECORDER is None:
+                _RECORDER = FlightRecorder()
+    return _RECORDER
+
+
+def configure(capacity: Optional[int] = None,
+              rank: Optional[int] = None) -> FlightRecorder:
+    """Install a recorder with explicit settings (replaces the lazy one)."""
+    global _RECORDER
+    with _state_lock:
+        _RECORDER = FlightRecorder(capacity=capacity, rank=rank)
+    return _RECORDER
+
+
+def reset() -> None:
+    """Drop the process recorder and per-site counters (tests)."""
+    global _RECORDER, _cid_counters
+    with _state_lock:
+        _RECORDER = None
+        _cid_counters = {}
+
+
+def record(kind: str, **fields) -> None:
+    """Module-level convenience: record into the process recorder; no-op
+    when recording is off (one env read + a None test)."""
+    rec = get()
+    if rec is not None:
+        rec.record(kind, **fields)
+
+
+def begin(name: str, key=None, **fields) -> None:
+    rec = get()
+    if rec is not None:
+        rec.begin(name, key=key, **fields)
+
+
+def end(name: str, key=None, **fields) -> None:
+    rec = get()
+    if rec is not None:
+        rec.end(name, key=key, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Collective-id assignment (trace-time)
+# ---------------------------------------------------------------------------
+
+#: per-op trace-time counters.  SPMD processes trace identical programs in
+#: identical order, so the k-th neighbor_allreduce call site gets the same
+#: id on every rank — the cross-rank alignment key merge.py joins on.
+_cid_counters: Dict[str, "itertools.count"] = {}
+_cid_lock = threading.Lock()
+
+
+def next_collective_id(op: str) -> str:
+    """``"<op>#<n>"`` — the n-th traced call site of ``op`` in this
+    process.  Incremented unconditionally (even with recording off) so a
+    mixed fleet (some ranks recording, some not) still assigns aligned
+    ids."""
+    with _cid_lock:
+        c = _cid_counters.get(op)
+        if c is None:
+            c = _cid_counters[op] = itertools.count()
+        return f"{op}#{next(c)}"
+
+
+# ---------------------------------------------------------------------------
+# Jitted-path hook
+# ---------------------------------------------------------------------------
+
+_suppress = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_blackbox():
+    """Trace-time escape hatch mirroring ``suppress_device_stage`` /
+    ``suppress_comm_metrics``: control-flow wrappers compiling
+    sub-computations into ``lax.switch`` branches hoist the recorder
+    event OUTSIDE the branch (an io_callback per branch is waste; an
+    *ordered* one is the BF-COMM012 abort class)."""
+    prev = getattr(_suppress, "on", False)
+    _suppress.on = True
+    try:
+        yield
+    finally:
+        _suppress.on = prev
+
+
+def _suppressed() -> bool:
+    return getattr(_suppress, "on", False)
+
+
+def traced_event(x, kind: str, *, fields: Optional[dict] = None,
+                 traced: Optional[dict] = None, axis_name=None):
+    """Record ``kind`` once per execution of the program position where
+    this is traced, returning ``x`` unchanged.
+
+    Identity (zero HLO) unless ``BLUEFOG_TPU_BLACKBOX=jit`` at trace
+    time.  ``fields`` are static labels; ``traced`` maps field names to
+    traced scalars (e.g. the step counter) recorded with runtime values.
+    With ``axis_name`` the event carries the mesh rank (one callback per
+    device).  ``kind`` endings ``_begin``/``_end`` route through the
+    recorder's open-span table keyed by ``(cid, rank, step)`` so a dump
+    shows in-flight jitted rounds too.
+
+    Ordering/abort constraints are the ``device_stage`` ones: unordered
+    ``io_callback`` only, B-before-E by dataflow (the callback's zero
+    result is folded into the output), ``custom_jvp`` so instrumented
+    collectives stay differentiable.
+    """
+    rec = get() if jit_enabled() else None
+    if rec is None or _suppressed():
+        return x
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from bluefog_tpu.utils.stamping import stamp
+
+    static = {k: v for k, v in (fields or {}).items()}
+    tnames = list((traced or {}).keys())
+    tvals = [jnp.asarray((traced or {})[k], jnp.float32) for k in tnames]
+    rank = (lax.axis_index(axis_name) if axis_name is not None
+            else jnp.int32(-1))
+
+    def cb(_tok, r, *tv):
+        # re-resolve the recorder at FIRE time (the trace-time check above
+        # is only the arming decision): a configure(rank=...)/reset() after
+        # compilation installs a new recorder, and a compiled step must
+        # record into the live one, not an orphan — same policy as
+        # device_stage's callback
+        live = get()
+        if live is None:
+            return np.float32(0.0)
+        f = dict(static)
+        for k, v in zip(tnames, tv):
+            fv = float(v)
+            f[k] = int(fv) if fv == int(fv) else fv
+        if int(r) >= 0:
+            f["rank"] = int(r)
+        step = f.get("step")
+        base = (f.get("cid"), f.get("rank"))
+        if kind.endswith("_begin"):
+            # stepless rounds: jax dispatches asynchronously, so step
+            # N+1's begin can fire before step N's end — a (cid, rank)
+            # key alone would collide and hide the genuinely-open round
+            # from the dump.  FIFO occurrence ids keep instances distinct.
+            key = base + ((step,) if step is not None
+                          else (live.begin_occurrence(base),))
+            live.begin(kind[:-len("_begin")], key=key, **f)
+        elif kind.endswith("_end"):
+            key = base + ((step,) if step is not None
+                          else (live.end_occurrence(base),))
+            live.end(kind[:-len("_end")], key=key, **f)
+        else:
+            live.record(kind, **f)
+        return np.float32(0.0)
+
+    # fire-after-data, order-by-dataflow, custom_jvp differentiability:
+    # the shared stamping shell (utils/stamping.py)
+    return stamp(x, cb, rank, *tvals)

@@ -45,6 +45,7 @@ from bluefog_tpu.metrics.registry import (
 from bluefog_tpu.metrics.export import (
     MetricsWriter,
     prometheus_text,
+    snapshot,
     step,
     write_prometheus,
 )
@@ -57,6 +58,7 @@ __all__ = [
     "metrics_start",
     "metrics_stop",
     "prometheus_text",
+    "snapshot",
     "step",
     "write_prometheus",
 ]

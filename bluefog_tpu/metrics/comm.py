@@ -104,54 +104,25 @@ def count(x, counters: Sequence[Tuple[str, object]],
     if reg is None or _suppressed() or not counters:
         return x
 
-    import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.experimental import io_callback
+
+    from bluefog_tpu.utils.stamping import stamp
 
     lbls = {str(k): str(v) for k, v in (labels or {}).items()}
     # materialize the counter objects at trace time: name/kind conflicts
     # surface here (at the call site), not inside a device callback
     objs = [reg.counter(name) for name, _ in counters]
-    amounts = [amount for _, amount in counters]
+    amounts = [jnp.asarray(a, jnp.float32) for _, a in counters]
 
     def cb(_token, *vals):
         for obj, v in zip(objs, vals):
             obj.inc(float(v), **lbls)
         return np.float32(0.0)
 
-    # custom_jvp shell: io_callback has no JVP rule; without this an
-    # instrumented collective inside jax.grad would fail to trace.
-    @jax.custom_jvp
-    def stamped(y):
-        leaves = [l for l in jax.tree_util.tree_leaves(y)
-                  if hasattr(l, "ravel") and getattr(l, "size", 0)]
-        token = (sum((l.ravel()[0].astype("float32") for l in leaves),
-                     start=jnp.float32(0)) if leaves else jnp.float32(0))
-        vals = [jnp.asarray(a, jnp.float32) for a in amounts]
-        zero = io_callback(cb, jax.ShapeDtypeStruct((), jnp.float32),
-                           token, *vals, ordered=False)
-
-        def fold(tree):
-            folded = [False]
-
-            def one(l):
-                if (not folded[0] and hasattr(l, "dtype")
-                        and jnp.issubdtype(l.dtype, jnp.number)):
-                    folded[0] = True
-                    return l + zero.astype(l.dtype)
-                return l
-
-            return jax.tree_util.tree_map(one, tree)
-
-        return fold(y)
-
-    @stamped.defjvp
-    def _stamped_jvp(primals, tangents):
-        (y,), (t,) = primals, tangents
-        return stamped(y), t
-
-    return stamped(x)
+    # fire-after-data, order-by-dataflow, custom_jvp differentiability:
+    # the shared stamping shell (utils/stamping.py)
+    return stamp(x, cb, *amounts)
 
 
 def record_collective(x, *, op: str, bytes_per_round, messages_per_round,

@@ -31,6 +31,7 @@ __all__ = [
     "attach_writer",
     "detach_writer",
     "prometheus_text",
+    "snapshot",
     "step",
     "write_prometheus",
 ]
@@ -126,6 +127,23 @@ def step(step: Optional[int] = None) -> Optional[dict]:
     if w is not None:
         w.write(record)
     return record
+
+
+def snapshot(*, drain: bool = True) -> Optional[dict]:
+    """One-shot registry snapshot (``None`` when metrics are off) — the
+    crash-dump API: ``bluefog_tpu.blackbox`` embeds it in each incident
+    file so the counters at failure time survive without the writer
+    machinery.  ``drain=True`` (default) waits out in-flight callback
+    effects first, same as :func:`step`; the blackbox dump passes
+    ``drain=False`` because a watchdog thread dumping while the main
+    thread is wedged in a device collective must never block on that
+    same device — a slightly stale counter beats no dump."""
+    reg = _reg.current()
+    if reg is None:
+        return None
+    if drain:
+        _drain_effects()
+    return reg.snapshot()
 
 
 def prometheus_text(registry: Optional[_reg.MetricsRegistry] = None) -> str:

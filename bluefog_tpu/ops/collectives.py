@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.metrics import registry as _mreg
 from bluefog_tpu.topology.graphs import Topology
@@ -271,6 +272,16 @@ def neighbor_allreduce(
     # enqueue/execute stage events from operations.cc (SURVEY.md §5).
     x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
                          axis_name=axis_name)
+    # blackbox flight-recorder round markers (identity unless
+    # BLUEFOG_TPU_BLACKBOX=jit at trace time): a begin without a matching
+    # end in a hang dump names the exact round this rank wedged in.  The
+    # cid is a trace-time call-site id, identical across SPMD processes,
+    # so bfblackbox-tpu can align ranks on (step, cid).
+    bb_cid = _bb.next_collective_id("neighbor_allreduce")
+    bb_fields = {"op": "neighbor_allreduce", "cid": bb_cid,
+                 "schedule": sched.name, "bytes": _mt.tree_bytes(x)}
+    x = _bb.traced_event(x, "collective_begin", fields=bb_fields,
+                         axis_name=axis_name)
 
     if backend == "pallas":
         # distinct collective_id per kernel invocation: DEVICES may be
@@ -368,6 +379,8 @@ def neighbor_allreduce(
             bytes_per_round=_mt.tree_bytes(x) * sched.num_slots,
             messages_per_round=n_invocations * sched.num_slots,
             schedule=sched.name, backend="pallas", chunks=n_invocations)
+        out = _bb.traced_event(out, "collective_end", fields=bb_fields,
+                               axis_name=axis_name)
         return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                                 axis_name=axis_name)
 
@@ -399,6 +412,8 @@ def neighbor_allreduce(
         bytes_per_round=_mt.tree_bytes(x) * sched.num_slots,
         messages_per_round=_mt.tree_leaf_count(x) * sched.num_slots,
         schedule=sched.name, backend="xla")
+    out = _bb.traced_event(out, "collective_end", fields=bb_fields,
+                           axis_name=axis_name)
     return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                             axis_name=axis_name)
 
@@ -444,6 +459,16 @@ def neighbor_allreduce_dynamic(
     # B/E pair carries the same information.
     x = _tl.device_stage(x, "bf.neighbor_allreduce", phase="B",
                          axis_name=axis_name)
+    # Blackbox round markers, hoisted like spans/metrics (one begin/end
+    # per step, outside the switch), with the TRACED step recorded so the
+    # cross-rank merge aligns rounds on real step numbers.
+    bb_cid = _bb.next_collective_id("neighbor_allreduce_dynamic")
+    bb_fields = {"op": "neighbor_allreduce_dynamic", "cid": bb_cid,
+                 "schedule": f"dynamic[{len(scheds)}]",
+                 "bytes": _mt.tree_bytes(x)}
+    bb_step = {"step": jnp.asarray(step, jnp.float32)}
+    x = _bb.traced_event(x, "collective_begin", fields=bb_fields,
+                         traced=bb_step, axis_name=axis_name)
     # Metrics follow the same hoisting rule as timeline spans: the inner
     # neighbor_allreduce records are suppressed inside the switch (exactly
     # one branch runs per step) and ONE outer record carries the taken
@@ -451,7 +476,8 @@ def neighbor_allreduce_dynamic(
     # reflects the actual schedule of every step without per-branch
     # callbacks.
     idx = jnp.asarray(step) % len(scheds)
-    with _tl.suppress_device_stage(), _mt.suppress_comm_metrics():
+    with _tl.suppress_device_stage(), _mt.suppress_comm_metrics(), \
+            _bb.suppress_blackbox():
         out = lax.switch(idx, branches, x)
     if _mreg.current() is not None:
         from bluefog_tpu.ops import pallas_gossip
@@ -471,6 +497,8 @@ def neighbor_allreduce_dynamic(
             messages_per_round=jnp.asarray(
                 [leaves * s.num_slots for s in scheds], jnp.float32)[idx],
             schedule=f"dynamic[{len(scheds)}]", backend=resolved)
+    out = _bb.traced_event(out, "collective_end", fields=bb_fields,
+                           traced=bb_step, axis_name=axis_name)
     return _tl.device_stage(out, "bf.neighbor_allreduce", phase="E",
                             axis_name=axis_name)
 
@@ -667,10 +695,17 @@ def allreduce(x, axis_name: str, *, average: bool = True):
             s = (s.astype(_acc_dtype(leaf)) / n).astype(leaf.dtype)
         return s
 
+    bb_cid = _bb.next_collective_id("allreduce")
+    bb_fields = {"op": "allreduce", "cid": bb_cid,
+                 "bytes": _mt.tree_bytes(x)}
+    x = _bb.traced_event(x, "collective_begin", fields=bb_fields,
+                         axis_name=axis_name)
     out = jax.tree_util.tree_map(one, x)
-    return _mt.record_collective(
+    out = _mt.record_collective(
         out, op="allreduce", bytes_per_round=_mt.tree_bytes(x),
         messages_per_round=_mt.tree_leaf_count(x), backend="xla")
+    return _bb.traced_event(out, "collective_end", fields=bb_fields,
+                            axis_name=axis_name)
 
 
 def allgather(x, axis_name: str, *, axis: int = 0, tiled: bool = False):
@@ -751,6 +786,11 @@ def hierarchical_neighbor_allreduce(
     x = _tl.device_stage(x, "bf.hierarchical_neighbor_allreduce", phase="B",
                          axis_name=axis_name)
     msched = _as_schedule(machine_schedule)
+    bb_cid = _bb.next_collective_id("hierarchical_neighbor_allreduce")
+    bb_fields = {"op": "hierarchical_neighbor_allreduce", "cid": bb_cid,
+                 "schedule": msched.name, "bytes": _mt.tree_bytes(x)}
+    x = _bb.traced_event(x, "collective_begin", fields=bb_fields,
+                         axis_name=axis_name)
     n_machines = msched.size
     groups = [list(range(m * local_size, (m + 1) * local_size)) for m in range(n_machines)]
 
@@ -794,6 +834,8 @@ def hierarchical_neighbor_allreduce(
         bytes_per_round=_mt.tree_bytes(x) * len(rank_perms),
         messages_per_round=_mt.tree_leaf_count(x) * len(rank_perms),
         schedule=msched.name, backend="xla")
+    out = _bb.traced_event(out, "collective_end", fields=bb_fields,
+                           axis_name=axis_name)
     return _tl.device_stage(out, "bf.hierarchical_neighbor_allreduce",
                             phase="E", axis_name=axis_name)
 
@@ -824,6 +866,11 @@ def hierarchical_neighbor_allreduce_2d(
     x = _tl.device_stage(x, "bf.hierarchical_neighbor_allreduce_2d", phase="B",
                          axis_name=(machine_axis, local_axis))
     msched = _as_schedule(machine_schedule)
+    bb_cid = _bb.next_collective_id("hierarchical_neighbor_allreduce_2d")
+    bb_fields = {"op": "hierarchical_neighbor_allreduce_2d", "cid": bb_cid,
+                 "schedule": msched.name, "bytes": _mt.tree_bytes(x)}
+    x = _bb.traced_event(x, "collective_begin", fields=bb_fields,
+                         axis_name=(machine_axis, local_axis))
 
     def one(leaf):
         acc_dt = _acc_dtype(leaf)
@@ -851,5 +898,7 @@ def hierarchical_neighbor_allreduce_2d(
         bytes_per_round=_mt.tree_bytes(x) * len(msched.perms),
         messages_per_round=_mt.tree_leaf_count(x) * len(msched.perms),
         schedule=msched.name, backend="xla")
+    out = _bb.traced_event(out, "collective_end", fields=bb_fields,
+                           axis_name=(machine_axis, local_axis))
     return _tl.device_stage(out, "bf.hierarchical_neighbor_allreduce_2d",
                             phase="E", axis_name=(machine_axis, local_axis))

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from flax import struct
 from jax import lax
 
+from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
@@ -163,6 +164,14 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
     # reference's per-tensor stage events for the window family
     payload = _tl.device_stage(payload, op_name, phase="B",
                                category="window", axis_name=axis_name)
+    # blackbox round markers for the window family (identity unless
+    # BLUEFOG_TPU_BLACKBOX=jit at trace time)
+    bb_cid = _bb.next_collective_id(op_name.replace("bf.", ""))
+    bb_fields = {"op": op_name.replace("bf.", ""), "cid": bb_cid,
+                 "window": state.spec.name,
+                 "bytes": _mt.tree_bytes(payload)}
+    payload = _bb.traced_event(payload, "collective_begin",
+                               fields=bb_fields, axis_name=axis_name)
     # same routing policy as gossip (auto_gossip_backend's stated
     # conditions) — the window transport is the same fused RDMA kernel
     # family in 'put'/'acc' mode.  chunkable=False: the landing buffers are
@@ -233,6 +242,8 @@ def _deliver(state: WindowState, payload, axis_name: str, *, accumulate: bool,
         messages_per_round=_mt.tree_leaf_count(payload) * sched.num_slots,
         schedule=sched.name, backend=backend,
         extra={"window": state.spec.name})
+    new_peers = _bb.traced_event(new_peers, "collective_end",
+                                 fields=bb_fields, axis_name=axis_name)
     new_peers = _tl.device_stage(new_peers, op_name, phase="E",
                                  category="window", axis_name=axis_name)
     return state.replace(peer_bufs=new_peers, assoc_peers=new_assoc)

@@ -36,6 +36,7 @@ import numpy as np
 import optax
 from jax import lax
 
+from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.metrics import registry as _mreg
 from bluefog_tpu.ops import collectives as C
@@ -244,6 +245,13 @@ def decentralized_optimizer(
                 [("bf_optimizer_steps_total", 1.0),
                  ("bf_optimizer_comm_rounds_total", comm_inc)],
                 {"opt": ct.value, "atc": str(bool(atc)).lower()})
+        # flight-recorder step event with the TRACED step counter
+        # (identity unless BLUEFOG_TPU_BLACKBOX=jit at trace time): a hang
+        # dump then shows the last optimizer update each rank completed
+        new_updates = _bb.traced_event(
+            new_updates, "optimizer_step", fields={"opt": ct.value},
+            traced={"step": state.count.astype(jnp.float32)},
+            axis_name=axis_name if isinstance(axis_name, str) else None)
         return new_updates, _DecentralizedState(base_state, new_count, new_comm_count)
 
     return optax.GradientTransformation(init_fn, update_fn)

@@ -176,6 +176,17 @@ def init(
         "bluefog_tpu.init: %d ranks (%d machines x %d local), topology=%s",
         n, n_machines, local_size, topo.name,
     )
+    try:
+        # arm the blackbox crash/hang dump triggers (excepthooks, fatal
+        # signals, faulthandler, atexit-after-exception) at framework
+        # bring-up — the watchdog path dumps on its own, but a rank dying
+        # of an uncaught exception must leave its flight recorder behind
+        # too.  No-op when BLUEFOG_TPU_BLACKBOX=0; idempotent.
+        from bluefog_tpu import blackbox
+
+        blackbox.install()
+    except Exception:
+        pass
     return _CTX
 
 

@@ -57,6 +57,7 @@ import contextlib
 
 import numpy as np
 
+from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import native
 from bluefog_tpu.topology.graphs import Topology
@@ -318,6 +319,10 @@ class AsyncWindow:
                 transport="shm" if self.shm else "local")
         _mt.inc("bf_window_deposits_total", 1.0, window=self.name,
                 op=op)
+        # flight recorder (always-on host path): the last deposits before
+        # a wedge are exactly what a hang dump needs to show
+        _bb.record("window_deposit", window=self.name, slot=slot,
+                   bytes=a.size * a.dtype.itemsize, op=op)
         return int(v)
 
     def read(self, slot: int, *, consume: bool = True
@@ -344,6 +349,8 @@ class AsyncWindow:
                     window=self.name)
         if consume and fresh == 0:
             _mt.inc("bf_window_stale_reads_total", 1.0, window=self.name)
+        _bb.record("window_read", window=self.name, slot=slot,
+                   fresh=int(fresh), consume=consume)
         return out, int(fresh)
 
     def set_self(self, arr: np.ndarray) -> None:
@@ -775,7 +782,14 @@ def run_async_dsgd(
             # churn fresh ~d-element buffers per step (d can be 10^8)
             gvec = np.empty(d, np.float64)
             payload = np.empty(d + 1, np.float64)
+            rec = _bb.get()  # flight recorder (None when off)
             while not stop.is_set():
+                # per-round blackbox markers: a begin without its end in a
+                # dump names the round (and rank) the loop wedged in
+                if rec is not None:
+                    rec.begin("collective", key=("async_dsgd", r, steps[r]),
+                              op="async_dsgd_round", cid="async_dsgd_round",
+                              step=steps[r], rank=r, peers=out_nbrs[r])
                 for k in range(len(in_nbrs[r])):
                     buf, fresh = wins[r].read(k, consume=True)
                     if fresh > 0:
@@ -795,6 +809,12 @@ def run_async_dsgd(
                     wins[j].deposit(slot_of[j][r], payload, accumulate=True)
                 x *= frac
                 p *= frac
+                if rec is not None:
+                    rec.end("collective", key=("async_dsgd", r, steps[r]),
+                            op="async_dsgd_round", cid="async_dsgd_round",
+                            step=steps[r], rank=r)
+                    rec.record("optimizer_step", step=steps[r], rank=r,
+                               loss=float(loss))
                 steps[r] += 1
                 if skew[r] > 0 or poll_interval_s > 0:
                     time.sleep(skew[r] + poll_interval_s)
@@ -1117,8 +1137,18 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     payload = np.empty(d + 1, np.float64)
     losses: List[float] = []
     steps = 0
+    rec = _bb.get()  # per-PROCESS flight recorder (None when off)
+    if rec is not None and rec.rank is None:
+        # one OS process per rank here: pin the dump identity so a
+        # shared (e.g. NFS) incident dir gets blackbox-rank<r>.jsonl per
+        # rank instead of every process fighting over rank 0's file
+        rec.rank = rank
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < duration_s:
+        if rec is not None:
+            rec.begin("collective", key=("async_dsgd_mp", rank, steps),
+                      op="async_dsgd_round", cid="async_dsgd_round",
+                      step=steps, rank=rank, peers=out_nbrs)
         for k in range(len(in_nbrs)):
             buf, fresh = win.read(k, consume=True)
             if fresh > 0:
@@ -1137,6 +1167,12 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             peers[j].deposit(peer_slot[j], payload, accumulate=True)
         x *= frac
         p *= frac
+        if rec is not None:
+            rec.end("collective", key=("async_dsgd_mp", rank, steps),
+                    op="async_dsgd_round", cid="async_dsgd_round",
+                    step=steps, rank=rank)
+            rec.record("optimizer_step", step=steps, rank=rank,
+                       loss=float(loss))
         steps += 1
         if skew_s > 0 or poll_interval_s > 0:
             time.sleep(skew_s + poll_interval_s)

@@ -130,6 +130,13 @@ def main(argv: Optional[List[str]] = None):
         "its latest checkpoint when it dies (peer failure kills survivors "
         "via the coordination service; the hang watchdog kills wedged "
         "collectives) — up to MAX_RESTARTS times")
+    ap.add_argument(
+        "--incident-dir", default=None, metavar="DIR",
+        help="with --supervise: directory collecting blackbox flight-"
+        "recorder dumps across restarts (one incident tree for "
+        "bfblackbox-tpu; the child inherits it as BLUEFOG_TPU_BLACKBOX_DIR "
+        "and earlier attempts' dumps are layered into restart-N/).  "
+        "Default: $BLUEFOG_TPU_BLACKBOX_DIR, else ./bf-incident")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -151,9 +158,31 @@ def main(argv: Optional[List[str]] = None):
                 "re-rendezvouses")
         from bluefog_tpu.utils.failure import run_supervised
 
+        incident = (args.incident_dir
+                    or os.environ.get("BLUEFOG_TPU_BLACKBOX_DIR")
+                    or "bf-incident")
         raise SystemExit(run_supervised(
             [sys.executable, args.script] + list(args.script_args),
-            max_restarts=args.supervise))
+            max_restarts=args.supervise, incident_dir=incident))
+    if args.process_id is not None:
+        # name this process's blackbox/faulthandler files by its real
+        # rank BEFORE install() opens them — co-located processes with a
+        # shared incident dir must not truncate each other's rank-0 files
+        os.environ.setdefault("BLUEFOG_TPU_RANK", str(args.process_id))
+    if args.num_processes is not None:
+        os.environ.setdefault("BLUEFOG_TPU_WORLD", str(args.num_processes))
+    try:
+        # dump triggers armed in the launched process itself: scripts that
+        # never call bf.init() (pure host runs) still leave a blackbox
+        # file behind on an uncaught exception or fatal signal.  The
+        # --supervise branch above deliberately skips this — the CHILD
+        # arms its own triggers (via bf.init or this path on re-exec);
+        # the supervisor only collects.
+        from bluefog_tpu import blackbox
+
+        blackbox.install()
+    except Exception:
+        pass
     initialize_cluster(args.coordinator, args.num_processes, args.process_id)
     sys.argv = [args.script] + list(args.script_args)
     runpy.run_path(args.script, run_name="__main__")

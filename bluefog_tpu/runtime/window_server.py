@@ -53,6 +53,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import native
 from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS
@@ -118,9 +119,15 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 class _Handler(socketserver.BaseRequestHandler):
     def setup(self):
         self.server.track(self.request)  # type: ignore[attr-defined]
+        # per-connection flight-recorder records (always-on host path): a
+        # hang dump on the OWNER shows which peers were connected and
+        # what their last deposits were — the receiving end of the
+        # one-sided story that the peers' own dumps cannot show
+        _bb.record("tcp_connect", peer=self.client_address[0])
 
     def finish(self):
         self.server.untrack(self.request)  # type: ignore[attr-defined]
+        _bb.record("tcp_disconnect", peer=self.client_address[0])
 
     def _geometry_ok(self, lib, name, dtype, n_elems):
         """The client's claimed (dtype, n_elems) must MATCH the window's
@@ -182,6 +189,10 @@ class _Handler(socketserver.BaseRequestHandler):
                                 peer=self.client_address[0])
                         _mt.inc("bf_tcp_deposits_total", 1.0,
                                 peer=self.client_address[0])
+                        _bb.record(
+                            "tcp_deposit", slot=slot, bytes=nbytes,
+                            window=name.decode("utf-8", "replace"),
+                            peer=self.client_address[0])
                     continue
                 if err:
                     sock.sendall(_STATUS.pack(err))
@@ -196,6 +207,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 if rc >= 0:
                     sock.sendall(_SELF_HDR.pack(dtype, n_elems))
                     sock.sendall(out.tobytes())
+                    _bb.record(
+                        "tcp_read",
+                        op="get_self" if op == _OP_GET_SELF else "read_slot",
+                        slot=slot, window=name.decode("utf-8", "replace"),
+                        peer=self.client_address[0])
         except (ConnectionError, OSError):
             return
 

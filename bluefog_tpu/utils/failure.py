@@ -125,6 +125,14 @@ class Heartbeat:
         self._last = time.monotonic()
         self._beats += 1
         self._step = step
+        try:
+            from bluefog_tpu.blackbox import recorder as _bbrec
+
+            rec = _bbrec.get()
+            if rec is not None:
+                rec.record("heartbeat_beat", step=step)
+        except Exception:
+            pass
 
     @property
     def beats(self) -> int:
@@ -175,6 +183,29 @@ class Heartbeat:
             if silent_for < self.timeout_s:
                 continue
             self.hangs_detected += 1
+            try:
+                # hang counter for scrapes/alerts (no-op when metrics off)
+                from bluefog_tpu.metrics import comm as _mcomm
+
+                _mcomm.inc("bf_hangs_total", 1.0, action=self.action)
+            except Exception:
+                pass
+            try:
+                # blackbox dump BEFORE escalating: once the watchdog kills
+                # the process (or HangError unwinds the loop) the flight
+                # recorder is gone — this file is the forensic record the
+                # bfblackbox-tpu merge diagnoses across ranks.  Carries
+                # the last-beat step so the merge can place this rank.
+                from bluefog_tpu import blackbox as _bb
+
+                _bb.dump("heartbeat_timeout", extra={
+                    "last_step": self._step,
+                    "silent_for_s": round(silent_for, 3),
+                    "beats": self._beats,
+                    "action": self.action,
+                })
+            except Exception:
+                pass
             log.error(
                 "heartbeat: no progress for %.1fs (last step %r) — hang "
                 "detected.\n%s", silent_for, self._step, _dump_stacks())
@@ -226,6 +257,7 @@ def run_supervised(
     max_restarts: int = 3,
     min_uptime_s: float = 0.0,
     env: Optional[dict] = None,
+    incident_dir: Optional[str] = None,
 ) -> int:
     """Process-level supervisor: run ``argv`` until it exits 0, restarting
     on failure up to ``max_restarts`` times (``bfrun-tpu --supervise N``).
@@ -237,7 +269,21 @@ def run_supervised(
     crash or wedged collective costs at most the progress since the last
     save.  ``min_uptime_s`` guards against hot crash loops: a run that died
     faster than this does not earn a restart.
+
+    ``incident_dir``: blackbox forensics across restarts.  The child
+    inherits it as ``BLUEFOG_TPU_BLACKBOX_DIR`` (so its watchdog/crash
+    dumps land there), and between attempts the supervisor layers the
+    dump files into ``restart-<n>/`` so a later attempt cannot overwrite
+    the evidence of an earlier one — the whole tree is ONE incident that
+    ``bfblackbox-tpu`` reads recursively.
     """
+    if incident_dir is not None:
+        env = dict(env if env is not None else os.environ)
+        # unconditional: an explicit incident_dir must win over an ambient
+        # BLUEFOG_TPU_BLACKBOX_DIR, or the children dump where the
+        # supervisor does not collect and the restart layering loses the
+        # evidence it exists to preserve
+        env["BLUEFOG_TPU_BLACKBOX_DIR"] = incident_dir
     restarts = 0
     while True:
         t0 = time.monotonic()
@@ -246,6 +292,31 @@ def run_supervised(
         if proc.returncode == 0:
             return 0
         restarts += 1
+        if incident_dir is not None:
+            try:
+                from bluefog_tpu import blackbox as _bb
+
+                moved = _bb.collect_attempt(incident_dir, restarts)
+                if moved:
+                    log.info("supervisor: collected %d blackbox file(s) "
+                             "into %s/restart-%d", moved, incident_dir,
+                             restarts)
+                # durable restart marker IN the incident tree (the
+                # supervisor's own in-memory recorder is never dumped, so
+                # recording there would be dead telemetry) — merge.py
+                # surfaces these next to the per-rank dumps
+                import json as _json
+
+                os.makedirs(incident_dir, exist_ok=True)
+                with open(os.path.join(incident_dir,
+                                       "supervisor.jsonl"), "a") as f:
+                    f.write(_json.dumps({
+                        "supervisor_restart": True, "attempt": restarts,
+                        "returncode": proc.returncode,
+                        "uptime_s": round(uptime, 3),
+                        "time": time.time()}) + "\n")
+            except Exception:
+                pass
         if restarts > max_restarts:
             log.error("supervisor: giving up after %d restarts (last rc %d)",
                       max_restarts, proc.returncode)

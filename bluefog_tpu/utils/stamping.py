@@ -1,0 +1,74 @@
+"""Shared unordered-io_callback "stamping" shell.
+
+One implementation of the subtle machinery that lets a host callback ride
+a jitted program WITHOUT the ordered-effects token (which this
+environment's XLA CHECK-fails on — the PR-1 abort class, linted as
+BF-COMM012), used by all three observability legs:
+
+- ``utils/timeline.device_stage`` (runtime spans),
+- ``metrics/comm.count`` (counter increments),
+- ``blackbox/recorder.traced_event`` (flight-recorder events).
+
+The contract, in one place so a fix lands once:
+
+1. **Fire-after-data**: the callback's first operand is a scalar *token*
+   summed from one element of every array leaf of ``x``, so the callback
+   observes each leaf's computation having produced data.
+2. **Order-by-dataflow**: the callback returns a float32 zero that is
+   folded into the first numeric leaf of the result — everything
+   downstream of the stamped value depends on the callback having fired,
+   which also pins it against DCE by construction.  (It does NOT order
+   two data-independent stamped positions against each other; callers
+   that need instance pairing use FIFO ids — see
+   ``Timeline.begin_async`` / ``FlightRecorder.begin_occurrence``.)
+3. **Differentiability**: a ``custom_jvp`` shell fires the callback on
+   the primal and passes tangents through untouched (identity — linear,
+   so reverse-mode transposes too); without it, ``io_callback`` (no JVP
+   rule) would make every instrumented collective untraceable under
+   ``jax.grad``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["stamp"]
+
+
+def stamp(x, cb, *operands):
+    """Fire ``cb(token, *operands)`` once per execution of the program
+    position where this is traced; returns ``x`` unchanged (modulo the
+    folded zero).  ``cb`` must return a ``np.float32`` scalar (zero).
+    ``operands`` may be traced values; they reach ``cb`` as the runtime
+    values of this execution."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    @jax.custom_jvp
+    def stamped(y):
+        leaves = [l for l in jax.tree_util.tree_leaves(y)
+                  if hasattr(l, "ravel") and getattr(l, "size", 0)]
+        token = (sum((l.ravel()[0].astype("float32") for l in leaves),
+                     start=jnp.float32(0)) if leaves else jnp.float32(0))
+        zero = io_callback(cb, jax.ShapeDtypeStruct((), jnp.float32),
+                           token, *operands, ordered=False)
+
+        def fold(tree):
+            folded = [False]
+
+            def one(l):
+                if (not folded[0] and hasattr(l, "dtype")
+                        and jnp.issubdtype(l.dtype, jnp.number)):
+                    folded[0] = True
+                    return l + zero.astype(l.dtype)
+                return l
+
+            return jax.tree_util.tree_map(one, tree)
+
+        return fold(y)
+
+    @stamped.defjvp
+    def _stamped_jvp(primals, tangents):
+        (y,), (t,) = primals, tangents
+        return stamped(y), t
+
+    return stamped(x)

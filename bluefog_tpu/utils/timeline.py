@@ -6,11 +6,26 @@ dedicated writer emitting ``chrome://tracing`` JSON, enabled by
 ``bf.timeline_start_activity / timeline_end_activity`` span API.
 
 Here: enabled by ``BLUEFOG_TPU_TIMELINE=<file>`` or :func:`timeline_start`.
-Spans are buffered in memory and flushed by a background writer thread (the
+Spans are buffered in memory and drained by a background writer thread (the
 reference's dedicated timeline thread), in chrome trace-event format.  Device
 -side activity is better captured with ``jax.profiler`` (Perfetto); every span
 recorded here is additionally wrapped in a ``jax.profiler.TraceAnnotation``
 so host spans and XLA activity line up in one Perfetto view.
+
+Two span flavors:
+
+- ``begin``/``end`` — classic duration events (``ph: "B"/"E"``), matched
+  by name per lane.  Right for host code where a lane (thread) opens and
+  closes its own spans in stack order.
+- ``begin_async``/``end_async`` — chrome *async* events (``ph: "b"/"e"``
+  with a unique ``id`` per span instance).  Two data-independent
+  same-name spans in one lane (e.g. gradient tracking's y-mix and
+  params-mix both named ``bf.neighbor_allreduce``) may land interleaved
+  ``b b e e``; async ids keep the renderer from crossing their
+  durations, which B/E name-matching cannot.  :func:`device_stage` emits
+  these.  Pairing is FIFO per (name, category, lane): begins and ends
+  are matched in arrival order, so rendered intervals never cross even
+  when the instances are indistinguishable.
 
 A C++ writer with the same wire format lives in ``bluefog_tpu/runtime``
 (csrc/timeline.cc) and is used when the native runtime library is built; this
@@ -20,12 +35,14 @@ pure-Python path is the always-available fallback.
 from __future__ import annotations
 
 import atexit
+import collections
 import contextlib
+import itertools
 import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "Timeline",
@@ -40,18 +57,49 @@ __all__ = [
 ]
 
 
+#: open-span table bounds (see Timeline.__init__)
+_OPEN_PER_KEY = 256
+_OPEN_KEYS = 512
+
+
 class Timeline:
-    """Buffered chrome-trace writer with a flusher thread."""
+    """Buffered chrome-trace writer with a flusher thread.
+
+    IO is **drain-and-append**: each flush serializes only the events
+    recorded since the previous one and appends them to the trace-event
+    array on disk — O(new events) per flush, where rewriting the whole
+    buffer every 2 s would be O(n²) IO over a long run.  The array's
+    closing ``]`` is written by :meth:`close`; until then the file is an
+    unterminated JSON array, which chrome/Perfetto accept (their
+    crash-tolerant format) — so a killed process still leaves a loadable
+    trace of everything flushed before the kill.
+    """
 
     def __init__(self, path: str, flush_interval_s: float = 2.0):
         self.path = path
         self._events: List[dict] = []
         self._lock = threading.Lock()
-        self._open_spans: Dict[str, float] = {}
+        self._io_lock = threading.Lock()
+        self._wrote_header = False
+        self._finalized = False
         self._t0 = time.perf_counter()
         self._stop = threading.Event()
+        # open-span bookkeeping (the blackbox dump reports these):
+        # sync spans count opens per (name, cat, tid); async spans queue
+        # (id, ts) FIFO per (name, cat, tid) for pairing.  BOUNDED, like
+        # the flight recorder's open table: a caller that begins spans it
+        # never ends (exception inside a span, mismatched end name) must
+        # not leak memory over a week-long run — per-key deques cap at
+        # _OPEN_PER_KEY (oldest unmatched open dropped), and the key
+        # count itself caps at _OPEN_KEYS (oldest key evicted).
+        self._open_sync: Dict[Tuple, "collections.deque"] = {}
+        self._open_async: Dict[Tuple, "collections.deque"] = {}
+        self._async_ids = itertools.count(1)
         self._native = _try_native(path)
         if self._native is None:
+            # each run owns its file: truncate up front, append from then on
+            with open(self.path, "w"):
+                pass
             self._thread = threading.Thread(
                 target=self._flush_loop, args=(flush_interval_s,), daemon=True
             )
@@ -74,6 +122,8 @@ class Timeline:
               "pid": os.getpid(), "tid": tid}
         with self._lock:
             self._events.append(ev)
+            self._open_push(self._open_sync, (name, category, tid),
+                            ev["ts"])
 
     def end(self, name: str, category: str = "activity", tid: int = 0):
         if getattr(self, "_closed", False):
@@ -85,6 +135,68 @@ class Timeline:
               "pid": os.getpid(), "tid": tid}
         with self._lock:
             self._events.append(ev)
+            opens = self._open_sync.get((name, category, tid))
+            if opens:
+                opens.pop()
+                if not opens:
+                    self._open_sync.pop((name, category, tid), None)
+
+    def _open_push(self, table, key, item) -> None:
+        """Append to a bounded open-span table (caller holds the lock)."""
+        q = table.get(key)
+        if q is None:
+            while len(table) >= _OPEN_KEYS:
+                table.pop(next(iter(table)))  # evict the oldest key
+            q = table[key] = collections.deque(maxlen=_OPEN_PER_KEY)
+        q.append(item)
+
+    def begin_async(self, name: str, category: str = "activity",
+                    tid: int = 0) -> int:
+        """Open an async span instance (``ph: "b"``); returns its id.
+
+        Native-writer caveat: the C++ writer's API has only B/E duration
+        events, so when it is loaded async spans DEGRADE to name-matched
+        B/E (interleaved same-name instances can render crossed there,
+        and open-span bookkeeping is skipped).  The no-mis-nest
+        guarantee holds on the pure-Python writer — the always-available
+        path, and the only one in containers without the native lib."""
+        if getattr(self, "_closed", False):
+            return 0
+        if self._native is not None:
+            self._native.begin(name.encode(), category.encode(), tid)
+            return 0
+        aid = next(self._async_ids)
+        ev = {"name": name, "cat": category, "ph": "b", "ts": self._now_us(),
+              "pid": os.getpid(), "tid": tid, "id": f"0x{aid:x}"}
+        with self._lock:
+            self._events.append(ev)
+            self._open_push(self._open_async, (name, category, tid),
+                            (aid, ev["ts"]))
+        return aid
+
+    def end_async(self, name: str, category: str = "activity",
+                  tid: int = 0) -> int:
+        """Close the OLDEST open async span instance of (name, category,
+        lane) — FIFO pairing: interleaved same-name instances render as
+        non-crossing intervals (see the class docstring)."""
+        if getattr(self, "_closed", False):
+            return 0
+        if self._native is not None:
+            self._native.end(name.encode(), category.encode(), tid)
+            return 0
+        with self._lock:
+            q = self._open_async.get((name, category, tid))
+            if q:
+                aid = q.popleft()[0]
+                if not q:
+                    self._open_async.pop((name, category, tid), None)
+            else:
+                aid = next(self._async_ids)  # unmatched end: own id
+            ev = {"name": name, "cat": category, "ph": "e",
+                  "ts": self._now_us(), "pid": os.getpid(), "tid": tid,
+                  "id": f"0x{aid:x}"}
+            self._events.append(ev)
+        return aid
 
     def instant(self, name: str, category: str = "marker"):
         if getattr(self, "_closed", False):
@@ -97,24 +209,54 @@ class Timeline:
         with self._lock:
             self._events.append(ev)
 
+    def open_spans(self) -> List[dict]:
+        """Spans begun but not yet ended — the blackbox dump's "what was
+        in flight" view of the timeline.  Timeout acquire: the dump path
+        runs from fatal-signal handlers on the thread they interrupt; if
+        that thread held this lock mid-begin, blocking would deadlock —
+        an empty open-span list beats a wedged dump."""
+        if not self._lock.acquire(timeout=1.0):
+            return []
+        try:
+            out: List[dict] = []
+            for (name, cat, tid), opens in self._open_sync.items():
+                for ts in opens:
+                    out.append({"name": name, "cat": cat, "tid": tid,
+                                "ts": ts, "flavor": "sync"})
+            for (name, cat, tid), q in self._open_async.items():
+                for aid, ts in q:
+                    out.append({"name": name, "cat": cat, "tid": tid,
+                                "ts": ts, "id": aid, "flavor": "async"})
+            return out
+        finally:
+            self._lock.release()
+
     def _flush_loop(self, interval: float):
         while not self._stop.wait(interval):
             self.flush()
 
     def flush(self):
+        """Drain buffered events and APPEND them to the file (no
+        re-serialization of what is already on disk)."""
+        if self._native is not None:
+            return
         with self._lock:
-            events = list(self._events)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            # Bare trace-event array — the same wire format the native
-            # writer (csrc/timeline.cc) emits, so consumers see one format.
-            json.dump(events, f)
-        os.replace(tmp, self.path)
+            drained, self._events = self._events, []
+        if not drained:
+            return
+        payload = ",\n".join(json.dumps(e) for e in drained)
+        with self._io_lock:
+            if self._finalized:
+                return  # closed under us: the array is already terminated
+            with open(self.path, "a") as f:
+                f.write(("[\n" if not self._wrote_header else ",\n")
+                        + payload)
+            self._wrote_header = True
 
     def close(self):
         # Idempotent: close() runs both explicitly (timeline_stop) and from
-        # atexit; the second call must not fall through to the pure-Python
-        # flush and truncate the file the native writer already finalized.
+        # atexit; the second call must not re-finalize the file the first
+        # one (or the native writer) already terminated.
         if getattr(self, "_closed", False):
             return
         self._closed = True
@@ -123,7 +265,14 @@ class Timeline:
             self._native = None
             return
         self._stop.set()
+        if getattr(self, "_thread", None) is not None:
+            self._thread.join(timeout=5.0)
         self.flush()
+        with self._io_lock:
+            if not self._finalized:
+                with open(self.path, "a") as f:
+                    f.write("\n]\n" if self._wrote_header else "[]\n")
+                self._finalized = True
 
 
 def _try_native(path: str):
@@ -281,14 +430,20 @@ def device_stage(x, name: str, *, phase: str = "B",
     spans along a data-dependence path, but it does NOT order two
     data-INDEPENDENT instrumented collectives in one step (e.g. gradient
     tracking's y-mix and params-mix) against each other: their same-name
-    B/E pairs may interleave in a lane, which Chrome-trace B/E matching
-    renders with crossed durations.  ``ordered=True`` would serialize
-    those too, but its runtime token is threaded through the compiled
-    program as an extra entry parameter and XLA's sharding propagation
-    CHECK-fails (hard process abort, not an exception) whenever the
-    jitted step takes more than one argument
-    (``allow-spmd-sharding-propagation-to-parameters-vector's size``) —
-    a mis-nested trace beats a dead process.
+    pairs may interleave in a lane.  Spans are therefore emitted as
+    chrome **async** events (``ph: "b"/"e"``, unique ``id`` per span
+    instance, FIFO-paired per lane — :meth:`Timeline.begin_async`), so
+    interleaved instances can never render as crossed durations the way
+    B/E name-matching did.  ``ordered=True`` would serialize the
+    callbacks themselves, but its runtime token is threaded through the
+    compiled program as an extra entry parameter and XLA's sharding
+    propagation CHECK-fails (hard process abort, not an exception)
+    whenever the jitted step takes more than one argument
+    (``allow-spmd-sharding-propagation-to-parameters-vector's size``).
+
+    When the blackbox flight recorder is on (its default), each event is
+    additionally recorded into the ring buffer (kind ``device_stage``) so
+    a hang dump shows the last device-side activity this rank saw.
 
     Trace-time gated: when no timeline is active at *trace* time this is the
     identity with zero HLO footprint (enable the timeline before building
@@ -303,51 +458,28 @@ def device_stage(x, name: str, *, phase: str = "B",
     tl = _get()
     if tl is None or getattr(_suppress_stage, "on", False):
         return x
-    import jax
+    import numpy as np
     from jax import lax
-    from jax.experimental import io_callback
+
+    from bluefog_tpu.utils.stamping import stamp
 
     rank = lax.axis_index(axis_name) if axis_name is not None else 0
 
-    import numpy as np
-
     def cb(_tok, r):
-        (tl.begin if phase == "B" else tl.end)(name, category, tid=int(r))
+        (tl.begin_async if phase == "B" else tl.end_async)(
+            name, category, tid=int(r))
+        try:
+            from bluefog_tpu.blackbox import recorder as _bb
+
+            rec = _bb.get()
+            if rec is not None:
+                rec.record("device_stage", name=name, phase=phase,
+                           rank=int(r))
+        except Exception:
+            pass
         return np.float32(0.0)
 
-    # custom_jvp shell: io_callback has no JVP rule, so without this a
-    # timeline-active trace would make every instrumented collective
-    # non-differentiable.  The callback fires on the primal; tangents pass
-    # straight through (identity — linear, so reverse-mode transposes too).
-    @jax.custom_jvp
-    def stamped(y):
-        leaves = [l for l in jax.tree_util.tree_leaves(y)
-                  if hasattr(l, "ravel")]
-        token = sum((l.ravel()[0].astype("float32") for l in leaves),
-                    start=jax.numpy.float32(0)) if leaves else 0
-        zero = io_callback(cb, jax.ShapeDtypeStruct((), jax.numpy.float32),
-                           token, rank, ordered=False)
-        # Fold the callback's zero result into one arithmetic leaf: the
-        # dataflow edge orders the span before everything that consumes
-        # this result (see the ordering note and its limits in the
-        # docstring) and pins the callback against DCE by construction.
-        def fold(tree):
-            folded = [False]
-
-            def one(l):
-                if (not folded[0] and hasattr(l, "dtype")
-                        and jax.numpy.issubdtype(l.dtype, jax.numpy.number)):
-                    folded[0] = True
-                    return l + zero.astype(l.dtype)
-                return l
-
-            return jax.tree_util.tree_map(one, tree)
-
-        return fold(y)
-
-    @stamped.defjvp
-    def _stamped_jvp(primals, tangents):
-        (y,), (t,) = primals, tangents
-        return stamped(y), t
-
-    return stamped(x)
+    # fire-after-data, order-by-dataflow, custom_jvp differentiability:
+    # the shared stamping shell (utils/stamping.py) — see its module
+    # docstring for the contract and the ordered-effects abort it avoids
+    return stamp(x, cb, rank)

@@ -8,8 +8,15 @@ hence at module import time here.
 
 import functools
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env may point at a TPU
+# blackbox host-path recording is on by default, and failure-path tests
+# legitimately trigger dumps — route them to a scratch dir instead of
+# littering ./blackbox in the repo (tests that care set their own dir)
+if "BLUEFOG_TPU_BLACKBOX_DIR" not in os.environ:
+    os.environ["BLUEFOG_TPU_BLACKBOX_DIR"] = tempfile.mkdtemp(
+        prefix="bf-blackbox-test-")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

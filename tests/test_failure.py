@@ -70,9 +70,13 @@ class TestHeartbeat:
                         time.sleep(0.01)
             return {"x": x}
 
+        # timeout must comfortably exceed one orbax save (observed up to
+        # ~1.1 s in this container under load): a deadline tighter than a
+        # save can fire MID-SAVE before the first beat, injecting
+        # HangError into the checkpoint machinery instead of the wedge
         out = run_with_restart(
             train, mgr, {"x": np.zeros(2)}, max_restarts=2,
-            recoverable=(), heartbeat_timeout_s=0.3, heartbeat_grace_s=10.0)
+            recoverable=(), heartbeat_timeout_s=3.0, heartbeat_grace_s=10.0)
         mgr.close()
         # attempt 1 started at 0 and wedged after saving step 3;
         # attempt 2 resumed at 4 and finished

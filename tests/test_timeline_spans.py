@@ -67,17 +67,26 @@ def test_gossip_rounds_emit_runtime_spans(tmp_path):
 
     events = [e for e in _load_events(trace)
               if e["name"] == "bf.neighbor_allreduce"]
-    begins = [e for e in events if e["ph"] == "B"]
-    ends = [e for e in events if e["ph"] == "E"]
-    # one B and one E per rank per step, in per-rank lanes
+    # device_stage emits chrome ASYNC events (ph b/e with per-instance
+    # ids) so same-name instances can never render as crossed durations
+    begins = [e for e in events if e["ph"] == "b"]
+    ends = [e for e in events if e["ph"] == "e"]
+    # one b and one e per rank per step, in per-rank lanes
     assert len(begins) == steps * N, (len(begins), steps * N)
     assert len(ends) == steps * N
     assert {e["tid"] for e in events} == set(range(N))
     for tid in range(N):
-        lane = sorted((e["ts"], e["ph"]) for e in events if e["tid"] == tid)
-        phases = [ph for _, ph in lane]
-        assert phases[0] == "B" and phases[-1] == "E"
-        assert phases.count("B") == steps and phases.count("E") == steps
+        lane = sorted((e["ts"], e["ph"], e["id"]) for e in events
+                      if e["tid"] == tid)
+        phases = [ph for _, ph, _ in lane]
+        assert phases[0] == "b" and phases[-1] == "e"
+        assert phases.count("b") == steps and phases.count("e") == steps
+        # every span instance has a unique id, opened exactly once and
+        # closed exactly once — the no-mis-nest guarantee
+        b_ids = [i for _, ph, i in lane if ph == "b"]
+        e_ids = [i for _, ph, i in lane if ph == "e"]
+        assert len(set(b_ids)) == steps
+        assert sorted(b_ids) == sorted(e_ids)
 
 
 def test_no_timeline_no_callbacks():
@@ -158,7 +167,7 @@ def test_hierarchical_spans(tmp_path):
         T.timeline_stop()
     events = [e for e in _load_events(trace)
               if e["name"] == "bf.hierarchical_neighbor_allreduce"]
-    assert {e["ph"] for e in events} == {"B", "E"}
+    assert {e["ph"] for e in events} == {"b", "e"}
 
 
 def test_window_op_spans(tmp_path):
@@ -190,7 +199,7 @@ def test_window_op_spans(tmp_path):
     for name in ("bf.win_put", "bf.win_accumulate", "bf.win_get",
                  "bf.win_update", "bf.win_update_then_collect"):
         events = [e for e in _load_events(trace) if e["name"] == name]
-        assert {e["ph"] for e in events} == {"B", "E"}, name
+        assert {e["ph"] for e in events} == {"b", "e"}, name
 
 
 def test_hierarchical_2d_spans(tmp_path):
@@ -214,7 +223,7 @@ def test_hierarchical_2d_spans(tmp_path):
         T.timeline_stop()
     events = [e for e in _load_events(trace)
               if e["name"] == "bf.hierarchical_neighbor_allreduce_2d"]
-    assert {e["ph"] for e in events} == {"B", "E"}
+    assert {e["ph"] for e in events} == {"b", "e"}
     assert {e["tid"] for e in events} == set(range(N))
 
 
@@ -273,3 +282,55 @@ def test_concurrent_same_name_activities_are_thread_safe(tmp_path):
     events = [e for e in _load_events(trace) if e["name"] == "shared_span"]
     assert len([e for e in events if e["ph"] == "B"]) == 300
     assert len([e for e in events if e["ph"] == "E"]) == 300
+
+
+def test_interleaved_async_spans_never_cross(tmp_path):
+    """Two data-independent same-name span instances landing b b e e in
+    one lane: FIFO id pairing must produce two NON-crossing intervals
+    (the old B/E name-matching rendered them crossed)."""
+    trace = str(tmp_path / "trace_x.json")
+    tl = T.Timeline(trace, flush_interval_s=60)
+    tl.begin_async("gossip", "g", tid=3)
+    tl.begin_async("gossip", "g", tid=3)
+    assert len(tl.open_spans()) == 2
+    tl.end_async("gossip", "g", tid=3)
+    tl.end_async("gossip", "g", tid=3)
+    assert tl.open_spans() == []
+    tl.close()
+    events = [e for e in _load_events(trace) if e["name"] == "gossip"]
+    assert [e["ph"] for e in events] == ["b", "b", "e", "e"]
+    # FIFO: first end closes the FIRST begin — intervals nest/abut, never
+    # cross, and each instance id appears exactly once per phase
+    assert events[0]["id"] == events[2]["id"]
+    assert events[1]["id"] == events[3]["id"]
+    assert events[0]["id"] != events[1]["id"]
+
+
+def test_flush_is_incremental_append(tmp_path):
+    """flush() drains and APPENDS only the new events instead of
+    rewriting the whole array each time (O(n^2) IO over a long run);
+    close() terminates the array into valid JSON."""
+    trace = str(tmp_path / "trace_f.json")
+    tl = T.Timeline(trace, flush_interval_s=3600)  # flusher effectively off
+    for i in range(100):
+        tl.instant(f"ev{i}")
+    tl.flush()
+    size1 = os.path.getsize(trace)
+    tl.flush()  # nothing new: the file must not be touched
+    assert os.path.getsize(trace) == size1
+    for i in range(100, 110):
+        tl.instant(f"ev{i}")
+    tl.flush()
+    size2 = os.path.getsize(trace)
+    # the second batch appended far less than a full rewrite would have
+    assert size1 < size2 < 2 * size1
+    tl.close()
+    events = _load_events(trace)
+    assert [e["name"] for e in events] == [f"ev{i}" for i in range(110)]
+
+
+def test_empty_timeline_closes_to_valid_json(tmp_path):
+    trace = str(tmp_path / "trace_e.json")
+    tl = T.Timeline(trace, flush_interval_s=3600)
+    tl.close()
+    assert _load_events(trace) == []
