@@ -19,6 +19,9 @@ This package checks all of that *before* anything runs:
   step function and walks the closed jaxpr for ``ppermute``/``psum``
   equations, verifying permutation bijectivity (deadlock-freedom), axis
   hygiene, host callbacks on the hot path, and buffer donation.
+- :mod:`~bluefog_tpu.analysis.window_lint` — BF-WIN source lint: loops
+  issuing pipelined (fire-and-forget) DCN window deposits must ``flush()``
+  before their audit barrier, or the mass audit silently leaks.
 - :mod:`~bluefog_tpu.analysis.lint` — the CLI
   (``python -m bluefog_tpu.analysis.lint``) running every pass over the
   repo's own topologies, optimizers, and examples; exits nonzero on
@@ -46,6 +49,7 @@ from bluefog_tpu.analysis.jaxpr_lint import (
     lint_jaxpr,
     lint_step_fn,
 )
+from bluefog_tpu.analysis.window_lint import check_pipelined_flush
 
 __all__ = [
     "Diagnostic",
@@ -65,4 +69,5 @@ __all__ = [
     "check_permutation",
     "lint_jaxpr",
     "lint_step_fn",
+    "check_pipelined_flush",
 ]

@@ -274,6 +274,39 @@ def comm_lint_pass(report: LintReport, size: int) -> None:
         name="gossip_train_step"))
 
 
+def window_pass(report: LintReport, size: int) -> None:
+    """BF-WIN source lint over the surfaces that issue pipelined window
+    deposits: the async runtime itself plus every example/benchmark that
+    could copy its loop shape.  A dsgd/gossip loop that fires
+    ``deposit_async`` and reaches its audit barrier without a ``flush()``
+    fence is an error (see :mod:`bluefog_tpu.analysis.window_lint`)."""
+    import glob
+
+    from bluefog_tpu.analysis.window_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # NOT window_server.py itself: the transport's own delegation
+    # wrappers (PipelinedRemoteWindow.deposit_async forwarding to its
+    # stream) can never contain a fence by construction — the lint is
+    # for USERS of the pipelined API
+    targets = [
+        os.path.join(root, "bluefog_tpu", "runtime", "async_windows.py"),
+    ]
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-WIN100",
+        f"window-lint scanned {n} file(s) for unfenced pipelined deposits",
+        pass_name="window-lint", subject="runtime"))
+
+
 _EXAMPLE_CONSTRUCTORS = (
     "ExponentialTwoGraph",
     "ExponentialGraph",
@@ -354,6 +387,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     topology_pass(report, size)
     dynamic_pass(report, size)
     collective_id_pass(report, size)
+    window_pass(report, size)
     examples_pass(report, size)
     if trace:
         comm_lint_pass(report, size)

@@ -1,0 +1,177 @@
+"""BF-WIN lint: pipelined window deposits must be fenced before barriers.
+
+The pipelined DCN transport (:class:`bluefog_tpu.runtime.window_server.
+PipelinedRemoteWindow`) makes ``deposit_async`` fire-and-forget: the
+payload is still queued or on the wire when the call returns.  Every
+correctness audit in the async runners leans on a "no rank deposits after
+this barrier" line (the dsgd mass-conservation drain), which is only true
+if the loop FENCES — calls ``flush()`` on its peer handles — before
+entering that barrier.  Forgetting the fence is not a crash: it is a
+silently leaky mass audit that fails rarely, under load, on the slowest
+peer.  Exactly the kind of bug a lint should catch at review time.
+
+This pass is a *source* lint (AST), not a jaxpr lint — the async loops are
+host Python.  The rule, per function:
+
+- **pipelined-deposit sites** are calls of an attribute named
+  ``deposit_async``, plus ``.deposit(...)`` calls on names bound from a
+  ``PipelinedRemoteWindow(...)`` construction in the same function;
+- **final-barrier sites** are ``<x>.wait("<stage>")`` calls whose first
+  argument is a string literal (the :class:`FileBarrier` idiom);
+- **fences** are calls of an attribute named ``flush``.
+
+BF-WIN001 (error): a function issues pipelined deposits and then reaches
+a barrier with no fence between the first deposit and the first
+subsequent barrier.  BF-WIN002 (warning): a function issues pipelined
+deposits and never fences at all (no barrier either — the handle may
+escape, but a loop-local handle that is never flushed usually means the
+fence lives in no one's code).  BF-WIN100 (info): scan summary.
+
+Line numbers approximate dominance (Python source order); that is the
+right fidelity for a lint — the seeded-violation test pins the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_pipelined_flush", "check_file"]
+
+_PIPELINED_CTORS = ("PipelinedRemoteWindow",)
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+class _FuncScan(ast.NodeVisitor):
+    """Collect deposit/fence/barrier call lines within ONE function body
+    (nested defs are scanned separately — their fences do not fence us)."""
+
+    def __init__(self):
+        self.deposits: List[int] = []
+        self.flushes: List[int] = []
+        self.barriers: List[int] = []
+        self.pipelined_names: set = set()
+
+    def visit_Assign(self, node: ast.Assign):
+        v = node.value
+        if isinstance(v, ast.Call) and _call_name(v) in _PIPELINED_CTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.pipelined_names.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "deposit_async":
+                self.deposits.append(node.lineno)
+            elif (f.attr == "deposit" and isinstance(f.value, ast.Name)
+                  and f.value.id in self.pipelined_names):
+                self.deposits.append(node.lineno)
+            elif f.attr == "flush":
+                self.flushes.append(node.lineno)
+            elif (f.attr == "wait" and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)):
+                self.barriers.append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # do not descend into nested defs
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _scan_function(fn: ast.AST, name: str, filename: str, *,
+                   nested: bool = False) -> List[Diagnostic]:
+    scan = _FuncScan()
+    for stmt in fn.body:  # type: ignore[attr-defined]
+        scan.visit(stmt)
+    if not scan.deposits:
+        return []
+    d0 = min(scan.deposits)
+    barriers_after = sorted(b for b in scan.barriers if b > d0)
+    diags: List[Diagnostic] = []
+    if barriers_after:
+        b0 = barriers_after[0]
+        if not any(d0 < f <= b0 for f in scan.flushes):
+            diags.append(Diagnostic(
+                "error", "BF-WIN001",
+                f"{name} (at {filename}:{d0}) issues pipelined window "
+                f"deposits (deposit_async) but reaches its barrier at "
+                f"line {b0} with no flush() fence in between — in-flight "
+                "deposits can land after the owners' final drain and "
+                "break the exactly-once mass audit",
+                pass_name="window-lint", subject=name))
+    elif not scan.flushes and not nested:
+        # nested defs are exempt from the never-fenced warning: a
+        # deposit closure whose CALLER fences (the bench's one_round
+        # shape) is idiomatic, and the enclosing function is scanned in
+        # its own right
+        diags.append(Diagnostic(
+            "warning", "BF-WIN002",
+            f"{name} (at {filename}:{d0}) issues pipelined window "
+            "deposits and never fences them (no flush() in the "
+            "function) — if no caller flushes the handle, deposits may "
+            "still be in flight when results are read",
+            pass_name="window-lint", subject=name))
+    return diags
+
+
+def check_pipelined_flush(source: str, *, filename: str = "<source>"
+                          ) -> List[Diagnostic]:
+    """Lint one Python source blob for the fence-before-barrier rule."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-WIN003",
+            f"could not parse {filename}: {e}",
+            pass_name="window-lint", subject=filename)]
+    diags: List[Diagnostic] = []
+    short = os.path.basename(filename)
+    # nested defs (closures) are scanned too, but flagged differently —
+    # collect which function nodes live inside another function
+    nested_fns = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if sub is not node and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested_fns.add(sub)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            diags.extend(_scan_function(node, node.name, short,
+                                        nested=node in nested_fns))
+    # module level (scripts deposit at top level too)
+    mod = ast.Module(body=[s for s in tree.body
+                           if not isinstance(s, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef,
+                                                 ast.ClassDef))],
+                     type_ignores=[])
+    diags.extend(_scan_function(mod, "<module>", short))
+    # methods live inside ClassDef bodies; walk covers them via the
+    # FunctionDef case above
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return [Diagnostic(
+            "warning", "BF-WIN003", f"could not read {path}: {e}",
+            pass_name="window-lint", subject=os.path.basename(path))]
+    return check_pipelined_flush(src, filename=path)

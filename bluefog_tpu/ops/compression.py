@@ -21,7 +21,12 @@ compression quality; the compressor must be a contraction in expectation:
 
 TPU-first wire format: every payload has a STATIC shape (k values per
 leaf), so the whole round jits into the same ``lax.ppermute`` fabric as
-uncompressed gossip.  ``random_block_k`` uses a **shared-seed mask**: all
+uncompressed gossip.  The HOST transport twin of these operators — same
+top-k value+index format and the same ``_kept`` arithmetic, numpy instead
+of jax so socket threads never trace — lives in
+:mod:`bluefog_tpu.runtime.wire_codec` and compresses the cross-host DCN
+deposit stream (``runtime/window_server.py``); a lockstep test
+(``tests/test_window_transport.py``) keeps the two in agreement.  ``random_block_k`` uses a **shared-seed mask**: all
 ranks derive the same slice offset from the round counter, so the wire
 carries k values and ZERO index bytes — the receiver reconstructs placement
 from the seed.  ``top_k`` is data-dependent, so its payload ships indices

@@ -25,11 +25,14 @@ from bluefog_tpu.runtime.async_windows import (AsyncWindow, FileBarrier,
                                                run_async_pushsum)
 from bluefog_tpu.runtime.launch import initialize_cluster
 from bluefog_tpu.runtime.native import Engine, PyEngine, engine
-from bluefog_tpu.runtime.window_server import RemoteWindow, WindowServer
+from bluefog_tpu.runtime.window_server import (DepositStream,
+                                               PipelinedRemoteWindow,
+                                               RemoteWindow, WindowServer)
 
 __all__ = [
     "initialize_cluster", "Engine", "PyEngine", "engine",
     "AsyncWindow", "TreePacker", "FileBarrier",
     "run_async_pushsum", "run_async_dsgd", "run_async_dsgd_rank",
-    "WindowServer", "RemoteWindow",
+    "WindowServer", "RemoteWindow", "PipelinedRemoteWindow",
+    "DepositStream",
 ]

@@ -126,6 +126,15 @@ class _PyWinTable:
         with self._mu:
             return self._wins.get(name)
 
+    def info(self, name):
+        """(n_slots, n_elems, dtype) or None — the fallback twin of the
+        native ``bf_win_info`` (the TCP window server validates remote
+        geometry through this before touching any buffer)."""
+        w = self._get(name)
+        if w is None:
+            return None
+        return len(w["slots"]), int(w["self"].size), w["self"].dtype
+
     def free(self, name):
         with self._mu:
             return 0 if self._wins.pop(name, None) is not None else -1
@@ -324,6 +333,20 @@ class AsyncWindow:
         _bb.record("window_deposit", window=self.name, slot=slot,
                    bytes=a.size * a.dtype.itemsize, op=op)
         return int(v)
+
+    def deposit_async(self, slot: int, arr: np.ndarray, *,
+                      accumulate: bool = True) -> int:
+        """Pipelined-transport-compatible spelling of :meth:`deposit`.
+        In-process and shm deposits are already one-sided memory writes
+        with nothing in flight afterwards, so this IS the synchronous
+        deposit — the alias exists so loops written against the pipelined
+        DCN handles (``deposit_async`` + :meth:`flush` fence) run
+        unchanged on every transport."""
+        return self.deposit(slot, arr, accumulate=accumulate)
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Fence for :meth:`deposit_async` — a no-op here (deposits land
+        before the call returns on the in-process/shm transports)."""
 
     def read(self, slot: int, *, consume: bool = True
              ) -> Tuple[np.ndarray, int]:
@@ -939,8 +962,9 @@ class _ShmTransport:
 
 
 class _RemoteHandle:
-    """AsyncWindow-shaped adapter over a :class:`RemoteWindow` (geometry
-    captured at open time, as the remote protocol requires it per call)."""
+    """AsyncWindow-shaped adapter over a :class:`RemoteWindow` /
+    :class:`PipelinedRemoteWindow` (geometry captured at open time, as the
+    remote protocol requires it per call)."""
 
     def __init__(self, rw, n_slots: int, n_elems: int):
         self._rw = rw
@@ -952,6 +976,21 @@ class _RemoteHandle:
         return self._rw.deposit(
             slot, np.ascontiguousarray(arr, self.dtype),
             accumulate=accumulate)
+
+    def deposit_async(self, slot, arr, *, accumulate=True):
+        """Fire-and-forget on the pipelined DCN transport; synchronous
+        (equivalent, just not overlapped) on the plain one."""
+        fn = getattr(self._rw, "deposit_async", None)
+        a = np.ascontiguousarray(arr, self.dtype)
+        if fn is None:
+            return self._rw.deposit(slot, a, accumulate=accumulate)
+        return fn(slot, a, accumulate=accumulate)
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Fence for :meth:`deposit_async` (no-op on the sync client)."""
+        fn = getattr(self._rw, "flush", None)
+        if fn is not None:
+            fn(timeout_s)
 
     def read(self, slot, *, consume=True):
         return self._rw.read(slot, self.n_elems, self.dtype, consume=consume)
@@ -967,13 +1006,24 @@ class _TcpTransport:
     """Any-host rank processes: process-local windows served over TCP
     (``runtime/window_server.py``) — the DCN shape of the one-sided path.
     Addresses rendezvous through the barrier directory (one
-    ``winaddr.<rank>`` file per rank)."""
+    ``winaddr.<rank>`` file per rank).
 
-    def __init__(self, bind_host: str = "0.0.0.0"):
+    ``pipeline=True`` (the default) opens peers as
+    :class:`~bluefog_tpu.runtime.window_server.PipelinedRemoteWindow`:
+    deposits are fire-and-forget through a per-peer background sender
+    (batched frames, windowed acks) and the dsgd loop fences with
+    ``flush()`` before its audit barrier.  ``wire_codec`` selects optional
+    DCN wire compression (``"f32"``/``"topk"``) — lossy, so it is opt-in
+    and must stay off when the exact push-sum mass audit matters."""
+
+    def __init__(self, bind_host: str = "0.0.0.0", *, pipeline: bool = True,
+                 wire_codec: Optional[str] = None):
         from bluefog_tpu.runtime.window_server import WindowServer
 
         self._server = WindowServer()
         self._server.start(bind_host)
+        self._pipeline = pipeline
+        self._codec = wire_codec
         self._addrs: Dict[int, Tuple[str, int]] = {}
 
     def create(self, wname: str, n_slots: int, n_elems: int) -> AsyncWindow:
@@ -1009,10 +1059,15 @@ class _TcpTransport:
             self._addrs[r] = (host, int(port))
 
     def open(self, owner: int, wname: str, n_slots: int, n_elems: int):
-        from bluefog_tpu.runtime.window_server import RemoteWindow
+        from bluefog_tpu.runtime.window_server import (PipelinedRemoteWindow,
+                                                       RemoteWindow)
 
-        return _RemoteHandle(RemoteWindow(self._addrs[owner], wname),
-                             n_slots, n_elems)
+        if self._pipeline:
+            rw = PipelinedRemoteWindow(self._addrs[owner], wname,
+                                       codec=self._codec)
+        else:
+            rw = RemoteWindow(self._addrs[owner], wname)
+        return _RemoteHandle(rw, n_slots, n_elems)
 
     def close(self) -> None:
         self._server.stop()
@@ -1032,6 +1087,7 @@ def run_async_dsgd_rank(
     poll_interval_s: float = 0.0,
     transport: str = "shm",
     tcp_bind: str = "0.0.0.0",
+    wire_codec: Optional[str] = None,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -1046,10 +1102,16 @@ def run_async_dsgd_rank(
     the loop itself is rendezvous-free, which is the entire point).
 
     ``transport`` selects the deposit fabric: ``"shm"`` (named shared
-    memory — same-host ranks) or ``"tcp"`` (each process serves its
+    memory — same-host ranks), ``"tcp"`` (each process serves its
     process-local windows via :class:`~bluefog_tpu.runtime.window_server.
     WindowServer`; ranks may live on DIFFERENT HOSTS as long as the
-    barrier directory is shared, e.g. NFS — the DCN deployment shape).
+    barrier directory is shared, e.g. NFS — the DCN deployment shape;
+    deposits ride the PIPELINED batched client and the loop fences with
+    ``flush()`` before the audit barrier), or ``"tcp-sync"`` (the
+    unpipelined per-deposit round-trip wire, kept for A/B measurement).
+    ``wire_codec`` (``"f32"``/``"topk"``, tcp only) turns on lossy DCN
+    wire compression — leave ``None`` whenever the exact mass audit
+    matters, as in these runners' reports.
 
     The algorithm, mass-conservation invariant, and bias caveats are those
     of :func:`run_async_dsgd` (subgradient-push); ``skew_s`` is this rank's
@@ -1063,9 +1125,15 @@ def run_async_dsgd_rank(
     if transport == "shm":
         tx = _ShmTransport()
     elif transport == "tcp":
-        tx = _TcpTransport(tcp_bind)
+        tx = _TcpTransport(tcp_bind, pipeline=True, wire_codec=wire_codec)
+    elif transport == "tcp-sync":
+        # the pre-pipelining wire shape (one blocking round-trip per
+        # deposit) — kept selectable for A/B measurement and bisection
+        tx = _TcpTransport(tcp_bind, pipeline=False)
     else:
-        raise ValueError(f"transport must be 'shm' or 'tcp', got {transport!r}")
+        raise ValueError(
+            f"transport must be 'shm', 'tcp' or 'tcp-sync', got "
+            f"{transport!r}")
     # the transport may already hold live resources (the TCP server thread +
     # socket start in its constructor): EVERYTHING from here on — including
     # setup failures like a TreePacker TypeError or a window-name collision
@@ -1164,7 +1232,11 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         payload[-1] = p
         payload *= frac
         for j in out_nbrs:
-            peers[j].deposit(peer_slot[j], payload, accumulate=True)
+            # fire-and-forget on the pipelined DCN transport: the
+            # background sender overlaps the wire with the next gradient
+            # step; the payload buffer is snapshotted at enqueue, so its
+            # reuse on the next iteration is safe
+            peers[j].deposit_async(peer_slot[j], payload, accumulate=True)
         x *= frac
         p *= frac
         if rec is not None:
@@ -1176,6 +1248,14 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         steps += 1
         if skew_s > 0 or poll_interval_s > 0:
             time.sleep(skew_s + poll_interval_s)
+    # FENCE before the audit barrier: every pipelined deposit must be
+    # acknowledged as APPLIED by its owner before this rank declares "I
+    # deposit no more" — otherwise in-flight mass would land after the
+    # owners' final drain and break the exactly-once mass audit.  The
+    # BF-WIN lint (analysis/window_lint.py) errors on loops that skip
+    # this.
+    for _j, _h in sorted(peers.items()):
+        _h.flush()
     # no rank deposits after this barrier, so the drain below is exact
     barrier.wait("stopped")
     wall = time.perf_counter() - t0

@@ -64,7 +64,12 @@ def build(force: bool = False) -> Optional[str]:
             cmd = [
                 "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
                 "-Wall", "-o", tmp,
-            ] + [os.path.join(_CSRC, s) for s in _SOURCES]
+            ] + [os.path.join(_CSRC, s) for s in _SOURCES] + [
+                # librt: shm_open/shm_unlink live there on pre-2.34 glibc;
+                # omitting it builds a .so whose shm windows fail to dlopen
+                # ("undefined symbol: shm_open") on those hosts
+                "-lrt",
+            ]
             try:
                 try:
                     proc = subprocess.run(

@@ -1,4 +1,4 @@
-"""Cross-HOST one-sided window transport: TCP deposits into the native table.
+"""Cross-HOST one-sided window transport: pipelined TCP deposits (wire v2).
 
 The passive-target window story by deployment scope (upstream
 ``bluefog/common/mpi_controller.cc`` Win* — ``MPI_Put`` lands anywhere in
@@ -10,65 +10,124 @@ the job; SURVEY.md §3.4):
   (``AsyncWindow(shm=True)``);
 - **separate hosts (DCN)** — THIS module: every process can run one
   :class:`WindowServer` exposing its windows on a TCP port; peers hold a
-  :class:`RemoteWindow` and deposit/read with no receiver involvement
-  beyond the server's daemon thread (the MPI progress-thread analog).
-  Within a TPU slice the device-side transport remains the Pallas RDMA
-  kernels; this is the host path that crosses slice/DCN boundaries, where
-  the reference used MPI over the cluster fabric.
+  :class:`RemoteWindow` (synchronous, one round-trip per op) or a
+  :class:`PipelinedRemoteWindow` (a per-peer background sender — the
+  userspace analog of the reference's MPI progress thread) and deposit
+  with no receiver involvement beyond the server's daemon thread.
 
-Wire protocol (little-endian, one request per round-trip):
+Wire protocol **v2** (little-endian).  Every frame starts
+``magic u32 | op u8 | name_len u16``; per-op bodies follow:
 
-  request:  magic u32 | op u8 | name_len u16 | name utf-8 |
-            slot i32 | flags u8 | dtype u8 | n_elems i64 | payload
-  response: status i64 (>=0 ok / deposit-count; <0 error) |
-            [GET_SELF only: dtype u8 | n_elems i64 | payload]
+  0 DEPOSIT      name | slot i32, flags u8, dtype u8, n_elems i64 | payload
+                 flags bit0 = accumulate, bit1 = deferred-ack (no status
+                 reply; errors latch per connection until FLUSH).
+                 reply (unless deferred): status i64.
+  1 GET_SELF     as v1: reply status i64 | dtype u8, n_elems i64 | payload
+  2 READ_SLOT    as v1 (flags bit0 = consume; status carries fresh-count)
+  3 HELLO        name_len == 0 | version u16, features u32.
+                 reply status i64 = negotiated feature mask (>= 0) or
+                 a negative error (wrong version).
+  4 DEPOSIT_BATCH  name_len == 0 | seq u32, count u32, then ``count``
+                 items, each ``name_len u16, slot i32, flags u8,
+                 dtype u8, codec u8, n_elems i64, wire_bytes i64, name,
+                 payload[wire_bytes]`` — ONE framed message for every
+                 slot/leaf bound for this peer in a round, ONE ack:
+                 ``seq u32 | status i64`` (items applied, or the first
+                 error; per-item ``wire_bytes`` keeps the stream
+                 parseable past a bad item, so one rejected deposit
+                 cannot desync its neighbors).
+  5 FLUSH        name_len == 0, no body.  reply status i64 = deposits
+                 applied on this connection since the last FLUSH, or the
+                 first latched deferred error (then cleared).
 
-ops: 0 = DEPOSIT (flags bit0 = accumulate), 1 = GET_SELF, 2 = READ_SLOT
-(flags bit0 = consume; response carries the fresh-count as status and the
-slot payload).  dtype: 0 = f32, 1 = f64 (the native table's types).
+Version negotiation is LOUD, never silent: a v2 server answers a v1-magic
+frame with one ``status = -101`` reply and drops the connection (the v1
+client surfaces it as a clear ``RuntimeError``), and rejects any HELLO
+whose version is not 2 the same way.  A v2 client talking to an old
+server gets its connection dropped at the first frame (the v1 server's
+magic check) and reports the likely version skew.
 
-Connections are persistent (a peer ranks' deposit stream reuses one
-socket); the server is a daemon ``ThreadingTCPServer`` writing straight
-into the process's native window table, so owner threads never
-participate in a transfer — deposits land while the owner computes.
+Zero-copy discipline: clients send scatter-gather ``sendmsg`` from
+memoryviews (no ``tobytes()``, no frame-assembly join); the server
+receives payloads with ``recv_into`` into per-connection reusable numpy
+buffers and deposits straight from them into the window table (no
+intermediate ``bytes``), and reads are served from a reusable reply
+buffer.  Optional wire compression (f32 downcast / top-k; negotiated via
+the HELLO feature mask, selected per item) lives in
+:mod:`bluefog_tpu.runtime.wire_codec`.
+
+The server writes into the native window table when the native runtime is
+available, and into the in-process pure-Python fallback table otherwise —
+the same dispatch :class:`~bluefog_tpu.runtime.async_windows.AsyncWindow`
+uses, so the TCP path (and its tests/bench) works on hosts without a C++
+toolchain.
 
 Trust model, stated plainly: the protocol is UNAUTHENTICATED (a magic
 word rejects accidental cross-talk, nothing more) — the same posture as
 the MPI/NCCL transports it replaces, which also trust the cluster
 network.  Bind to a cluster-internal interface (``start(host=...)``);
 never expose the port beyond the training fabric.  Malformed requests
-cannot corrupt the owner (geometry is validated against the window's
-actual shape before any allocation or native call), but a network-level
-writer CAN deposit garbage values, as it can with MPI.
+cannot corrupt or OOM the owner (geometry is validated against the
+window's actual shape, and claimed lengths are bounded before any
+allocation), but a network-level writer CAN deposit garbage values, as
+it can with MPI.
 """
 
 from __future__ import annotations
 
+import collections
 import ctypes
+import itertools
 import socket
 import socketserver
 import struct
 import threading
-from typing import Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
-from bluefog_tpu.runtime import native
-from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS
+from bluefog_tpu.runtime import native, wire_codec
+from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS, _fallback
 
-__all__ = ["WindowServer", "RemoteWindow"]
+__all__ = ["WindowServer", "RemoteWindow", "PipelinedRemoteWindow",
+           "DepositStream", "PROTOCOL_VERSION"]
 
-_MAGIC = 0xBF_51_0E_01
+_MAGIC = 0xBF_51_0E_02      # wire v2
+_MAGIC_V1 = 0xBF_51_0E_01   # recognized only to reject it loudly
+PROTOCOL_VERSION = 2
+
 _HDR = struct.Struct("<IBH")          # magic, op, name_len
 _BODY = struct.Struct("<iBBq")        # slot, flags, dtype, n_elems
 _STATUS = struct.Struct("<q")
 _SELF_HDR = struct.Struct("<Bq")      # dtype, n_elems
+_HELLO = struct.Struct("<HI")         # version, features
+_BATCH_HDR = struct.Struct("<II")     # seq, count
+_ITEM = struct.Struct("<HiBBBqq")     # name_len, slot, flags, dtype,
+                                      # codec, n_elems, wire_bytes
+_ACK = struct.Struct("<Iq")           # seq, status
 
 _OP_DEPOSIT = 0
 _OP_GET_SELF = 1
 _OP_READ_SLOT = 2
+_OP_HELLO = 3
+_OP_DEPOSIT_BATCH = 4
+_OP_FLUSH = 5
+
+_FLAG_ACCUMULATE = 1
+_FLAG_DEFERRED_ACK = 2
+
+# HELLO feature bits (server replies with the granted intersection)
+FEATURE_BATCH = 1
+FEATURE_CODEC_F32 = 2
+FEATURE_CODEC_TOPK = 4
+_SERVER_FEATURES = FEATURE_BATCH | FEATURE_CODEC_F32 | FEATURE_CODEC_TOPK
+
+_CODEC_FEATURE = {wire_codec.CODEC_NONE: 0,
+                  wire_codec.CODEC_F32: FEATURE_CODEC_F32,
+                  wire_codec.CODEC_TOPK: FEATURE_CODEC_TOPK}
 
 # the ONE dtype-id table (async_windows owns np.dtype -> id; invert here)
 _DTYPES = {v: k for k, v in _DTYPE_IDS.items()}
@@ -77,6 +136,24 @@ _DTYPES = {v: k for k, v in _DTYPE_IDS.items()}
 _ERR_GEOMETRY = -2   # dtype/n_elems disagree with the window's geometry
 _ERR_NO_WINDOW = -3
 _ERR_BAD_OP = -100
+_ERR_VERSION = -101  # protocol version mismatch (v1 frame / bad HELLO)
+_ERR_CODEC = -102    # codec not granted for this connection / bad payload
+_ERR_TOO_LARGE = -104  # claimed length exceeds any legal encoding
+
+_ERR_TEXT = {
+    _ERR_GEOMETRY: "size/dtype mismatch with the window's geometry",
+    _ERR_NO_WINDOW: "no such window on the serving host",
+    _ERR_BAD_OP: "unparseable request",
+    _ERR_VERSION: (f"protocol version mismatch (this client speaks "
+                   f"v{PROTOCOL_VERSION}; peer rejected the handshake)"),
+    _ERR_CODEC: "wire codec not negotiated or payload undecodable",
+    _ERR_TOO_LARGE: "claimed payload length exceeds any legal encoding",
+}
+
+
+def _err_text(rc: int) -> str:
+    return _ERR_TEXT.get(rc, "window missing, slot out of range, or "
+                         "size/dtype mismatch")
 
 
 def _routable_host() -> str:
@@ -104,16 +181,242 @@ def _routable_host() -> str:
     return "127.0.0.1"  # single-host fallback (tests, laptops)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
+def _recv_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` exactly from the socket (no intermediate bytes)."""
+    got, n = 0, len(view)
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed mid-message")
         got += r
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Small fixed-size header reads only — payloads go via _recv_into."""
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
     return bytes(buf)
+
+
+_IOV_CHUNK = 512  # comfortably under any platform's IOV_MAX
+
+
+def _sendmsg_all(sock: socket.socket, views: List) -> int:
+    """Scatter-gather send of the whole frame, handling partial sends and
+    the kernel's iovec limit.  ``views`` are bytes / byte-cast
+    memoryviews; nothing is ever joined into one buffer."""
+    views = collections.deque(
+        mv for mv in (v if isinstance(v, memoryview) else memoryview(v)
+                      for v in views) if len(mv))
+    total = sum(len(v) for v in views)
+    if not hasattr(sock, "sendmsg"):  # exotic platforms: still no join
+        for v in views:
+            sock.sendall(v)
+        return total
+    sent_total = 0
+    while views:
+        batch = list(itertools.islice(views, _IOV_CHUNK))  # peek a prefix
+        sent = sock.sendmsg(batch)
+        sent_total += sent
+        while sent:  # advance the deque by exactly the bytes accepted
+            head = views[0]
+            if sent >= len(head):
+                sent -= len(head)
+                views.popleft()
+            else:
+                views[0] = head[sent:]
+                sent = 0
+    return sent_total
+
+
+# ---------------------------------------------------------------------------
+# Window-table dispatch: native runtime when present, pure-Python otherwise
+# ---------------------------------------------------------------------------
+
+
+class _NativeOps:
+    """Server-side window ops over the native table (csrc/windows.cc)."""
+
+    def __init__(self, lib):
+        self._lib = lib
+
+    def info(self, name_b: bytes) -> Optional[Tuple[int, int, int]]:
+        ns = ctypes.c_int()
+        ne = ctypes.c_longlong()
+        dt = ctypes.c_int()
+        if self._lib.bf_win_info(name_b, ctypes.byref(ns), ctypes.byref(ne),
+                                 ctypes.byref(dt)) != 0:
+            return None
+        return ns.value, int(ne.value), dt.value
+
+    def deposit(self, name_b, slot, arr, accumulate) -> int:
+        return self._lib.bf_win_deposit(name_b, slot, arr.ctypes.data,
+                                        arr.size, 1 if accumulate else 0)
+
+    def read(self, name_b, slot, out, consume) -> int:
+        return self._lib.bf_win_read(name_b, slot, out.ctypes.data,
+                                     out.size, 1 if consume else 0)
+
+    def read_self(self, name_b, out) -> int:
+        return self._lib.bf_win_read_self(name_b, out.ctypes.data, out.size)
+
+
+class _PyOps:
+    """Same ops over the in-process pure-Python fallback table — keeps the
+    DCN transport (and its tests/bench) alive on hosts without a C++
+    toolchain, with identical status conventions."""
+
+    def __init__(self):
+        self._table = _fallback()
+
+    def info(self, name_b: bytes) -> Optional[Tuple[int, int, int]]:
+        got = self._table.info(name_b.decode())
+        if got is None:
+            return None
+        n_slots, n_elems, dtype = got
+        return n_slots, n_elems, _DTYPE_IDS[np.dtype(dtype)]
+
+    def deposit(self, name_b, slot, arr, accumulate) -> int:
+        return self._table.deposit(name_b.decode(), slot, arr, accumulate)
+
+    def read(self, name_b, slot, out, consume) -> int:
+        buf, fresh = self._table.read(name_b.decode(), slot, consume)
+        if buf is None:
+            return -1
+        out[:] = buf
+        return fresh
+
+    def read_self(self, name_b, out) -> int:
+        buf = self._table.read_self(name_b.decode())
+        if buf is None:
+            return -1
+        out[:] = buf
+        return 0
+
+
+def _table_ops():
+    lib = native.load()
+    return _NativeOps(lib) if lib is not None else _PyOps()
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class _ApplyWorker:
+    """Per-connection background applier for BATCHED deposits: the handler
+    thread does nothing but ``recv_into`` free buffers and hand them over;
+    this thread decodes + lands them in the window table and sends the
+    batch ack when its last item applied.  Both halves release the GIL
+    (socket reads, numpy copies/adds), so receive of item N+1 genuinely
+    overlaps apply of item N — the server side of the progress-engine
+    story, and where the pipelined transport's throughput edge over the
+    sync wire comes from on the receiving host.
+
+    Hand-off granularity is ONE WIRE BATCH, not one item: the handler
+    accumulates a batch's jobs locally and posts them as a single list,
+    so the two threads pay one queue wake-up per frame instead of one per
+    leaf (per-item ping-pong costs hundreds of microseconds of scheduler
+    latency — more than a small leaf's entire payload).  The bounded
+    batch queue (2 frames) is the memory/backpressure bound: the recv
+    loop blocks when the applier falls two frames behind.  The ack for
+    seq S is sent ONLY after every item of S hit the table — that
+    ordering is what makes the client's ``flush()`` a real fence."""
+
+    _MAX_FREE = 256  # pooled payload buffers kept per connection
+
+    def __init__(self, handler, sock, ops, write_lock, peer):
+        self._handler = handler
+        self._sock = sock
+        self._ops = ops
+        self._wlock = write_lock
+        self._peer = peer
+        import queue as _q
+
+        self._jobs: "_q.Queue" = _q.Queue(maxsize=2)
+        self._closed = False
+        self._free: Dict[int, List[np.ndarray]] = {}
+        self._free_mu = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"bf-win-apply:{peer}")
+        self._thread.start()
+
+    def take_buffer(self, nbytes: int) -> np.ndarray:
+        with self._free_mu:
+            free = self._free.get(nbytes)
+            if free:
+                return free.pop()
+        return np.empty(max(nbytes, 1), np.uint8)
+
+    def _give_buffer(self, buf: np.ndarray) -> None:
+        with self._free_mu:
+            free = self._free.setdefault(buf.nbytes, [])
+            if len(free) < self._MAX_FREE:
+                free.append(buf)
+
+    def submit_batch(self, seq: int, jobs: List) -> None:
+        """One wire batch's jobs (('item', …) / ('err', code) entries, in
+        arrival order); blocks when the applier is two frames behind."""
+        self._jobs.put((seq, jobs))
+
+    def close(self) -> None:
+        import queue as _q
+
+        self._closed = True  # the loop polls this, so no sentinel race
+        try:
+            # best effort wake-up; never block the handler's finish()
+            self._jobs.put_nowait(None)
+        except _q.Full:
+            pass
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        import queue as _q
+
+        h = self._handler
+        while True:
+            try:
+                batch = self._jobs.get(timeout=1.0)
+            except _q.Empty:
+                if self._closed:
+                    return  # drained and told to stop — no thread leak
+                continue
+            if batch is None:
+                return
+            seq, jobs = batch
+            applied = 0
+            first_err = 0
+            for job in jobs:
+                if job[0] == "err":
+                    if not first_err:
+                        first_err = job[1]
+                    continue
+                (_, name_b, slot, flags, dtype_id, codec, n_elems, buf,
+                 nbytes) = job
+                try:
+                    rc = h._apply_deposit(self._ops, name_b, slot, flags,
+                                          dtype_id, codec, n_elems,
+                                          memoryview(buf)[:nbytes])
+                except Exception:
+                    # NOTHING a payload contains may kill the applier: a
+                    # dead applier acks no one and wedges the connection,
+                    # which is strictly worse than a rejected item
+                    rc = _ERR_BAD_OP
+                self._give_buffer(buf)
+                if rc < 0:
+                    if not first_err:
+                        first_err = rc
+                else:
+                    applied += 1
+            _mt.inc("bf_tcp_batches_total", 1.0, peer=self._peer)
+            _bb.record("tcp_batch_deposit", seq=seq, applied=applied,
+                       err=first_err, peer=self._peer)
+            try:
+                with self._wlock:
+                    self._sock.sendall(_ACK.pack(seq, first_err or applied))
+            except OSError:
+                return  # peer gone; the recv loop will notice too
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -124,30 +427,170 @@ class _Handler(socketserver.BaseRequestHandler):
         # what their last deposits were — the receiving end of the
         # one-sided story that the peers' own dumps cannot show
         _bb.record("tcp_connect", peer=self.client_address[0])
+        # reusable per-connection receive/decode scratch (grown once per
+        # high-water mark, then zero allocation on the hot path)
+        self._pay: Dict[int, np.ndarray] = {}     # dtype id -> raw buffer
+        self._dense: Dict[int, np.ndarray] = {}   # dtype id -> decode dst
+        self._out: Dict[int, np.ndarray] = {}     # dtype id -> reply buffer
+        self._name = bytearray(256)
+        self._discard = None  # allocated only if a bad item must be eaten
+        self._deferred_applied = 0
+        self._deferred_err = 0
+        # replies can come from two threads once a batch stream starts
+        # (handler: sync ops; apply worker: batch acks) — serialize writes
+        self._wmu = threading.Lock()
+        self._worker: Optional[_ApplyWorker] = None  # created on 1st batch
+
+    def _send(self, data) -> None:
+        with self._wmu:
+            self.request.sendall(data)
+
+    def _send_views(self, views) -> None:
+        with self._wmu:
+            _sendmsg_all(self.request, views)
 
     def finish(self):
+        if self._worker is not None:
+            self._worker.close()
         self.server.untrack(self.request)  # type: ignore[attr-defined]
         _bb.record("tcp_disconnect", peer=self.client_address[0])
 
-    def _geometry_ok(self, lib, name, dtype, n_elems):
-        """The client's claimed (dtype, n_elems) must MATCH the window's
-        actual geometry before anything is allocated or the native table is
-        touched: the C entry points validate n_elems only and then copy
-        nbytes = n_elems * window_elem_size — a lying dtype would otherwise
-        over-read the payload or overflow the reply buffer, and a huge
-        n_elems would allocate unbounded memory in the owner process."""
-        ns = ctypes.c_int()
-        ne = ctypes.c_longlong()
-        dt = ctypes.c_int()
-        if lib.bf_win_info(name, ctypes.byref(ns), ctypes.byref(ne),
-                           ctypes.byref(dt)) != 0:
-            return _ERR_NO_WINDOW
-        if dt.value != dtype or ne.value != n_elems:
-            return _ERR_GEOMETRY
-        return 0
+    # ------------------------------------------------------------ plumbing
+    def _geometry(self, ops, name_b):
+        return ops.info(name_b)
+
+    def _pay_buf(self, dtype_id: int, nbytes: int) -> np.ndarray:
+        buf = self._pay.get(dtype_id)
+        if buf is None or buf.nbytes < nbytes:
+            buf = np.empty(max(nbytes, 1), np.uint8)
+            self._pay[dtype_id] = buf
+        return buf
+
+    def _dense_buf(self, dtype_id: int, n_elems: int) -> np.ndarray:
+        buf = self._dense.get(dtype_id)
+        if buf is None or buf.size < n_elems:
+            buf = np.empty(max(n_elems, 1), _DTYPES[dtype_id])
+            self._dense[dtype_id] = buf
+        return buf
+
+    def _out_buf(self, dtype_id: int, n_elems: int) -> np.ndarray:
+        buf = self._out.get(dtype_id)
+        if buf is None or buf.size < n_elems:
+            buf = np.empty(max(n_elems, 1), _DTYPES[dtype_id])
+            self._out[dtype_id] = buf
+        return buf
+
+    def _eat(self, sock, nbytes: int) -> None:
+        """Consume and discard ``nbytes`` of payload (bad item in a batch)
+        without allocating proportionally to the claimed length."""
+        if self._discard is None:
+            self._discard = bytearray(1 << 20)
+        mv = memoryview(self._discard)
+        while nbytes > 0:  # strict: a negative count must never turn the
+            # python slice below into a giant read of unsent bytes
+            chunk = min(nbytes, len(mv))
+            _recv_into(sock, mv[:chunk])
+            nbytes -= chunk
+
+    def _recv_name(self, sock, name_len: int) -> bytes:
+        if name_len > len(self._name):
+            self._name = bytearray(name_len)
+        mv = memoryview(self._name)[:name_len]
+        _recv_into(sock, mv)
+        return bytes(mv)
+
+    # ------------------------------------------------------------ handlers
+    def _apply_deposit(self, ops, name_b, slot, flags, dtype_id, codec,
+                       n_elems, payload_mv) -> int:
+        """Decode (if needed) and land one validated deposit; returns the
+        native status (deposit count) or a negative error."""
+        if codec == wire_codec.CODEC_NONE:
+            if len(payload_mv) != n_elems * _DTYPES[dtype_id].itemsize:
+                return _ERR_CODEC  # belt-and-braces; validated upstream
+            # zero-copy: a dtype view over the receive buffer
+            arr = np.frombuffer(payload_mv, _DTYPES[dtype_id],
+                                count=n_elems)
+        else:
+            try:
+                # exact-size VIEW of the grown scratch: decode requires
+                # out.size == n_elems, so handing it the whole buffer
+                # would silently allocate fresh per item
+                arr = wire_codec.decode(
+                    codec, payload_mv, n_elems, _DTYPES[dtype_id],
+                    out=self._dense_buf(dtype_id, n_elems)[:n_elems])
+            except ValueError:
+                return _ERR_CODEC
+        rc = ops.deposit(name_b, slot, arr,
+                         bool(flags & _FLAG_ACCUMULATE))
+        if rc >= 0:
+            nbytes = n_elems * _DTYPES[dtype_id].itemsize
+            _mt.inc("bf_tcp_deposit_bytes_total", nbytes,
+                    window=name_b.decode("utf-8", "replace"),
+                    peer=self.client_address[0])
+            _mt.inc("bf_tcp_deposits_total", 1.0,
+                    peer=self.client_address[0])
+            _bb.record("tcp_deposit", slot=slot, bytes=nbytes,
+                       window=name_b.decode("utf-8", "replace"),
+                       peer=self.client_address[0])
+        return rc
+
+    def _handle_batch(self, ops, sock) -> bool:
+        """One DEPOSIT_BATCH frame; returns False to drop the connection
+        (only when the stream itself is unrecoverable).  The handler
+        thread only validates headers and ``recv_into``s payloads; the
+        per-connection :class:`_ApplyWorker` decodes and lands them, so
+        receiving item N+1 overlaps applying item N.  The ack is emitted
+        by the worker after the batch's last item applied."""
+        if self._worker is None:
+            self._worker = _ApplyWorker(
+                self, sock, ops, self._wmu, self.client_address[0])
+        worker = self._worker
+        seq, count = _BATCH_HDR.unpack(_recv_exact(sock, _BATCH_HDR.size))
+        jobs: List = []
+        for _ in range(count):
+            (name_len, slot, flags, dtype_id, codec, n_elems,
+             wire_bytes) = _ITEM.unpack(_recv_exact(sock, _ITEM.size))
+            if (dtype_id not in _DTYPES or n_elems < 0 or wire_bytes < 0
+                    or codec not in wire_codec.CODEC_NAMES):
+                # lengths are unparseable -> the stream cannot be resynced
+                self._send(_ACK.pack(seq, _ERR_BAD_OP))
+                return False
+            name_b = self._recv_name(sock, name_len)
+            err = 0
+            itemsize = _DTYPES[dtype_id].itemsize
+            if wire_bytes > wire_codec.wire_bytes_bound(n_elems, itemsize):
+                err = _ERR_TOO_LARGE
+            elif (codec == wire_codec.CODEC_NONE
+                  and wire_bytes != n_elems * itemsize) or (
+                      codec == wire_codec.CODEC_F32
+                      and wire_bytes != n_elems * 4):
+                # fixed-length codecs must claim EXACTLY their length: an
+                # under-length dense payload would otherwise blow up in
+                # the applier, and an over-length one smuggle trailing
+                # garbage (topk is variable-length; decode validates it)
+                err = _ERR_GEOMETRY
+            elif not self.server.features_granted(  # type: ignore
+                    self.request, _CODEC_FEATURE.get(codec, 0)):
+                err = _ERR_CODEC
+            else:
+                info = self._geometry(ops, name_b)
+                if info is None:
+                    err = _ERR_NO_WINDOW
+                elif info[2] != dtype_id or info[1] != n_elems:
+                    err = _ERR_GEOMETRY
+            if err:
+                self._eat(sock, wire_bytes)
+                jobs.append(("err", err))
+                continue
+            buf = worker.take_buffer(wire_bytes)
+            _recv_into(sock, memoryview(buf)[:wire_bytes])
+            jobs.append(("item", name_b, slot, flags, dtype_id, codec,
+                         n_elems, buf, wire_bytes))
+        worker.submit_batch(seq, jobs)
+        return True
 
     def handle(self):
-        lib = self.server.lib  # type: ignore[attr-defined]
+        ops = self.server.ops  # type: ignore[attr-defined]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
@@ -157,61 +600,103 @@ class _Handler(socketserver.BaseRequestHandler):
                 except ConnectionError:
                     return  # peer done
                 magic, op, name_len = _HDR.unpack(hdr)
+                if magic == _MAGIC_V1:
+                    # an old client: reject LOUDLY (it is blocked on an
+                    # 8-byte status right now), then drop — never try to
+                    # parse a v1 stream as v2
+                    self._send(_STATUS.pack(_ERR_VERSION))
+                    return
                 if magic != _MAGIC:
                     return  # not ours; drop the connection
-                name = _recv_exact(sock, name_len)
+                if op == _OP_HELLO:
+                    body = _recv_exact(sock, _HELLO.size)
+                    version, features = _HELLO.unpack(body)
+                    if version != PROTOCOL_VERSION:
+                        self._send(_STATUS.pack(_ERR_VERSION))
+                        return
+                    granted = features & _SERVER_FEATURES
+                    self.server.set_features(self.request, granted)  # type: ignore
+                    self._send(_STATUS.pack(granted))
+                    continue
+                if op == _OP_DEPOSIT_BATCH:
+                    if not self._handle_batch(ops, sock):
+                        return
+                    continue
+                if op == _OP_FLUSH:
+                    rc = self._deferred_err or self._deferred_applied
+                    self._deferred_err = 0
+                    self._deferred_applied = 0
+                    _bb.record("tcp_flush", peer=self.client_address[0],
+                               status=rc)
+                    self._send(_STATUS.pack(rc))
+                    continue
+                name = self._recv_name(sock, name_len)
                 slot, flags, dtype, n_elems = _BODY.unpack(
                     _recv_exact(sock, _BODY.size))
-                if dtype not in _DTYPES or op not in (
+                if dtype not in _DTYPES or n_elems < 0 or op not in (
                         _OP_DEPOSIT, _OP_GET_SELF, _OP_READ_SLOT):
-                    sock.sendall(_STATUS.pack(_ERR_BAD_OP))
+                    self._send(_STATUS.pack(_ERR_BAD_OP))
                     return  # cannot even parse the payload; drop
-                err = self._geometry_ok(lib, name, dtype, n_elems)
+                info = self._geometry(ops, name)
+                err = 0
+                if info is None:
+                    err = _ERR_NO_WINDOW
+                elif info[2] != dtype or info[1] != n_elems:
+                    # the client's claimed (dtype, n_elems) must MATCH the
+                    # window's geometry before anything is allocated: the C
+                    # entry points validate n_elems only and copy nbytes =
+                    # n_elems * window_elem_size — a lying dtype would over-
+                    # read the payload or overflow the reply buffer, and a
+                    # huge n_elems would allocate unbounded owner memory
+                    err = _ERR_GEOMETRY
                 if op == _OP_DEPOSIT:
+                    deferred = bool(flags & _FLAG_DEFERRED_ACK)
                     if err:
-                        # the payload is still on the wire and its length
-                        # is client-claimed, so the stream cannot be
-                        # resynced — report and drop the connection
-                        sock.sendall(_STATUS.pack(err))
+                        if deferred:
+                            # the payload length is client-claimed but
+                            # parseable (dense wire): eat it, latch, go on
+                            self._eat(sock,
+                                      n_elems * _DTYPES[dtype].itemsize)
+                            if not self._deferred_err:
+                                self._deferred_err = err
+                            continue
+                        # sync path keeps v1's posture: report and drop
+                        self._send(_STATUS.pack(err))
                         return
                     nbytes = n_elems * _DTYPES[dtype].itemsize
-                    payload = _recv_exact(sock, nbytes)
-                    arr = np.frombuffer(payload, _DTYPES[dtype])
-                    rc = lib.bf_win_deposit(name, slot, arr.ctypes.data,
-                                            n_elems, flags & 1)
-                    sock.sendall(_STATUS.pack(rc))
-                    if rc >= 0:
-                        # per-peer DCN deposit volume, recorded on the
-                        # daemon thread (the registry is thread-safe);
-                        # no-op when metrics are disabled
-                        _mt.inc("bf_tcp_deposit_bytes_total", nbytes,
-                                window=name.decode("utf-8", "replace"),
-                                peer=self.client_address[0])
-                        _mt.inc("bf_tcp_deposits_total", 1.0,
-                                peer=self.client_address[0])
-                        _bb.record(
-                            "tcp_deposit", slot=slot, bytes=nbytes,
-                            window=name.decode("utf-8", "replace"),
-                            peer=self.client_address[0])
+                    buf = self._pay_buf(dtype, nbytes)
+                    mv = memoryview(buf)[:nbytes]
+                    _recv_into(sock, mv)
+                    rc = self._apply_deposit(
+                        ops, name, slot, flags, dtype,
+                        wire_codec.CODEC_NONE, n_elems, mv)
+                    if deferred:
+                        if rc >= 0:
+                            self._deferred_applied += 1
+                        elif not self._deferred_err:
+                            self._deferred_err = rc
+                        continue
+                    self._send(_STATUS.pack(rc))
                     continue
                 if err:
-                    sock.sendall(_STATUS.pack(err))
+                    self._send(_STATUS.pack(err))
                     continue
-                out = np.empty(n_elems, _DTYPES[dtype])
+                out = self._out_buf(dtype, n_elems)[:n_elems]
                 if op == _OP_GET_SELF:
-                    rc = lib.bf_win_read_self(name, out.ctypes.data, n_elems)
+                    rc = ops.read_self(name, out)
                 else:
-                    rc = lib.bf_win_read(name, slot, out.ctypes.data,
-                                         n_elems, flags & 1)
-                sock.sendall(_STATUS.pack(rc))
-                if rc >= 0:
-                    sock.sendall(_SELF_HDR.pack(dtype, n_elems))
-                    sock.sendall(out.tobytes())
-                    _bb.record(
-                        "tcp_read",
-                        op="get_self" if op == _OP_GET_SELF else "read_slot",
-                        slot=slot, window=name.decode("utf-8", "replace"),
-                        peer=self.client_address[0])
+                    rc = ops.read(name, slot, out, bool(flags & 1))
+                if rc < 0:
+                    self._send(_STATUS.pack(rc))
+                    continue
+                self._send_views([
+                    _STATUS.pack(rc), _SELF_HDR.pack(dtype, n_elems),
+                    memoryview(out).cast("B")])
+                _bb.record(
+                    "tcp_read",
+                    op="get_self" if op == _OP_GET_SELF else "read_slot",
+                    slot=slot, window=name.decode("utf-8", "replace"),
+                    peer=self.client_address[0])
         except (ConnectionError, OSError):
             return
 
@@ -223,6 +708,7 @@ class _Server(socketserver.ThreadingTCPServer):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._conns: set = set()
+        self._features: Dict[int, int] = {}  # id(sock) -> granted mask
         self._conns_mu = threading.Lock()
 
     def track(self, sock):
@@ -232,6 +718,17 @@ class _Server(socketserver.ThreadingTCPServer):
     def untrack(self, sock):
         with self._conns_mu:
             self._conns.discard(sock)
+            self._features.pop(id(sock), None)
+
+    def set_features(self, sock, granted: int):
+        with self._conns_mu:
+            self._features[id(sock)] = granted
+
+    def features_granted(self, sock, needed: int) -> bool:
+        if not needed:
+            return True
+        with self._conns_mu:
+            return bool(self._features.get(id(sock), 0) & needed)
 
     def close_connections(self):
         """stop() must QUIESCE: shutting down the accept loop alone leaves
@@ -251,18 +748,15 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class WindowServer:
-    """Expose this process's native windows for remote one-sided access.
+    """Expose this process's windows for remote one-sided access.
 
     ``WindowServer().start()`` binds (default: an ephemeral port on all
     interfaces) and serves deposits/reads on daemon threads.  The address
-    to hand to peers is ``.address``.  Requires the native runtime (the
-    same table the shm and in-process paths use)."""
+    to hand to peers is ``.address``.  Serves the native runtime's window
+    table when available, the in-process pure-Python table otherwise."""
 
     def __init__(self):
-        self._lib = native.load()
-        if self._lib is None:
-            raise RuntimeError(
-                "WindowServer requires the native runtime window table")
+        self._ops = _table_ops()
         self._server: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -270,7 +764,7 @@ class WindowServer:
         if self._server is not None:
             raise RuntimeError("server already running")
         self._server = _Server((host, port), _Handler)
-        self._server.lib = self._lib  # type: ignore[attr-defined]
+        self._server.ops = self._ops  # type: ignore[attr-defined]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
         self._thread.start()
@@ -301,12 +795,18 @@ class WindowServer:
             self._thread = None
 
 
+# ---------------------------------------------------------------------------
+# Clients
+# ---------------------------------------------------------------------------
+
+
 class RemoteWindow:
-    """Client handle to a window served by another host's
+    """Synchronous client handle to a window served by another host's
     :class:`WindowServer` — ``deposit`` is ``MPI_Put``/``MPI_Accumulate``
     across the DCN, ``read_self`` the passive ``win_get``.  One persistent
     connection per handle; NOT thread-safe (one handle per rank thread,
-    like an MPI endpoint)."""
+    like an MPI endpoint).  For hot deposit paths prefer
+    :class:`PipelinedRemoteWindow`, which overlaps the wire with compute."""
 
     def __init__(self, address: Tuple[str, int], name: str,
                  timeout_s: float = 30.0):
@@ -316,37 +816,52 @@ class RemoteWindow:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def _request(self, op: int, slot: int, flags: int, dtype_id: int,
-                 n_elems: int, payload: bytes = b"") -> int:
-        msg = (_HDR.pack(_MAGIC, op, len(self._name_b)) + self._name_b +
-               _BODY.pack(slot, flags, dtype_id, n_elems) + payload)
-        self._sock.sendall(msg)
-        (rc,) = _STATUS.unpack(_recv_exact(self._sock, _STATUS.size))
+                 n_elems: int, payload_view=None) -> int:
+        pre = (_HDR.pack(_MAGIC, op, len(self._name_b)) + self._name_b +
+               _BODY.pack(slot, flags, dtype_id, n_elems))
+        views = [pre] if payload_view is None else [pre, payload_view]
+        try:
+            _sendmsg_all(self._sock, views)
+            (rc,) = _STATUS.unpack(_recv_exact(self._sock, _STATUS.size))
+        except ConnectionError:
+            raise ConnectionError(
+                f"window server for {self.name!r} closed the connection "
+                "mid-request (server stopped, or a protocol version "
+                "mismatch — v1 servers drop unrecognized v2 frames)")
         return rc
 
     def _recv_array(self) -> np.ndarray:
         dtype, n_elems = _SELF_HDR.unpack(
             _recv_exact(self._sock, _SELF_HDR.size))
-        raw = _recv_exact(self._sock, n_elems * _DTYPES[dtype].itemsize)
-        return np.frombuffer(raw, _DTYPES[dtype]).copy()
+        # single-allocation receive: the destination array IS the receive
+        # buffer (no intermediate bytes + frombuffer().copy())
+        out = np.empty(n_elems, _DTYPES[dtype])
+        _recv_into(self._sock, memoryview(out).cast("B"))
+        return out
 
     def deposit(self, slot: int, arr: np.ndarray, *,
                 accumulate: bool = True) -> int:
         a = np.ascontiguousarray(arr)
         if a.dtype not in _DTYPE_IDS:
             raise TypeError(f"RemoteWindow supports f32/f64, got {a.dtype}")
-        rc = self._request(_OP_DEPOSIT, slot, 1 if accumulate else 0,
-                           _DTYPE_IDS[a.dtype], a.size, a.tobytes())
+        rc = self._request(_OP_DEPOSIT, slot,
+                           _FLAG_ACCUMULATE if accumulate else 0,
+                           _DTYPE_IDS[a.dtype], a.size,
+                           memoryview(a).cast("B"))
         if rc < 0:
             raise RuntimeError(
                 f"remote deposit into {self.name!r}[{slot}] failed ({rc}): "
-                "window missing, slot out of range, or size/dtype mismatch")
+                + _err_text(rc))
+        _mt.inc("bf_tcp_single_deposits_total", 1.0)
         return rc
 
     def read_self(self, n_elems: int, dtype=np.float64) -> np.ndarray:
         rc = self._request(_OP_GET_SELF, 0, 0,
                            _DTYPE_IDS[np.dtype(dtype)], n_elems)
         if rc < 0:
-            raise RuntimeError(f"remote read_self of {self.name!r} failed")
+            raise RuntimeError(
+                f"remote read_self of {self.name!r} failed ({rc}): "
+                + _err_text(rc))
         return self._recv_array()
 
     def read(self, slot: int, n_elems: int, dtype=np.float64, *,
@@ -354,7 +869,9 @@ class RemoteWindow:
         rc = self._request(_OP_READ_SLOT, slot, 1 if consume else 0,
                            _DTYPE_IDS[np.dtype(dtype)], n_elems)
         if rc < 0:
-            raise RuntimeError(f"remote read of {self.name!r}[{slot}] failed")
+            raise RuntimeError(
+                f"remote read of {self.name!r}[{slot}] failed ({rc}): "
+                + _err_text(rc))
         return self._recv_array(), rc
 
     def close(self) -> None:
@@ -362,3 +879,422 @@ class RemoteWindow:
             self._sock.close()
         except OSError:
             pass
+
+
+class _Item:
+    __slots__ = ("name_b", "slot", "flags", "dtype_id", "codec", "n_elems",
+                 "views", "wire_bytes", "dense_bytes", "pooled")
+
+    def __init__(self, name_b, slot, flags, dtype_id, codec, n_elems,
+                 views, wire_bytes, dense_bytes, pooled):
+        self.name_b = name_b
+        self.slot = slot
+        self.flags = flags
+        self.dtype_id = dtype_id
+        self.codec = codec
+        self.n_elems = n_elems
+        self.views = views
+        self.wire_bytes = wire_bytes
+        self.dense_bytes = dense_bytes
+        self.pooled = pooled  # buffer to return to the pool after send
+
+
+class DepositStream:
+    """Per-PEER pipelined deposit engine: fire-and-forget deposits into any
+    of a peer's windows through one background sender with a bounded
+    in-flight window — the userspace analog of the reference's MPI
+    progress thread servicing ``win_put``/``win_accumulate`` while the
+    training thread computes.
+
+    - :meth:`deposit_async` enqueues and returns immediately (by default it
+      snapshots the payload, so callers may reuse their buffer — the
+      async-DSGD hot loop does).  The sender thread coalesces everything
+      queued — across windows/leaves — into ONE batched wire frame per
+      send (one ack), keeps at most ``max_in_flight`` batches
+      unacknowledged, and reports transport errors at the next call or at
+      :meth:`flush`.
+    - :meth:`flush` is the FENCE: it returns only when every enqueued
+      deposit has been acknowledged as applied by the serving host.  Any
+      loop whose correctness audit assumes "no deposit lands after X"
+      (the async-DSGD mass audit barrier) MUST flush before X — the
+      BF-WIN lint rule checks exactly this.
+
+    One stream per (client process, peer host): every window bound for the
+    same peer should share it, so a round's leaves ride one frame.
+    Optional wire compression (``codec="f32"`` / ``"topk"``) is negotiated
+    at connect; lossy codecs are opt-in and must NOT be used on payloads
+    whose exact mass matters (push-sum ``p``).  NOT thread-safe for
+    concurrent producers (one stream per rank thread)."""
+
+    def __init__(self, address: Tuple[str, int],
+                 timeout_s: float = 30.0, *, codec: Optional[str] = None,
+                 topk_ratio: float = 0.1, max_in_flight: int = 4,
+                 max_queue_items: int = 1024,
+                 max_batch_bytes: int = 16 << 20):
+        self._peer = f"{address[0]}:{address[1]}"
+        self._codec = wire_codec.CODEC_IDS[codec or "none"]
+        self._topk_ratio = float(topk_ratio)
+        self._max_in_flight = max(1, int(max_in_flight))
+        self._max_queue = max(1, int(max_queue_items))
+        # coalescing cap: without it a fast producer collapses the whole
+        # queue into one mega-frame and the pipeline degenerates to
+        # stop-and-wait at frame granularity — several bounded frames in
+        # flight is what keeps client send, server recv, and server apply
+        # continuously overlapped
+        self._max_batch_bytes = max(1 << 16, int(max_batch_bytes))
+        self._sock = socket.create_connection(address, timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        want = FEATURE_BATCH | _CODEC_FEATURE[self._codec]
+        _sendmsg_all(self._sock, [
+            _HDR.pack(_MAGIC, _OP_HELLO, 0),
+            _HELLO.pack(PROTOCOL_VERSION, want)])
+        (granted,) = _STATUS.unpack(_recv_exact(self._sock, _STATUS.size))
+        # connect/HELLO honored timeout_s; the steady-state stream must
+        # NOT — the ack reader is a free-running background thread whose
+        # recv legitimately sits idle for as long as the training loop
+        # goes without depositing (a per-request timeout here would
+        # spuriously fail healthy idle streams after timeout_s)
+        self._sock.settimeout(None)
+        if granted < 0:
+            raise RuntimeError(
+                f"window server at {self._peer} rejected the v"
+                f"{PROTOCOL_VERSION} handshake ({granted}): "
+                + _err_text(int(granted)))
+        if want & ~granted:
+            raise RuntimeError(
+                f"window server at {self._peer} does not support the "
+                f"requested transport features (want {want:#x}, granted "
+                f"{int(granted):#x}) — wire codec "
+                f"{wire_codec.CODEC_NAMES[self._codec]!r} unavailable")
+        self._cv = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._inflight: Dict[int, Tuple[float, int, int, int]] = {}
+        self._seq = 0
+        self._err: Optional[str] = None
+        self._closed = False
+        self._pool: Dict[Tuple[int, int], List[np.ndarray]] = {}
+        self._flushes = 0
+        # bench/observability: recent (send -> ack) latencies in seconds
+        self.ack_latencies: collections.deque = collections.deque(
+            maxlen=4096)
+        self._sender = threading.Thread(
+            target=self._send_loop, daemon=True,
+            name=f"bf-win-send:{self._peer}")
+        self._acker = threading.Thread(
+            target=self._ack_loop, daemon=True,
+            name=f"bf-win-ack:{self._peer}")
+        self._sender.start()
+        self._acker.start()
+
+    # ------------------------------------------------------------ producer
+    def _take(self, dtype: np.dtype, n: int) -> np.ndarray:
+        key = (_DTYPE_IDS[dtype], n)
+        free = self._pool.get(key)
+        if free:
+            return free.pop()
+        return np.empty(n, dtype)
+
+    def _give(self, arr: np.ndarray) -> None:
+        key = (_DTYPE_IDS[arr.dtype], arr.size)
+        free = self._pool.setdefault(key, [])
+        if len(free) < self._max_in_flight * 2 + 2:
+            free.append(arr)
+
+    def _raise_if_err(self) -> None:
+        if self._err is not None:
+            raise RuntimeError(
+                f"pipelined deposits to {self._peer} failed: {self._err}")
+
+    def deposit_async(self, name: bytes, slot: int, arr: np.ndarray, *,
+                      accumulate: bool = True, copy: bool = True) -> None:
+        """Enqueue one deposit into the peer's window ``name`` (bytes);
+        returns immediately.  ``copy=True`` (default) snapshots ``arr``
+        into a pooled buffer so the caller may overwrite it right away;
+        pass ``copy=False`` only when the buffer is immutable until
+        :meth:`flush` returns.  Errors (including those from earlier
+        fire-and-forget deposits) raise here or at flush."""
+        a = np.ascontiguousarray(arr)
+        if a.dtype not in _DTYPE_IDS:
+            raise TypeError(
+                f"pipelined deposits support f32/f64, got {a.dtype}")
+        a = a.reshape(-1)
+        self._raise_if_err()
+        dense_bytes = a.nbytes
+        pooled = None
+        if self._codec == wire_codec.CODEC_NONE:
+            if copy:
+                pooled = self._take(a.dtype, a.size)
+                np.copyto(pooled, a)
+                a = pooled
+            views = [memoryview(a).cast("B")]
+            wire = dense_bytes
+        else:
+            # lossy codecs allocate fresh wire arrays; the source is free
+            views, wire = wire_codec.encode(
+                a, self._codec, topk_ratio=self._topk_ratio)
+        item = _Item(name, slot, _FLAG_ACCUMULATE if accumulate else 0,
+                     _DTYPE_IDS[a.dtype], self._codec, a.size, views,
+                     wire, dense_bytes, pooled)
+        t0 = time.perf_counter()
+        with self._cv:
+            while (len(self._queue) >= self._max_queue
+                   and self._err is None and not self._closed):
+                self._cv.wait(timeout=1.0)
+            self._raise_if_err()
+            if self._closed:
+                raise RuntimeError(
+                    f"DepositStream to {self._peer} is closed")
+            self._queue.append(item)
+            self._cv.notify_all()
+        stalled = time.perf_counter() - t0
+        if stalled > 0.005:
+            # backpressure made the TRAINING thread wait: that is exactly
+            # the signal a wedged/slow peer gives first — record it where
+            # forensics will look
+            _mt.inc("bf_tcp_queue_stalls_total", 1.0, peer=self._peer)
+            _bb.record("tcp_queue_stall", peer=self._peer,
+                       waited_s=round(stalled, 6))
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Fence: block until every enqueued deposit is acknowledged as
+        APPLIED by the serving host (or raise the transport error).  After
+        ``flush`` returns, an owner-side read observes all of this
+        handle's prior deposits — the pipelined path's replacement for the
+        per-deposit round-trip the synchronous client pays."""
+        self._flushes += 1
+        key = (self._peer, self._flushes)
+        _bb.begin("tcp_flush", key=key, peer=self._peer)
+        t0 = time.perf_counter()
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._err is not None or (
+                    not self._queue and not self._inflight),
+                timeout=timeout_s)
+        waited = time.perf_counter() - t0
+        _bb.end("tcp_flush", key=key, peer=self._peer,
+                waited_s=round(waited, 6))
+        _mt.observe("bf_tcp_flush_seconds", waited, peer=self._peer)
+        self._raise_if_err()
+        if not ok:
+            raise TimeoutError(
+                f"flush of pipelined deposits to {self._peer} timed out "
+                f"after {timeout_s}s ({len(self._queue)} queued, "
+                f"{len(self._inflight)} in flight)")
+
+    # ------------------------------------------------------------- threads
+    def _send_loop(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    self._cv.wait_for(
+                        lambda: self._queue or self._closed
+                        or self._err is not None)
+                    if self._err is not None:
+                        return
+                    if not self._queue:
+                        if self._closed:
+                            return
+                        continue
+                    t0 = time.perf_counter()
+                    while (len(self._inflight) >= self._max_in_flight
+                           and self._err is None and not self._closed):
+                        self._cv.wait(timeout=1.0)
+                    if self._err is not None:
+                        return
+                    stalled = time.perf_counter() - t0
+                    items = []
+                    nbytes = 0
+                    while self._queue and (
+                            not items
+                            or nbytes < self._max_batch_bytes):
+                        it = self._queue.popleft()
+                        items.append(it)
+                        nbytes += it.wire_bytes
+                    self._seq += 1
+                    seq = self._seq
+                    self._inflight[seq] = (
+                        time.perf_counter(), len(items),
+                        sum(i.wire_bytes for i in items),
+                        sum(i.dense_bytes for i in items))
+                    self._cv.notify_all()
+                if stalled > 0.005:
+                    _mt.inc("bf_tcp_window_stalls_total", 1.0,
+                            peer=self._peer)
+                    _bb.record("tcp_window_stall", peer=self._peer,
+                               waited_s=round(stalled, 6))
+                views: List = [_HDR.pack(_MAGIC, _OP_DEPOSIT_BATCH, 0),
+                               _BATCH_HDR.pack(seq, len(items))]
+                wire_total = 0
+                dense_total = 0
+                for it in items:
+                    views.append(_ITEM.pack(
+                        len(it.name_b), it.slot, it.flags, it.dtype_id,
+                        it.codec, it.n_elems, it.wire_bytes))
+                    views.append(it.name_b)
+                    views.extend(it.views)
+                    wire_total += it.wire_bytes
+                    dense_total += it.dense_bytes
+                _sendmsg_all(self._sock, views)
+                with self._cv:
+                    for it in items:
+                        if it.pooled is not None:
+                            self._give(it.pooled)
+                _mt.inc("bf_tcp_pipelined_batches_total", 1.0,
+                        peer=self._peer)
+                _mt.inc("bf_tcp_pipelined_items_total", float(len(items)),
+                        peer=self._peer)
+                _mt.inc("bf_tcp_wire_bytes_total", wire_total,
+                        peer=self._peer,
+                        codec=wire_codec.CODEC_NAMES[self._codec])
+                _mt.inc("bf_tcp_dense_bytes_total", dense_total,
+                        peer=self._peer)
+                _mt.set("bf_tcp_inflight_batches",
+                        float(len(self._inflight)), peer=self._peer)
+                if dense_total and self._codec != wire_codec.CODEC_NONE:
+                    _mt.set(
+                        "bf_compression_ratio", wire_total / dense_total,
+                        compressor="wire_"
+                        + wire_codec.CODEC_NAMES[self._codec],
+                        transport="tcp")
+        except Exception as e:  # noqa: BLE001 — NOTHING may kill the
+            # sender silently: a dead sender with _err unset means every
+            # later flush() blocks forever at the audit fence with no
+            # diagnostic (struct.error from an out-of-range slot is just
+            # as fatal to the stream as a socket error)
+            self._fail(f"send failed: {type(e).__name__}: {e}")
+
+    def _ack_loop(self) -> None:
+        buf = bytearray(_ACK.size)
+        mv = memoryview(buf)
+        while True:
+            try:
+                _recv_into(self._sock, mv)
+            except (OSError, ConnectionError, ValueError):
+                if not self._closed:
+                    self._fail("connection lost before all deposits "
+                               "were acknowledged")
+                return
+            seq, status = _ACK.unpack(buf)
+            with self._cv:
+                entry = self._inflight.pop(seq, None)
+                self._cv.notify_all()
+            if entry is not None:
+                lat = time.perf_counter() - entry[0]
+                self.ack_latencies.append(lat)
+                _mt.observe("bf_tcp_ack_latency_seconds", lat,
+                            peer=self._peer)
+                _mt.set("bf_tcp_inflight_batches",
+                        float(len(self._inflight)), peer=self._peer)
+            if status < 0:
+                self._fail(f"peer rejected a batched deposit ({status}): "
+                           + _err_text(int(status)))
+                return
+
+    def _fail(self, msg: str) -> None:
+        with self._cv:
+            if self._err is None:
+                self._err = msg
+            self._queue.clear()
+            self._cv.notify_all()
+        _bb.record("tcp_pipeline_error", peer=self._peer, error=msg)
+
+    def close(self) -> None:
+        """Close the stream.  Does NOT flush: callers owning an exactness
+        invariant must :meth:`flush` first (the BF-WIN lint enforces this
+        for the dsgd loops)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._sender.join(timeout=5)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._acker.join(timeout=5)
+
+
+class PipelinedRemoteWindow:
+    """Per-window client handle over a per-peer :class:`DepositStream`:
+    fire-and-forget :meth:`deposit_async` + :meth:`flush` fence, with
+    synchronous ops (:meth:`read`, :meth:`read_self`, :meth:`deposit`)
+    riding a separate request/response connection so they never interleave
+    with the deposit stream's framing.
+
+    ``stream=`` shares an existing peer stream across several windows of
+    the SAME peer (a round's leaves then coalesce into one wire frame —
+    the batched multi-deposit op; :func:`DepositStream.flush` on the
+    shared stream fences all of them at once).  Without it the handle owns
+    a private stream and :meth:`close` tears it down."""
+
+    def __init__(self, address: Tuple[str, int], name: str,
+                 timeout_s: float = 30.0, *, codec: Optional[str] = None,
+                 topk_ratio: Optional[float] = None,
+                 max_in_flight: Optional[int] = None,
+                 max_queue_items: Optional[int] = None,
+                 stream: Optional[DepositStream] = None):
+        self.name = name
+        self._name_b = name.encode()
+        if stream is not None and any(
+                v is not None for v in (codec, topk_ratio, max_in_flight,
+                                        max_queue_items)):
+            # a shared stream carries ITS configuration; accepting these
+            # kwargs here would silently ignore them (e.g. codec='f32'
+            # riding an uncompressed stream)
+            raise ValueError(
+                "stream= is mutually exclusive with codec/topk_ratio/"
+                "max_in_flight/max_queue_items — configure the shared "
+                "DepositStream itself")
+        self._sync = RemoteWindow(address, name, timeout_s)
+        self._owns_stream = stream is None
+        if stream is not None:
+            self.stream = stream
+            return
+        try:
+            self.stream = DepositStream(
+                address, timeout_s, codec=codec,
+                topk_ratio=0.1 if topk_ratio is None else topk_ratio,
+                max_in_flight=4 if max_in_flight is None else max_in_flight,
+                max_queue_items=(1024 if max_queue_items is None
+                                 else max_queue_items))
+        except BaseException:
+            # a rejected handshake (version/feature) must not leak the
+            # already-open sync connection and its server handler thread
+            self._sync.close()
+            raise
+
+    @property
+    def ack_latencies(self):
+        return self.stream.ack_latencies
+
+    def deposit_async(self, slot: int, arr: np.ndarray, *,
+                      accumulate: bool = True, copy: bool = True) -> None:
+        """Fire-and-forget deposit (see :meth:`DepositStream.
+        deposit_async`); fence with :meth:`flush`."""
+        self.stream.deposit_async(self._name_b, slot, arr,
+                                  accumulate=accumulate, copy=copy)
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Fence: every prior :meth:`deposit_async` is applied on the
+        owner when this returns.  On a shared stream this fences the whole
+        peer (all windows), which is what the dsgd audit needs."""
+        self.stream.flush(timeout_s)
+
+    def deposit(self, slot: int, arr: np.ndarray, *,
+                accumulate: bool = True) -> int:
+        """Synchronous deposit (own round-trip; callers needing ordering
+        vs the async stream must flush first)."""
+        return self._sync.deposit(slot, arr, accumulate=accumulate)
+
+    def read(self, slot: int, n_elems: int, dtype=np.float64, *,
+             consume: bool = True) -> Tuple[np.ndarray, int]:
+        return self._sync.read(slot, n_elems, dtype, consume=consume)
+
+    def read_self(self, n_elems: int, dtype=np.float64) -> np.ndarray:
+        return self._sync.read_self(n_elems, dtype)
+
+    def close(self) -> None:
+        """Close the handle (and its stream, when privately owned).  Does
+        NOT flush — fence first when exactness matters."""
+        if self._owns_stream:
+            self.stream.close()
+        self._sync.close()

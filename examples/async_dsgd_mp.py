@@ -70,8 +70,12 @@ def worker(rank: int, n: int, bdir: str, duration_s: float, lr: float,
         return float(loss), grads
 
     # base sleep scales with rank count so the skew stays visible above
-    # scheduler contention when many rank processes share few cores
-    skew_s = 0.0005 * max(n - 1, 1) * (1.0 + 4.0 * rank / max(n - 1, 1))
+    # scheduler contention when many rank processes share few cores; the
+    # pipelined tcp transport runs background sender/ack threads that
+    # raise every rank's per-step floor by several ms, so its skew must
+    # be an order larger to dominate
+    base = 0.004 if transport == "tcp" else 0.0005
+    skew_s = base * max(n - 1, 1) * (1.0 + 4.0 * rank / max(n - 1, 1))
     report = run_async_dsgd_rank(
         RingGraph(n), rank, params0, loss_and_grad,
         barrier=FileBarrier(bdir, n, rank), lr=lr, duration_s=duration_s,

@@ -470,3 +470,107 @@ class TestMoEGuards:
             MoEConfig(gpt=GPTConfig.tiny(), num_experts=1, router="top2")
         with pytest.raises(ValueError, match="unknown router"):
             MoEConfig(gpt=GPTConfig.tiny(), num_experts=4, router="top3")
+
+
+# ---------------------------------------------------------------------------
+# BF-WIN: pipelined window deposits must fence before their barrier
+# ---------------------------------------------------------------------------
+
+
+class TestWindowLint:
+    def test_seeded_violation_unfenced_deposits(self):
+        # the exact bug the rule exists for: fire-and-forget deposits, a
+        # barrier that the mass audit trusts, and no flush in between
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+
+        src = (
+            "def loop(peers, slots, payload, barrier, win, n_in):\n"
+            "    for step in range(100):\n"
+            "        for j in peers:\n"
+            "            peers[j].deposit_async(slots[j], payload)\n"
+            "    barrier.wait('stopped')\n"
+            "    for k in range(n_in):\n"
+            "        win.read(k, consume=True)\n"
+        )
+        diags = check_pipelined_flush(src, filename="seeded.py")
+        assert any(d.code == "BF-WIN001" and d.severity == "error"
+                   for d in diags), [d.format() for d in diags]
+
+    def test_fenced_loop_is_clean(self):
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+
+        src = (
+            "def loop(peers, slots, payload, barrier):\n"
+            "    for step in range(100):\n"
+            "        for j in peers:\n"
+            "            peers[j].deposit_async(slots[j], payload)\n"
+            "    for j in peers:\n"
+            "        peers[j].flush()\n"
+            "    barrier.wait('stopped')\n"
+        )
+        assert not check_pipelined_flush(src, filename="clean.py")
+
+    def test_never_fenced_deposits_warn(self):
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+
+        src = (
+            "def fire(peer, payload):\n"
+            "    peer.deposit_async(0, payload)\n"
+        )
+        diags = check_pipelined_flush(src, filename="warn.py")
+        assert [d.code for d in diags] == ["BF-WIN002"]
+        assert diags[0].severity == "warning"
+
+    def test_pipelined_ctor_receiver_deposit_counts(self):
+        # .deposit() on a name bound from PipelinedRemoteWindow(...) is a
+        # pipelined site too (the sync-spelling trap)
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+
+        src = (
+            "def loop(addr, payload, barrier):\n"
+            "    pw = PipelinedRemoteWindow(addr, 'w')\n"
+            "    pw.deposit_async(0, payload)\n"
+            "    barrier.wait('stopped')\n"
+        )
+        diags = check_pipelined_flush(src, filename="ctor.py")
+        assert any(d.code == "BF-WIN001" for d in diags)
+
+    def test_real_dsgd_loop_is_fenced(self):
+        # the repo's own mp-dsgd body deposits pipelined and MUST stay
+        # fenced — this is the regression tripwire for future edits
+        import inspect
+
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+        from bluefog_tpu.runtime import async_windows
+
+        diags = check_pipelined_flush(
+            inspect.getsource(async_windows), filename="async_windows.py")
+        assert not [d for d in diags if d.severity == "error"], \
+            [d.format() for d in diags]
+
+    def test_nested_deposit_closure_exempt_from_never_fenced(self):
+        # a deposit closure whose CALLER fences (the bench's one_round
+        # shape) must not trip BF-WIN002; BF-WIN001 still applies when
+        # the closure itself races a barrier
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+
+        src = (
+            "def run(stream, names, payloads):\n"
+            "    def one_round():\n"
+            "        for nm, p in zip(names, payloads):\n"
+            "            stream.deposit_async(nm, 0, p)\n"
+            "    for _ in range(10):\n"
+            "        one_round()\n"
+            "    stream.flush()\n"
+        )
+        assert not check_pipelined_flush(src, filename="closure.py")
+
+    def test_window_pass_runs_in_sweep(self):
+        # the bflint-tpu sweep includes the window pass (BF-WIN100 info)
+        # and reports NO warnings of its own on the repo as committed
+        # (false positives would break warnings-as-errors gating)
+        report = run_all(size=8, trace=False)
+        assert report.has("BF-WIN100"), report.format(verbose=True)
+        assert report.ok, report.format()
+        assert not [d for d in report.warnings
+                    if d.code.startswith("BF-WIN")], report.format()

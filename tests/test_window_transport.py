@@ -1,0 +1,530 @@
+"""Pipelined zero-copy DCN window transport (wire v2).
+
+Covers the tentpole surfaces of the batched deposit engine
+(``runtime/window_server.py``):
+
+- protocol-version negotiation: a v1 client against the v2 server is
+  rejected with a clear error (status ``-101``), not silently corrupted;
+  a HELLO with the wrong version likewise; codec features must be
+  negotiated before the server accepts compressed items;
+- the batched multi-deposit wire op: multi-window/multi-slot batches,
+  one ack, exactly-once counts, per-item error isolation (a bad item
+  cannot desync its neighbors in the same frame);
+- pipelined semantics: fire-and-forget with payload-snapshot, ``flush``
+  as a real fence (owner observes everything on return), deferred errors
+  surfacing loudly at the fence;
+- wire codecs (f32 / top-k) through the server into the table, and the
+  wire_codec ``kept`` arithmetic staying in lockstep with the device
+  compressor's ``_kept`` (the "reuse, not fork" contract);
+- malformed/truncated-frame fuzz of the batched parser: garbage never
+  crashes the serving process — at worst the one connection drops and
+  fresh clients still work;
+- the multi-process pipelined dsgd run: the mass-conservation audit
+  stays EXACT through the pipelined transport (the flush fence before
+  the "stopped" barrier is what makes it exact).
+
+These tests run against whichever window table the host has (native or
+the pure-Python fallback) — the transport must behave identically on
+both, so there is deliberately NO native skip here.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests._util import REPO as _REPO, clean_env, uniq as _uniq
+
+
+def _mk(name, n_slots, n_elems, dtype=np.float64):
+    from bluefog_tpu.runtime.async_windows import AsyncWindow
+
+    return AsyncWindow(name, n_slots=n_slots, n_elems=n_elems, dtype=dtype)
+
+
+def _serve():
+    from bluefog_tpu.runtime.window_server import WindowServer
+
+    srv = WindowServer()
+    _, port = srv.start("127.0.0.1")
+    return srv, port
+
+
+def _recv_exactly(sock, n):
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        assert got, "server closed mid-reply"
+        buf += got
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# version negotiation
+# ---------------------------------------------------------------------------
+
+
+def test_v1_client_is_rejected_loudly():
+    """A v1-magic frame gets ONE clear error status back (-101), exactly
+    where the old client blocks on its reply — then the connection drops."""
+    name = _uniq("wt_v1")
+    win = _mk(name, 1, 4)
+    srv, port = _serve()
+    try:
+        hdr = struct.Struct("<IBH")
+        body = struct.Struct("<iBBq")
+        status = struct.Struct("<q")
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            nb = name.encode()
+            msg = (hdr.pack(0xBF_51_0E_01, 0, len(nb)) + nb +
+                   body.pack(0, 1, 1, 4) + np.ones(4).tobytes())
+            s.sendall(msg)
+            (rc,) = status.unpack(s.recv(8))
+            assert rc == -101, rc
+            assert s.recv(1) == b""  # server dropped the connection
+    finally:
+        srv.stop()
+        win.free()
+
+
+def test_hello_wrong_version_rejected():
+    from bluefog_tpu.runtime.window_server import (_HDR, _HELLO, _MAGIC,
+                                                   _OP_HELLO, _STATUS)
+
+    srv, port = _serve()
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            s.sendall(_HDR.pack(_MAGIC, _OP_HELLO, 0) + _HELLO.pack(3, 0))
+            (rc,) = _STATUS.unpack(s.recv(8))
+            assert rc == -101, rc
+    finally:
+        srv.stop()
+
+
+def test_codec_requires_negotiation():
+    """A batch item claiming a codec the connection never negotiated is
+    rejected per-item (the frame survives; the client sees the error at
+    its fence), and the client-side HELLO surfaces unsupported feature
+    requests as a clear exception."""
+    from bluefog_tpu.runtime import window_server as ws
+
+    name = _uniq("wt_nego")
+    win = _mk(name, 1, 8)
+    srv, port = _serve()
+    try:
+        # hand-build a batch with codec=f32 on a connection with NO hello
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            nb = name.encode()
+            payload = np.ones(8, np.float32)
+            item = ws._ITEM.pack(len(nb), 0, 1, 1, 1, 8, payload.nbytes)
+            s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_DEPOSIT_BATCH, 0)
+                      + ws._BATCH_HDR.pack(7, 1) + item + nb
+                      + payload.tobytes())
+            seq, rc = ws._ACK.unpack(s.recv(12))
+            assert seq == 7 and rc == -102, (seq, rc)
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 0  # nothing landed
+    finally:
+        srv.stop()
+        win.free()
+
+
+def test_kept_matches_device_compressor():
+    """wire_codec.kept is the numpy twin of ops.compression._kept — the
+    'reusing quantize/top-k' contract, enforced instead of imported (the
+    host path must not drag jax into socket threads)."""
+    jax = pytest.importorskip("jax")  # noqa: F841 — compression imports jax
+    from bluefog_tpu.ops.compression import _kept, top_k
+    from bluefog_tpu.runtime import wire_codec
+
+    for n in (1, 2, 3, 7, 100, 1023, 65536):
+        for r in (0.01, 0.1, 0.25, 0.5, 0.9, 1.0):
+            assert wire_codec.kept(n, r) == _kept(n, r), (n, r)
+    # and the top-k support matches the device compressor's support
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(64).astype(np.float32)
+    comp = top_k(0.25)
+    dev = np.asarray(comp.decompress(
+        comp.compress(jax.numpy.asarray(x), None), None,
+        jax.numpy.asarray(x)))
+    views, nbytes = wire_codec.encode(x, wire_codec.CODEC_TOPK,
+                                      topk_ratio=0.25)
+    wire = b"".join(bytes(v) for v in views)
+    host = wire_codec.decode(wire_codec.CODEC_TOPK, memoryview(wire),
+                             64, np.float32)
+    np.testing.assert_allclose(host, dev, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# batched deposits + pipelined semantics
+# ---------------------------------------------------------------------------
+
+
+def test_batch_multi_window_roundtrip():
+    """One DepositStream batches deposits for SEVERAL windows/slots of the
+    same peer into shared frames; every deposit lands exactly once."""
+    from bluefog_tpu.runtime.window_server import DepositStream
+
+    n1, n2 = _uniq("wt_a"), _uniq("wt_b")
+    wa = _mk(n1, 2, 4)
+    wb = _mk(n2, 1, 6)
+    srv, port = _serve()
+    try:
+        st = DepositStream(("127.0.0.1", port))
+        pa = np.arange(4, dtype=np.float64)
+        pb = np.ones(6)
+        for k in range(5):
+            st.deposit_async(n1.encode(), 0, pa)
+            st.deposit_async(n1.encode(), 1, 2 * pa, accumulate=False)
+            st.deposit_async(n2.encode(), 0, pb)
+        st.flush(timeout_s=30)
+        buf, fresh = wa.read(0, consume=True)
+        assert fresh == 5
+        np.testing.assert_allclose(buf, 5 * pa)
+        buf, fresh = wa.read(1, consume=True)
+        assert fresh == 5
+        np.testing.assert_allclose(buf, 2 * pa)  # put, not accumulate
+        buf, fresh = wb.read(0, consume=True)
+        assert fresh == 5
+        np.testing.assert_allclose(buf, 5.0)
+        st.close()
+    finally:
+        srv.stop()
+        wa.free()
+        wb.free()
+
+
+def test_pipelined_snapshot_semantics_and_fence():
+    """The hot-loop contract: the caller reuses ONE payload buffer,
+    mutating it immediately after deposit_async — the wire must carry the
+    value at enqueue time, and flush() must be a real fence (owner sees
+    every deposit once flush returns)."""
+    from bluefog_tpu.runtime.window_server import PipelinedRemoteWindow
+
+    name = _uniq("wt_snap")
+    win = _mk(name, 1, 8)
+    srv, port = _serve()
+    try:
+        pw = PipelinedRemoteWindow(("127.0.0.1", port), name)
+        buf = np.zeros(8)
+        expect = np.zeros(8)
+        for k in range(100):
+            buf[:] = k
+            pw.deposit_async(0, buf, accumulate=True)
+            expect += k
+        pw.flush(timeout_s=30)
+        got, fresh = win.read(0, consume=True)
+        assert fresh == 100
+        np.testing.assert_allclose(got, expect)
+        pw.close()
+    finally:
+        srv.stop()
+        win.free()
+
+
+def test_pipelined_errors_surface_at_fence():
+    """Fire-and-forget deposits into a missing window cannot raise at the
+    call — the error must latch and surface LOUDLY at flush (or the next
+    deposit), never silently vanish."""
+    from bluefog_tpu.runtime.window_server import DepositStream
+
+    srv, port = _serve()
+    try:
+        st = DepositStream(("127.0.0.1", port))
+        st.deposit_async(b"no_such_window", 0, np.ones(4))
+        with pytest.raises(RuntimeError, match="no such window|failed"):
+            st.flush(timeout_s=30)
+        st.close()
+    finally:
+        srv.stop()
+
+
+def test_batch_bad_item_does_not_desync_good_items():
+    """Per-item wire_bytes keeps the batched stream parseable past a bad
+    item: deposits before AND after the bad one in the same frame land."""
+    from bluefog_tpu.runtime import window_server as ws
+
+    name = _uniq("wt_mix")
+    win = _mk(name, 1, 4)
+    srv, port = _serve()
+    try:
+        nb = name.encode()
+        good = np.full(4, 2.0)
+        bad_nb = b"missing_win"
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            frames = [ws._HDR.pack(ws._MAGIC, ws._OP_DEPOSIT_BATCH, 0),
+                      ws._BATCH_HDR.pack(1, 3)]
+            for wname, arr in ((nb, good), (bad_nb, good), (nb, good)):
+                frames.append(ws._ITEM.pack(
+                    len(wname), 0, 1, 1, 0, 4, arr.nbytes))
+                frames.append(wname)
+                frames.append(arr.tobytes())
+            s.sendall(b"".join(frames))
+            seq, rc = ws._ACK.unpack(s.recv(12))
+            assert seq == 1 and rc == -3, (seq, rc)  # first error reported
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 2  # both good items landed despite the middle one
+        np.testing.assert_allclose(buf, 4.0)
+    finally:
+        srv.stop()
+        win.free()
+
+
+def test_dense_item_wire_bytes_must_match_exactly():
+    """A dense (codec none) item whose wire_bytes disagrees with
+    n_elems*itemsize — under OR over (within the topk bound) — is
+    rejected per item and the CONNECTION SURVIVES: later frames on the
+    same socket still ack and apply.  Regression: an under-length dense
+    payload used to blow up inside the apply worker, killing the applier
+    thread and wedging every later batch on that connection."""
+    from bluefog_tpu.runtime import window_server as ws
+
+    name = _uniq("wt_exact")
+    win = _mk(name, 1, 8)
+    srv, port = _serve()
+    arr = np.ones(8)
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            for bad_wire in (56, 72):  # -8 and +8 vs the true 64
+                payload = b"x" * bad_wire
+                s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_DEPOSIT_BATCH, 0)
+                          + ws._BATCH_HDR.pack(5, 1)
+                          + ws._ITEM.pack(len(name.encode()), 0, 1, 1, 0,
+                                          8, bad_wire)
+                          + name.encode() + payload)
+                seq, rc = ws._ACK.unpack(_recv_exactly(s, 12))
+                assert seq == 5 and rc == -2, (bad_wire, seq, rc)
+            # the same connection still works after both bad items
+            s.sendall(_valid_batch_bytes(ws, name.encode(), arr, seq=6))
+            seq, rc = ws._ACK.unpack(_recv_exactly(s, 12))
+            assert seq == 6 and rc == 1, (seq, rc)
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 1
+        np.testing.assert_allclose(buf, arr)
+    finally:
+        srv.stop()
+        win.free()
+
+
+def test_wire_codecs_end_to_end():
+    from bluefog_tpu.runtime.window_server import DepositStream
+
+    name = _uniq("wt_codec")
+    win = _mk(name, 2, 64)
+    srv, port = _serve()
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal(64)
+    try:
+        st = DepositStream(("127.0.0.1", port), codec="f32")
+        st.deposit_async(name.encode(), 0, x, accumulate=False)
+        st.flush(timeout_s=30)
+        got, fresh = win.read(0, consume=True)
+        assert fresh == 1
+        np.testing.assert_allclose(got, x.astype(np.float32), rtol=1e-6)
+        st.close()
+
+        st = DepositStream(("127.0.0.1", port), codec="topk",
+                           topk_ratio=0.25)
+        st.deposit_async(name.encode(), 1, x, accumulate=False)
+        st.flush(timeout_s=30)
+        got, fresh = win.read(1, consume=True)
+        assert fresh == 1
+        k = 16
+        idx = np.argsort(-np.abs(x))[:k]
+        dense = np.zeros(64)
+        dense[idx] = x[idx].astype(np.float32)
+        np.testing.assert_allclose(got, dense, rtol=1e-6)
+        st.close()
+    finally:
+        srv.stop()
+        win.free()
+
+
+def test_deferred_ack_singles_and_flush_op():
+    """The deferred-ack wire flag: singles stream without per-deposit
+    status; the FLUSH op returns the applied count, or the first latched
+    error (then clears it)."""
+    from bluefog_tpu.runtime import window_server as ws
+
+    name = _uniq("wt_defer")
+    win = _mk(name, 1, 4)
+    srv, port = _serve()
+    try:
+        nb = name.encode()
+        p = np.ones(4)
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            def dep(target, flags=ws._FLAG_ACCUMULATE | ws._FLAG_DEFERRED_ACK):
+                s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_DEPOSIT, len(target))
+                          + target + ws._BODY.pack(0, flags, 1, 4)
+                          + p.tobytes())
+
+            def flush():
+                s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_FLUSH, 0))
+                (rc,) = ws._STATUS.unpack(s.recv(8))
+                return rc
+
+            dep(nb)
+            dep(nb)
+            dep(nb)
+            assert flush() == 3
+            assert flush() == 0  # counter cleared
+            dep(b"missing_win")  # latches -3, payload eaten
+            dep(nb)              # still applies
+            assert flush() == -3  # first error wins, then state resets
+            assert flush() == 0
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 4
+        np.testing.assert_allclose(buf, 4.0)
+    finally:
+        srv.stop()
+        win.free()
+
+
+# ---------------------------------------------------------------------------
+# malformed / truncated frame fuzz
+# ---------------------------------------------------------------------------
+
+
+def _valid_batch_bytes(ws, name_b, arr, seq=9):
+    return (ws._HDR.pack(ws._MAGIC, ws._OP_DEPOSIT_BATCH, 0)
+            + ws._BATCH_HDR.pack(seq, 1)
+            + ws._ITEM.pack(len(name_b), 0, 1, 1, 0, arr.size, arr.nbytes)
+            + name_b + arr.tobytes())
+
+
+def test_fuzz_malformed_and_truncated_batch_frames():
+    """Randomly truncated and bit-flipped batch frames must never take the
+    server down: each bad stream at worst loses ITS connection, and a
+    fresh client immediately afterwards works.  (The parser's worst
+    enemies: lying lengths, unknown codecs, counts that overrun.)"""
+    from bluefog_tpu.runtime import window_server as ws
+    from bluefog_tpu.runtime.window_server import RemoteWindow
+
+    name = _uniq("wt_fuzz")
+    win = _mk(name, 1, 8)
+    srv, port = _serve()
+    rng = np.random.default_rng(11)
+    arr = np.ones(8)
+    base = _valid_batch_bytes(ws, name.encode(), arr)
+    try:
+        for trial in range(60):
+            blob = bytearray(base)
+            mode = trial % 3
+            if mode == 0:  # truncate anywhere (mid-header, mid-payload)
+                blob = blob[:int(rng.integers(1, len(blob)))]
+            elif mode == 1:  # flip bytes after the magic (keep it ours)
+                for _ in range(int(rng.integers(1, 6))):
+                    i = int(rng.integers(ws._HDR.size, len(blob)))
+                    blob[i] = int(rng.integers(0, 256))
+            else:  # absurd claimed lengths in the item header
+                off = ws._HDR.size + ws._BATCH_HDR.size
+                item = ws._ITEM.pack(
+                    len(name.encode()), 0, 1, 1, 0,
+                    int(rng.integers(1, 1 << 40)),
+                    int(rng.integers(1, 1 << 40)))
+                blob[off:off + ws._ITEM.size] = item
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=10) as s:
+                s.settimeout(5)
+                try:
+                    s.sendall(blob)
+                    s.shutdown(socket.SHUT_WR)
+                    while s.recv(4096):
+                        pass
+                except OSError:
+                    pass  # connection torn either way — that is allowed
+        # the server must still be fully functional for a fresh client
+        rw = RemoteWindow(("127.0.0.1", port), name)
+        win.read(0, consume=True)  # discard whatever fuzz landed
+        assert rw.deposit(0, arr, accumulate=True) >= 1
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 1
+        np.testing.assert_allclose(buf, arr)
+        rw.close()
+    finally:
+        srv.stop()
+        win.free()
+
+
+def test_truncated_payload_never_applies_partially():
+    """A connection dying mid-payload must not deposit a partial buffer:
+    the item only applies after its full payload arrived."""
+    from bluefog_tpu.runtime import window_server as ws
+
+    name = _uniq("wt_trunc")
+    win = _mk(name, 1, 1024)
+    srv, port = _serve()
+    arr = np.ones(1024)
+    try:
+        full = _valid_batch_bytes(ws, name.encode(), arr)
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            s.sendall(full[:len(full) - 512])  # half the payload missing
+        import time
+
+        time.sleep(0.2)  # let the handler observe the EOF
+        buf, fresh = win.read(0, consume=True)
+        assert fresh == 0, "partial payload must never be applied"
+    finally:
+        srv.stop()
+        win.free()
+
+
+# ---------------------------------------------------------------------------
+# multi-process pipelined dsgd: the audit stays exact
+# ---------------------------------------------------------------------------
+
+
+def _run_dsgd_workers(transport, nproc=2, duration="1.5"):
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as bdir:
+        worker = os.path.join(_REPO, "tests", "_mp_async_worker.py")
+        # the worker asserts rank 0 outsteps the LAST rank by >1.5x, so
+        # the last rank must carry the largest skew; the margins are wider
+        # than the shm test's because the pipelined transport adds
+        # background threads whose scheduling noise inflates every rank's
+        # per-step floor on small CI hosts
+        # (3 rank processes over 2 CI cores run ~25 ms/step from CPU
+        # contention alone — double that when the host throttles — so the
+        # slow rank's skew must dominate even an inflated per-step floor
+        # for the worker's >1.5x assertion to have margin)
+        skews_ms = ["0.5", "12.0"] if nproc == 2 else ["0.5", "2.0", "45.0"]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(r), str(nproc), bdir,
+                 duration, skews_ms[r], transport],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=clean_env(), cwd=_REPO)
+            for r in range(nproc)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("pipelined dsgd workers timed out:\n"
+                        + "\n".join(o or "" for o in outs))
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {r} failed:\n{out}"
+            assert f"ASYNC_MP_OK {r}" in out, f"worker {r} output:\n{out}"
+
+
+def test_pipelined_dsgd_mass_audit_exact_two_processes():
+    """Two OS processes, pipelined TCP deposits, skewed step rates: the
+    worker asserts mass conservation EXACTLY (sum p == n to 1e-9·n) plus
+    convergence — the flush fence before the 'stopped' barrier is what
+    makes the audit exact under fire-and-forget deposits."""
+    _run_dsgd_workers("tcp", nproc=2)
+
+
+@pytest.mark.slow
+def test_pipelined_dsgd_mass_audit_soak_three_processes():
+    """Soak variant: three ranks, longer run, more in-flight overlap."""
+    _run_dsgd_workers("tcp", nproc=3, duration="5.0")
