@@ -307,6 +307,36 @@ def window_pass(report: LintReport, size: int) -> None:
         pass_name="window-lint", subject="runtime"))
 
 
+def resilience_pass(report: LintReport, size: int) -> None:
+    """BF-RES source lint over the surfaces that open or retry network
+    connections: the runtime transports, the supervisor, and every
+    example/benchmark that could copy their loop shapes.  An unbounded
+    reconnect loop (no retry budget or deadline) is an error — see
+    :mod:`bluefog_tpu.analysis.resilience_lint`."""
+    import glob
+
+    from bluefog_tpu.analysis.resilience_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "runtime", "*.py")))
+    targets.append(os.path.join(root, "bluefog_tpu", "utils", "failure.py"))
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-RES100",
+        f"resilience-lint scanned {n} file(s) for unbounded "
+        "reconnect/retry loops",
+        pass_name="resilience-lint", subject="runtime"))
+
+
 _EXAMPLE_CONSTRUCTORS = (
     "ExponentialTwoGraph",
     "ExponentialGraph",
@@ -388,6 +418,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     dynamic_pass(report, size)
     collective_id_pass(report, size)
     window_pass(report, size)
+    resilience_pass(report, size)
     examples_pass(report, size)
     if trace:
         comm_lint_pass(report, size)

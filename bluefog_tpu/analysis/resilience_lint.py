@@ -1,0 +1,141 @@
+"""BF-RES lint: every reconnect/retry loop must carry a bound.
+
+The resilience layer's reconnect discipline
+(:class:`bluefog_tpu.runtime.resilience.Backoff`) is budget-or-deadline
+by construction — exhaustion is what turns "the network hiccupped" into
+"the peer is DEAD", which is what lets the gossip heal instead of
+spinning.  An UNBOUNDED retry loop defeats the whole state machine: it
+never declares the peer dead, it hammers the listen queue/port of a
+restarting peer forever, and under a partition it wedges the training
+thread invisibly.  This pass rejects that shape at review time.
+
+The rule, per loop (AST source lint, like :mod:`bluefog_tpu.analysis.
+window_lint` — the reconnect loops are host Python):
+
+- a **connect site** is a call whose name is connect-like
+  (``create_connection``, ``connect``, ``connect_ex``, or any name
+  containing ``reconnect``);
+- a loop is **unbounded** when it is ``while True`` (or a constant-true
+  test) or iterates ``itertools.count()``;
+- a loop is **budgeted** when its header or body references the bounded-
+  retry vocabulary: iterating a value built from ``Backoff(...)``, a
+  call to ``next_delay``, or any name/attribute mentioning ``backoff``,
+  ``budget``, ``deadline``, ``attempt`` or ``retries`` (the counter a
+  hand-rolled bound necessarily reads).
+
+**BF-RES001** (error): an unbounded, unbudgeted loop around a connect
+site.  **BF-RES100** (info): scan summary.  Bounded ``for`` loops
+(``for _ in range(5)``) are inherently budgeted and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_retry_budgets", "check_file"]
+
+_CONNECT_NAMES = ("create_connection", "connect", "connect_ex")
+_BUDGET_WORDS = ("backoff", "budget", "deadline", "attempt", "retries",
+                 "next_delay")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_connectish(name: str) -> bool:
+    low = name.lower()
+    return name in _CONNECT_NAMES or "reconnect" in low
+
+
+def _mentions_budget(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, ast.Call):
+            ident = _call_name(sub)
+        if ident and any(w in ident.lower() for w in _BUDGET_WORDS):
+            return True
+    return False
+
+
+def _is_unbounded(loop: ast.AST) -> bool:
+    if isinstance(loop, ast.While):
+        t = loop.test
+        if isinstance(t, ast.Constant) and bool(t.value):
+            return True
+        return False
+    if isinstance(loop, ast.For):
+        it = loop.iter
+        return isinstance(it, ast.Call) and _call_name(it) == "count"
+    return False
+
+
+def _connect_sites(loop: ast.AST) -> List[int]:
+    lines = []
+    for sub in ast.walk(loop):
+        if isinstance(sub, ast.Call) and _is_connectish(_call_name(sub)):
+            lines.append(sub.lineno)
+    return lines
+
+
+def check_retry_budgets(source: str, *, filename: str = "<source>"
+                        ) -> List[Diagnostic]:
+    """Lint one Python source blob for unbounded reconnect loops."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-RES003",
+            f"could not parse {filename}: {e}",
+            pass_name="resilience-lint", subject=filename)]
+    short = os.path.basename(filename)
+    diags: List[Diagnostic] = []
+    flagged: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        sites = _connect_sites(node)
+        if not sites:
+            continue
+        if not _is_unbounded(node):
+            continue
+        if _mentions_budget(node):
+            continue
+        site = min(sites)
+        if site in flagged:
+            continue  # a nested loop pair reports once, at the site
+        flagged.add(site)
+        diags.append(Diagnostic(
+            "error", "BF-RES001",
+            f"unbounded retry loop at {short}:{node.lineno} around a "
+            f"connect call (line {site}) with no retry budget or "
+            "deadline — reconnect loops must iterate a "
+            "resilience.Backoff (or carry an explicit attempt/deadline "
+            "bound) so a dead peer is eventually DECLARED dead and "
+            "healed out instead of being hammered forever",
+            pass_name="resilience-lint", subject=f"{short}:{node.lineno}"))
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return [Diagnostic(
+            "warning", "BF-RES003", f"could not read {path}: {e}",
+            pass_name="resilience-lint", subject=os.path.basename(path))]
+    return check_retry_budgets(src, filename=path)

@@ -1,0 +1,90 @@
+"""Deterministic fault injection for peer-fault-tolerance testing.
+
+The ROADMAP's north star ("heavy traffic, as many scenarios as you can
+imagine") needs failures ON DEMAND, not by luck: this package is a
+seedable, counter-driven fault injector whose shims live at the exact
+choke points the resilience layer defends —
+
+- **socket faults** at the DCN window transport
+  (:mod:`bluefog_tpu.runtime.window_server`): drop or truncate a frame,
+  delay or stall a connection, and — the nastiest case — drop the
+  connection *after* a batch applied but *before* its ack left, which is
+  precisely the ambiguity the stream-epoch replay protocol exists to
+  resolve;
+- **process faults** for multi-process runs: SIGKILL / SIGSTOP a rank at
+  a deterministic step or wall-clock offset (a SIGSTOPped process
+  arranges its own SIGCONT through a tiny helper child, so one spec line
+  expresses the full freeze/thaw round trip);
+- **thread faults** for the in-process rank loops
+  (:func:`~bluefog_tpu.runtime.async_windows.run_async_dsgd`): ``die``
+  raises :class:`ChaosKill` inside the rank loop (the thread-model
+  analog of SIGKILL) and ``stall`` freezes the loop for a fixed time
+  (the analog of SIGSTOP/SIGCONT).
+
+Faults are configured with ``BLUEFOG_TPU_CHAOS=<spec>`` (read lazily,
+like the metrics/blackbox env vars), programmatically via
+:func:`configure`, or by wrapping a command with the ``bfchaos-tpu``
+CLI.  Everything is deterministic given the same traffic: triggers count
+frames/steps, and probabilistic rules draw from a per-rule seeded RNG.
+
+Spec grammar (``;``-separated rules)::
+
+    spec  := rule (';' rule)*
+    rule  := site ':' fault (':' key '=' value)*
+    site  := 'server' | 'ack' | 'client' | 'any' | 'rank<N>'
+    fault := 'drop' | 'truncate' | 'delay' | 'stall'          (socket)
+           | 'sigkill' | 'sigstop' | 'die'                    (process/thread)
+
+Socket-rule keys: ``after_frames=N`` (fire once when the site's frame
+counter reaches N), ``every=K`` (every K-th frame), ``prob=P`` (seeded
+coin per frame), ``times=T`` (max firings; 0 = unlimited), ``seed=S``,
+``ms=M`` (delay milliseconds), ``s=S`` (stall seconds).  Rank-rule keys:
+``at_step=N`` (fired from the rank loop's :func:`check_step`),
+``after_s=T`` (armed as a timer by :func:`arm`), ``for_s=T`` (sigstop
+duration / stall length via ``s=``).
+
+Examples::
+
+    server:drop:after_frames=40        # cut the connection at frame 40
+    ack:drop:after_frames=3            # apply batch 3, drop before ack
+    client:truncate:after_frames=5     # send half a frame, then cut
+    server:delay:ms=20:prob=0.1:seed=7 # 10% of frames delayed 20 ms
+    rank2:sigkill:at_step=8            # rank 2 SIGKILLs itself at step 8
+    rank1:sigstop:after_s=0.8:for_s=1  # freeze rank 1 for 1 s
+    rank2:die:at_step=8                # thread-mode death (ChaosKill)
+    rank1:stall:at_step=6:s=1.5        # thread-mode freeze/thaw
+
+The injector records every firing in the flight recorder
+(``chaos_inject``) and the ``bf_chaos_injections_total`` counter, so an
+incident dump shows the injected fault next to the failure it caused.
+"""
+
+from bluefog_tpu.chaos.injector import (
+    ChaosKill,
+    ChaosSpecError,
+    Injector,
+    Rule,
+    arm,
+    check_step,
+    configure,
+    enabled,
+    fire,
+    get,
+    parse_spec,
+    reset,
+)
+
+__all__ = [
+    "ChaosKill",
+    "ChaosSpecError",
+    "Injector",
+    "Rule",
+    "arm",
+    "check_step",
+    "configure",
+    "enabled",
+    "fire",
+    "get",
+    "parse_spec",
+    "reset",
+]

@@ -1,0 +1,6 @@
+"""``python -m bluefog_tpu.chaos`` == the ``bfchaos-tpu`` CLI."""
+
+from bluefog_tpu.chaos.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,90 @@
+"""``bfchaos-tpu`` — run a command under a deterministic fault spec.
+
+::
+
+    bfchaos-tpu --spec "server:drop:after_frames=40" -- \\
+        python examples/async_dsgd_mp.py
+    bfchaos-tpu --spec "rank2:sigkill:at_step=8" --explain
+    bfchaos-tpu --grammar
+
+The spec is validated HERE (a typo fails fast with the offending rule,
+not silently deep inside a worker), exported to the child as
+``BLUEFOG_TPU_CHAOS``, and the child's transport/runner shims do the
+injecting.  ``--explain`` prints the parsed rules without running
+anything; ``--grammar`` prints the spec grammar.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+from bluefog_tpu.chaos.injector import ChaosSpecError, parse_spec
+
+__all__ = ["main"]
+
+_GRAMMAR = """\
+spec  := rule (';' rule)*
+rule  := site ':' fault (':' key '=' value)*
+site  := 'server' | 'ack' | 'client' | 'any' | 'rank<N>'
+fault := drop | truncate | delay | stall            (socket sites)
+       | sigkill | sigstop | die | stall            (rank sites)
+
+socket keys: after_frames=N  every=K  prob=P  times=T  seed=S
+             ms=M (delay)    s=S (stall)
+rank keys:   at_step=N  after_s=T  for_s=T (sigstop thaw / stall length)
+
+examples:
+  server:drop:after_frames=40      cut a server connection at frame 40
+  ack:drop:after_frames=3          apply batch 3, drop before the ack
+  client:truncate:after_frames=5   send half a frame, then cut
+  server:delay:ms=20:prob=0.1      delay 10%% of frames by 20 ms
+  rank2:sigkill:at_step=8          rank 2 SIGKILLs itself at step 8
+  rank1:sigstop:after_s=0.8:for_s=1  freeze rank 1 for 1 s, then thaw
+"""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bfchaos-tpu",
+        description="Run a command under a deterministic "
+                    "BLUEFOG_TPU_CHAOS fault spec.")
+    ap.add_argument("--spec", default=None,
+                    help="chaos spec (see --grammar)")
+    ap.add_argument("--explain", action="store_true",
+                    help="parse and print the rules, run nothing")
+    ap.add_argument("--grammar", action="store_true",
+                    help="print the spec grammar and exit")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to run (prefix with --)")
+    args = ap.parse_args(argv)
+
+    if args.grammar:
+        print(_GRAMMAR)
+        return 0
+    if args.spec is None:
+        ap.error("--spec is required (or use --grammar)")
+    try:
+        rules = parse_spec(args.spec)
+    except ChaosSpecError as e:
+        print(f"bfchaos-tpu: bad spec: {e}", file=sys.stderr)
+        return 2
+    if args.explain:
+        for i, r in enumerate(rules):
+            print(f"rule {i}: {r}")
+        return 0
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (bfchaos-tpu --spec ... -- cmd args)")
+    env = dict(os.environ)
+    env["BLUEFOG_TPU_CHAOS"] = args.spec
+    return subprocess.call(cmd, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

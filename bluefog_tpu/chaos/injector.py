@@ -1,0 +1,378 @@
+"""The chaos injector: spec parsing, trigger evaluation, fault execution.
+
+See the package docstring for the grammar.  Design notes:
+
+- **Cheap when off.**  ``fire()`` is one module-level call with a None
+  check; the env var is read once and cached (``configure``/``reset``
+  invalidate), so instrumented hot paths (one check per wire frame) cost
+  nothing in production.
+- **Deterministic.**  Triggers are per-rule counters over the traffic
+  the site actually sees (``after_frames``/``every``), and ``prob``
+  draws from ``random.Random(seed ^ rule_index)`` — the same run
+  produces the same injection sequence.
+- **Faults are executed where they are honest.**  Socket rules only
+  *return an action*; the transport shim applies it (closing ITS socket,
+  sleeping on ITS thread).  Process rules execute real signals on the
+  current process — a SIGKILLed rank dies exactly as an OOM-killed one
+  would.  ``sigstop`` with ``for_s`` spawns a detached helper child that
+  sleeps and SIGCONTs the parent (a stopped process cannot resume
+  itself).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.metrics import comm as _mt
+
+__all__ = [
+    "ChaosKill",
+    "ChaosSpecError",
+    "Injector",
+    "Rule",
+    "arm",
+    "check_step",
+    "configure",
+    "enabled",
+    "fire",
+    "get",
+    "parse_spec",
+    "reset",
+]
+
+_ENV = "BLUEFOG_TPU_CHAOS"
+
+_SOCKET_FAULTS = ("drop", "truncate", "delay", "stall")
+_RANK_FAULTS = ("sigkill", "sigstop", "die", "stall")
+_SOCKET_SITES = ("server", "ack", "client", "any")
+
+_INT_KEYS = ("after_frames", "every", "times", "seed", "at_step")
+_FLOAT_KEYS = ("prob", "ms", "s", "after_s", "for_s")
+
+
+class ChaosKill(Exception):
+    """Raised by a ``die`` rule inside a rank loop — the thread-model
+    analog of SIGKILL.  The resilient runners treat the raising thread
+    as dead (no drain, no final publish); anything else propagating it
+    is a test-harness bug, so it is a plain ``Exception``."""
+
+    def __init__(self, rank: int, step: Optional[int] = None):
+        super().__init__(f"chaos killed rank {rank} at step {step}")
+        self.rank = rank
+        self.step = step
+
+
+class ChaosSpecError(ValueError):
+    """Malformed ``BLUEFOG_TPU_CHAOS`` spec."""
+
+
+@dataclasses.dataclass
+class Rule:
+    site: str                 # 'server' | 'ack' | 'client' | 'any' | 'rank'
+    fault: str
+    rank: Optional[int] = None
+    after_frames: Optional[int] = None
+    every: Optional[int] = None
+    prob: Optional[float] = None
+    times: Optional[int] = None      # None -> default per trigger kind
+    seed: int = 0
+    ms: float = 0.0                  # delay milliseconds
+    s: float = 0.0                   # stall seconds
+    at_step: Optional[int] = None
+    after_s: Optional[float] = None
+    for_s: Optional[float] = None
+
+    def max_fires(self) -> int:
+        """0 = unlimited."""
+        if self.times is not None:
+            return self.times
+        # a one-shot by nature: counter threshold or a scheduled fault
+        if (self.after_frames is not None or self.at_step is not None
+                or self.after_s is not None):
+            return 1
+        return 0
+
+
+def _parse_rule(text: str, index: int) -> Rule:
+    parts = [p.strip() for p in text.split(":") if p.strip()]
+    if len(parts) < 2:
+        raise ChaosSpecError(
+            f"rule {text!r}: need at least '<site>:<fault>'")
+    site_raw, fault = parts[0].lower(), parts[1].lower()
+    rank: Optional[int] = None
+    if site_raw.startswith("rank"):
+        try:
+            rank = int(site_raw[4:])
+        except ValueError:
+            raise ChaosSpecError(
+                f"rule {text!r}: bad rank site {site_raw!r} "
+                "(want e.g. 'rank2')") from None
+        site = "rank"
+        if fault not in _RANK_FAULTS:
+            raise ChaosSpecError(
+                f"rule {text!r}: fault {fault!r} is not a rank fault "
+                f"{_RANK_FAULTS}")
+    elif site_raw in _SOCKET_SITES:
+        site = site_raw
+        if fault not in _SOCKET_FAULTS:
+            raise ChaosSpecError(
+                f"rule {text!r}: fault {fault!r} is not a socket fault "
+                f"{_SOCKET_FAULTS}")
+    else:
+        raise ChaosSpecError(
+            f"rule {text!r}: unknown site {site_raw!r} (want one of "
+            f"{_SOCKET_SITES} or 'rank<N>')")
+    kw: Dict[str, object] = {}
+    for p in parts[2:]:
+        if "=" not in p:
+            raise ChaosSpecError(f"rule {text!r}: bad key=value {p!r}")
+        k, v = p.split("=", 1)
+        k = k.strip().lower()
+        try:
+            if k in _INT_KEYS:
+                kw[k] = int(v)
+            elif k in _FLOAT_KEYS:
+                kw[k] = float(v)
+            else:
+                raise ChaosSpecError(
+                    f"rule {text!r}: unknown key {k!r}")
+        except ValueError:
+            raise ChaosSpecError(
+                f"rule {text!r}: bad value for {k!r}: {v!r}") from None
+    rule = Rule(site=site, fault=fault, rank=rank,
+                seed=int(kw.pop("seed", index)), **kw)  # type: ignore
+    if rule.site == "rank" and rule.at_step is None and rule.after_s is None:
+        raise ChaosSpecError(
+            f"rule {text!r}: rank faults need at_step= or after_s=")
+    if rule.fault == "die" and rule.at_step is None:
+        raise ChaosSpecError(
+            f"rule {text!r}: 'die' is a thread-loop fault and needs "
+            "at_step= (a timer thread cannot kill another thread)")
+    if rule.prob is not None and not (0.0 <= rule.prob <= 1.0):
+        raise ChaosSpecError(f"rule {text!r}: prob must be in [0, 1]")
+    return rule
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    rules = [
+        _parse_rule(part, i)
+        for i, part in enumerate(p for p in spec.split(";") if p.strip())
+    ]
+    if not rules:
+        raise ChaosSpecError(f"empty chaos spec {spec!r}")
+    return rules
+
+
+class Injector:
+    """Evaluates the parsed rules against the traffic.  Thread-safe: the
+    shims call in from server daemon threads, stream sender threads, and
+    rank loops concurrently."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.rules = parse_spec(spec)
+        self._mu = threading.Lock()
+        self._counters: Dict[int, int] = {i: 0 for i in range(len(self.rules))}
+        self._fired: Dict[int, int] = {i: 0 for i in range(len(self.rules))}
+        self._rngs = [random.Random((r.seed << 8) ^ i)
+                      for i, r in enumerate(self.rules)]
+        self._armed: set = set()
+        self._timers: List[threading.Timer] = []
+
+    # ------------------------------------------------------------ triggers
+    def _record(self, rule: Rule, idx: int, **ctx) -> None:
+        self._fired[idx] += 1
+        _bb.record("chaos_inject", site=rule.site, fault=rule.fault,
+                   rule=idx, **{k: v for k, v in ctx.items()
+                                if isinstance(v, (str, int, float))})
+        _mt.inc("bf_chaos_injections_total", 1.0, fault=rule.fault,
+                site=rule.site)
+
+    def fire(self, site: str, **ctx) -> Optional[Tuple]:
+        """Socket shim entry: count this frame for every matching rule
+        and return the first triggered action —
+        ``('drop',) | ('truncate',) | ('delay', s) | ('stall', s)`` —
+        or None.  Called per wire frame; must stay cheap."""
+        action: Optional[Tuple] = None
+        with self._mu:
+            for i, r in enumerate(self.rules):
+                # rank rules never match here: fire() sites are the
+                # socket shims, and 'any' is defined as any SOCKET site
+                if r.site != site and r.site != "any":
+                    continue
+                self._counters[i] += 1
+                if action is not None:
+                    continue  # keep counting other rules
+                mx = r.max_fires()
+                if mx and self._fired[i] >= mx:
+                    continue
+                hit = True
+                if r.after_frames is not None:
+                    hit = self._counters[i] == r.after_frames
+                elif r.every is not None:
+                    hit = self._counters[i] % max(r.every, 1) == 0
+                elif r.prob is not None:
+                    hit = self._rngs[i].random() < r.prob
+                if not hit:
+                    continue
+                self._record(r, i, **ctx)
+                if r.fault == "drop":
+                    action = ("drop",)
+                elif r.fault == "truncate":
+                    action = ("truncate",)
+                elif r.fault == "delay":
+                    action = ("delay", r.ms / 1000.0)
+                else:  # stall
+                    action = ("stall", r.s)
+        return action
+
+    # ------------------------------------------------------- process faults
+    def _execute_rank_fault(self, rule: Rule, idx: int, rank: int,
+                            step: Optional[int]) -> None:
+        self._record(rule, idx, rank=rank, step=step if step is not None
+                     else -1)
+        if rule.fault == "die":
+            raise ChaosKill(rank, step)
+        if rule.fault == "stall":
+            time.sleep(rule.s if rule.s > 0 else (rule.for_s or 0.0))
+            return
+        if rule.fault == "sigkill":
+            os.kill(os.getpid(), signal.SIGKILL)
+            return  # unreachable
+        if rule.fault == "sigstop":
+            if rule.for_s:
+                # a stopped process cannot SIGCONT itself: detach a tiny
+                # helper that sleeps through the freeze and thaws us
+                subprocess.Popen(
+                    [sys.executable, "-c",
+                     "import time,os,signal,sys;"
+                     "time.sleep(float(sys.argv[1]));"
+                     "os.kill(int(sys.argv[2]), signal.SIGCONT)",
+                     str(rule.for_s), str(os.getpid())],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            os.kill(os.getpid(), signal.SIGSTOP)
+
+    def check_step(self, rank: int, step: int) -> None:
+        """Rank-loop hook: execute any matured ``at_step`` fault for this
+        rank.  ``die`` raises :class:`ChaosKill`; ``stall`` sleeps here;
+        signals are delivered to the current process."""
+        todo: List[Tuple[Rule, int]] = []
+        with self._mu:
+            for i, r in enumerate(self.rules):
+                if r.site != "rank" or r.rank != rank or r.at_step is None:
+                    continue
+                mx = r.max_fires()
+                if mx and self._fired[i] >= mx:
+                    continue
+                if step >= r.at_step:
+                    todo.append((r, i))
+        for r, i in todo:
+            self._execute_rank_fault(r, i, rank, step)
+
+    def arm(self, rank: int) -> None:
+        """Arm wall-clock (``after_s``) faults for this rank.  Idempotent
+        per rank; timers are daemon threads, so an armed fault cannot
+        keep a finished process alive."""
+        with self._mu:
+            if rank in self._armed:
+                return
+            self._armed.add(rank)
+            rules = [(r, i) for i, r in enumerate(self.rules)
+                     if r.site == "rank" and r.rank == rank
+                     and r.after_s is not None]
+        for r, i in rules:
+            t = threading.Timer(
+                r.after_s, self._execute_rank_fault, args=(r, i, rank, None))
+            t.daemon = True
+            t.start()
+            with self._mu:
+                self._timers.append(t)
+
+    def cancel(self) -> None:
+        with self._mu:
+            timers = list(self._timers)
+            self._timers.clear()
+        for t in timers:
+            t.cancel()
+
+    def stats(self) -> Dict[int, Tuple[int, int]]:
+        """rule index -> (frames counted, times fired)."""
+        with self._mu:
+            return {i: (self._counters[i], self._fired[i])
+                    for i in range(len(self.rules))}
+
+
+# ---------------------------------------------------------------------------
+# Process-global state (env-lazy, like metrics/blackbox)
+# ---------------------------------------------------------------------------
+
+_injector: Optional[Injector] = None
+_resolved = False
+_state_mu = threading.Lock()
+
+
+def configure(spec: Optional[str]) -> Optional[Injector]:
+    """Install an injector from ``spec`` (None disables chaos and stops
+    consulting the env until :func:`reset`)."""
+    global _injector, _resolved
+    with _state_mu:
+        if _injector is not None:
+            _injector.cancel()
+        _injector = Injector(spec) if spec else None
+        _resolved = True
+        return _injector
+
+
+def reset() -> None:
+    """Drop any configured injector and re-read the env next time."""
+    global _injector, _resolved
+    with _state_mu:
+        if _injector is not None:
+            _injector.cancel()
+        _injector = None
+        _resolved = False
+
+
+def get() -> Optional[Injector]:
+    global _injector, _resolved
+    if _resolved:
+        return _injector
+    with _state_mu:
+        if not _resolved:
+            spec = os.environ.get(_ENV, "").strip()
+            _injector = Injector(spec) if spec else None
+            _resolved = True
+    return _injector
+
+
+def enabled() -> bool:
+    return get() is not None
+
+
+def fire(site: str, **ctx) -> Optional[Tuple]:
+    """Module-level socket shim (no-op unless chaos is configured)."""
+    inj = get()
+    return None if inj is None else inj.fire(site, **ctx)
+
+
+def check_step(rank: int, step: int) -> None:
+    """Module-level rank-loop shim (no-op unless chaos is configured)."""
+    inj = get()
+    if inj is not None:
+        inj.check_step(rank, step)
+
+
+def arm(rank: int) -> None:
+    """Arm wall-clock process faults for this rank (no-op when off)."""
+    inj = get()
+    if inj is not None:
+        inj.arm(rank)

@@ -50,18 +50,19 @@ from __future__ import annotations
 import os
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import contextlib
 
 import numpy as np
 
+from bluefog_tpu import chaos as _chaos
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
-from bluefog_tpu.runtime import native
-from bluefog_tpu.topology.graphs import Topology
-from bluefog_tpu.utils import timeline as _timeline
+from bluefog_tpu.runtime import native, resilience as _res
+from bluefog_tpu.topology.graphs import Topology, heal as _heal
+from bluefog_tpu.utils import log as _log, timeline as _timeline
 
 
 @contextlib.contextmanager
@@ -335,13 +336,18 @@ class AsyncWindow:
         return int(v)
 
     def deposit_async(self, slot: int, arr: np.ndarray, *,
-                      accumulate: bool = True) -> int:
+                      accumulate: bool = True, copy: bool = True) -> int:
         """Pipelined-transport-compatible spelling of :meth:`deposit`.
         In-process and shm deposits are already one-sided memory writes
         with nothing in flight afterwards, so this IS the synchronous
         deposit — the alias exists so loops written against the pipelined
         DCN handles (``deposit_async`` + :meth:`flush` fence) run
-        unchanged on every transport."""
+        unchanged on every transport.  ``copy`` is accepted for exact
+        signature parity with ``PipelinedRemoteWindow.deposit_async``
+        (asserted by a test so the one-loop-body invariant cannot
+        drift); both values behave identically here because the payload
+        is consumed before this call returns."""
+        del copy
         return self.deposit(slot, arr, accumulate=accumulate)
 
     def flush(self, timeout_s: Optional[float] = None) -> None:
@@ -554,6 +560,14 @@ class PushSumReport:
     true_mean: np.ndarray      # (n_elems,)
     max_abs_err: float
     total_mass: float          # sum of p over ranks; must stay == n_ranks
+    # fault-tolerant runs: ranks declared DEAD and the push-sum mass they
+    # carried to the grave (audit invariant: total_mass + died_mass == n)
+    dead_ranks: List[int] = field(default_factory=list)
+    died_mass: float = 0.0
+    # per-rank health transition log [(t, from_state, to_state)] from the
+    # shared board — the DEAD -> REJOINED timeline, durable past the
+    # blackbox ring's eviction horizon
+    health_transitions: Optional[Dict[int, list]] = None
 
 
 def run_async_pushsum(
@@ -565,6 +579,7 @@ def run_async_pushsum(
     timeout_s: float = 30.0,
     name: str = "async_pushsum",
     poll_interval_s: float = 0.002,
+    resilience: Optional[_res.ResilienceConfig] = None,
 ) -> PushSumReport:
     """Asynchronous push-sum over ``topology`` with deliberately skewed rank
     step rates; returns once every rank's ``x / p`` is within ``tol`` of the
@@ -576,6 +591,19 @@ def run_async_pushsum(
       skew: per-rank extra sleep (seconds) per step — rank-dependent compute
         time.  Default makes the slowest rank ~5x the fastest.
       tol / timeout_s: convergence gate.
+      resilience: opt into peer-fault tolerance.  Ranks then beat a shared
+        :class:`~bluefog_tpu.runtime.resilience.HealthBoard` each round; a
+        rank that stops beating (chaos ``die``/``stall``, a crashed thread)
+        is declared DEAD after ``dead_after_s`` of silence and the
+        survivors re-normalize their mixing weights over the surviving set
+        (:func:`bluefog_tpu.topology.heal`) — push-sum's weight channel
+        keeps the surviving average unbiased through the change.  A rank
+        that beats again is REJOINED and re-admitted at the next round
+        boundary.  Convergence is then judged by survivor CONSENSUS (the
+        surviving average is the mass-weighted mean of what survived, not
+        the original ``x0`` mean).  ``dead_after_s`` must exceed the
+        slowest rank's per-step sleep, or healthy-but-slow ranks read as
+        dead.
 
     Protocol per rank step (no barriers anywhere):
       1. consume own landing slots, folding received (x, p) mass in;
@@ -584,7 +612,8 @@ def run_async_pushsum(
       3. publish the current estimate; sleep ``skew[r]``.
     A monitor thread watches the published estimates and raises the global
     stop flag on convergence; ranks then drain any remaining in-flight mass
-    so the mass-conservation invariant (sum p == n) holds exactly.
+    so the mass-conservation invariant (sum p == n; with deaths,
+    ``total_mass + died_mass == n``) holds exactly.
     """
     n = topology.size
     x0 = np.asarray(x0, np.float64)
@@ -611,14 +640,37 @@ def run_async_pushsum(
     estimates = x0.copy()
     est_mu = threading.Lock()
     errors: List[BaseException] = []
+    board = (_res.HealthBoard(n, suspect_after_s=resilience.suspect_after_s,
+                              dead_after_s=resilience.dead_after_s)
+             if resilience is not None else None)
+    died = [False] * n
+    died_mass = [0.0] * n
 
     def rank_loop(r: int):
+        x = x0[r].copy()
+        p = 1.0
         try:
-            x = x0[r].copy()
-            p = 1.0
-            frac = 1.0 / (len(out_nbrs[r]) + 1)
+            my_out = list(out_nbrs[r])
+            frac = 1.0 / (len(my_out) + 1)
+            known_dead: set = set()
             while not stop.is_set():
-                # 1. consume whatever landed (possibly nothing — stale is ok)
+                _chaos.check_step(r, steps[r])
+                if board is not None:
+                    board.beat(r)
+                    dead_now = board.dead_ranks() - {r}
+                    if dead_now != known_dead:
+                        # round boundary: re-admit any REJOINED rank (it
+                        # left the dead set by beating again) and heal
+                        # the mixing weights over the current survivors
+                        for j in known_dead - dead_now:
+                            board.admit(j)
+                        known_dead = set(dead_now)
+                        healed = _heal(topology, known_dead)
+                        my_out = list(healed.out_neighbors(r))
+                        frac = 1.0 / (len(my_out) + 1)
+                # 1. consume whatever landed (possibly nothing — stale is
+                # ok; slots of DEAD in-neighbors still drain their final
+                # in-flight mass, which is what keeps the audit exact)
                 for k in range(len(in_nbrs[r])):
                     buf, fresh = wins[r].read(k, consume=True)
                     if fresh > 0:
@@ -626,7 +678,7 @@ def run_async_pushsum(
                         p += buf[-1]
                 # 2. split mass outward — receivers need not be listening
                 payload = np.concatenate([x * frac, [p * frac]])
-                for j in out_nbrs[r]:
+                for j in my_out:
                     wins[j].deposit(slot_of[j][r], payload, accumulate=True)
                 x *= frac
                 p *= frac
@@ -644,6 +696,13 @@ def run_async_pushsum(
             with est_mu:
                 estimates[r] = x / p
             wins[r].set_self(np.concatenate([x, [p]]))
+        except _chaos.ChaosKill:
+            # simulated rank death: no drain, no publish — but being
+            # in-process, the corpse can leave a last will recording the
+            # mass it took down, which makes the survivors' audit exact:
+            # total_mass + died_mass == n
+            died[r] = True
+            died_mass[r] = p
         except BaseException as e:  # surfaced by the caller
             errors.append(e)
             stop.set()
@@ -659,10 +718,21 @@ def run_async_pushsum(
         time.sleep(poll_interval_s * 5)
         if errors:
             break
+        alive = [r for r in range(n) if not died[r]]
+        if not alive:
+            break  # chaos killed everyone; report below says so
         with est_mu:
-            err = float(np.abs(estimates - true_mean).max())
-        # every rank must also have taken a few steps (no vacuous pass)
-        if err < tol and min(steps) >= 3:
+            if board is None:
+                err = float(np.abs(estimates - true_mean).max())
+            else:
+                # with deaths the surviving average is the mass-weighted
+                # mean of what survived, unknowable in advance — judge
+                # survivor CONSENSUS instead
+                zs = estimates[alive]
+                err = float(np.abs(zs - zs.mean(axis=0)).max())
+        # every live rank must also have taken a few steps (no vacuous
+        # pass); post-death, survivors must have stepped past the kill
+        if err < tol and min(steps[r] for r in alive) >= 3:
             converged = True
             break
     stop.set()
@@ -684,7 +754,10 @@ def run_async_pushsum(
         raise errors[0]
 
     # Mass invariant: self mass + anything deposited after a rank's final
-    # drain (threads are joined, so slot reads race with nothing).
+    # drain (threads are joined, so slot reads race with nothing).  A dead
+    # rank's window still participates: its landing slots hold the mass
+    # that was in flight toward the corpse, and counting it is what makes
+    # the audit exact — total + died_mass == n.
     total_mass = 0.0
     for r in range(n):
         total_mass += float(wins[r].read_self()[-1])
@@ -692,8 +765,15 @@ def run_async_pushsum(
             buf, fresh = wins[r].read(k, consume=False)
             if fresh > 0:
                 total_mass += float(buf[-1])
+    alive = [r for r in range(n) if not died[r]]
     with est_mu:
-        final_err = float(np.abs(estimates - true_mean).max())
+        if not alive:
+            final_err = float("inf")  # no survivors, no consensus claim
+        elif board is None or not any(died):
+            final_err = float(np.abs(estimates - true_mean).max())
+        else:
+            zs = estimates[alive]
+            final_err = float(np.abs(zs - zs.mean(axis=0)).max())
     report = PushSumReport(
         converged=converged and final_err < 10 * tol,
         wall_time_s=wall,
@@ -702,6 +782,11 @@ def run_async_pushsum(
         true_mean=true_mean,
         max_abs_err=final_err,
         total_mass=total_mass,
+        dead_ranks=[r for r in range(n) if died[r]],
+        died_mass=float(sum(died_mass)),
+        health_transitions=(
+            {r: board.transitions(r) for r in range(n)}
+            if board is not None else None),
     )
     for w in wins:
         w.free()
@@ -723,6 +808,17 @@ class DSGDReport:
     final_params: list               # per rank, de-biased z = x/p pytrees
     total_mass: float                # sum of p over ranks (+ in flight) == n
     consensus_gap: float             # max over ranks of max|z_r - z_mean|
+    # fault-tolerant runs only:
+    dead_ranks: List[int] = field(default_factory=list)
+    # thread-mode: mass the chaos-killed threads carried to the grave
+    # (exact audit: total_mass + died_mass == n)
+    died_mass: float = 0.0
+    # process-mode: the surviving set's mass measured at the post-heal
+    # rendezvous (exact audit: total_mass == baseline_mass)
+    baseline_mass: Optional[float] = None
+    # thread-mode: per-rank health transitions [(t, from, to)] from the
+    # shared board (see PushSumReport.health_transitions)
+    health_transitions: Optional[Dict[int, list]] = None
 
 
 def run_async_dsgd(
@@ -735,6 +831,7 @@ def run_async_dsgd(
     skew: Optional[Sequence[float]] = None,
     name: str = "async_dsgd",
     poll_interval_s: float = 0.0,
+    resilience: Optional[_res.ResilienceConfig] = None,
 ) -> DSGDReport:
     """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
     over the passive-target windows: the execution model of the reference's
@@ -773,6 +870,14 @@ def run_async_dsgd(
         mass so the audit is exact).
       skew: per-rank extra sleep per step; default makes the slowest rank
         ~5x the fastest (the asynchrony the SPMD path cannot express).
+      resilience: opt into peer-fault tolerance (see
+        :func:`run_async_pushsum`): ranks beat a shared health board each
+        round, a silent rank is declared DEAD after ``dead_after_s`` and
+        healed out of the mixing weights (:func:`bluefog_tpu.topology.
+        heal`); a rank that beats again is re-admitted at the next round
+        boundary.  A chaos-killed thread leaves a last will of the mass
+        it carried, so the audit stays exact: ``report.total_mass +
+        report.died_mass == n``.
     """
     n = topology.size
     packer = TreePacker(params0, np.float64)
@@ -795,24 +900,44 @@ def run_async_dsgd(
     finals: list = [None] * n
     errors: List[BaseException] = []
     x0 = packer.pack(params0)
+    board = (_res.HealthBoard(n, suspect_after_s=resilience.suspect_after_s,
+                              dead_after_s=resilience.dead_after_s)
+             if resilience is not None else None)
+    died = [False] * n
+    died_mass = [0.0] * n
 
     def rank_loop(r: int):
+        p = 1.0
         try:
             x = x0.copy()
-            p = 1.0
-            frac = 1.0 / (len(out_nbrs[r]) + 1)
+            my_out = list(out_nbrs[r])
+            frac = 1.0 / (len(my_out) + 1)
+            known_dead: set = set()
             # model-sized scratch, allocated once: the hot loop must not
             # churn fresh ~d-element buffers per step (d can be 10^8)
             gvec = np.empty(d, np.float64)
             payload = np.empty(d + 1, np.float64)
             rec = _bb.get()  # flight recorder (None when off)
             while not stop.is_set():
+                _chaos.check_step(r, steps[r])
+                if board is not None:
+                    board.beat(r)
+                    dead_now = board.dead_ranks() - {r}
+                    if dead_now != known_dead:
+                        # heal at the round boundary: re-admit REJOINED
+                        # ranks, re-normalize weights over survivors
+                        for j in known_dead - dead_now:
+                            board.admit(j)
+                        known_dead = set(dead_now)
+                        healed = _heal(topology, known_dead)
+                        my_out = list(healed.out_neighbors(r))
+                        frac = 1.0 / (len(my_out) + 1)
                 # per-round blackbox markers: a begin without its end in a
                 # dump names the round (and rank) the loop wedged in
                 if rec is not None:
                     rec.begin("collective", key=("async_dsgd", r, steps[r]),
                               op="async_dsgd_round", cid="async_dsgd_round",
-                              step=steps[r], rank=r, peers=out_nbrs[r])
+                              step=steps[r], rank=r, peers=my_out)
                 for k in range(len(in_nbrs[r])):
                     buf, fresh = wins[r].read(k, consume=True)
                     if fresh > 0:
@@ -828,7 +953,7 @@ def run_async_dsgd(
                 payload[:-1] = x
                 payload[-1] = p
                 payload *= frac
-                for j in out_nbrs[r]:
+                for j in my_out:
                     wins[j].deposit(slot_of[j][r], payload, accumulate=True)
                 x *= frac
                 p *= frac
@@ -849,6 +974,11 @@ def run_async_dsgd(
                     p += buf[-1]
             finals[r] = x / p
             wins[r].set_self(np.concatenate([x, [p]]))
+        except _chaos.ChaosKill:
+            # simulated death: no drain, no final publish; the last will
+            # (mass carried to the grave) keeps the audit exact
+            died[r] = True
+            died_mass[r] = p
         except BaseException as e:
             errors.append(e)
             stop.set()
@@ -880,15 +1010,27 @@ def run_async_dsgd(
             if fresh > 0:
                 total_mass += float(buf[-1])
 
-    zs = np.stack(finals)
-    gap = float(np.abs(zs - zs.mean(axis=0)).max())
+    # consensus over SURVIVORS (a chaos-killed rank has no final z; its
+    # window's residual mass was already counted by the audit above)
+    alive = [r for r in range(n) if finals[r] is not None]
+    if alive:
+        zs = np.stack([finals[r] for r in alive])
+        gap = float(np.abs(zs - zs.mean(axis=0)).max())
+    else:
+        gap = float("inf")  # chaos killed every rank
     report = DSGDReport(
         wall_time_s=wall,
         steps_per_rank=list(steps),
         losses=losses,
-        final_params=[packer.unpack(z) for z in finals],
+        final_params=[packer.unpack(finals[r]) if finals[r] is not None
+                      else None for r in range(n)],
         total_mass=total_mass,
         consensus_gap=gap,
+        dead_ranks=[r for r in range(n) if died[r]],
+        died_mass=float(sum(died_mass)),
+        health_transitions=(
+            {r: board.transitions(r) for r in range(n)}
+            if board is not None else None),
     )
     for w in wins:
         w.free()
@@ -918,24 +1060,45 @@ class FileBarrier:
     audit finished) and explicitly NO collective runtime in between — a
     shared directory is the whole requirement, so the barrier does not drag
     jax.distributed into the async path.  Rank ``r`` touches
-    ``<dir>/<stage>.<r>`` and waits until all ``n`` exist."""
+    ``<dir>/<stage>.<r>`` and waits until all ``n`` exist.
+
+    :attr:`exclude` is the barrier's fault-tolerance: ranks declared DEAD
+    by the resilience layer go in this set and are no longer waited for —
+    survivors stop burning the full timeout per stage on a corpse.  The
+    exclusion set is re-read every poll, so a rank that is declared dead
+    *while* others already wait unblocks them immediately."""
 
     def __init__(self, path: str, n_ranks: int, rank: int):
         self.path = path
         self.n = int(n_ranks)
         self.rank = int(rank)
+        self.exclude: set = set()
         os.makedirs(path, exist_ok=True)
 
     def wait(self, stage: str, timeout_s: float = 120.0) -> None:
         open(os.path.join(self.path, f"{stage}.{self.rank}"), "w").close()
-        want = [os.path.join(self.path, f"{stage}.{r}")
-                for r in range(self.n)]
+
+        def missing_ranks():
+            return [r for r in range(self.n)
+                    if r not in self.exclude and not os.path.exists(
+                        os.path.join(self.path, f"{stage}.{r}"))]
+
         t0 = time.perf_counter()
-        while not all(os.path.exists(p) for p in want):
+        while missing_ranks():
             if time.perf_counter() - t0 > timeout_s:
-                missing = [p for p in want if not os.path.exists(p)]
+                missing = missing_ranks()
+                # rank NUMBERS, not paths: the cross-rank merge needs to
+                # name the absent rank, and the blackbox event makes the
+                # timeout part of the incident record before the raise
+                # unwinds this process
+                _bb.record("barrier_timeout", stage=stage,
+                           missing_ranks=missing, rank=self.rank,
+                           waited_s=round(time.perf_counter() - t0, 3),
+                           dir=self.path)
                 raise TimeoutError(
-                    f"barrier {stage!r} timed out; missing {missing}")
+                    f"barrier {stage!r} timed out after {timeout_s:.0f}s "
+                    f"on rank {self.rank}; missing rank(s) {missing} "
+                    f"(dir {self.path})")
             time.sleep(0.005)
 
 
@@ -977,14 +1140,20 @@ class _RemoteHandle:
             slot, np.ascontiguousarray(arr, self.dtype),
             accumulate=accumulate)
 
-    def deposit_async(self, slot, arr, *, accumulate=True):
+    def deposit_async(self, slot, arr, *, accumulate=True, copy=True):
         """Fire-and-forget on the pipelined DCN transport; synchronous
         (equivalent, just not overlapped) on the plain one."""
         fn = getattr(self._rw, "deposit_async", None)
         a = np.ascontiguousarray(arr, self.dtype)
         if fn is None:
             return self._rw.deposit(slot, a, accumulate=accumulate)
-        return fn(slot, a, accumulate=accumulate)
+        return fn(slot, a, accumulate=accumulate, copy=copy)
+
+    @property
+    def health(self):
+        """Peer health of the underlying pipelined stream (None on the
+        sync client or when resilience is off)."""
+        return getattr(self._rw, "health", None)
 
     def flush(self, timeout_s: Optional[float] = None) -> None:
         """Fence for :meth:`deposit_async` (no-op on the sync client)."""
@@ -1017,13 +1186,15 @@ class _TcpTransport:
     and must stay off when the exact push-sum mass audit matters."""
 
     def __init__(self, bind_host: str = "0.0.0.0", *, pipeline: bool = True,
-                 wire_codec: Optional[str] = None):
+                 wire_codec: Optional[str] = None,
+                 resilience: Optional[_res.ResilienceConfig] = None):
         from bluefog_tpu.runtime.window_server import WindowServer
 
         self._server = WindowServer()
         self._server.start(bind_host)
         self._pipeline = pipeline
         self._codec = wire_codec
+        self._resilience = resilience
         self._addrs: Dict[int, Tuple[str, int]] = {}
 
     def create(self, wname: str, n_slots: int, n_elems: int) -> AsyncWindow:
@@ -1063,8 +1234,17 @@ class _TcpTransport:
                                                        RemoteWindow)
 
         if self._pipeline:
-            rw = PipelinedRemoteWindow(self._addrs[owner], wname,
-                                       codec=self._codec)
+            cfg = self._resilience
+            if cfg is not None:
+                rw = PipelinedRemoteWindow(
+                    self._addrs[owner], wname, codec=self._codec,
+                    reconnect=cfg.backoff_kwargs(),
+                    heartbeat_interval_s=cfg.heartbeat_interval_s or None,
+                    suspect_after_s=cfg.suspect_after_s,
+                    dead_after_s=cfg.dead_after_s)
+            else:
+                rw = PipelinedRemoteWindow(self._addrs[owner], wname,
+                                           codec=self._codec)
         else:
             rw = RemoteWindow(self._addrs[owner], wname)
         return _RemoteHandle(rw, n_slots, n_elems)
@@ -1088,6 +1268,7 @@ def run_async_dsgd_rank(
     transport: str = "shm",
     tcp_bind: str = "0.0.0.0",
     wire_codec: Optional[str] = None,
+    resilience: Optional[_res.ResilienceConfig] = None,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -1118,6 +1299,25 @@ def run_async_dsgd_rank(
     extra per-step sleep (pass different values per process to realize the
     skewed execution the SPMD path cannot express).
 
+    ``resilience`` (tcp transport only) opts into peer-fault tolerance:
+    deposit streams reconnect with bounded backoff and replay their
+    unacked batches; a SUSPECT peer's share is WITHHELD (kept, not
+    deposited — unbiased under the push-sum weight channel, and it stops
+    the sender bleeding mass into a possible corpse during the detection
+    window; idle heartbeats are what clear the suspicion); a peer whose
+    reconnect budget is exhausted is declared DEAD, announced to the
+    other survivors through a tombstone file in the barrier directory,
+    and healed out of the mixing weights.
+    The survivors then hold a quiesce-rendezvous (fence + heal barrier,
+    dead ranks excluded) and record the surviving set's exact push-sum
+    mass as ``report.baseline_mass`` — the final audit over the
+    survivors must reproduce it exactly (``report.total_mass ==
+    report.baseline_mass``).  Requirements: the barrier directory is the
+    dissemination channel, rank 0 (the reporting rank) must survive, and
+    one failure event settles before the next is detected (staggered
+    single failures are fine; a simultaneous multi-rank wipe may time
+    out the heal rendezvous and abort).
+
     Returns a :class:`DSGDReport` on rank 0 (``losses`` filled only at index
     ``rank`` — other ranks' loss curves stay in their processes), ``None``
     elsewhere.
@@ -1125,7 +1325,8 @@ def run_async_dsgd_rank(
     if transport == "shm":
         tx = _ShmTransport()
     elif transport == "tcp":
-        tx = _TcpTransport(tcp_bind, pipeline=True, wire_codec=wire_codec)
+        tx = _TcpTransport(tcp_bind, pipeline=True, wire_codec=wire_codec,
+                           resilience=resilience)
     elif transport == "tcp-sync":
         # the pre-pipelining wire shape (one blocking round-trip per
         # deposit) — kept selectable for A/B measurement and bisection
@@ -1163,7 +1364,8 @@ def run_async_dsgd_rank(
             topology, rank, params0, loss_and_grad, barrier=barrier, lr=lr,
             duration_s=duration_s, skew_s=skew_s, name=name,
             poll_interval_s=poll_interval_s, win=win, transport=tx,
-            create_window=_create, open_window=_open)
+            create_window=_create, open_window=_open,
+            resilience=resilience if transport == "tcp" else None)
     finally:
         for w in opened:
             try:
@@ -1175,7 +1377,8 @@ def run_async_dsgd_rank(
 
 def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         lr, duration_s, skew_s, name, poll_interval_s, win,
-                        transport, create_window, open_window):
+                        transport, create_window, open_window,
+                        resilience=None):
     n = topology.size
     packer = TreePacker(params0, np.float64)
     d = packer.size
@@ -1200,23 +1403,152 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
 
     x = packer.pack(params0)
     p = 1.0
-    frac = 1.0 / (len(out_nbrs) + 1)
+    my_out = list(out_nbrs)
+    frac = 1.0 / (len(my_out) + 1)
     gvec = np.empty(d, np.float64)
     payload = np.empty(d + 1, np.float64)
     losses: List[float] = []
     steps = 0
+    cfg = resilience
+    dead: set = set()
+    baseline_mass: Optional[float] = None
+    exact = True  # False once a failure escapes the rendezvous protocol
     rec = _bb.get()  # per-PROCESS flight recorder (None when off)
     if rec is not None and rec.rank is None:
         # one OS process per rank here: pin the dump identity so a
         # shared (e.g. NFS) incident dir gets blackbox-rank<r>.jsonl per
         # rank instead of every process fighting over rank 0's file
         rec.rank = rank
+    _chaos.arm(rank)
+
+    # ---------------------------------------------------- fault handling
+    def _tombstone(j: int) -> None:
+        # announce a death to survivors that may never touch the dead
+        # rank's transport themselves (the barrier dir is the one shared
+        # medium every rank already polls)
+        path = os.path.join(barrier.path, f"dead.{j}")
+        try:
+            open(path, "w").close()
+        except OSError:
+            pass
+
+    def _tombstoned() -> set:
+        return {r2 for r2 in range(n)
+                if r2 != rank and r2 not in dead and os.path.exists(
+                    os.path.join(barrier.path, f"dead.{r2}"))}
+
+    def _heal_and_rebase(newly: set) -> None:
+        """Declare ``newly`` DEAD, heal the mixing weights over the
+        survivors, and hold the quiesce-rendezvous that makes the
+        surviving set's mass auditable EXACTLY: every survivor fences
+        its live peers, meets at a heal barrier (dead excluded), and
+        measures its local mass while nothing is in flight."""
+        nonlocal my_out, frac, baseline_mass
+        pending = set(newly)
+        while pending:
+            for j in sorted(pending):
+                _tombstone(j)
+                _bb.record("peer_dead", peer=f"rank{j}", rank=rank,
+                           step=steps)
+                _mt.set("bf_peer_state", float(_res.DEAD), peer=f"rank{j}")
+            dead.update(pending)
+            barrier.exclude |= pending
+            for j in pending:
+                peers.pop(j, None)  # the caller's finally frees it
+            pending = set()
+            healed = _heal(topology, dead)
+            my_out = list(healed.out_neighbors(rank))
+            frac = 1.0 / (len(my_out) + 1)
+            # FENCE the survivors: nothing of ours may be in flight when
+            # the baseline is measured.  A fence that fails names the
+            # next corpse — extend and repeat.
+            for j in sorted(my_out):
+                try:
+                    peers[j].flush(cfg.barrier_timeout_s)
+                except (RuntimeError, TimeoutError, OSError):
+                    pending.add(j)
+        stage = "heal" + "".join(f"-{j}" for j in sorted(dead))
+        nonlocal exact
+        try:
+            barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
+            # between the two heal barriers no survivor deposits, so
+            # local mass (own p + unconsumed landing slots) is the whole
+            # truth
+            local = p
+            for k in range(len(in_nbrs)):
+                buf, fresh = win.read(k, consume=False)
+                if fresh > 0:
+                    local += float(buf[-1])
+            mpath = os.path.join(barrier.path, f"{stage}.mass.{rank}")
+            with open(mpath + ".tmp", "w") as f:
+                # repr of a PYTHON float: round-trips to the exact same
+                # binary64 (numpy scalar reprs do not parse back)
+                f.write(repr(float(local)))
+            os.replace(mpath + ".tmp", mpath)
+            barrier.wait(stage + "-resume",
+                         timeout_s=cfg.barrier_timeout_s)
+            total = 0.0
+            for r2 in range(n):
+                if r2 in dead:
+                    continue
+                with open(os.path.join(barrier.path,
+                                       f"{stage}.mass.{r2}")) as f:
+                    total += float(f.read())
+            baseline_mass = total
+        except (TimeoutError, OSError, ValueError) as e:
+            # a survivor never made the rendezvous (it exited the loop
+            # first, or a second failure overlapped the first): the run
+            # goes on healed, but the exactness claim is withdrawn
+            baseline_mass = None
+            exact = False
+            _log.warn("rank %d: heal rendezvous %r degraded (%s: %s); "
+                      "continuing without an exact baseline", rank, stage,
+                      type(e).__name__, e)
+        _bb.record("peer_dead_healed", rank=rank, dead=sorted(dead),
+                   baseline_mass=baseline_mass, exact=exact)
+
+    def _wait_resilient(stage: str) -> None:
+        """Barrier that learns its exclusion set: when ranks die between
+        the loop's detection window and a rendezvous, the timeout names
+        them and the survivors stop waiting for corpses.  Past the
+        rendezvous protocol there is no rebase, so exactness is off."""
+        nonlocal exact
+        if cfg is None:
+            barrier.wait(stage)
+            return
+        try:
+            barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
+        except TimeoutError:
+            missing = {r2 for r2 in range(n)
+                       if r2 not in barrier.exclude and not os.path.exists(
+                           os.path.join(barrier.path, f"{stage}.{r2}"))}
+            if not missing:
+                raise
+            for j in sorted(missing):
+                _tombstone(j)
+            dead.update(missing)
+            barrier.exclude |= missing
+            for j in missing:
+                peers.pop(j, None)
+            exact = False
+            barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
+
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < duration_s:
+        _chaos.check_step(rank, steps)
+        if cfg is not None and steps % 16 == 0:
+            # throttled: n-1 stat() calls against a possibly-NFS barrier
+            # dir have no place on every hot-loop round; 16 rounds adds
+            # at most ~tens of ms to a detection deadline that is
+            # dominated by the reconnect budget anyway (the deposit
+            # failure path below detects independently of this check)
+            newly = _tombstoned()
+            if newly:
+                _heal_and_rebase(newly)
         if rec is not None:
             rec.begin("collective", key=("async_dsgd_mp", rank, steps),
                       op="async_dsgd_round", cid="async_dsgd_round",
-                      step=steps, rank=rank, peers=out_nbrs)
+                      step=steps, rank=rank, peers=my_out)
         for k in range(len(in_nbrs)):
             buf, fresh = win.read(k, consume=True)
             if fresh > 0:
@@ -1231,14 +1563,53 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         payload[:-1] = x
         payload[-1] = p
         payload *= frac
-        for j in out_nbrs:
+        failed: List[int] = []
+        withheld = 0
+        for j in my_out:
+            if cfg is not None:
+                h = peers[j].health
+                if h is not None:
+                    state = h.poll()
+                    if state == _res.REJOINED:
+                        # the stream reconnected to a peer we had given
+                        # up on mid-round: re-admit at THIS round
+                        # boundary and resume sending
+                        h.admit()
+                        state = _res.HEALTHY
+                    if state == _res.DEAD:
+                        failed.append(j)
+                        continue
+                    if state != _res.HEALTHY:
+                        # SUSPECT: withhold this peer's share instead of
+                        # bleeding mass into a possible corpse — any
+                        # row-stochastic split is unbiased under the
+                        # push-sum weight channel, so keeping the share
+                        # is free; sending resumes on recovery.  Without
+                        # this, every round of the detection window
+                        # leaks 1/(deg+1) of our mass into the void.
+                        withheld += 1
+                        continue
             # fire-and-forget on the pipelined DCN transport: the
             # background sender overlaps the wire with the next gradient
             # step; the payload buffer is snapshotted at enqueue, so its
             # reuse on the next iteration is safe
-            peers[j].deposit_async(peer_slot[j], payload, accumulate=True)
+            try:
+                peers[j].deposit_async(peer_slot[j], payload,
+                                       accumulate=True)
+            except (RuntimeError, TimeoutError, OSError):
+                if cfg is None:
+                    raise
+                failed.append(j)
         x *= frac
         p *= frac
+        if failed or withheld:
+            # undelivered shares stay OURS — mass must never evaporate
+            # at a dead peer's doorstep
+            for _ in range(len(failed) + withheld):
+                x += payload[:-1]
+                p += payload[-1]
+        if failed:
+            _heal_and_rebase(set(failed))
         if rec is not None:
             rec.end("collective", key=("async_dsgd_mp", rank, steps),
                     op="async_dsgd_round", cid="async_dsgd_round",
@@ -1254,10 +1625,27 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     # owners' final drain and break the exactly-once mass audit.  The
     # BF-WIN lint (analysis/window_lint.py) errors on loops that skip
     # this.
+    final_failed: set = set()
     for _j, _h in sorted(peers.items()):
-        _h.flush()
+        try:
+            _h.flush()
+        except (RuntimeError, TimeoutError, OSError):
+            if cfg is None:
+                raise
+            final_failed.add(_j)
+    if final_failed:
+        # a peer died after the last detection window: too late for a
+        # rebase rendezvous, so the exactness claim is withdrawn — the
+        # run still completes over the survivors
+        for j in sorted(final_failed):
+            _tombstone(j)
+        dead.update(final_failed)
+        barrier.exclude |= final_failed
+        for j in final_failed:
+            peers.pop(j, None)
+        exact = False
     # no rank deposits after this barrier, so the drain below is exact
-    barrier.wait("stopped")
+    _wait_resilient("stopped")
     wall = time.perf_counter() - t0
     for k in range(len(in_nbrs)):
         buf, fresh = win.read(k, consume=True)
@@ -1267,22 +1655,23 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     win.set_self(np.concatenate([x, [p]]))
     meta.deposit(rank, np.array([steps, losses[-1] if losses else 0.0]),
                  accumulate=False)
-    barrier.wait("done")
+    _wait_resilient("done")
 
     report = None
     if rank == 0:
         wins = {rank: win}
         wins.update(peers)
-        for r in range(n):
+        alive = [r for r in range(n) if r not in dead]
+        for r in alive:
             if r not in wins:
                 wins[r] = open_window(
                     r, f"{name}:{r}",
                     max(len(list(topology.in_neighbors(r))), 1), d + 1)
         total_mass = 0.0
-        zs = np.empty((n, d))
-        for r in range(n):
+        zs = np.empty((len(alive), d))
+        for i, r in enumerate(alive):
             s = wins[r].read_self()
-            zs[r] = s[:-1] / s[-1]
+            zs[i] = s[:-1] / s[-1]
             total_mass += float(s[-1])
             for k in range(wins[r].n_slots):
                 buf, fresh = wins[r].read(k, consume=False)
@@ -1292,17 +1681,22 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                      for r in range(n)]
         all_losses: List[List[float]] = [[] for _ in range(n)]
         all_losses[rank] = losses
+        finals: list = [None] * n
+        for i, r in enumerate(alive):
+            finals[r] = packer.unpack(zs[i])
         report = DSGDReport(
             wall_time_s=wall,
             steps_per_rank=steps_all,
             losses=all_losses,
-            final_params=[packer.unpack(z) for z in zs],
+            final_params=finals,
             total_mass=total_mass,
             consensus_gap=float(np.abs(zs - zs.mean(axis=0)).max()),
+            dead_ranks=sorted(dead),
+            baseline_mass=baseline_mass if exact else None,
         )
     # owners unlink only after the audit has read every segment (the
     # caller's finally frees everything this process opened)
-    barrier.wait("audited")
+    _wait_resilient("audited")
     return report
 
 

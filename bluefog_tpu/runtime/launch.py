@@ -125,6 +125,10 @@ def main(argv: Optional[List[str]] = None):
     )
     _add_common_args(ap)
     ap.add_argument(
+        "--restart-backoff", type=float, default=2.0, metavar="SECONDS",
+        help="with --supervise: initial delay before a restart (doubles "
+             "per attempt with jitter, capped; 0 = restart immediately)")
+    ap.add_argument(
         "--supervise", type=int, default=None, metavar="MAX_RESTARTS",
         help="run the script as a supervised subprocess, restarting it from "
         "its latest checkpoint when it dies (peer failure kills survivors "
@@ -163,7 +167,8 @@ def main(argv: Optional[List[str]] = None):
                     or "bf-incident")
         raise SystemExit(run_supervised(
             [sys.executable, args.script] + list(args.script_args),
-            max_restarts=args.supervise, incident_dir=incident))
+            max_restarts=args.supervise, incident_dir=incident,
+            restart_backoff_s=args.restart_backoff))
     if args.process_id is not None:
         # name this process's blackbox/faulthandler files by its real
         # rank BEFORE install() opens them — co-located processes with a

@@ -39,6 +39,25 @@ Wire protocol **v2** (little-endian).  Every frame starts
   5 FLUSH        name_len == 0, no body.  reply status i64 = deposits
                  applied on this connection since the last FLUSH, or the
                  first latched deferred error (then cleared).
+  6 STREAM_ATTACH  name_len == 0 | stream_id u64, epoch u32.  Binds this
+                 connection to a client :class:`DepositStream` lineage:
+                 the server quiesces any older-epoch connection of the
+                 same stream (drains its applier, so nothing of the old
+                 generation can land afterwards), then replies
+                 status i64 = the highest batch seq ALREADY APPLIED for
+                 this stream — the client drops those from its replay
+                 window and re-sends only the rest, which is what makes
+                 reconnect replay idempotent: a batch that was applied
+                 but un-acked when the connection died is acknowledged
+                 by the attach reply instead of being applied twice.
+                 An attach whose epoch is not strictly newer gets
+                 ``-105`` (a zombie connection can never steal a live
+                 stream).  Requires the RESUME feature bit.
+  7 HEARTBEAT    name_len == 0 | seq u32.  Lightweight peer liveness
+                 probe; reply is an ACK frame ``(seq | 0x80000000, 0)``
+                 so heartbeat replies share the deposit stream's ack
+                 channel without ambiguity.  Requires the HEARTBEAT
+                 feature bit.
 
 Version negotiation is LOUD, never silent: a v2 server answers a v1-magic
 frame with one ``status = -101`` reply and drops the connection (the v1
@@ -78,6 +97,7 @@ from __future__ import annotations
 import collections
 import ctypes
 import itertools
+import os
 import socket
 import socketserver
 import struct
@@ -87,9 +107,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bluefog_tpu import chaos as _chaos
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
-from bluefog_tpu.runtime import native, wire_codec
+from bluefog_tpu.runtime import native, resilience, wire_codec
 from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS, _fallback
 
 __all__ = ["WindowServer", "RemoteWindow", "PipelinedRemoteWindow",
@@ -108,6 +129,9 @@ _BATCH_HDR = struct.Struct("<II")     # seq, count
 _ITEM = struct.Struct("<HiBBBqq")     # name_len, slot, flags, dtype,
                                       # codec, n_elems, wire_bytes
 _ACK = struct.Struct("<Iq")           # seq, status
+_ATTACH = struct.Struct("<QI")        # stream_id, epoch
+_HB = struct.Struct("<I")             # heartbeat seq
+_HB_MARK = 0x8000_0000                # ack-frame seq bit: heartbeat reply
 
 _OP_DEPOSIT = 0
 _OP_GET_SELF = 1
@@ -115,6 +139,8 @@ _OP_READ_SLOT = 2
 _OP_HELLO = 3
 _OP_DEPOSIT_BATCH = 4
 _OP_FLUSH = 5
+_OP_STREAM_ATTACH = 6
+_OP_HEARTBEAT = 7
 
 _FLAG_ACCUMULATE = 1
 _FLAG_DEFERRED_ACK = 2
@@ -123,7 +149,10 @@ _FLAG_DEFERRED_ACK = 2
 FEATURE_BATCH = 1
 FEATURE_CODEC_F32 = 2
 FEATURE_CODEC_TOPK = 4
-_SERVER_FEATURES = FEATURE_BATCH | FEATURE_CODEC_F32 | FEATURE_CODEC_TOPK
+FEATURE_HEARTBEAT = 8
+FEATURE_RESUME = 16   # STREAM_ATTACH + idempotent reconnect replay
+_SERVER_FEATURES = (FEATURE_BATCH | FEATURE_CODEC_F32 | FEATURE_CODEC_TOPK
+                    | FEATURE_HEARTBEAT | FEATURE_RESUME)
 
 _CODEC_FEATURE = {wire_codec.CODEC_NONE: 0,
                   wire_codec.CODEC_F32: FEATURE_CODEC_F32,
@@ -139,6 +168,8 @@ _ERR_BAD_OP = -100
 _ERR_VERSION = -101  # protocol version mismatch (v1 frame / bad HELLO)
 _ERR_CODEC = -102    # codec not granted for this connection / bad payload
 _ERR_TOO_LARGE = -104  # claimed length exceeds any legal encoding
+_ERR_STALE_EPOCH = -105  # attach/batch from a superseded stream epoch
+_ERR_BUSY = -106     # previous stream generation could not be quiesced
 
 _ERR_TEXT = {
     _ERR_GEOMETRY: "size/dtype mismatch with the window's geometry",
@@ -148,6 +179,11 @@ _ERR_TEXT = {
                    f"v{PROTOCOL_VERSION}; peer rejected the handshake)"),
     _ERR_CODEC: "wire codec not negotiated or payload undecodable",
     _ERR_TOO_LARGE: "claimed payload length exceeds any legal encoding",
+    _ERR_STALE_EPOCH: ("stream epoch superseded (a newer connection of "
+                       "this DepositStream attached; this one is a "
+                       "zombie)"),
+    _ERR_BUSY: ("previous stream generation still draining; attach "
+                "again after backoff"),
 }
 
 
@@ -360,7 +396,10 @@ class _ApplyWorker:
         arrival order); blocks when the applier is two frames behind."""
         self._jobs.put((seq, jobs))
 
-    def close(self) -> None:
+    def close(self) -> bool:
+        """Stop the worker after it drains every queued batch; returns
+        True iff the thread is provably finished (callers deciding
+        whether an applied high-water mark is FINAL rely on this)."""
         import queue as _q
 
         self._closed = True  # the loop polls this, so no sentinel race
@@ -370,6 +409,7 @@ class _ApplyWorker:
         except _q.Full:
             pass
         self._thread.join(timeout=5)
+        return not self._thread.is_alive()
 
     def _loop(self) -> None:
         import queue as _q
@@ -412,6 +452,28 @@ class _ApplyWorker:
             _mt.inc("bf_tcp_batches_total", 1.0, peer=self._peer)
             _bb.record("tcp_batch_deposit", seq=seq, applied=applied,
                        err=first_err, peer=self._peer)
+            # the stream's applied high-water mark moves BEFORE the ack
+            # leaves: a reconnecting client must never learn (via
+            # STREAM_ATTACH) that an already-applied batch is still
+            # outstanding, or it would replay it into a double-apply.
+            # The first ERROR is latched alongside — if this ack dies
+            # with the connection, the reconnect attach reports the
+            # error instead of silently retiring the batch as success.
+            h._note_applied(seq, first_err)
+            act = _chaos.fire("ack", peer=self._peer, seq=seq)
+            if act is not None and act[0] == "drop":
+                # injected applied-but-UNACKED failure: the exact
+                # ambiguity the stream-epoch replay protocol resolves —
+                # cut the connection instead of acking
+                for fn in (lambda: self._sock.shutdown(socket.SHUT_RDWR),
+                           self._sock.close):
+                    try:
+                        fn()
+                    except OSError:
+                        pass
+                return
+            if act is not None and act[0] in ("delay", "stall"):
+                time.sleep(act[1])
             try:
                 with self._wlock:
                     self._sock.sendall(_ACK.pack(seq, first_err or applied))
@@ -440,6 +502,9 @@ class _Handler(socketserver.BaseRequestHandler):
         # (handler: sync ops; apply worker: batch acks) — serialize writes
         self._wmu = threading.Lock()
         self._worker: Optional[_ApplyWorker] = None  # created on 1st batch
+        # DepositStream lineage binding (STREAM_ATTACH); None = unbound
+        self._stream_sid: Optional[int] = None
+        self._stream_epoch = 0
 
     def _send(self, data) -> None:
         with self._wmu:
@@ -454,6 +519,33 @@ class _Handler(socketserver.BaseRequestHandler):
             self._worker.close()
         self.server.untrack(self.request)  # type: ignore[attr-defined]
         _bb.record("tcp_disconnect", peer=self.client_address[0])
+
+    def quiesce(self) -> bool:
+        """Fence a superseded connection: close its socket and DRAIN its
+        apply worker, so nothing of the old stream generation can land
+        after the successor's STREAM_ATTACH reply.  Called by the server
+        when a newer epoch of the same stream attaches; safe to race
+        with this handler's own ``finish`` (both paths are idempotent).
+        Returns False when the worker could not be proven drained (the
+        attach must then refuse rather than reply a non-final mark)."""
+        for fn in (lambda: self.request.shutdown(socket.SHUT_RDWR),
+                   self.request.close):
+            try:
+                fn()
+            except OSError:
+                pass
+        w = self._worker
+        if w is not None:
+            return w.close()  # joins: the worker drains every batch
+        return True
+
+    def _note_applied(self, seq: int, err: int = 0) -> None:
+        """Apply-worker callback: advance this stream's applied
+        high-water mark and latch the first batch error (no-op for
+        connections that never attached)."""
+        if self._stream_sid is not None:
+            self.server.note_applied(  # type: ignore[attr-defined]
+                self._stream_sid, self._stream_epoch, seq, err)
 
     # ------------------------------------------------------------ plumbing
     def _geometry(self, ops, name_b):
@@ -546,6 +638,31 @@ class _Handler(socketserver.BaseRequestHandler):
                 self, sock, ops, self._wmu, self.client_address[0])
         worker = self._worker
         seq, count = _BATCH_HDR.unpack(_recv_exact(sock, _BATCH_HDR.size))
+        if self._stream_sid is not None and seq <= self.server.stream_applied(  # type: ignore[attr-defined]
+                self._stream_sid):
+            # replayed duplicate of a batch this stream already applied
+            # (it was in flight, applied, but un-acked when the previous
+            # connection died): consume the frame WITHOUT touching the
+            # window table, ack as applied — server-side exactly-once
+            for _ in range(count):
+                (name_len, _slot, _flags, dt, _codec, n_elems,
+                 wire_bytes) = _ITEM.unpack(_recv_exact(sock, _ITEM.size))
+                if (wire_bytes < 0 or n_elems < 0 or dt not in _DTYPES
+                        or wire_bytes > wire_codec.wire_bytes_bound(
+                            n_elems, _DTYPES[dt].itemsize)):
+                    # same bound discipline as the fresh path: a lying
+                    # duplicate cannot make the server consume unbounded
+                    # claimed bytes
+                    self._send(_ACK.pack(seq, _ERR_BAD_OP))
+                    return False
+                self._recv_name(sock, name_len)
+                self._eat(sock, wire_bytes)
+            _mt.inc("bf_tcp_deduped_batches_total", 1.0,
+                    peer=self.client_address[0])
+            _bb.record("tcp_dedup_batch", seq=seq, items=count,
+                       peer=self.client_address[0])
+            self._send(_ACK.pack(seq, count))
+            return True
         jobs: List = []
         for _ in range(count):
             (name_len, slot, flags, dtype_id, codec, n_elems,
@@ -608,6 +725,33 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 if magic != _MAGIC:
                     return  # not ours; drop the connection
+                act = _chaos.fire("server", op=op,
+                                  peer=self.client_address[0])
+                if act is not None:
+                    kind = act[0]
+                    if kind in ("drop", "truncate"):
+                        # 'truncate' differs from 'drop' only in where it
+                        # cuts: the frame header was consumed, the body
+                        # was not — the client observes a connection that
+                        # died mid-frame either way
+                        return
+                    if kind in ("delay", "stall"):
+                        time.sleep(act[1])
+                if op == _OP_HEARTBEAT:
+                    (hb_seq,) = _HB.unpack(_recv_exact(sock, _HB.size))
+                    self._send(_ACK.pack((hb_seq & ~_HB_MARK) | _HB_MARK, 0))
+                    continue
+                if op == _OP_STREAM_ATTACH:
+                    sid, epoch = _ATTACH.unpack(
+                        _recv_exact(sock, _ATTACH.size))
+                    rc = self.server.attach_stream(  # type: ignore
+                        sid, epoch, self)
+                    self._send(_STATUS.pack(rc))
+                    if rc < 0:
+                        return  # zombie generation; drop it
+                    self._stream_sid = sid
+                    self._stream_epoch = epoch
+                    continue
                 if op == _OP_HELLO:
                     body = _recv_exact(sock, _HELLO.size)
                     version, features = _HELLO.unpack(body)
@@ -705,11 +849,88 @@ class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
+    _MAX_STREAMS = 512  # attach-state entries kept (oldest evicted)
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._conns: set = set()
         self._features: Dict[int, int] = {}  # id(sock) -> granted mask
         self._conns_mu = threading.Lock()
+        # DepositStream lineage state: stream_id -> [epoch, applied_seq,
+        # handler, last_activity, first_err].  Survives connection churn
+        # — that is the whole point: the applied high-water mark is what
+        # makes replay after a reconnect idempotent, and the latched
+        # first batch error is what keeps a rejected deposit LOUD even
+        # when the connection died before its negative ack got out.
+        self._streams: Dict[int, list] = {}
+        self._streams_mu = threading.Lock()
+
+    # ------------------------------------------------------ stream lineage
+    def attach_stream(self, sid: int, epoch: int, handler) -> int:
+        """Bind ``handler`` as the live connection of stream ``sid`` at
+        ``epoch``.  Quiesces the superseded connection (if any) BEFORE
+        replying, so the returned applied-seq is final for everything the
+        old generation received.  Returns the applied high-water mark;
+        ``_ERR_STALE_EPOCH`` if ``epoch`` is not strictly newer;
+        ``_ERR_BUSY`` (retryable) if the old generation could not be
+        proven drained; or the stream's latched first batch error —
+        errors must not be silently retired by a reconnect."""
+        with self._streams_mu:
+            st = self._streams.get(sid)
+            if st is not None and epoch <= st[0]:
+                return _ERR_STALE_EPOCH
+            old_handler = st[2] if st is not None else None
+        if old_handler is not None and old_handler is not handler:
+            # outside the lock: quiesce JOINS the old apply worker (it
+            # may be mid-deposit), and note_applied from that drain needs
+            # the lock
+            if not old_handler.quiesce():
+                # a wedged old applier means the mark below could still
+                # move AFTER our reply — refuse (retryably) rather than
+                # hand out a non-final mark and risk a double apply
+                return _ERR_BUSY
+        with self._streams_mu:
+            st = self._streams.get(sid)
+            if st is None:
+                if len(self._streams) >= self._MAX_STREAMS:
+                    oldest = min(self._streams,
+                                 key=lambda k: self._streams[k][3])
+                    del self._streams[oldest]
+                st = self._streams[sid] = [0, 0, None, time.monotonic(), 0]
+            if epoch <= st[0]:
+                return _ERR_STALE_EPOCH  # lost an attach race
+            st[0] = epoch
+            st[2] = handler
+            st[3] = time.monotonic()
+            if st[4]:
+                # the stream already rejected a deposit (and the ack may
+                # have died with the old connection): report THAT, not a
+                # clean resume point — the client fails loudly exactly as
+                # the lost ack would have made it
+                return st[4]
+            return st[1]
+
+    def stream_applied(self, sid: int) -> int:
+        with self._streams_mu:
+            st = self._streams.get(sid)
+            return st[1] if st is not None else 0
+
+    def note_applied(self, sid: int, epoch: int, seq: int,
+                     err: int = 0) -> None:
+        """Advance the applied high-water mark (monotonic) and latch the
+        stream's first batch error.  The epoch is deliberately NOT
+        checked: a drained old-generation worker's applies are real
+        applies, and recording them is exactly what keeps the successor's
+        replay from repeating them.  Touching last_activity keeps busy
+        lineages out of the eviction scan's reach."""
+        with self._streams_mu:
+            st = self._streams.get(sid)
+            if st is not None:
+                if seq > st[1]:
+                    st[1] = seq
+                if err and not st[4]:
+                    st[4] = err
+                st[3] = time.monotonic()
 
     def track(self, sock):
         with self._conns_mu:
@@ -924,14 +1145,36 @@ class DepositStream:
     Optional wire compression (``codec="f32"`` / ``"topk"``) is negotiated
     at connect; lossy codecs are opt-in and must NOT be used on payloads
     whose exact mass matters (push-sum ``p``).  NOT thread-safe for
-    concurrent producers (one stream per rank thread)."""
+    concurrent producers (one stream per rank thread).
+
+    Fault tolerance (``reconnect=``): when enabled, a broken connection
+    does not latch a terminal error immediately — the sender reconnects
+    with exponential backoff + jitter under a RETRY BUDGET
+    (:class:`~bluefog_tpu.runtime.resilience.Backoff`; pass ``True`` for
+    the defaults or a dict of Backoff kwargs), re-attaches its stream
+    lineage (STREAM_ATTACH carries a stable stream id + a fresh epoch),
+    and REPLAYS the unacked in-flight batches.  The attach reply is the
+    server's applied high-water mark, so a batch that was applied but
+    un-acked when the connection died is retired instead of re-sent —
+    and the server dedups by the same mark, making replay idempotent
+    end to end.  Only when the budget is exhausted does the stream latch
+    the error and mark the peer DEAD (:attr:`health`).
+    ``heartbeat_interval_s > 0`` additionally probes an *idle* stream
+    with the lightweight HEARTBEAT wire op, so peer health does not go
+    stale between deposits."""
 
     def __init__(self, address: Tuple[str, int],
                  timeout_s: float = 30.0, *, codec: Optional[str] = None,
                  topk_ratio: float = 0.1, max_in_flight: int = 4,
                  max_queue_items: int = 1024,
-                 max_batch_bytes: int = 16 << 20):
+                 max_batch_bytes: int = 16 << 20,
+                 reconnect=None,
+                 heartbeat_interval_s: float = 0.0,
+                 suspect_after_s: float = 2.0,
+                 dead_after_s: float = 20.0):
+        self._addr = (address[0], int(address[1]))
         self._peer = f"{address[0]}:{address[1]}"
+        self._timeout_s = float(timeout_s)
         self._codec = wire_codec.CODEC_IDS[codec or "none"]
         self._topk_ratio = float(topk_ratio)
         self._max_in_flight = max(1, int(max_in_flight))
@@ -942,33 +1185,31 @@ class DepositStream:
         # flight is what keeps client send, server recv, and server apply
         # continuously overlapped
         self._max_batch_bytes = max(1 << 16, int(max_batch_bytes))
-        self._sock = socket.create_connection(address, timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        want = FEATURE_BATCH | _CODEC_FEATURE[self._codec]
-        _sendmsg_all(self._sock, [
-            _HDR.pack(_MAGIC, _OP_HELLO, 0),
-            _HELLO.pack(PROTOCOL_VERSION, want)])
-        (granted,) = _STATUS.unpack(_recv_exact(self._sock, _STATUS.size))
-        # connect/HELLO honored timeout_s; the steady-state stream must
-        # NOT — the ack reader is a free-running background thread whose
-        # recv legitimately sits idle for as long as the training loop
-        # goes without depositing (a per-request timeout here would
-        # spuriously fail healthy idle streams after timeout_s)
-        self._sock.settimeout(None)
-        if granted < 0:
-            raise RuntimeError(
-                f"window server at {self._peer} rejected the v"
-                f"{PROTOCOL_VERSION} handshake ({granted}): "
-                + _err_text(int(granted)))
-        if want & ~granted:
-            raise RuntimeError(
-                f"window server at {self._peer} does not support the "
-                f"requested transport features (want {want:#x}, granted "
-                f"{int(granted):#x}) — wire codec "
-                f"{wire_codec.CODEC_NAMES[self._codec]!r} unavailable")
+        # --------------------------------------------------- resilience
+        self._resume = bool(reconnect)
+        self._reconnect_cfg = (dict(reconnect)
+                               if isinstance(reconnect, dict) else {})
+        self._hb_interval = float(heartbeat_interval_s)
+        self._hb_last = time.monotonic()
+        self._hb_seq = 0
+        self._hb_sent: Dict[int, float] = {}
+        self.health: Optional[resilience.PeerHealth] = (
+            resilience.PeerHealth(self._peer,
+                                  suspect_after_s=suspect_after_s,
+                                  dead_after_s=dead_after_s)
+            if (self._resume or self._hb_interval > 0) else None)
+        # stable lineage id + per-connection epoch (see STREAM_ATTACH)
+        self._stream_id = int.from_bytes(os.urandom(8), "little") or 1
+        self._epoch = 0
+        self._sock_gen = 0
+        self._conn_broken = False
+        self._wake = threading.Event()  # interrupts backoff sleeps on close
         self._cv = threading.Condition()
         self._queue: collections.deque = collections.deque()
-        self._inflight: Dict[int, Tuple[float, int, int, int]] = {}
+        # seq -> (t_send, retained items | None, n_items, wire, dense);
+        # items are retained until the ack ONLY when reconnect is on —
+        # they are the replay window
+        self._inflight: Dict[int, Tuple] = {}
         self._seq = 0
         self._err: Optional[str] = None
         self._closed = False
@@ -977,6 +1218,7 @@ class DepositStream:
         # bench/observability: recent (send -> ack) latencies in seconds
         self.ack_latencies: collections.deque = collections.deque(
             maxlen=4096)
+        self._sock = self._connect_once(self._timeout_s)
         self._sender = threading.Thread(
             target=self._send_loop, daemon=True,
             name=f"bf-win-send:{self._peer}")
@@ -985,6 +1227,187 @@ class DepositStream:
             name=f"bf-win-ack:{self._peer}")
         self._sender.start()
         self._acker.start()
+
+    # --------------------------------------------------------- connection
+    def _connect_once(self, timeout_s: float) -> socket.socket:
+        """One connect + HELLO (+ STREAM_ATTACH when resuming).  Raises
+        on any failure.  On a resumed stream the attach reply retires
+        every in-flight batch the server already applied, which is the
+        idempotence half of reconnect replay."""
+        sock = socket.create_connection(self._addr, timeout=timeout_s)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            want = FEATURE_BATCH | _CODEC_FEATURE[self._codec]
+            if self._resume:
+                want |= FEATURE_RESUME
+            if self._hb_interval > 0:
+                want |= FEATURE_HEARTBEAT
+            _sendmsg_all(sock, [
+                _HDR.pack(_MAGIC, _OP_HELLO, 0),
+                _HELLO.pack(PROTOCOL_VERSION, want)])
+            (granted,) = _STATUS.unpack(_recv_exact(sock, _STATUS.size))
+            if granted < 0:
+                raise RuntimeError(
+                    f"window server at {self._peer} rejected the v"
+                    f"{PROTOCOL_VERSION} handshake ({granted}): "
+                    + _err_text(int(granted)))
+            if want & ~granted:
+                raise RuntimeError(
+                    f"window server at {self._peer} does not support the "
+                    f"requested transport features (want {want:#x}, "
+                    f"granted {int(granted):#x})")
+            if self._resume:
+                self._epoch += 1
+                _sendmsg_all(sock, [
+                    _HDR.pack(_MAGIC, _OP_STREAM_ATTACH, 0),
+                    _ATTACH.pack(self._stream_id, self._epoch)])
+                (applied,) = _STATUS.unpack(
+                    _recv_exact(sock, _STATUS.size))
+                if applied == _ERR_BUSY:
+                    # old generation still draining: retryable — surface
+                    # as a connection-level condition so the backoff
+                    # loop tries again
+                    raise ConnectionError(
+                        f"stream attach to {self._peer} deferred: "
+                        + _err_text(_ERR_BUSY))
+                if applied < 0:
+                    # terminal: a latched batch error (a deposit this
+                    # stream sent WAS rejected; the ack died with the
+                    # old connection) or a superseded epoch — retrying
+                    # cannot fix either
+                    raise RuntimeError(
+                        f"stream attach to {self._peer} rejected "
+                        f"({int(applied)}): " + _err_text(int(applied)))
+                self._retire_through(int(applied))
+            # connect/HELLO/attach honored timeout_s; the steady-state
+            # stream must NOT — the ack reader is a free-running
+            # background thread whose recv legitimately sits idle for as
+            # long as the training loop goes without depositing
+            sock.settimeout(None)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        return sock
+
+    def _retire_through(self, applied_seq: int) -> None:
+        """Drop in-flight batches the server reports as applied (they
+        were applied-but-unacked when the old connection died)."""
+        with self._cv:
+            for s in [s for s in self._inflight if s <= applied_seq]:
+                entry = self._inflight.pop(s)
+                for it in entry[1] or ():
+                    if it.pooled is not None:
+                        self._give(it.pooled)
+            self._cv.notify_all()
+
+    def _frame_views(self, seq: int, items: List["_Item"]) -> List:
+        views: List = [_HDR.pack(_MAGIC, _OP_DEPOSIT_BATCH, 0),
+                       _BATCH_HDR.pack(seq, len(items))]
+        for it in items:
+            views.append(_ITEM.pack(
+                len(it.name_b), it.slot, it.flags, it.dtype_id,
+                it.codec, it.n_elems, it.wire_bytes))
+            views.append(it.name_b)
+            views.extend(it.views)
+        return views
+
+    def _recover(self, reason: str) -> bool:
+        """Reconnect with bounded backoff + jitter and replay the unacked
+        in-flight window.  True when the stream is live again; False
+        after latching the terminal error (budget exhausted or the
+        stream is closing) — the peer is then DEAD."""
+        if not self._resume or self._closed:
+            return False
+        h = self.health
+        if h is not None:
+            h.note_failure()
+        _bb.record("tcp_reconnect", peer=self._peer, reason=reason[:200],
+                   inflight=len(self._inflight))
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        bo = resilience.Backoff(**{**dict(base_s=0.05, cap_s=2.0,
+                                          factor=2.0, jitter=0.5,
+                                          budget=8),
+                                   **self._reconnect_cfg})
+        for delay in bo:
+            _mt.observe("bf_reconnect_backoff_seconds", delay,
+                        peer=self._peer)
+            if self._wake.wait(delay) or self._closed:
+                return False
+            try:
+                sock = self._connect_once(self._timeout_s)
+            except (OSError, ConnectionError):
+                if h is not None:
+                    h.note_failure()
+                continue
+            except RuntimeError as e:
+                # handshake/attach REJECTION (version, features, a
+                # latched batch error, a superseded epoch): terminal —
+                # burning the rest of the budget would only relabel the
+                # real error as "peer unreachable"
+                self._fail(str(e))
+                return False
+            # replay what the attach reply left outstanding, in seq
+            # order; the server dedups anything a zombie raced in
+            with self._cv:
+                pending = sorted(self._inflight.items())
+            replayed = 0
+            try:
+                for seq, entry in pending:
+                    _sendmsg_all(sock, self._frame_views(seq, entry[1]))
+                    replayed += 1
+            except (OSError, ConnectionError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if h is not None:
+                    h.note_failure()
+                continue
+            with self._cv:
+                self._sock = sock
+                self._sock_gen += 1
+                self._conn_broken = False
+                self._cv.notify_all()
+            self._hb_last = time.monotonic()
+            _mt.inc("bf_reconnects_total", 1.0, peer=self._peer)
+            if replayed:
+                _mt.inc("bf_replayed_batches_total", float(replayed),
+                        peer=self._peer)
+            _bb.record("tcp_reconnected", peer=self._peer,
+                       epoch=self._epoch, replayed=replayed)
+            if h is not None:
+                h.note_ok()
+            return True
+        if h is not None:
+            h.mark_dead("reconnect budget exhausted")
+        self._fail(f"peer unreachable ({reason}); reconnect budget "
+                   f"exhausted after {bo.attempts} attempt(s)")
+        return False
+
+    def _heartbeat(self) -> bool:
+        """Probe an idle stream's liveness (HEARTBEAT wire op); the reply
+        rides the ack channel with the high bit set."""
+        self._hb_seq = (self._hb_seq + 1) & 0x7FFF_FFFF
+        seq = self._hb_seq
+        self._hb_sent[seq] = time.perf_counter()
+        while len(self._hb_sent) > 64:
+            self._hb_sent.pop(next(iter(self._hb_sent)))
+        self._hb_last = time.monotonic()
+        try:
+            _sendmsg_all(self._sock, [
+                _HDR.pack(_MAGIC, _OP_HEARTBEAT, 0), _HB.pack(seq)])
+        except (OSError, ConnectionError) as e:
+            if self._resume:
+                return self._recover(f"heartbeat send failed: {e}")
+            self._fail(f"heartbeat send failed: {e}")
+            return False
+        return True
 
     # ------------------------------------------------------------ producer
     def _take(self, dtype: np.dtype, n: int) -> np.ndarray:
@@ -1083,24 +1506,55 @@ class DepositStream:
 
     # ------------------------------------------------------------- threads
     def _send_loop(self) -> None:
+        # idle polling only exists for the resilience features: without
+        # them the wait is unbounded, exactly the pre-resilience shape
+        poll = None
+        if self._hb_interval > 0:
+            poll = min(self._hb_interval / 2.0, 1.0)
+        elif self.health is not None:
+            poll = 1.0
         try:
             while True:
                 with self._cv:
                     self._cv.wait_for(
                         lambda: self._queue or self._closed
-                        or self._err is not None)
+                        or self._err is not None or self._conn_broken,
+                        timeout=poll)
                     if self._err is not None:
                         return
-                    if not self._queue:
+                    broken = self._conn_broken
+                    if not self._queue and not broken:
                         if self._closed:
                             return
-                        continue
+                        idle = True
+                    else:
+                        idle = False
+                if broken:
+                    # the ack reader saw the connection die first
+                    if not self._recover("connection lost"):
+                        return
+                    continue
+                if idle:
+                    if self.health is not None:
+                        self.health.poll()
+                    if (self._hb_interval > 0 and
+                            time.monotonic() - self._hb_last
+                            >= self._hb_interval):
+                        if not self._heartbeat():
+                            return
+                    continue
+                with self._cv:
                     t0 = time.perf_counter()
                     while (len(self._inflight) >= self._max_in_flight
-                           and self._err is None and not self._closed):
+                           and self._err is None and not self._closed
+                           and not self._conn_broken):
                         self._cv.wait(timeout=1.0)
+                        if self.health is not None:
+                            self.health.poll()
                     if self._err is not None:
                         return
+                    if self._conn_broken:
+                        continue  # the outer loop recovers first
                     stalled = time.perf_counter() - t0
                     items = []
                     nbytes = 0
@@ -1112,33 +1566,52 @@ class DepositStream:
                         nbytes += it.wire_bytes
                     self._seq += 1
                     seq = self._seq
+                    wire_total = sum(i.wire_bytes for i in items)
+                    dense_total = sum(i.dense_bytes for i in items)
+                    # items are retained until the ack when reconnect is
+                    # on: they ARE the replay window
                     self._inflight[seq] = (
-                        time.perf_counter(), len(items),
-                        sum(i.wire_bytes for i in items),
-                        sum(i.dense_bytes for i in items))
+                        time.perf_counter(),
+                        items if self._resume else None,
+                        len(items), wire_total, dense_total)
                     self._cv.notify_all()
                 if stalled > 0.005:
                     _mt.inc("bf_tcp_window_stalls_total", 1.0,
                             peer=self._peer)
                     _bb.record("tcp_window_stall", peer=self._peer,
                                waited_s=round(stalled, 6))
-                views: List = [_HDR.pack(_MAGIC, _OP_DEPOSIT_BATCH, 0),
-                               _BATCH_HDR.pack(seq, len(items))]
-                wire_total = 0
-                dense_total = 0
-                for it in items:
-                    views.append(_ITEM.pack(
-                        len(it.name_b), it.slot, it.flags, it.dtype_id,
-                        it.codec, it.n_elems, it.wire_bytes))
-                    views.append(it.name_b)
-                    views.extend(it.views)
-                    wire_total += it.wire_bytes
-                    dense_total += it.dense_bytes
-                _sendmsg_all(self._sock, views)
-                with self._cv:
-                    for it in items:
-                        if it.pooled is not None:
-                            self._give(it.pooled)
+                views = self._frame_views(seq, items)
+                try:
+                    act = _chaos.fire("client", peer=self._peer, seq=seq)
+                    if act is not None:
+                        if act[0] in ("delay", "stall"):
+                            time.sleep(act[1])
+                        elif act[0] == "truncate":
+                            # a TORN frame on the wire, then the cut: the
+                            # server must discard the partial batch and
+                            # the replay must deliver it exactly once
+                            _sendmsg_all(self._sock,
+                                         views[:max(2, len(views) // 2)])
+                            raise ConnectionError("chaos: truncated frame")
+                        elif act[0] == "drop":
+                            raise ConnectionError("chaos: dropped "
+                                                  "connection")
+                    _sendmsg_all(self._sock, views)
+                except (OSError, ConnectionError) as e:
+                    if self._resume:
+                        if self._recover(
+                                f"send failed: {type(e).__name__}: {e}"):
+                            continue
+                        return  # _recover latched the terminal error
+                    raise
+                if not self._resume:
+                    # without a replay window the snapshots are recycled
+                    # as soon as the kernel took them (pre-resilience
+                    # memory profile); with one, the ack reader recycles
+                    with self._cv:
+                        for it in items:
+                            if it.pooled is not None:
+                                self._give(it.pooled)
                 _mt.inc("bf_tcp_pipelined_batches_total", 1.0,
                         peer=self._peer)
                 _mt.inc("bf_tcp_pipelined_items_total", float(len(items)),
@@ -1167,16 +1640,47 @@ class DepositStream:
         buf = bytearray(_ACK.size)
         mv = memoryview(buf)
         while True:
+            with self._cv:
+                sock = self._sock
+                gen = self._sock_gen
             try:
-                _recv_into(self._sock, mv)
+                _recv_into(sock, mv)
             except (OSError, ConnectionError, ValueError):
-                if not self._closed:
-                    self._fail("connection lost before all deposits "
-                               "were acknowledged")
+                if self._closed:
+                    return
+                if self._resume:
+                    # flag the outage and wait for the sender to swap in
+                    # a reconnected socket (or give up); only the CURRENT
+                    # generation's failure counts — a socket the sender
+                    # already replaced is stale news
+                    with self._cv:
+                        if self._sock_gen == gen:
+                            self._conn_broken = True
+                        self._cv.notify_all()
+                        self._cv.wait_for(
+                            lambda: self._sock_gen != gen or self._closed
+                            or self._err is not None)
+                        if self._closed or self._err is not None:
+                            return
+                    continue
+                self._fail("connection lost before all deposits "
+                           "were acknowledged")
                 return
             seq, status = _ACK.unpack(buf)
+            if seq & _HB_MARK:
+                t0 = self._hb_sent.pop(seq & ~_HB_MARK, None)
+                if t0 is not None:
+                    _mt.observe("bf_peer_heartbeat_rtt_seconds",
+                                time.perf_counter() - t0, peer=self._peer)
+                if self.health is not None:
+                    self.health.note_ok()
+                continue
             with self._cv:
                 entry = self._inflight.pop(seq, None)
+                if entry is not None:
+                    for it in entry[1] or ():
+                        if it.pooled is not None:
+                            self._give(it.pooled)
                 self._cv.notify_all()
             if entry is not None:
                 lat = time.perf_counter() - entry[0]
@@ -1189,6 +1693,8 @@ class DepositStream:
                 self._fail(f"peer rejected a batched deposit ({status}): "
                            + _err_text(int(status)))
                 return
+            if self.health is not None:
+                self.health.note_ok()
 
     def _fail(self, msg: str) -> None:
         with self._cv:
@@ -1205,6 +1711,7 @@ class DepositStream:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+        self._wake.set()  # interrupt a mid-backoff reconnect sleep
         self._sender.join(timeout=5)
         try:
             self._sock.close()
@@ -1231,19 +1738,26 @@ class PipelinedRemoteWindow:
                  topk_ratio: Optional[float] = None,
                  max_in_flight: Optional[int] = None,
                  max_queue_items: Optional[int] = None,
+                 reconnect=None,
+                 heartbeat_interval_s: Optional[float] = None,
+                 suspect_after_s: Optional[float] = None,
+                 dead_after_s: Optional[float] = None,
                  stream: Optional[DepositStream] = None):
         self.name = name
         self._name_b = name.encode()
         if stream is not None and any(
                 v is not None for v in (codec, topk_ratio, max_in_flight,
-                                        max_queue_items)):
+                                        max_queue_items, reconnect,
+                                        heartbeat_interval_s,
+                                        suspect_after_s, dead_after_s)):
             # a shared stream carries ITS configuration; accepting these
             # kwargs here would silently ignore them (e.g. codec='f32'
             # riding an uncompressed stream)
             raise ValueError(
                 "stream= is mutually exclusive with codec/topk_ratio/"
-                "max_in_flight/max_queue_items — configure the shared "
-                "DepositStream itself")
+                "max_in_flight/max_queue_items/reconnect/"
+                "heartbeat_interval_s/suspect_after_s/dead_after_s — "
+                "configure the shared DepositStream itself")
         self._sync = RemoteWindow(address, name, timeout_s)
         self._owns_stream = stream is None
         if stream is not None:
@@ -1255,12 +1769,25 @@ class PipelinedRemoteWindow:
                 topk_ratio=0.1 if topk_ratio is None else topk_ratio,
                 max_in_flight=4 if max_in_flight is None else max_in_flight,
                 max_queue_items=(1024 if max_queue_items is None
-                                 else max_queue_items))
+                                 else max_queue_items),
+                reconnect=reconnect,
+                heartbeat_interval_s=(0.0 if heartbeat_interval_s is None
+                                      else heartbeat_interval_s),
+                suspect_after_s=(2.0 if suspect_after_s is None
+                                 else suspect_after_s),
+                dead_after_s=(20.0 if dead_after_s is None
+                              else dead_after_s))
         except BaseException:
             # a rejected handshake (version/feature) must not leak the
             # already-open sync connection and its server handler thread
             self._sync.close()
             raise
+
+    @property
+    def health(self):
+        """Per-peer :class:`~bluefog_tpu.runtime.resilience.PeerHealth`
+        of the underlying stream (None when resilience is off)."""
+        return self.stream.health
 
     @property
     def ack_latencies(self):

@@ -21,6 +21,7 @@ from bluefog_tpu.topology.graphs import (
     IsRegularGraph,
     GetRecvWeights,
     GetSendWeights,
+    heal,
 )
 from bluefog_tpu.topology.dynamic import (
     GetDynamicOnePeerSendRecvRanks,

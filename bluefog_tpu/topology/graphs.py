@@ -42,6 +42,7 @@ __all__ = [
     "IsRegularGraph",
     "GetRecvWeights",
     "GetSendWeights",
+    "heal",
 ]
 
 
@@ -354,3 +355,52 @@ def GetSendWeights(topo: Topology, rank: int) -> Tuple[float, Dict[int, float]]:
     """``(self_weight, {dst_rank: weight})`` — the weight each destination will
     apply to this rank's tensor (upstream ``topology_util.GetSendWeights``)."""
     return topo.self_weight(rank), {i: float(topo.weights[i, rank]) for i in topo.out_neighbors(rank)}
+
+
+def heal(topo: Topology, dead_ranks) -> Topology:
+    """Re-normalize the mixing weights over the ranks that survive
+    ``dead_ranks`` — the self-healing step the fault-tolerant gossip
+    loops take when a peer is declared DEAD.
+
+    Each surviving row drops its dead in-neighbors' columns and is
+    rescaled by the surviving row mass (weights keep their *relative*
+    proportions), so it stays row-stochastic; a survivor whose every
+    neighbor died degenerates to a pure self-loop.  Dead rows are
+    replaced by identity self-loops — their indices stay valid (rank
+    numbering is stable across the failure, which the rejoin path
+    needs), but no surviving row references them.
+
+    Push-sum unbiasedness through the change: the (x, p) weight channel
+    de-biases whatever row-stochastic matrix is in effect per round, so
+    switching to the healed matrix mid-run keeps the surviving average
+    unbiased — mass simply stops flowing toward the corpse.  A REJOINED
+    rank is re-admitted by healing with it removed from ``dead_ranks``
+    (typically ``heal(topo, dead - {rejoined})`` at a round boundary).
+
+    ``heal(topo, [])`` returns ``topo`` unchanged; killing every rank is
+    a ``ValueError`` (there is no one left to average)."""
+    dead = frozenset(int(r) for r in dead_ranks)
+    if not dead:
+        return topo
+    n = topo.size
+    bad = [r for r in dead if not (0 <= r < n)]
+    if bad:
+        raise ValueError(f"dead ranks {sorted(bad)} out of range for "
+                         f"size-{n} topology")
+    if len(dead) >= n:
+        raise ValueError("cannot heal a topology with every rank dead")
+    w = topo.weights.copy()
+    for r in dead:
+        w[r, :] = 0.0
+        w[:, r] = 0.0
+        w[r, r] = 1.0
+    for i in range(n):
+        if i in dead:
+            continue
+        s = w[i].sum()
+        if s <= 0.0:
+            w[i, i] = 1.0  # every neighbor died: isolated self-loop
+        else:
+            w[i] /= s
+    return Topology(weights=w,
+                    name=f"{topo.name}+heal({sorted(dead)})")

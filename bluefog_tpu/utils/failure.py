@@ -258,6 +258,10 @@ def run_supervised(
     min_uptime_s: float = 0.0,
     env: Optional[dict] = None,
     incident_dir: Optional[str] = None,
+    restart_backoff_s: float = 2.0,
+    restart_backoff_cap_s: float = 30.0,
+    restart_jitter: float = 0.25,
+    restart_backoff_seed: Optional[int] = None,
 ) -> int:
     """Process-level supervisor: run ``argv`` until it exits 0, restarting
     on failure up to ``max_restarts`` times (``bfrun-tpu --supervise N``).
@@ -270,6 +274,15 @@ def run_supervised(
     save.  ``min_uptime_s`` guards against hot crash loops: a run that died
     faster than this does not earn a restart.
 
+    Restarts are NOT immediate: each attempt backs off (default ~2 s,
+    doubling, capped at ``restart_backoff_cap_s``, ±``restart_jitter``
+    relative jitter) so a crash-looping job does not hammer shared
+    resources — the checkpoint store it re-reads on every boot, the
+    window-server ports it re-binds, the coordination service the whole
+    gang re-registers with.  The jitter also de-synchronizes supervisors
+    restarted by the same outage.  Set ``restart_backoff_s=0`` to restore
+    the immediate-restart behavior (tests).
+
     ``incident_dir``: blackbox forensics across restarts.  The child
     inherits it as ``BLUEFOG_TPU_BLACKBOX_DIR`` (so its watchdog/crash
     dumps land there), and between attempts the supervisor layers the
@@ -277,6 +290,15 @@ def run_supervised(
     the evidence of an earlier one — the whole tree is ONE incident that
     ``bfblackbox-tpu`` reads recursively.
     """
+    from bluefog_tpu.runtime.resilience import Backoff
+
+    backoff = None
+    if restart_backoff_s > 0:
+        backoff = Backoff(base_s=restart_backoff_s,
+                          cap_s=restart_backoff_cap_s,
+                          jitter=restart_jitter,
+                          budget=max_restarts + 1,
+                          seed=restart_backoff_seed)
     if incident_dir is not None:
         env = dict(env if env is not None else os.environ)
         # unconditional: an explicit incident_dir must win over an ambient
@@ -325,5 +347,9 @@ def run_supervised(
             log.error("supervisor: died after %.1fs (< min uptime %.1fs); "
                       "not restarting a crash loop", uptime, min_uptime_s)
             return proc.returncode
-        log.warn("supervisor: job exited rc %d after %.1fs; restart %d/%d",
-                 proc.returncode, uptime, restarts, max_restarts)
+        delay = backoff.next_delay() if backoff is not None else 0.0
+        log.warn("supervisor: job exited rc %d after %.1fs; restart %d/%d "
+                 "in %.1fs", proc.returncode, uptime, restarts,
+                 max_restarts, delay)
+        if delay > 0:
+            time.sleep(delay)

@@ -574,3 +574,106 @@ class TestWindowLint:
         assert report.ok, report.format()
         assert not [d for d in report.warnings
                     if d.code.startswith("BF-WIN")], report.format()
+
+
+# ---------------------------------------------------------------------------
+# BF-RES: reconnect/retry loops must carry a budget or deadline
+# ---------------------------------------------------------------------------
+
+
+class TestResilienceLint:
+    def test_seeded_violation_unbounded_reconnect(self):
+        # the exact bug the rule exists for: while True around a connect
+        # with no budget — the peer is never declared DEAD, the gossip
+        # never heals, and a restarting peer's port is hammered forever
+        from bluefog_tpu.analysis.resilience_lint import check_retry_budgets
+
+        src = (
+            "import socket\n"
+            "def reconnect_forever(addr):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return socket.create_connection(addr)\n"
+            "        except OSError:\n"
+            "            pass\n"
+        )
+        diags = check_retry_budgets(src, filename="seeded.py")
+        assert any(d.code == "BF-RES001" and d.severity == "error"
+                   for d in diags), [d.format() for d in diags]
+
+    def test_itertools_count_is_unbounded_too(self):
+        from bluefog_tpu.analysis.resilience_lint import check_retry_budgets
+
+        src = (
+            "import itertools, socket\n"
+            "def reconnect(addr):\n"
+            "    for _ in itertools.count():\n"
+            "        try:\n"
+            "            return socket.create_connection(addr)\n"
+            "        except OSError:\n"
+            "            pass\n"
+        )
+        diags = check_retry_budgets(src, filename="count.py")
+        assert any(d.code == "BF-RES001" for d in diags)
+
+    def test_backoff_iteration_is_clean(self):
+        # the blessed shape: iterate a resilience.Backoff (budget by
+        # construction) — exactly what DepositStream._recover does
+        from bluefog_tpu.analysis.resilience_lint import check_retry_budgets
+
+        src = (
+            "import socket\n"
+            "from bluefog_tpu.runtime.resilience import Backoff\n"
+            "def reconnect(addr):\n"
+            "    for delay in Backoff(budget=5):\n"
+            "        try:\n"
+            "            return socket.create_connection(addr)\n"
+            "        except OSError:\n"
+            "            continue\n"
+        )
+        assert not check_retry_budgets(src, filename="clean.py")
+
+    def test_bounded_for_and_explicit_counter_are_clean(self):
+        from bluefog_tpu.analysis.resilience_lint import check_retry_budgets
+
+        src = (
+            "import socket\n"
+            "def a(addr):\n"
+            "    for _ in range(5):\n"
+            "        try:\n"
+            "            return socket.create_connection(addr)\n"
+            "        except OSError:\n"
+            "            pass\n"
+            "def b(addr, max_attempts):\n"
+            "    attempts = 0\n"
+            "    while True:\n"
+            "        attempts += 1\n"
+            "        if attempts > max_attempts:\n"
+            "            raise OSError('unreachable')\n"
+            "        try:\n"
+            "            return socket.create_connection(addr)\n"
+            "        except OSError:\n"
+            "            pass\n"
+        )
+        assert not check_retry_budgets(src, filename="bounded.py")
+
+    def test_plain_loops_without_connect_ignored(self):
+        from bluefog_tpu.analysis.resilience_lint import check_retry_budgets
+
+        src = (
+            "def serve(sock):\n"
+            "    while True:\n"
+            "        data = sock.recv(4096)\n"
+            "        if not data:\n"
+            "            return\n"
+        )
+        assert not check_retry_budgets(src, filename="serve.py")
+
+    def test_resilience_pass_runs_in_sweep_and_repo_is_clean(self):
+        # the bflint-tpu sweep includes the pass (BF-RES100 info) and
+        # the repo's own runtime — including DepositStream._recover and
+        # run_supervised's restart loop — lints clean
+        report = run_all(size=8, trace=False)
+        assert report.has("BF-RES100"), report.format(verbose=True)
+        assert not [d for d in report.diagnostics
+                    if d.code == "BF-RES001"], report.format()

@@ -743,7 +743,8 @@ print("WORKER_DONE")
         # finding: setdefault lost to the environment)
         env["BLUEFOG_TPU_BLACKBOX_DIR"] = str(tmp_path / "wrong-dir")
         rc = run_supervised([sys.executable, str(script)], max_restarts=2,
-                            env=env, incident_dir=incident)
+                            env=env, incident_dir=incident,
+                            restart_backoff_s=0.05)
         assert rc == 0
         layered = os.path.join(incident, "restart-1",
                                "blackbox-rank0.jsonl")

@@ -114,7 +114,8 @@ print("WORKER_DONE", x.tolist())
         ckpt = str(tmp_path / "ckpt")
         script = tmp_path / "worker.py"
         script.write_text(self.WORKER.format(repo=_REPO, ckpt=ckpt))
-        rc = run_supervised([sys.executable, str(script)], max_restarts=2)
+        rc = run_supervised([sys.executable, str(script)], max_restarts=2,
+                            restart_backoff_s=0.05)
         assert rc == 0
         mgr = CheckpointManager(ckpt, async_save=False)
         assert mgr.latest_step() == 5
@@ -127,5 +128,6 @@ print("WORKER_DONE", x.tolist())
     def test_supervisor_gives_up(self, tmp_path):
         script = tmp_path / "always_dies.py"
         script.write_text("import sys; sys.exit(9)\n")
-        rc = run_supervised([sys.executable, str(script)], max_restarts=2)
+        rc = run_supervised([sys.executable, str(script)], max_restarts=2,
+                            restart_backoff_s=0.05)
         assert rc == 9

@@ -1,0 +1,808 @@
+"""Peer-fault tolerance: chaos injection, reconnect/replay, self-healing.
+
+Covers the resilience tentpole end to end:
+
+1. primitives — :class:`Backoff` (seeded jitter determinism, mandatory
+   budget), the HEALTHY→SUSPECT→DEAD→REJOINED state machine
+   (:class:`PeerHealth` / :class:`HealthBoard`), and the
+   :func:`topology.heal` weight re-normalization;
+2. the chaos injector — spec grammar, deterministic counters/seeds, the
+   ``bfchaos-tpu`` CLI;
+3. the wire — DepositStream reconnect with bounded backoff, idempotent
+   replay of unacked batches (including the applied-but-UNACKED ack-drop
+   ambiguity and a hand-crafted duplicate frame: server-side dedup,
+   zero double-applies), heartbeat liveness, DEAD on budget exhaustion;
+4. self-healing gossip — kill-one-of-three mid-dsgd with the EXACT mass
+   audit over the surviving set, SIGSTOP-shaped stall with DEAD→REJOINED
+   re-admission and exact global mass, and the same for push-sum;
+5. the satellites — FileBarrier exclusion set + rank-number timeouts,
+   ``run_supervised`` restart backoff, and the
+   AsyncWindow/PipelinedRemoteWindow signature-parity tripwire.
+
+Fault tests carry the ``chaos`` marker (slow multi-process variants add
+``slow``); everything is deterministic — counters and seeded RNGs, no
+luck involved.
+"""
+
+import inspect
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests._util import REPO as _REPO, clean_env, uniq as _uniq
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolated():
+    """No chaos spec leaks between tests (the injector is process-global
+    and env-lazy, like the metrics/blackbox registries)."""
+    from bluefog_tpu import chaos
+
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# 1. primitives
+# ---------------------------------------------------------------------------
+
+
+class TestBackoff:
+    def test_seeded_schedule_is_reproducible(self):
+        from bluefog_tpu.runtime.resilience import Backoff
+
+        a = list(Backoff(base_s=0.05, cap_s=1.0, budget=6, seed=7))
+        b = list(Backoff(base_s=0.05, cap_s=1.0, budget=6, seed=7))
+        assert a == b and len(a) == 6
+        # exponential shape under the jitter envelope, capped
+        for k, d in enumerate(a):
+            nominal = min(0.05 * 2 ** k, 1.0)
+            assert 0.5 * nominal <= d <= 1.5 * nominal
+
+    def test_budget_exhaustion_raises(self):
+        from bluefog_tpu.runtime.resilience import Backoff, BudgetExhausted
+
+        bo = Backoff(budget=2, jitter=0.0)
+        bo.next_delay()
+        bo.next_delay()
+        with pytest.raises(BudgetExhausted):
+            bo.next_delay()
+
+    def test_bound_is_mandatory(self):
+        # an unbounded Backoff is exactly what BF-RES001 exists to
+        # reject; the constructor refuses to build one
+        from bluefog_tpu.runtime.resilience import Backoff
+
+        with pytest.raises(ValueError):
+            Backoff(budget=None, deadline_s=None)
+
+    def test_deadline_bound(self):
+        from bluefog_tpu.runtime.resilience import Backoff, BudgetExhausted
+
+        bo = Backoff(base_s=0.01, budget=None, deadline_s=0.0,
+                     jitter=0.0)
+        bo.next_delay()  # first draw starts the clock
+        time.sleep(0.01)
+        with pytest.raises(BudgetExhausted):
+            bo.next_delay()
+
+    def test_max_total_quotes_detection_deadline(self):
+        from bluefog_tpu.runtime.resilience import Backoff
+
+        bo = Backoff(base_s=0.1, cap_s=0.4, factor=2.0, jitter=0.5,
+                     budget=4)
+        # 0.1 + 0.2 + 0.4 + 0.4, worst-case jitter 1.5x
+        assert abs(bo.max_total_s() - 1.1 * 1.5) < 1e-9
+
+
+class TestHealthStateMachine:
+    def _clocked(self):
+        t = [0.0]
+        from bluefog_tpu.runtime.resilience import PeerHealth
+
+        h = PeerHealth("peer", suspect_after_s=1.0, dead_after_s=3.0,
+                       clock=lambda: t[0])
+        return h, t
+
+    def test_silence_promotes_suspect_then_dead(self):
+        from bluefog_tpu.runtime import resilience as R
+
+        h, t = self._clocked()
+        assert h.poll() == R.HEALTHY
+        t[0] = 1.5
+        assert h.poll() == R.SUSPECT
+        t[0] = 3.5
+        assert h.poll() == R.DEAD
+        # DEAD is sticky under further silence
+        t[0] = 10.0
+        assert h.poll() == R.DEAD
+
+    def test_suspect_recovers_and_dead_rejoins(self):
+        from bluefog_tpu.runtime import resilience as R
+
+        h, t = self._clocked()
+        t[0] = 1.5
+        h.poll()
+        assert h.note_ok() == R.HEALTHY  # SUSPECT -> HEALTHY directly
+        t[0] = 10.0
+        h.poll()
+        assert h.state == R.DEAD
+        assert h.note_ok() == R.REJOINED  # evidence of life
+        # REJOINED is sticky until the gossip loop re-admits at a round
+        # boundary — poll() must not silently flip it either way
+        t[0] = 20.0
+        assert h.poll() == R.REJOINED
+        h.admit()
+        assert h.state == R.HEALTHY
+        # the full cycle is on the transition log
+        seq = [(a, b) for (_, a, b) in h.transitions]
+        assert (R.DEAD, R.REJOINED) in seq and (R.REJOINED, R.HEALTHY) in seq
+
+    def test_hard_failure_promotes_suspect(self):
+        from bluefog_tpu.runtime import resilience as R
+
+        h, _ = self._clocked()
+        assert h.note_failure() == R.SUSPECT  # an RST beats silence
+
+    def test_health_board_detects_silent_rank(self):
+        from bluefog_tpu.runtime import resilience as R
+
+        t = [0.0]
+        board = R.HealthBoard(3, suspect_after_s=0.5, dead_after_s=1.0,
+                              clock=lambda: t[0])
+        for r in range(3):
+            board.beat(r)
+        t[0] = 1.5
+        board.beat(0)
+        board.beat(1)  # rank 2 is silent
+        assert board.dead_ranks() == {2}
+        board.beat(2)  # it speaks again
+        assert board.state(2) == R.REJOINED
+        assert board.dead_ranks() == set()  # REJOINED is not DEAD
+        board.admit(2)
+        assert board.state(2) == R.HEALTHY
+
+
+class TestHeal:
+    def test_renormalizes_over_survivors(self):
+        from bluefog_tpu import topology as T
+
+        topo = T.FullyConnectedGraph(4)
+        healed = T.heal(topo, [3])
+        w = healed.weights
+        # row-stochastic (Topology.__post_init__ enforces it; assert
+        # anyway — it IS the invariant)
+        np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+        # survivors no longer reference the corpse, proportions kept
+        assert (w[:3, 3] == 0).all()
+        np.testing.assert_allclose(w[:3, :3], 1.0 / 3.0)
+        # dead row is an inert self-loop; rank indices stay stable
+        assert w[3, 3] == 1.0 and (w[3, :3] == 0).all()
+        assert healed.size == topo.size
+
+    def test_relative_weights_preserved(self):
+        from bluefog_tpu import topology as T
+
+        w = np.array([[0.5, 0.2, 0.3],
+                      [0.1, 0.6, 0.3],
+                      [0.25, 0.25, 0.5]])
+        healed = T.heal(T.Topology(weights=w, name="t"), [2])
+        # row 0 drops col 2 and rescales by 0.7: 5/7, 2/7
+        np.testing.assert_allclose(healed.weights[0, :2],
+                                   [0.5 / 0.7, 0.2 / 0.7])
+
+    def test_isolated_survivor_becomes_self_loop(self):
+        from bluefog_tpu import topology as T
+
+        # star: leaves only talk to the center; kill the center
+        topo = T.StarGraph(4, center_rank=0)
+        healed = T.heal(topo, [0])
+        for r in range(1, 4):
+            assert healed.weights[r, r] == 1.0
+
+    def test_rejoin_is_heal_with_smaller_dead_set(self):
+        from bluefog_tpu import topology as T
+
+        topo = T.FullyConnectedGraph(3)
+        assert T.IsTopologyEquivalent(T.heal(topo, []), topo)
+        # re-admission: healing with the rejoined rank removed restores
+        # the original matrix
+        assert T.IsTopologyEquivalent(
+            T.heal(topo, set()), T.heal(topo, {1} - {1}))
+
+    def test_errors(self):
+        from bluefog_tpu import topology as T
+
+        topo = T.RingGraph(3)
+        with pytest.raises(ValueError):
+            T.heal(topo, [5])
+        with pytest.raises(ValueError):
+            T.heal(topo, [0, 1, 2])
+
+
+# ---------------------------------------------------------------------------
+# 2. chaos injector
+# ---------------------------------------------------------------------------
+
+
+class TestChaosSpec:
+    def test_grammar_round_trip(self):
+        from bluefog_tpu.chaos import parse_spec
+
+        rules = parse_spec("server:drop:after_frames=3; "
+                           "ack:delay:ms=20:prob=0.5:seed=9; "
+                           "rank2:sigkill:at_step=8; "
+                           "rank1:sigstop:after_s=0.5:for_s=1.0")
+        assert [r.fault for r in rules] == ["drop", "delay", "sigkill",
+                                            "sigstop"]
+        assert rules[0].after_frames == 3 and rules[2].rank == 2
+        assert rules[3].for_s == 1.0
+
+    @pytest.mark.parametrize("bad", [
+        "", "server", "server:frobnicate", "rank:die:at_step=1",
+        "rankX:die:at_step=1", "server:drop:nonsense=1",
+        "server:delay:prob=2.0", "rank1:die",        # die needs at_step
+        "rank1:sigkill",                             # needs a trigger
+        "bogus:drop:after_frames=1",
+    ])
+    def test_bad_specs_fail_fast(self, bad):
+        from bluefog_tpu.chaos import ChaosSpecError, parse_spec
+
+        with pytest.raises(ChaosSpecError):
+            parse_spec(bad)
+
+    def test_counter_trigger_is_deterministic_and_one_shot(self):
+        from bluefog_tpu.chaos import Injector
+
+        inj = Injector("server:drop:after_frames=3")
+        hits = [inj.fire("server") for _ in range(6)]
+        assert hits == [None, None, ("drop",), None, None, None]
+        # sites are independent
+        assert inj.fire("client") is None
+
+    def test_prob_trigger_is_seeded(self):
+        from bluefog_tpu.chaos import Injector
+
+        spec = "server:delay:ms=5:prob=0.3:seed=42:times=0"
+        inj1, inj2 = Injector(spec), Injector(spec)
+        seq1 = [inj1.fire("server") is not None for _ in range(50)]
+        seq2 = [inj2.fire("server") is not None for _ in range(50)]
+        assert seq1 == seq2  # same seed, same traffic -> same faults
+        assert any(seq1) and not all(seq1)
+
+    def test_env_lazy_and_reset(self, monkeypatch):
+        from bluefog_tpu import chaos
+
+        monkeypatch.setenv("BLUEFOG_TPU_CHAOS",
+                           "server:drop:after_frames=1")
+        chaos.reset()
+        assert chaos.enabled()
+        assert chaos.fire("server") == ("drop",)
+        chaos.configure(None)
+        assert not chaos.enabled()
+        chaos.reset()
+
+    def test_die_rule_raises_chaoskill(self):
+        from bluefog_tpu import chaos
+
+        chaos.configure("rank1:die:at_step=5")
+        chaos.check_step(1, 4)  # not yet
+        chaos.check_step(0, 99)  # wrong rank
+        with pytest.raises(chaos.ChaosKill):
+            chaos.check_step(1, 5)
+        # one-shot: the corpse does not die twice
+        chaos.check_step(1, 6)
+
+    def test_cli_explain_grammar_and_env_passthrough(self):
+        cli = [sys.executable, "-m", "bluefog_tpu.chaos"]
+        env = clean_env()
+        out = subprocess.run(
+            cli + ["--spec", "server:drop:after_frames=2", "--explain"],
+            capture_output=True, text=True, env=env, cwd=_REPO)
+        assert out.returncode == 0 and "drop" in out.stdout
+        assert subprocess.run(cli + ["--grammar"], capture_output=True,
+                              env=env, cwd=_REPO).returncode == 0
+        bad = subprocess.run(cli + ["--spec", "nope", "--explain"],
+                             capture_output=True, text=True, env=env,
+                             cwd=_REPO)
+        assert bad.returncode == 2 and "bad spec" in bad.stderr
+        run = subprocess.run(
+            cli + ["--spec", "server:stall:s=1", "--",
+                   sys.executable, "-c",
+                   "import os; print(os.environ['BLUEFOG_TPU_CHAOS'])"],
+            capture_output=True, text=True, env=env, cwd=_REPO)
+        assert run.returncode == 0
+        assert "server:stall:s=1" in run.stdout
+
+
+# ---------------------------------------------------------------------------
+# satellites: barrier exclusion, signature parity, supervisor backoff
+# ---------------------------------------------------------------------------
+
+
+class TestFileBarrier:
+    def test_exclusion_set_skips_dead_ranks(self, tmp_path):
+        from bluefog_tpu.runtime.async_windows import FileBarrier
+
+        b = FileBarrier(str(tmp_path), 3, rank=0)
+        open(os.path.join(str(tmp_path), "stage.1"), "w").close()
+        b.exclude.add(2)  # rank 2 is a corpse: do not wait 120 s for it
+        t0 = time.perf_counter()
+        b.wait("stage", timeout_s=5.0)
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_timeout_names_rank_numbers_and_records_blackbox(self, tmp_path):
+        from bluefog_tpu.blackbox import recorder as bb
+        from bluefog_tpu.runtime.async_windows import FileBarrier
+
+        b = FileBarrier(str(tmp_path), 4, rank=0)
+        b.exclude.add(3)
+        with pytest.raises(TimeoutError) as ei:
+            b.wait("audit", timeout_s=0.2)
+        msg = str(ei.value)
+        # rank NUMBERS, not the file paths the old message dumped
+        assert "missing rank(s) [1, 2]" in msg, msg
+        assert str(tmp_path) in msg  # the dir is still named once
+        rec = bb.get()
+        assert rec is not None
+        evs = [e for e in rec.events() if e["kind"] == "barrier_timeout"]
+        assert evs and evs[-1]["missing_ranks"] == [1, 2]
+        assert evs[-1]["stage"] == "audit"
+
+
+class TestSignatureParity:
+    """Satellite: the one-loop-body-on-all-transports invariant —
+    ``AsyncWindow``'s no-op aliases must track the pipelined transport's
+    signatures exactly, or a loop written against one silently stops
+    running on the other."""
+
+    @staticmethod
+    def _params(fn):
+        return [(p.name, p.kind, p.default)
+                for p in inspect.signature(fn).parameters.values()]
+
+    def test_deposit_async_parity(self):
+        from bluefog_tpu.runtime.async_windows import (AsyncWindow,
+                                                       _RemoteHandle)
+        from bluefog_tpu.runtime.window_server import PipelinedRemoteWindow
+
+        want = self._params(PipelinedRemoteWindow.deposit_async)
+        assert self._params(AsyncWindow.deposit_async) == want
+        assert self._params(_RemoteHandle.deposit_async) == want
+
+    def test_flush_parity_including_timeout_kwarg(self):
+        from bluefog_tpu.runtime.async_windows import (AsyncWindow,
+                                                       _RemoteHandle)
+        from bluefog_tpu.runtime.window_server import PipelinedRemoteWindow
+
+        want = self._params(PipelinedRemoteWindow.flush)
+        assert self._params(AsyncWindow.flush) == want
+        assert self._params(_RemoteHandle.flush) == want
+        sig = inspect.signature(AsyncWindow.flush)
+        assert sig.parameters["timeout_s"].default is None
+
+
+class TestSupervisorBackoff:
+    SCRIPT = """\
+import os, sys
+marker = sys.argv[1]
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(7)
+sys.exit(0)
+"""
+
+    def test_restart_waits_with_backoff(self, tmp_path):
+        from bluefog_tpu.utils.failure import run_supervised
+
+        script = tmp_path / "crash_once.py"
+        script.write_text(self.SCRIPT)
+        marker = str(tmp_path / "crashed")
+        t0 = time.perf_counter()
+        rc = run_supervised(
+            [sys.executable, str(script), marker], max_restarts=2,
+            restart_backoff_s=0.4, restart_jitter=0.0)
+        elapsed = time.perf_counter() - t0
+        assert rc == 0
+        assert elapsed >= 0.4, elapsed  # the one restart waited
+
+    def test_zero_backoff_restores_immediate_restart(self, tmp_path):
+        from bluefog_tpu.utils.failure import run_supervised
+
+        script = tmp_path / "crash_once.py"
+        script.write_text(self.SCRIPT)
+        marker = str(tmp_path / "crashed")
+        rc = run_supervised(
+            [sys.executable, str(script), marker], max_restarts=2,
+            restart_backoff_s=0.0)
+        assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. the wire: reconnect, replay, dedup, heartbeats
+# ---------------------------------------------------------------------------
+
+
+def _serve(name, n_elems=8):
+    from bluefog_tpu.runtime.async_windows import AsyncWindow
+    from bluefog_tpu.runtime.window_server import WindowServer
+
+    win = AsyncWindow(name, n_slots=1, n_elems=n_elems, dtype=np.float64)
+    srv = WindowServer()
+    _, port = srv.start("127.0.0.1")
+    return win, srv, port
+
+
+_FAST = dict(base_s=0.02, cap_s=0.2, budget=6, seed=0)
+
+
+@pytest.mark.chaos
+class TestStreamReconnectReplay:
+    def _run_deposits(self, name, port, rounds=20, **stream_kw):
+        from bluefog_tpu.runtime.window_server import DepositStream
+
+        st = DepositStream(("127.0.0.1", port), reconnect=_FAST,
+                           **stream_kw)
+        total = np.zeros(8)
+        try:
+            for i in range(rounds):
+                v = np.full(8, float(i + 1))
+                st.deposit_async(name.encode(), 0, v, accumulate=True)
+                total += v
+                st.flush(timeout_s=30)
+        finally:
+            st.close()
+        return st, total
+
+    def test_transient_drop_reconnects_and_replays_exactly_once(self):
+        from bluefog_tpu import chaos
+        from bluefog_tpu.metrics import registry as mreg
+        from bluefog_tpu.runtime import resilience as R
+
+        name = _uniq("res_drop")
+        win, srv, port = _serve(name)
+        reg = mreg.metrics_start()
+        chaos.configure("server:drop:after_frames=6")
+        try:
+            st, total = self._run_deposits(name, port)
+            got, fresh = win.read(0, consume=False)
+            # EXACT value and EXACT apply count: reconnect replayed the
+            # torn batch once, never twice
+            assert np.array_equal(got, total)
+            assert fresh == 20
+            snap = reg.snapshot()
+            assert any("bf_reconnects_total" in k and v >= 1
+                       for k, v in snap.items()), snap
+            # health dipped to SUSPECT during the outage and recovered
+            seq = [(a, b) for (_, a, b) in st.health.transitions]
+            assert (R.HEALTHY, R.SUSPECT) in seq
+            assert st.health.state == R.HEALTHY
+        finally:
+            mreg.metrics_stop()
+            srv.stop()
+            win.free()
+
+    def test_applied_but_unacked_batch_is_not_double_applied(self):
+        # the ack-drop ambiguity: the server APPLIES a batch, then the
+        # connection dies before the ack leaves.  The STREAM_ATTACH
+        # reply (applied high-water mark) retires it client-side; the
+        # seq dedup would catch it server-side.  Either way: exactly
+        # once.
+        from bluefog_tpu import chaos
+
+        name = _uniq("res_ackdrop")
+        win, srv, port = _serve(name)
+        chaos.configure("ack:drop:after_frames=3")
+        try:
+            _, total = self._run_deposits(name, port, rounds=10)
+            got, fresh = win.read(0, consume=False)
+            assert np.array_equal(got, total)
+            assert fresh == 10  # the ambiguous batch applied ONCE
+        finally:
+            srv.stop()
+            win.free()
+
+    def test_client_truncated_frame_replayed_not_partially_applied(self):
+        from bluefog_tpu import chaos
+
+        name = _uniq("res_trunc")
+        win, srv, port = _serve(name)
+        chaos.configure("client:truncate:after_frames=4")
+        try:
+            _, total = self._run_deposits(name, port, rounds=12)
+            got, fresh = win.read(0, consume=False)
+            assert np.array_equal(got, total)
+            assert fresh == 12
+        finally:
+            srv.stop()
+            win.free()
+
+    def test_handcrafted_duplicate_batch_is_deduped_server_side(self):
+        # simulate a zombie replaying a frame the server already applied
+        # on the SAME connection: the server must ack it as applied
+        # without touching the table (the belt-and-braces half of
+        # exactly-once, independent of the client's attach bookkeeping)
+        import socket as socklib
+        import struct
+
+        from bluefog_tpu.runtime import window_server as ws
+
+        name = _uniq("res_dup")
+        win, srv, port = _serve(name)
+        try:
+            s = socklib.create_connection(("127.0.0.1", port), timeout=10)
+            s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_HELLO, 0)
+                      + ws._HELLO.pack(ws.PROTOCOL_VERSION,
+                                       ws.FEATURE_BATCH
+                                       | ws.FEATURE_RESUME))
+            (granted,) = ws._STATUS.unpack(s.recv(8))
+            assert granted >= 0
+            s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_STREAM_ATTACH, 0)
+                      + ws._ATTACH.pack(12345, 1))
+            (applied,) = ws._STATUS.unpack(s.recv(8))
+            assert applied == 0
+            payload = np.full(8, 3.0).tobytes()
+            nb = name.encode()
+            frame = (ws._HDR.pack(ws._MAGIC, ws._OP_DEPOSIT_BATCH, 0)
+                     + ws._BATCH_HDR.pack(1, 1)
+                     + ws._ITEM.pack(len(nb), 0, 1, 1, 0, 8, len(payload))
+                     + nb + payload)
+            s.sendall(frame)
+            seq, status = struct.unpack("<Iq", s.recv(12))
+            assert (seq, status) == (1, 1)
+            s.sendall(frame)  # the duplicate, verbatim
+            seq, status = struct.unpack("<Iq", s.recv(12))
+            assert seq == 1 and status >= 0
+            got, fresh = win.read(0, consume=False)
+            np.testing.assert_array_equal(got, np.full(8, 3.0))
+            assert fresh == 1  # ONE apply, not two
+            # a stale epoch can never steal the stream back
+            s2 = socklib.create_connection(("127.0.0.1", port),
+                                           timeout=10)
+            s2.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_HELLO, 0)
+                       + ws._HELLO.pack(ws.PROTOCOL_VERSION,
+                                        ws.FEATURE_BATCH
+                                        | ws.FEATURE_RESUME))
+            s2.recv(8)
+            s2.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_STREAM_ATTACH, 0)
+                       + ws._ATTACH.pack(12345, 1))  # not newer
+            (rc,) = ws._STATUS.unpack(s2.recv(8))
+            assert rc == ws._ERR_STALE_EPOCH
+            s2.close()
+            s.close()
+        finally:
+            srv.stop()
+            win.free()
+
+    def test_latched_batch_error_survives_connection_death(self):
+        # a REJECTED deposit whose negative ack died with the connection
+        # must NOT be retired as success by the reconnect: the server
+        # latches the stream's first batch error and the attach reply
+        # reports it, so the client fails as loudly as the lost ack
+        # would have made it
+        from bluefog_tpu import chaos
+        from bluefog_tpu.runtime.window_server import DepositStream
+
+        name = _uniq("res_latch")
+        win, srv, port = _serve(name)
+        chaos.configure("ack:drop:after_frames=1")
+        st = DepositStream(("127.0.0.1", port), reconnect=_FAST)
+        try:
+            st.deposit_async(b"res_no_such_window", 0, np.ones(8))
+            with pytest.raises(RuntimeError, match="no such window"):
+                st.flush(timeout_s=30)
+        finally:
+            st.close()
+            srv.stop()
+            win.free()
+
+    def test_budget_exhaustion_marks_peer_dead(self):
+        from bluefog_tpu.runtime import resilience as R
+        from bluefog_tpu.runtime.window_server import DepositStream
+
+        name = _uniq("res_dead")
+        win, srv, port = _serve(name)
+        st = DepositStream(("127.0.0.1", port),
+                           reconnect=dict(base_s=0.01, cap_s=0.05,
+                                          budget=3, seed=0))
+        try:
+            srv.stop()  # the peer is gone for good
+            st.deposit_async(name.encode(), 0, np.ones(8))
+            with pytest.raises(RuntimeError, match="unreachable"):
+                st.flush(timeout_s=30)
+            assert st.health.state == R.DEAD
+            # terminal: later deposits fail fast, no zombie retry loop
+            with pytest.raises(RuntimeError):
+                st.deposit_async(name.encode(), 0, np.ones(8))
+        finally:
+            st.close()
+            win.free()
+
+    def test_heartbeat_keeps_idle_stream_health_fresh(self):
+        from bluefog_tpu.metrics import registry as mreg
+        from bluefog_tpu.runtime import resilience as R
+        from bluefog_tpu.runtime.window_server import DepositStream
+
+        name = _uniq("res_hb")
+        win, srv, port = _serve(name)
+        reg = mreg.metrics_start()
+        st = DepositStream(("127.0.0.1", port), reconnect=_FAST,
+                           heartbeat_interval_s=0.05,
+                           suspect_after_s=0.5, dead_after_s=10.0)
+        try:
+            time.sleep(0.6)  # idle: several heartbeat round trips
+            assert st.health.state == R.HEALTHY
+            snap = reg.snapshot()
+            rtts = [v for k, v in snap.items()
+                    if k.startswith("bf_peer_heartbeat_rtt_seconds_count")]
+            assert rtts and rtts[0] >= 2, snap
+        finally:
+            st.close()
+            mreg.metrics_stop()
+            srv.stop()
+            win.free()
+
+
+# ---------------------------------------------------------------------------
+# 4. self-healing gossip (thread mode — deterministic, in-process)
+# ---------------------------------------------------------------------------
+
+
+def _quadratic(n):
+    targets = np.stack([np.full(4, float(r + 1)) for r in range(n)])
+
+    def loss_and_grad(r, step, params):
+        w = np.asarray(params["w"], np.float64)
+        diff = w - targets[r]
+        return 0.5 * float(diff @ diff), {"w": diff}
+
+    return loss_and_grad
+
+
+@pytest.mark.chaos
+class TestSelfHealingGossip:
+    def test_dsgd_kill_one_of_three_exact_audit_over_survivors(self):
+        from bluefog_tpu import chaos, topology as T
+        from bluefog_tpu.runtime.async_windows import run_async_dsgd
+        from bluefog_tpu.runtime.resilience import ResilienceConfig
+
+        chaos.configure("rank2:die:at_step=8")
+        cfg = ResilienceConfig(suspect_after_s=0.1, dead_after_s=0.3)
+        rep = run_async_dsgd(
+            T.FullyConnectedGraph(3), {"w": np.zeros(4, np.float32)},
+            _quadratic(3), duration_s=2.0,
+            skew=[0.001, 0.002, 0.003], name=_uniq("res_kill"),
+            resilience=cfg)
+        assert rep.dead_ranks == [2]
+        # the EXACT audit: surviving mass + the corpse's last will + the
+        # in-flight mass stranded in its landing slots == n, to float
+        # round-off — nothing leaked, nothing double-counted
+        assert abs(rep.total_mass + rep.died_mass - 3.0) < 1e-9
+        assert 0.0 < rep.died_mass < 1.5
+        # survivors detected the death within the configured deadline
+        # and kept training long past the kill step
+        assert rep.steps_per_rank[2] == 8
+        assert min(rep.steps_per_rank[0], rep.steps_per_rank[1]) > 50
+        # and they converged among themselves (survivor consensus)
+        assert rep.consensus_gap < 0.5, rep.consensus_gap
+        assert rep.final_params[2] is None
+
+    def test_dsgd_stall_is_dead_then_rejoined_mass_exact(self):
+        # the SIGSTOP/SIGCONT shape in thread clothing: rank 1 freezes
+        # past the dead deadline (declared DEAD, healed away), thaws,
+        # beats again (REJOINED), and is re-admitted at the next round
+        # boundary — and because nobody actually died, the ORIGINAL
+        # global audit stays exact: sum p == n
+        from bluefog_tpu import chaos, topology as T
+        from bluefog_tpu.runtime import resilience as R
+        from bluefog_tpu.runtime.async_windows import run_async_dsgd
+        from bluefog_tpu.runtime.resilience import ResilienceConfig
+
+        chaos.configure("rank1:stall:at_step=6:s=0.8")
+        cfg = ResilienceConfig(suspect_after_s=0.15, dead_after_s=0.35)
+        rep = run_async_dsgd(
+            T.FullyConnectedGraph(3), {"w": np.zeros(4, np.float32)},
+            _quadratic(3), duration_s=2.5,
+            skew=[0.001, 0.001, 0.001], name=_uniq("res_stall"),
+            resilience=cfg)
+        assert rep.dead_ranks == []  # it came back
+        assert abs(rep.total_mass - 3.0) < 1e-9, rep.total_mass
+        # the stalled rank resumed stepping after the freeze
+        assert rep.steps_per_rank[1] > 6 + 10, rep.steps_per_rank
+        # the health timeline shows the full DEAD -> REJOIN -> re-admit
+        # cycle (carried on the report — the blackbox ring may have
+        # evicted the early events under gossip traffic)
+        seq = [(a, b) for (_, a, b) in rep.health_transitions[1]]
+        assert (R.SUSPECT, R.DEAD) in seq, seq
+        assert (R.DEAD, R.REJOINED) in seq, seq
+        assert (R.REJOINED, R.HEALTHY) in seq, seq
+        assert seq.index((R.SUSPECT, R.DEAD)) \
+            < seq.index((R.DEAD, R.REJOINED)) \
+            < seq.index((R.REJOINED, R.HEALTHY))
+
+    def test_pushsum_kill_one_survivor_consensus_and_exact_mass(self):
+        from bluefog_tpu import chaos, topology as T
+        from bluefog_tpu.runtime.async_windows import run_async_pushsum
+        from bluefog_tpu.runtime.resilience import ResilienceConfig
+
+        chaos.configure("rank2:die:at_step=5")
+        cfg = ResilienceConfig(suspect_after_s=0.1, dead_after_s=0.3)
+        x0 = np.array([[1.0], [2.0], [9.0]])
+        rep = run_async_pushsum(
+            T.FullyConnectedGraph(3), x0, tol=1e-4, timeout_s=10.0,
+            name=_uniq("res_ps"), resilience=cfg)
+        assert rep.dead_ranks == [2]
+        assert abs(rep.total_mass + rep.died_mass - 3.0) < 1e-9
+        # survivors reached consensus (on the mass-weighted surviving
+        # average, NOT the original mean — rank 2 took mass with it)
+        assert rep.converged, (rep.max_abs_err, rep.steps_per_rank)
+        alive = [0, 1]
+        spread = np.abs(rep.estimates[alive]
+                        - rep.estimates[alive].mean(axis=0)).max()
+        assert spread < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# 5. multi-process: real SIGKILL / SIGSTOP through the TCP transport
+# ---------------------------------------------------------------------------
+
+
+def _run_resilience_workers(mode, nproc=3, duration="3.5", timeout=240):
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as bdir:
+        worker = os.path.join(_REPO, "tests", "_mp_resilience_worker.py")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(r), str(nproc), bdir,
+                 duration, mode],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=clean_env(), cwd=_REPO)
+            for r in range(nproc)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=timeout)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"resilience workers ({mode}) timed out:\n"
+                        + "\n".join(o or "" for o in outs))
+        return procs, outs
+
+
+@pytest.mark.chaos
+def test_mp_sigkill_one_of_three_survivors_heal_and_audit_exactly():
+    """The acceptance scenario: one of three rank PROCESSES is SIGKILLed
+    mid-dsgd.  The survivors' deposit streams fail, reconnect attempts
+    exhaust their budget (the configured detection deadline), the peer is
+    declared DEAD and healed out of the mixing weights, survivors finish
+    the run, and rank 0's audit over the surviving set matches the
+    post-heal baseline EXACTLY — replay double-applied nothing, the
+    healed weights leaked nothing."""
+    procs, outs = _run_resilience_workers("kill2")
+    # rank 2 died by SIGKILL (-9); the survivors exited clean
+    assert procs[2].returncode == -9, (procs[2].returncode, outs[2])
+    for r in (0, 1):
+        assert procs[r].returncode == 0, f"worker {r} failed:\n{outs[r]}"
+        assert f"RES_MP_OK {r}" in outs[r], outs[r]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_mp_sigstop_sigcont_rejoin_round_trip():
+    """SIGSTOP a rank for ~1 s mid-run, SIGCONT it (the chaos helper
+    child thaws it): the survivors' peer health dips to SUSPECT and
+    recovers, nobody is declared dead, and the global mass audit stays
+    exact — a paused peer costs latency, never mass."""
+    procs, outs = _run_resilience_workers("sigstop1", duration="4.0")
+    for r in range(3):
+        assert procs[r].returncode == 0, f"worker {r} failed:\n{outs[r]}"
+        assert f"RES_MP_OK {r}" in outs[r], outs[r]
