@@ -88,6 +88,24 @@ def topology_pass(report: LintReport, size: int) -> None:
         report.extend(check_topology(topo))
         report.extend(check_schedule(build_schedule(topo)))
 
+    # elastic membership: every replan the runtime can produce while the
+    # fleet grows/shrinks must itself verify (active-submatrix strong
+    # connectivity — the B-connectivity-style guarantee that no member
+    # pair is ever cut off — plus stochasticity and a nonzero gap).
+    # Sweep the member-set sizes 1..size over a deterministic choice of
+    # members (the same sorted-list mapping every rank uses).
+    from bluefog_tpu import topology as T
+
+    base = T.ExponentialTwoGraph(size)
+    for m in range(1, size + 1):
+        members = list(range(0, 2 * m, 2))[:m]  # spread, not a prefix
+        members = [r % size for r in members][:m]
+        if len(set(members)) < m:
+            members = list(range(m))
+        replanned = T.replan(base, members)
+        report.extend(check_topology(
+            replanned, name=f"replan[n={size},m={m}]"))
+
 
 def dynamic_pass(report: LintReport, size: int) -> None:
     import numpy as np

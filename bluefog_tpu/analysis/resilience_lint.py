@@ -26,6 +26,20 @@ window_lint` — the reconnect loops are host Python):
 **BF-RES001** (error): an unbounded, unbudgeted loop around a connect
 site.  **BF-RES100** (info): scan summary.  Bounded ``for`` loops
 (``for _ in range(5)``) are inherently budgeted and never flagged.
+
+**BF-RES002** (error) — the membership pass, same vocabulary trick on a
+different invariant: every ADMISSION site must sit at a round boundary
+behind a quiesce.  Re-admitting a REJOINED/JOINING peer mid-round
+changes the mixing weights while a round's deposits are in flight —
+exactly the torn state the exact mass audit exists to catch — so any
+function that calls an admission primitive (``admit``, or a name
+containing ``admit``/``readmit``) must also reference the
+round-boundary/quiesce vocabulary: ``round``/``boundary``, a
+``barrier``/``rendezvous`` wait, a ``flush``/``fence`` of the live
+peers, ``quiesce``, or the ``heal``/``replan`` call that IS the
+boundary's weight change.  A function that admits without any of these
+markers is admitting mid-round.  (The state-machine definition itself
+— a method named ``admit`` — is exempt: the rule is for callers.)
 """
 
 from __future__ import annotations
@@ -36,11 +50,14 @@ from typing import List
 
 from bluefog_tpu.analysis.report import Diagnostic
 
-__all__ = ["check_retry_budgets", "check_file"]
+__all__ = ["check_admission_paths", "check_retry_budgets", "check_file"]
 
 _CONNECT_NAMES = ("create_connection", "connect", "connect_ex")
 _BUDGET_WORDS = ("backoff", "budget", "deadline", "attempt", "retries",
                  "next_delay")
+_ADMIT_WORDS = ("admit", "readmit")
+_BOUNDARY_WORDS = ("round", "boundary", "barrier", "rendezvous", "flush",
+                   "fence", "quiesce", "heal", "replan")
 
 
 def _call_name(node: ast.Call) -> str:
@@ -130,6 +147,63 @@ def check_retry_budgets(source: str, *, filename: str = "<source>"
     return diags
 
 
+def _is_admit_call(node: ast.Call) -> bool:
+    name = _call_name(node).lower()
+    return any(w in name for w in _ADMIT_WORDS)
+
+
+def _mentions_boundary(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, ast.Call):
+            ident = _call_name(sub)
+        elif isinstance(sub, ast.FunctionDef):
+            ident = sub.name
+        if ident and any(w in ident.lower() for w in _BOUNDARY_WORDS):
+            return True
+    return False
+
+
+def check_admission_paths(source: str, *, filename: str = "<source>"
+                          ) -> List[Diagnostic]:
+    """BF-RES002: every admission call site must carry a round-boundary
+    / quiesce marker in its enclosing function (see module docstring)."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-RES003",
+            f"could not parse {filename}: {e}",
+            pass_name="resilience-lint", subject=filename)]
+    short = os.path.basename(filename)
+    diags: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.lower() in _ADMIT_WORDS:
+            continue  # the state-machine primitive itself, not a caller
+        sites = [sub.lineno for sub in ast.walk(node)
+                 if isinstance(sub, ast.Call) and _is_admit_call(sub)]
+        if not sites:
+            continue
+        if _mentions_boundary(node):
+            continue
+        diags.append(Diagnostic(
+            "error", "BF-RES002",
+            f"admission call at {short}:{min(sites)} inside "
+            f"{node.name!r} has no round-boundary/quiesce marker — "
+            "re-admitting a peer mid-round changes the mixing weights "
+            "under in-flight deposits; admit only behind a barrier/"
+            "fence/flush/heal/replan at a round boundary",
+            pass_name="resilience-lint",
+            subject=f"{short}:{min(sites)}"))
+    return diags
+
+
 def check_file(path: str) -> List[Diagnostic]:
     try:
         with open(path, "r", encoding="utf-8") as f:
@@ -138,4 +212,5 @@ def check_file(path: str) -> List[Diagnostic]:
         return [Diagnostic(
             "warning", "BF-RES003", f"could not read {path}: {e}",
             pass_name="resilience-lint", subject=os.path.basename(path))]
-    return check_retry_budgets(src, filename=path)
+    return (check_retry_budgets(src, filename=path)
+            + check_admission_paths(src, filename=path))

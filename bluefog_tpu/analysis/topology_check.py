@@ -204,8 +204,54 @@ def check_mixing_matrix(
 
 
 def check_topology(topo: Topology, **kwargs) -> List[Diagnostic]:
-    """Alias of :func:`check_mixing_matrix` for :class:`Topology` inputs."""
-    return check_mixing_matrix(topo, **kwargs)
+    """:func:`check_mixing_matrix` for :class:`Topology` inputs, aware of
+    elastic membership: a healed/replanned topology carries
+    ``topo.inactive`` (corpses, drained leavers, reserved capacity
+    slots) whose rows are inert identity self-loops BY DESIGN.  Judging
+    the full matrix would be wrong twice over — the inactive block's
+    eigenvalue of exactly 1 reads as "no contraction", and the
+    disconnected inactive nodes as "consensus splits" — so the standard
+    invariants run on the ACTIVE submatrix, after verifying the
+    embedding itself: an inactive row must be exactly an identity
+    self-loop, and no active row may reference an inactive rank (that
+    is mass flowing to a corpse — the bug the heal exists to stop)."""
+    inactive = getattr(topo, "inactive", frozenset())
+    if not inactive:
+        return check_mixing_matrix(topo, **kwargs)
+    w = _as_matrix(topo)
+    n = w.shape[0]
+    subject = kwargs.pop("name", None) or topo.name
+    diags: List[Diagnostic] = []
+    bad_rows = [r for r in sorted(inactive)
+                if not (abs(w[r, r] - 1.0) <= _ATOL
+                        and (np.abs(np.delete(w[r], r)) <= _ATOL).all())]
+    if bad_rows:
+        diags.append(Diagnostic(
+            "error", "BF-TOPO030",
+            f"inactive rank(s) {bad_rows[:8]} are not inert identity "
+            "self-loops: a healed-out/not-yet-joined slot must hold no "
+            "mixing weight",
+            pass_name="topology", subject=subject))
+    leaky = sorted({i for i in range(n) if i not in inactive
+                    for j in inactive if abs(w[i, j]) > _ATOL})
+    if leaky:
+        diags.append(Diagnostic(
+            "error", "BF-TOPO031",
+            f"active rank(s) {leaky[:8]} still weight an inactive "
+            "rank's column: every gossip round leaks mass toward a "
+            "corpse/empty slot",
+            pass_name="topology", subject=subject))
+    active = sorted(set(range(n)) - set(inactive))
+    if not active:
+        diags.append(Diagnostic(
+            "error", "BF-TOPO032",
+            "every rank is inactive: there is no member set to verify",
+            pass_name="topology", subject=subject))
+        return diags
+    sub = w[np.ix_(active, active)]
+    diags.extend(check_mixing_matrix(
+        sub, name=f"{subject}[active n={len(active)}]", **kwargs))
+    return diags
 
 
 def check_schedule(

@@ -34,6 +34,7 @@ Spec grammar (``;``-separated rules)::
     site  := 'server' | 'ack' | 'client' | 'any' | 'rank<N>'
     fault := 'drop' | 'truncate' | 'delay' | 'stall'          (socket)
            | 'sigkill' | 'sigstop' | 'die'                    (process/thread)
+           | 'leave' | 'join'                                 (membership churn)
 
 Socket-rule keys: ``after_frames=N`` (fire once when the site's frame
 counter reaches N), ``every=K`` (every K-th frame), ``prob=P`` (seeded
@@ -53,6 +54,10 @@ Examples::
     rank1:sigstop:after_s=0.8:for_s=1  # freeze rank 1 for 1 s
     rank2:die:at_step=8                # thread-mode death (ChaosKill)
     rank1:stall:at_step=6:s=1.5        # thread-mode freeze/thaw
+    rank1:leave:at_step=20             # graceful drain at step 20 (ChaosLeave)
+    rank3:join:after_s=0.5             # rank 3 attaches to the job at t=0.5s
+    rank3:join:after_s=0.5;rank3:leave:at_step=10;rank3:join:after_s=2
+                                       # a flapping joiner: join, drain, rejoin
 
 The injector records every firing in the flight recorder
 (``chaos_inject``) and the ``bf_chaos_injections_total`` counter, so an
@@ -61,6 +66,7 @@ incident dump shows the injected fault next to the failure it caused.
 
 from bluefog_tpu.chaos.injector import (
     ChaosKill,
+    ChaosLeave,
     ChaosSpecError,
     Injector,
     Rule,
@@ -70,12 +76,14 @@ from bluefog_tpu.chaos.injector import (
     enabled,
     fire,
     get,
+    join_times,
     parse_spec,
     reset,
 )
 
 __all__ = [
     "ChaosKill",
+    "ChaosLeave",
     "ChaosSpecError",
     "Injector",
     "Rule",
@@ -85,6 +93,7 @@ __all__ = [
     "enabled",
     "fire",
     "get",
+    "join_times",
     "parse_spec",
     "reset",
 ]

@@ -32,10 +32,12 @@ rule  := site ':' fault (':' key '=' value)*
 site  := 'server' | 'ack' | 'client' | 'any' | 'rank<N>'
 fault := drop | truncate | delay | stall            (socket sites)
        | sigkill | sigstop | die | stall            (rank sites)
+       | leave | join                               (membership churn)
 
 socket keys: after_frames=N  every=K  prob=P  times=T  seed=S
              ms=M (delay)    s=S (stall)
 rank keys:   at_step=N  after_s=T  for_s=T (sigstop thaw / stall length)
+             (leave needs at_step=; join needs after_s=)
 
 examples:
   server:drop:after_frames=40      cut a server connection at frame 40
@@ -44,6 +46,8 @@ examples:
   server:delay:ms=20:prob=0.1      delay 10%% of frames by 20 ms
   rank2:sigkill:at_step=8          rank 2 SIGKILLs itself at step 8
   rank1:sigstop:after_s=0.8:for_s=1  freeze rank 1 for 1 s, then thaw
+  rank1:leave:at_step=20           graceful drain (mass handed off)
+  rank3:join:after_s=0.5           rank 3 attaches to the running job
 """
 
 

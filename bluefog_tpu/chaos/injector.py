@@ -36,6 +36,7 @@ from bluefog_tpu.metrics import comm as _mt
 
 __all__ = [
     "ChaosKill",
+    "ChaosLeave",
     "ChaosSpecError",
     "Injector",
     "Rule",
@@ -45,6 +46,7 @@ __all__ = [
     "enabled",
     "fire",
     "get",
+    "join_times",
     "parse_spec",
     "reset",
 ]
@@ -52,7 +54,7 @@ __all__ = [
 _ENV = "BLUEFOG_TPU_CHAOS"
 
 _SOCKET_FAULTS = ("drop", "truncate", "delay", "stall")
-_RANK_FAULTS = ("sigkill", "sigstop", "die", "stall")
+_RANK_FAULTS = ("sigkill", "sigstop", "die", "stall", "leave", "join")
 _SOCKET_SITES = ("server", "ack", "client", "any")
 
 _INT_KEYS = ("after_frames", "every", "times", "seed", "at_step")
@@ -67,6 +69,20 @@ class ChaosKill(Exception):
 
     def __init__(self, rank: int, step: Optional[int] = None):
         super().__init__(f"chaos killed rank {rank} at step {step}")
+        self.rank = rank
+        self.step = step
+
+
+class ChaosLeave(Exception):
+    """Raised by a ``leave`` rule inside a rank loop — a *graceful drain*
+    request, the membership-churn twin of :class:`ChaosKill`.  The
+    elastic runners catch it and perform the full leave protocol (fence,
+    hand push-sum mass to the out-neighbors, write the ``left`` record)
+    instead of treating the rank as a corpse; anything else propagating
+    it is a harness bug, so it is a plain ``Exception``."""
+
+    def __init__(self, rank: int, step: Optional[int] = None):
+        super().__init__(f"chaos drained rank {rank} at step {step}")
         self.rank = rank
         self.step = step
 
@@ -157,6 +173,17 @@ def _parse_rule(text: str, index: int) -> Rule:
         raise ChaosSpecError(
             f"rule {text!r}: 'die' is a thread-loop fault and needs "
             "at_step= (a timer thread cannot kill another thread)")
+    if rule.fault == "leave" and rule.at_step is None:
+        raise ChaosSpecError(
+            f"rule {text!r}: 'leave' is a graceful drain executed by the "
+            "rank loop itself and needs at_step= (the leave protocol — "
+            "fence, mass handoff, record — must run on the leaving "
+            "rank's own thread at a round boundary)")
+    if rule.fault == "join" and rule.after_s is None:
+        raise ChaosSpecError(
+            f"rule {text!r}: 'join' schedules when a rank ATTACHES to "
+            "the running job and needs after_s= (queried by the elastic "
+            "runner via join_times(), not executed as a fault)")
     if rule.prob is not None and not (0.0 <= rule.prob <= 1.0):
         raise ChaosSpecError(f"rule {text!r}: prob must be in [0, 1]")
     return rule
@@ -242,6 +269,8 @@ class Injector:
                      else -1)
         if rule.fault == "die":
             raise ChaosKill(rank, step)
+        if rule.fault == "leave":
+            raise ChaosLeave(rank, step)
         if rule.fault == "stall":
             time.sleep(rule.s if rule.s > 0 else (rule.for_s or 0.0))
             return
@@ -288,7 +317,7 @@ class Injector:
             self._armed.add(rank)
             rules = [(r, i) for i, r in enumerate(self.rules)
                      if r.site == "rank" and r.rank == rank
-                     and r.after_s is not None]
+                     and r.after_s is not None and r.fault != "join"]
         for r, i in rules:
             t = threading.Timer(
                 r.after_s, self._execute_rank_fault, args=(r, i, rank, None))
@@ -296,6 +325,24 @@ class Injector:
             t.start()
             with self._mu:
                 self._timers.append(t)
+
+    def join_times(self, rank: int) -> List[float]:
+        """The ``after_s`` offsets of this rank's ``join`` rules, sorted —
+        the elastic runners consult this ONCE at startup to schedule when
+        the rank attaches (a flapping joiner is two+ join rules
+        interleaved with leave rules).  Each call marks the rules fired,
+        so the schedule is consumed exactly once per run."""
+        out: List[float] = []
+        with self._mu:
+            for i, r in enumerate(self.rules):
+                if (r.site == "rank" and r.rank == rank
+                        and r.fault == "join" and r.after_s is not None):
+                    mx = r.max_fires()
+                    if mx and self._fired[i] >= mx:
+                        continue
+                    out.append(float(r.after_s))
+                    self._record(r, i, rank=rank, step=-1)
+        return sorted(out)
 
     def cancel(self) -> None:
         with self._mu:
@@ -376,3 +423,9 @@ def arm(rank: int) -> None:
     inj = get()
     if inj is not None:
         inj.arm(rank)
+
+
+def join_times(rank: int) -> List[float]:
+    """This rank's scheduled join offsets (empty when chaos is off)."""
+    inj = get()
+    return [] if inj is None else inj.join_times(rank)

@@ -60,8 +60,10 @@ import numpy as np
 from bluefog_tpu import chaos as _chaos
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
-from bluefog_tpu.runtime import native, resilience as _res
-from bluefog_tpu.topology.graphs import Topology, heal as _heal
+from bluefog_tpu.runtime import (membership as _mship, native,
+                                 resilience as _res)
+from bluefog_tpu.topology.graphs import (Topology, heal as _heal,
+                                         replan as _replan)
 from bluefog_tpu.utils import log as _log, timeline as _timeline
 
 
@@ -336,7 +338,8 @@ class AsyncWindow:
         return int(v)
 
     def deposit_async(self, slot: int, arr: np.ndarray, *,
-                      accumulate: bool = True, copy: bool = True) -> int:
+                      accumulate: bool = True, copy: bool = True,
+                      drain: bool = False) -> int:
         """Pipelined-transport-compatible spelling of :meth:`deposit`.
         In-process and shm deposits are already one-sided memory writes
         with nothing in flight afterwards, so this IS the synchronous
@@ -346,8 +349,15 @@ class AsyncWindow:
         signature parity with ``PipelinedRemoteWindow.deposit_async``
         (asserted by a test so the one-loop-body invariant cannot
         drift); both values behave identically here because the payload
-        is consumed before this call returns."""
+        is consumed before this call returns.  ``drain=True`` marks a
+        graceful leaver's final mass handoff (same record the wire
+        transport's flag bit2 produces on the owner)."""
         del copy
+        if drain:
+            _mt.inc("bf_drain_deposits_total", 1.0,
+                    peer="local")
+            _bb.record("drain_deposit", window=self.name, slot=slot,
+                       peer="local")
         return self.deposit(slot, arr, accumulate=accumulate)
 
     def flush(self, timeout_s: Optional[float] = None) -> None:
@@ -814,11 +824,19 @@ class DSGDReport:
     # (exact audit: total_mass + died_mass == n)
     died_mass: float = 0.0
     # process-mode: the surviving set's mass measured at the post-heal
-    # rendezvous (exact audit: total_mass == baseline_mass)
+    # rendezvous (exact audit: total_mass == baseline_mass); elastic
+    # runs re-measure it at every join admission, so the audit stays
+    # exact as the fleet grows
     baseline_mass: Optional[float] = None
     # thread-mode: per-rank health transitions [(t, from, to)] from the
     # shared board (see PushSumReport.health_transitions)
     health_transitions: Optional[Dict[int, list]] = None
+    # elastic membership: ranks that completed a graceful drain (their
+    # push-sum mass was HANDED OFF to out-neighbors — conserved, unlike
+    # a corpse's, which shows up in died_mass) and ranks admitted
+    # through the JOINING path at least once
+    left_ranks: List[int] = field(default_factory=list)
+    joined_ranks: List[int] = field(default_factory=list)
 
 
 def run_async_dsgd(
@@ -832,6 +850,8 @@ def run_async_dsgd(
     name: str = "async_dsgd",
     poll_interval_s: float = 0.0,
     resilience: Optional[_res.ResilienceConfig] = None,
+    join_at_s: Optional[Dict[int, Sequence[float]]] = None,
+    leave_at_s: Optional[Dict[int, float]] = None,
 ) -> DSGDReport:
     """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
     over the passive-target windows: the execution model of the reference's
@@ -878,6 +898,29 @@ def run_async_dsgd(
         boundary.  A chaos-killed thread leaves a last will of the mass
         it carried, so the audit stays exact: ``report.total_mass +
         report.died_mass == n``.
+      join_at_s / leave_at_s: elastic membership (intentional change, the
+        complement of ``resilience``'s unplanned death).  ``join_at_s``
+        maps a rank to the wall-clock offsets at which it ATTACHES to the
+        running job (an EMPTY offset list marks a reserved capacity slot
+        that never joins): the rank starts ABSENT (a reserved capacity slot),
+        then at each offset warm-starts by pulling a live member's
+        published ``(x, p)`` snapshot from its window (``read_self`` —
+        no checkpoint anywhere), enters with fresh push-sum weight
+        ``p = 1`` and is admitted through the JOINING state at a round
+        boundary.  ``leave_at_s`` maps a rank to the offset of its
+        GRACEFUL DRAIN: it fences, hands its entire ``(x, p)`` mass to
+        its live out-neighbors in final ``drain``-flagged deposits (a
+        leaver's mass is conserved, never written off like a corpse's),
+        and exits; a later join offset re-admits it (a flapping member).
+        Chaos rules compose: ``rankN:join:after_s=T`` adds a join offset
+        and ``rankN:leave:at_step=K`` drains at step K
+        (:class:`~bluefog_tpu.chaos.ChaosLeave`).  Live ranks then
+        re-plan the mixing graph over the current member set at round
+        boundaries (:func:`bluefog_tpu.topology.replan` — deterministic
+        in the member list, so every rank converges on the same plan
+        with no coordination), and the audit is exact over the churn:
+        ``report.total_mass + report.died_mass == len(initial members) +
+        len(admissions)`` (= ``report.baseline_mass``).
     """
     n = topology.size
     packer = TreePacker(params0, np.float64)
@@ -888,11 +931,44 @@ def run_async_dsgd(
         skew = [base * (1.0 + 4.0 * r / max(n - 1, 1)) for r in range(n)]
 
     in_nbrs = [list(topology.in_neighbors(r)) for r in range(n)]
-    out_nbrs = [list(topology.out_neighbors(r)) for r in range(n)]
-    slot_of = [{src: k for k, src in enumerate(in_nbrs[r])} for r in range(n)]
 
-    wins = _create_windows(
-        name, [max(len(in_nbrs[r]), 1) for r in range(n)], d + 1)
+    # elastic membership: merge the explicit schedules with chaos churn
+    # rules (rankN:join:after_s adds a join offset; rankN:leave:at_step
+    # raises ChaosLeave inside the loop)
+    joins: Dict[int, List[float]] = {}
+    for r, ts in (join_at_s or {}).items():
+        seq = [ts] if isinstance(ts, (int, float)) else list(ts)
+        joins[int(r)] = sorted(float(t) for t in seq)
+    for r in range(n):
+        ct = _chaos.join_times(r)
+        if ct:
+            joins.setdefault(r, []).extend(ct)
+            joins[r].sort()
+    leaves = {int(r): float(t) for r, t in (leave_at_s or {}).items()}
+    inj = _chaos.get()
+    elastic = bool(joins or leaves) or (
+        inj is not None and any(ru.fault in ("leave", "join")
+                                for ru in inj.rules))
+    members0 = frozenset(range(n)) - frozenset(joins)
+    if elastic and not members0:
+        raise ValueError("every rank has a join schedule; at least one "
+                         "initial member must seed the warm-start chain")
+
+    # Slot scheme: elastic runs take one landing slot PER CAPACITY RANK
+    # (slot index == source rank) — stable under arbitrary membership
+    # change, which dense in-neighbor slot maps are not (a replanned
+    # graph has edges the original topology had no slot for).  Fixed
+    # fleets keep the dense in-degree sizing: at ~log2(n) slots per
+    # rank it is O(n log n · d) total where capacity slots are
+    # O(n² · d) — a real memory difference when d is model-sized.
+    if elastic:
+        wins = _create_windows(name, [n] * n, d + 1)
+        slot_of = None
+    else:
+        wins = _create_windows(
+            name, [max(len(in_nbrs[r]), 1) for r in range(n)], d + 1)
+        slot_of = [{src: k for k, src in enumerate(in_nbrs[r])}
+                   for r in range(n)]
 
     stop = threading.Event()
     steps = [0] * n
@@ -900,80 +976,266 @@ def run_async_dsgd(
     finals: list = [None] * n
     errors: List[BaseException] = []
     x0 = packer.pack(params0)
-    board = (_res.HealthBoard(n, suspect_after_s=resilience.suspect_after_s,
-                              dead_after_s=resilience.dead_after_s)
-             if resilience is not None else None)
+    board = (_res.HealthBoard(
+        n,
+        suspect_after_s=(resilience.suspect_after_s
+                         if resilience is not None else 0.5),
+        dead_after_s=(resilience.dead_after_s
+                      if resilience is not None else 2.0),
+        members=members0 if elastic else None)
+        if (resilience is not None or elastic) else None)
     died = [False] * n
     died_mass = [0.0] * n
 
+    # shared membership truth; each rank re-derives its plan from it at
+    # round boundaries, so every loop converges on the same replan with
+    # no coordination beyond this set (replan is deterministic in the
+    # member list)
+    mem_mu = threading.Lock()
+    members = set(members0)
+    left_final: set = set()
+    ever_joined: set = set()
+    joined_mass = [0.0]
+    plan_cache: Dict[frozenset, Topology] = {}
+
+    def _plan(active: frozenset) -> Topology:
+        # the gauge tracks the CURRENT set even when the plan itself is
+        # a cache hit (a flapping member returns to a set already seen)
+        _mt.set("bf_members", float(len(active)))
+        with mem_mu:
+            cached = plan_cache.get(active)
+        if cached is not None:
+            return cached
+        t0p = time.perf_counter()
+        if elastic:
+            plan = _replan(topology, active)
+        else:
+            plan = _heal(topology, frozenset(range(n)) - active)
+        _mt.observe("bf_replan_seconds", time.perf_counter() - t0p)
+        with mem_mu:
+            plan_cache[active] = plan
+        return plan
+
+    t_run0 = time.perf_counter()
+
     def rank_loop(r: int):
         p = 1.0
-        try:
-            x = x0.copy()
-            my_out = list(out_nbrs[r])
-            frac = 1.0 / (len(my_out) + 1)
-            known_dead: set = set()
-            # model-sized scratch, allocated once: the hot loop must not
-            # churn fresh ~d-element buffers per step (d can be 10^8)
-            gvec = np.empty(d, np.float64)
-            payload = np.empty(d + 1, np.float64)
-            rec = _bb.get()  # flight recorder (None when off)
-            while not stop.is_set():
-                _chaos.check_step(r, steps[r])
-                if board is not None:
-                    board.beat(r)
-                    dead_now = board.dead_ranks() - {r}
-                    if dead_now != known_dead:
-                        # heal at the round boundary: re-admit REJOINED
-                        # ranks, re-normalize weights over survivors
-                        for j in known_dead - dead_now:
-                            board.admit(j)
-                        known_dead = set(dead_now)
-                        healed = _heal(topology, known_dead)
-                        my_out = list(healed.out_neighbors(r))
-                        frac = 1.0 / (len(my_out) + 1)
-                # per-round blackbox markers: a begin without its end in a
-                # dump names the round (and rank) the loop wedged in
-                if rec is not None:
-                    rec.begin("collective", key=("async_dsgd", r, steps[r]),
-                              op="async_dsgd_round", cid="async_dsgd_round",
-                              step=steps[r], rank=r, peers=my_out)
-                for k in range(len(in_nbrs[r])):
-                    buf, fresh = wins[r].read(k, consume=True)
-                    if fresh > 0:
-                        x += buf[:-1]
-                        p += buf[-1]
-                z = x / p
-                loss, grads = loss_and_grad(r, steps[r], packer.unpack(z))
-                losses[r].append(float(loss))
-                # x/p-space gradient step: z' = z - lr*grad  =>  dx = -lr*p*g
-                packer.pack(grads, out=gvec)
-                gvec *= lr * p
-                x -= gvec
-                payload[:-1] = x
-                payload[-1] = p
-                payload *= frac
-                for j in my_out:
-                    wins[j].deposit(slot_of[j][r], payload, accumulate=True)
-                x *= frac
-                p *= frac
-                if rec is not None:
-                    rec.end("collective", key=("async_dsgd", r, steps[r]),
-                            op="async_dsgd_round", cid="async_dsgd_round",
-                            step=steps[r], rank=r)
-                    rec.record("optimizer_step", step=steps[r], rank=r,
-                               loss=float(loss))
-                steps[r] += 1
-                if skew[r] > 0 or poll_interval_s > 0:
-                    time.sleep(skew[r] + poll_interval_s)
-            # drain in-flight mass so the audit below is exact
-            for k in range(len(in_nbrs[r])):
+        # model-sized scratch, allocated once: the hot loop must not
+        # churn fresh ~d-element buffers per step (d can be 10^8)
+        gvec = np.empty(d, np.float64)
+        payload = np.empty(d + 1, np.float64)
+        self_buf = np.empty(d + 1, np.float64)
+        rec = _bb.get()  # flight recorder (None when off)
+        my_joins = list(joins.get(r, []))
+        is_member = r in members0
+        leave_deadline = leaves.get(r)
+
+        my_slots = (range(n) if elastic else range(len(in_nbrs[r])))
+
+        def consume(x, p):
+            for k in my_slots:
+                if elastic and k == r:
+                    continue
                 buf, fresh = wins[r].read(k, consume=True)
                 if fresh > 0:
                     x += buf[:-1]
                     p += buf[-1]
-            finals[r] = x / p
-            wins[r].set_self(np.concatenate([x, [p]]))
+            return p
+
+        try:
+            x = x0.copy()
+            while not stop.is_set():
+                if not is_member:
+                    # ------------------------------------ JOIN the job
+                    if not my_joins:
+                        return  # reserved capacity slot, never scheduled
+                    t_join = my_joins.pop(0)
+                    while (time.perf_counter() - t_run0 < t_join
+                           and not stop.is_set()):
+                        time.sleep(0.002)
+                    if stop.is_set():
+                        return
+                    # warm-start: pull a live member's published (x, p)
+                    # snapshot through its window — no checkpoint read
+                    # anywhere.  The pair is published atomically (one
+                    # set_self under the window's self mutex), so the
+                    # joiner's first state is round-consistent.
+                    t_ws = time.perf_counter()
+                    if board is not None:
+                        board.mark_joining(r)
+                    z = None
+                    deadline = t_ws + max(duration_s, 5.0)
+                    while (z is None and not stop.is_set()
+                           and time.perf_counter() < deadline):
+                        with mem_mu:
+                            cand = sorted(members - {r})
+                        for nb in cand:
+                            s = wins[nb].read_self()
+                            if s[-1] > 0.0:
+                                z = s[:-1] / s[-1]
+                                break
+                        if z is None:
+                            time.sleep(0.002)
+                    if z is None:
+                        z = x0  # no member published yet: cold start
+                    x = np.array(z, np.float64)
+                    p = 1.0  # fresh push-sum weight: mass enters HERE
+                    with mem_mu:
+                        members.add(r)
+                        joined_mass[0] += 1.0
+                        ever_joined.add(r)
+                        left_final.discard(r)
+                    if board is not None:
+                        board.admit(r)  # its own first round boundary
+                    is_member = True
+                    _mt.observe("bf_join_warmstart_seconds",
+                                time.perf_counter() - t_ws)
+                    _bb.record("peer_join", peer=f"rank{r}", rank=r,
+                               warmstart_s=round(
+                                   time.perf_counter() - t_ws, 6))
+                    # publish immediately: a second joiner may warm from
+                    # this rank before its first full round
+                    self_buf[:-1] = x
+                    self_buf[-1] = p
+                    wins[r].set_self(self_buf)
+
+                # ------------------------------------------ gossip loop
+                my_out: List[int] = []
+                frac = 1.0
+                known_active: Optional[frozenset] = None
+                want_leave = False
+                try:
+                    while not stop.is_set():
+                        _chaos.check_step(r, steps[r])
+                        if (leave_deadline is not None
+                                and time.perf_counter() - t_run0
+                                >= leave_deadline):
+                            leave_deadline = None
+                            want_leave = True
+                            break
+                        if board is not None:
+                            board.beat(r)
+                        with mem_mu:
+                            active = frozenset(members)
+                        if resilience is not None:
+                            active = active - (board.dead_ranks() - {r})
+                        if active != known_active:
+                            # round boundary: re-admit ranks that came
+                            # back (REJOINED) or announced (JOINING),
+                            # then re-plan the graph over the current
+                            # member set
+                            if known_active is not None \
+                                    and board is not None:
+                                for j in active - known_active:
+                                    if board.state(j) in (_res.REJOINED,
+                                                          _res.JOINING):
+                                        board.admit(j)
+                            known_active = active
+                            plan = _plan(active)
+                            my_out = list(plan.out_neighbors(r))
+                            frac = 1.0 / (len(my_out) + 1)
+                        # per-round blackbox markers: a begin without its
+                        # end in a dump names the round the loop wedged in
+                        if rec is not None:
+                            rec.begin("collective",
+                                      key=("async_dsgd", r, steps[r]),
+                                      op="async_dsgd_round",
+                                      cid="async_dsgd_round",
+                                      step=steps[r], rank=r, peers=my_out)
+                        p = consume(x, p)
+                        if elastic:
+                            # publish a coherent (x, p) snapshot: what a
+                            # JOINING peer warm-starts from
+                            self_buf[:-1] = x
+                            self_buf[-1] = p
+                            wins[r].set_self(self_buf)
+                        z = x / p
+                        loss, grads = loss_and_grad(r, steps[r],
+                                                    packer.unpack(z))
+                        losses[r].append(float(loss))
+                        # x/p-space gradient step:
+                        # z' = z - lr*grad  =>  dx = -lr*p*g
+                        packer.pack(grads, out=gvec)
+                        gvec *= lr * p
+                        x -= gvec
+                        payload[:-1] = x
+                        payload[-1] = p
+                        payload *= frac
+                        for j in my_out:
+                            wins[j].deposit(
+                                r if elastic else slot_of[j][r],
+                                payload, accumulate=True)
+                        x *= frac
+                        p *= frac
+                        if rec is not None:
+                            rec.end("collective",
+                                    key=("async_dsgd", r, steps[r]),
+                                    op="async_dsgd_round",
+                                    cid="async_dsgd_round",
+                                    step=steps[r], rank=r)
+                            rec.record("optimizer_step", step=steps[r],
+                                       rank=r, loss=float(loss))
+                        steps[r] += 1
+                        if skew[r] > 0 or poll_interval_s > 0:
+                            time.sleep(skew[r] + poll_interval_s)
+                except _chaos.ChaosLeave:
+                    want_leave = True
+
+                if not want_leave:
+                    # run ended: drain in-flight mass so the audit below
+                    # is exact, publish the final state
+                    p = consume(x, p)
+                    finals[r] = x / p
+                    wins[r].set_self(np.concatenate([x, [p]]))
+                    return
+
+                # -------------------------------------- GRACEFUL DRAIN
+                # fence (in-process deposits are applied synchronously,
+                # so the flush is the formal round-boundary marker), fold
+                # any landed mass, then hand the ENTIRE (x, p) to live
+                # out-neighbors in drain-flagged deposits: a leaver's
+                # mass is CONSERVED in the audit, never written off like
+                # a corpse's
+                wins[r].flush()
+                p = consume(x, p)
+                with mem_mu:
+                    live = sorted(members - {r})
+                live = [j for j in live if not died[j]]
+                if board is not None:
+                    live = [j for j in live
+                            if board.state(j) != _res.DEAD]
+                plan = _plan(known_active
+                             if known_active else frozenset({r} | set(live)))
+                tgt = [j for j in plan.out_neighbors(r) if j in live]
+                tgt = tgt or live
+                if tgt:
+                    payload[:-1] = x
+                    payload[-1] = p
+                    payload /= float(len(tgt))
+                    for j in tgt:
+                        wins[j].deposit_async(r, payload,
+                                              accumulate=True, drain=True)
+                    x[:] = 0.0
+                    p = 0.0
+                # else: no live member to hand off to — keep the mass
+                # and publish it; the audit still counts it below
+                self_buf[:-1] = x
+                self_buf[-1] = p
+                wins[r].set_self(self_buf)
+                with mem_mu:
+                    members.discard(r)
+                    left_final.add(r)
+                    n_mem = len(members)
+                if board is not None:
+                    board.mark_left(r)
+                else:
+                    _bb.record("peer_leave", peer=f"rank{r}", rank=r,
+                               step=steps[r])
+                _mt.set("bf_members", float(n_mem))
+                finals[r] = None
+                is_member = False
+                # back to the outer loop: a later join offset re-admits
+                # this rank (a flapping member)
         except _chaos.ChaosKill:
             # simulated death: no drain, no final publish; the last will
             # (mass carried to the grave) keeps the audit exact
@@ -1004,14 +1266,21 @@ def run_async_dsgd(
 
     total_mass = 0.0
     for r in range(n):
-        total_mass += float(wins[r].read_self()[-1])
-        for k in range(len(in_nbrs[r])):
+        if not died[r]:
+            # a corpse's published snapshot is stale (the authoritative
+            # grave mass is its last will, died_mass); everyone else's
+            # final set_self is the truth
+            total_mass += float(wins[r].read_self()[-1])
+        for k in (range(n) if elastic else range(len(in_nbrs[r]))):
+            if elastic and k == r:
+                continue
             buf, fresh = wins[r].read(k, consume=False)
             if fresh > 0:
                 total_mass += float(buf[-1])
 
-    # consensus over SURVIVORS (a chaos-killed rank has no final z; its
-    # window's residual mass was already counted by the audit above)
+    # consensus over SURVIVORS (a chaos-killed rank has no final z; a
+    # leaver handed its state off; their windows' residual mass was
+    # already counted by the audit above)
     alive = [r for r in range(n) if finals[r] is not None]
     if alive:
         zs = np.stack([finals[r] for r in alive])
@@ -1028,9 +1297,16 @@ def run_async_dsgd(
         consensus_gap=gap,
         dead_ranks=[r for r in range(n) if died[r]],
         died_mass=float(sum(died_mass)),
+        # elastic: the exact expectation the audit must reproduce —
+        # every unit of mass that ever entered (initial members + one
+        # per admission) is either held by a window or in a grave
+        baseline_mass=(float(len(members0)) + joined_mass[0]
+                       if elastic else None),
         health_transitions=(
             {r: board.transitions(r) for r in range(n)}
             if board is not None else None),
+        left_ranks=sorted(left_final),
+        joined_ranks=sorted(ever_joined),
     )
     for w in wins:
         w.free()
@@ -1114,7 +1390,7 @@ class _ShmTransport:
     def publish(self, barrier: FileBarrier, rank: int) -> None:
         pass  # the shm namespace IS the rendezvous
 
-    def collect(self, barrier: FileBarrier, n: int) -> None:
+    def collect(self, barrier: FileBarrier, ranks) -> None:
         pass
 
     def open(self, owner: int, wname: str, n_slots: int, n_elems: int):
@@ -1140,14 +1416,17 @@ class _RemoteHandle:
             slot, np.ascontiguousarray(arr, self.dtype),
             accumulate=accumulate)
 
-    def deposit_async(self, slot, arr, *, accumulate=True, copy=True):
+    def deposit_async(self, slot, arr, *, accumulate=True, copy=True,
+                      drain=False):
         """Fire-and-forget on the pipelined DCN transport; synchronous
-        (equivalent, just not overlapped) on the plain one."""
+        (equivalent, just not overlapped) on the plain one, where the
+        drain mark is carried by the owner's audit protocol instead of
+        a wire flag."""
         fn = getattr(self._rw, "deposit_async", None)
         a = np.ascontiguousarray(arr, self.dtype)
         if fn is None:
             return self._rw.deposit(slot, a, accumulate=accumulate)
-        return fn(slot, a, accumulate=accumulate, copy=copy)
+        return fn(slot, a, accumulate=accumulate, copy=copy, drain=drain)
 
     @property
     def health(self):
@@ -1207,13 +1486,16 @@ class _TcpTransport:
             f.write(f"{host}:{port}")
         os.replace(path + ".tmp", path)
 
-    def collect(self, barrier: FileBarrier, n: int,
+    def collect(self, barrier: FileBarrier, ranks,
                 timeout_s: float = 60.0) -> None:
         # the barrier dir may be NFS on the cross-host path: another
         # host's winaddr file can lag the barrier (the same visibility
-        # delay FileBarrier.wait polls for), so poll here too
+        # delay FileBarrier.wait polls for), so poll here too.  ``ranks``
+        # is the set to resolve — the CURRENT member set for elastic
+        # jobs (a reserved capacity slot has no address yet), or one
+        # newly-announced joiner during admission.
         deadline = time.perf_counter() + timeout_s
-        for r in range(n):
+        for r in ranks:
             path = os.path.join(barrier.path, f"winaddr.{r}")
             while True:
                 try:
@@ -1269,6 +1551,9 @@ def run_async_dsgd_rank(
     tcp_bind: str = "0.0.0.0",
     wire_codec: Optional[str] = None,
     resilience: Optional[_res.ResilienceConfig] = None,
+    join: bool = False,
+    leave_after_s: Optional[float] = None,
+    initial_members: Optional[Sequence[int]] = None,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -1318,9 +1603,37 @@ def run_async_dsgd_rank(
     single failures are fine; a simultaneous multi-rank wipe may time
     out the heal rendezvous and abort).
 
+    **Elastic membership** (tcp transport; requires ``resilience=``):
+    ``topology`` is the job's CAPACITY — its size bounds how many ranks
+    can ever participate, and slot indices are rank numbers so the
+    windows survive arbitrary membership change.  ``initial_members``
+    names the ranks that start the job (default: all); the rest are
+    reserved slots.  A later process calls this function with
+    ``join=True`` on a reserved (or previously-departed) rank: it
+    attaches its window server, **warm-starts by reading a live
+    member's published (x, p) snapshot from its window — no checkpoint
+    file anywhere**, announces itself through a ``member.<r>`` record in
+    the barrier directory (the same dissemination channel as the
+    ``dead.<r>`` tombstones), and is admitted at a round boundary
+    through a quiesce-rendezvous that re-measures the exact push-sum
+    baseline over the grown member set.  ``leave_after_s`` (or a chaos
+    ``rankN:leave:at_step`` rule) triggers the graceful-drain
+    counterpart: the leaver fences its deposit streams, waits for the
+    members to fence theirs (nothing in flight toward it afterwards),
+    hands its ENTIRE push-sum mass to its out-neighbors in final
+    ``drain``-flagged deposits — a leaver's mass is conserved in the
+    audit, unlike a corpse's — writes ``left.<r>``, and exits.  The
+    live ranks re-plan the mixing graph over the current member set
+    (:func:`bluefog_tpu.topology.replan`, deterministic in the member
+    list) at every membership round boundary.  Rank 0 reports; it must
+    be a stable initial member.  Membership events are assumed to
+    settle one at a time (staggered churn is fine; two simultaneous
+    rendezvous can time out each other and degrade the exactness claim,
+    loudly, exactly as overlapping failures do).
+
     Returns a :class:`DSGDReport` on rank 0 (``losses`` filled only at index
     ``rank`` — other ranks' loss curves stay in their processes), ``None``
-    elsewhere.
+    elsewhere (including joiners and leavers).
     """
     if transport == "shm":
         tx = _ShmTransport()
@@ -1341,13 +1654,27 @@ def run_async_dsgd_rank(
     # — must release them, so the try begins immediately
     opened: List = []
     try:
+        if (join or leave_after_s is not None
+                or initial_members is not None) and transport != "tcp":
+            raise ValueError(
+                "elastic membership (join/leave/initial_members) requires "
+                "transport='tcp' (member discovery rides the winaddr "
+                "records; the shm namespace has none)")
         d = TreePacker(params0, np.float64).size
-        n_in = len(list(topology.in_neighbors(rank)))
 
         # every window/handle this process opens is freed in the finally —
         # a mid-run exception (loss_and_grad raising, a peer dying at a
-        # barrier) must not leak shm segments or sockets
-        win = tx.create(f"{name}:{rank}", max(n_in, 1), d + 1)
+        # barrier) must not leak shm segments or sockets.  Elastic jobs
+        # take one landing slot PER CAPACITY RANK (slot index == source
+        # rank — stable under arbitrary membership change, which dense
+        # in-neighbor slot maps are not); fixed fleets keep the dense
+        # in-degree sizing, whose memory is O(in_degree · d) per rank
+        # instead of O(capacity · d).
+        if join or leave_after_s is not None or initial_members is not None:
+            n_slots = topology.size
+        else:
+            n_slots = max(len(list(topology.in_neighbors(rank))), 1)
+        win = tx.create(f"{name}:{rank}", n_slots, d + 1)
         opened.append(win)
 
         def _create(wname, n_slots, n_elems):
@@ -1365,7 +1692,9 @@ def run_async_dsgd_rank(
             duration_s=duration_s, skew_s=skew_s, name=name,
             poll_interval_s=poll_interval_s, win=win, transport=tx,
             create_window=_create, open_window=_open,
-            resilience=resilience if transport == "tcp" else None)
+            resilience=resilience if transport == "tcp" else None,
+            join=join, leave_after_s=leave_after_s,
+            initial_members=initial_members)
     finally:
         for w in opened:
             try:
@@ -1378,39 +1707,47 @@ def run_async_dsgd_rank(
 def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         lr, duration_s, skew_s, name, poll_interval_s, win,
                         transport, create_window, open_window,
-                        resilience=None):
+                        resilience=None, join=False, leave_after_s=None,
+                        initial_members=None):
     n = topology.size
     packer = TreePacker(params0, np.float64)
     d = packer.size
-    in_nbrs = list(topology.in_neighbors(rank))
-    out_nbrs = list(topology.out_neighbors(rank))
+    cfg = resilience
+    inj = _chaos.get()
+    chaos_leave = (inj is not None and any(
+        ru.site == "rank" and ru.rank == rank and ru.fault == "leave"
+        for ru in inj.rules))
+    # elasticity is decided by the ARGUMENTS, which every rank of a job
+    # shares by construction — a chaos leave rule alone cannot flip one
+    # process into the elastic slot scheme while its peers stay dense
+    elastic = bool(join or leave_after_s is not None
+                   or initial_members is not None)
+    if chaos_leave and not elastic:
+        raise ValueError(
+            "a rankN:leave chaos rule needs an ELASTIC job (every rank "
+            "must run the membership protocol): start the fleet with "
+            "initial_members=/join=/leave_after_s= on all ranks")
+    if elastic and cfg is None:
+        raise ValueError(
+            "elastic membership (join/leave/initial_members) rides the "
+            "resilient rendezvous machinery; pass "
+            "resilience=ResilienceConfig(...)")
+    if (join or leave_after_s is not None or chaos_leave) and rank == 0:
+        raise ValueError("rank 0 is the reporting rank and must be a "
+                         "stable initial member (cannot join or leave)")
+    members: set = (set(range(n)) if initial_members is None
+                    else {int(r) for r in initial_members})
+    if not join and rank not in members:
+        raise ValueError(f"rank {rank} is not in initial_members "
+                         f"{sorted(members)} (a later process joins "
+                         "with join=True)")
     meta = None
-    if rank == 0:
-        # per-rank (steps, last_loss) land here so the report can carry
-        # every rank's step count across the process boundary
-        meta = create_window(f"{name}:meta", n, 2)
-    transport.publish(barrier, rank)
-    barrier.wait("created")
-    transport.collect(barrier, n)
-    if rank != 0:
-        meta = open_window(0, f"{name}:meta", n, 2)
-    peers = {j: open_window(
-        j, f"{name}:{j}",
-        max(len(list(topology.in_neighbors(j))), 1), d + 1)
-        for j in out_nbrs}
-    peer_slot = {j: list(topology.in_neighbors(j)).index(rank)
-                 for j in out_nbrs}
-
-    x = packer.pack(params0)
-    p = 1.0
-    my_out = list(out_nbrs)
-    frac = 1.0 / (len(my_out) + 1)
-    gvec = np.empty(d, np.float64)
-    payload = np.empty(d + 1, np.float64)
+    dead: set = set()
+    left: set = set()
+    ever_joined: set = set()
+    handled: set = set()  # (kind, rank, token) records already consumed
     losses: List[float] = []
     steps = 0
-    cfg = resilience
-    dead: set = set()
     baseline_mass: Optional[float] = None
     exact = True  # False once a failure escapes the rendezvous protocol
     rec = _bb.get()  # per-PROCESS flight recorder (None when off)
@@ -1420,6 +1757,80 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         # rank instead of every process fighting over rank 0's file
         rec.rank = rank
     _chaos.arm(rank)
+
+    x = packer.pack(params0)
+    p = 1.0
+    gvec = np.empty(d, np.float64)
+    payload = np.empty(d + 1, np.float64)
+    self_buf = np.empty(d + 1, np.float64)
+    peers: Dict[int, object] = {}
+
+    # slot scheme (must agree across every rank of the job): elastic =
+    # slot index == source rank over capacity slots; fixed fleet = the
+    # dense in-neighbor mapping of the original topology
+    in_nbrs = list(topology.in_neighbors(rank))
+    my_slots = (range(n) if elastic else range(len(in_nbrs)))
+
+    def _peer_slots(j: int) -> int:
+        return (n if elastic
+                else max(len(list(topology.in_neighbors(j))), 1))
+
+    def _slot_in(j: int) -> int:
+        """Our landing slot in peer j's window."""
+        return (rank if elastic
+                else list(topology.in_neighbors(j)).index(rank))
+
+    def _ensure_peer(j: int):
+        if j not in peers:
+            peers[j] = open_window(j, f"{name}:{j}", _peer_slots(j),
+                                   d + 1)
+        return peers[j]
+
+    def _make_plan():
+        """The mixing plan over the CURRENT member set: a fresh replan
+        for elastic fleets (re-optimized degree caps and spectral gap as
+        n changes), the PR-5 renormalizing heal for fixed ones.
+        Deterministic in (members, dead), so every rank that has seen
+        the same records converges on the same matrix with no extra
+        coordination."""
+        t0p = time.perf_counter()
+        if elastic:
+            plan = _replan(topology, members - dead)
+        else:
+            plan = _heal(topology, dead)
+        _mt.observe("bf_replan_seconds", time.perf_counter() - t0p)
+        _mt.set("bf_members", float(len(members - dead)))
+        return plan
+
+    def _local_mass() -> float:
+        """Own p + unconsumed landing-slot mass, valid only while
+        nothing is in flight (inside a quiesce-rendezvous)."""
+        local = p
+        for k in my_slots:
+            if elastic and k == rank:
+                continue
+            buf, fresh = win.read(k, consume=False)
+            if fresh > 0:
+                local += float(buf[-1])
+        return local
+
+    def _mass_rendezvous(stage: str) -> float:
+        """Second half of a quiesce-rendezvous: publish local mass, meet
+        at ``<stage>-resume``, and sum the member set's mass files —
+        the exact baseline every later audit must reproduce."""
+        mpath = os.path.join(barrier.path, f"{stage}.mass.{rank}")
+        with open(mpath + ".tmp", "w") as f:
+            # repr of a PYTHON float: round-trips to the exact same
+            # binary64 (numpy scalar reprs do not parse back)
+            f.write(repr(float(_local_mass())))
+        os.replace(mpath + ".tmp", mpath)
+        barrier.wait(stage + "-resume", timeout_s=cfg.barrier_timeout_s)
+        total = 0.0
+        for r2 in sorted(members - dead):
+            with open(os.path.join(barrier.path,
+                                   f"{stage}.mass.{r2}")) as f:
+                total += float(f.read())
+        return total
 
     # ---------------------------------------------------- fault handling
     def _tombstone(j: int) -> None:
@@ -1433,7 +1844,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             pass
 
     def _tombstoned() -> set:
-        return {r2 for r2 in range(n)
+        return {r2 for r2 in sorted(members)
                 if r2 != rank and r2 not in dead and os.path.exists(
                     os.path.join(barrier.path, f"dead.{r2}"))}
 
@@ -1456,15 +1867,15 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             for j in pending:
                 peers.pop(j, None)  # the caller's finally frees it
             pending = set()
-            healed = _heal(topology, dead)
-            my_out = list(healed.out_neighbors(rank))
+            plan = _make_plan()
+            my_out = list(plan.out_neighbors(rank))
             frac = 1.0 / (len(my_out) + 1)
             # FENCE the survivors: nothing of ours may be in flight when
             # the baseline is measured.  A fence that fails names the
             # next corpse — extend and repeat.
             for j in sorted(my_out):
                 try:
-                    peers[j].flush(cfg.barrier_timeout_s)
+                    _ensure_peer(j).flush(cfg.barrier_timeout_s)
                 except (RuntimeError, TimeoutError, OSError):
                     pending.add(j)
         stage = "heal" + "".join(f"-{j}" for j in sorted(dead))
@@ -1472,29 +1883,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         try:
             barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
             # between the two heal barriers no survivor deposits, so
-            # local mass (own p + unconsumed landing slots) is the whole
-            # truth
-            local = p
-            for k in range(len(in_nbrs)):
-                buf, fresh = win.read(k, consume=False)
-                if fresh > 0:
-                    local += float(buf[-1])
-            mpath = os.path.join(barrier.path, f"{stage}.mass.{rank}")
-            with open(mpath + ".tmp", "w") as f:
-                # repr of a PYTHON float: round-trips to the exact same
-                # binary64 (numpy scalar reprs do not parse back)
-                f.write(repr(float(local)))
-            os.replace(mpath + ".tmp", mpath)
-            barrier.wait(stage + "-resume",
-                         timeout_s=cfg.barrier_timeout_s)
-            total = 0.0
-            for r2 in range(n):
-                if r2 in dead:
-                    continue
-                with open(os.path.join(barrier.path,
-                                       f"{stage}.mass.{r2}")) as f:
-                    total += float(f.read())
-            baseline_mass = total
+            # local mass is the whole truth
+            baseline_mass = _mass_rendezvous(stage)
         except (TimeoutError, OSError, ValueError) as e:
             # a survivor never made the rendezvous (it exited the loop
             # first, or a second failure overlapped the first): the run
@@ -1506,6 +1896,155 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                       type(e).__name__, e)
         _bb.record("peer_dead_healed", rank=rank, dead=sorted(dead),
                    baseline_mass=baseline_mass, exact=exact)
+
+    # ------------------------------------------------ elastic membership
+    def _admit_joiner(j: int, token: str) -> None:
+        """A ``member.<j>`` record appeared: admit the joiner at THIS
+        round boundary.  Quiesce-rendezvous (fence, join barrier, mass
+        files) re-establishes the exact baseline over the grown member
+        set — the joiner's fresh ``p = 1`` enters the books here."""
+        nonlocal my_out, frac, baseline_mass, exact
+        transport.collect(barrier, [j])
+        members.add(j)
+        dead.discard(j)
+        left.discard(j)
+        ever_joined.add(j)
+        barrier.exclude.discard(j)
+        _bb.record("peer_join", peer=f"rank{j}", rank=rank, step=steps)
+        _mt.set("bf_peer_state", float(_res.JOINING), peer=f"rank{j}")
+        plan = _make_plan()
+        my_out = list(plan.out_neighbors(rank))
+        frac = 1.0 / (len(my_out) + 1)
+        stage = f"join-{j}-{token}"
+        try:
+            for jj in my_out:
+                _ensure_peer(jj)
+            # FENCE: nothing of ours may be in flight while the grown
+            # member set measures its baseline
+            for jj in sorted(k for k in peers if k in members - dead):
+                peers[jj].flush(cfg.barrier_timeout_s)
+            barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
+            baseline_mass = _mass_rendezvous(stage)
+        except (RuntimeError, TimeoutError, OSError, ValueError) as e:
+            baseline_mass = None
+            exact = False
+            _log.warn("rank %d: join rendezvous %r degraded (%s: %s); "
+                      "continuing without an exact baseline", rank, stage,
+                      type(e).__name__, e)
+        _mt.set("bf_peer_state", float(_res.HEALTHY), peer=f"rank{j}")
+        _bb.record("peer_admitted", peer=f"rank{j}", rank=rank,
+                   members=sorted(members), baseline_mass=baseline_mass,
+                   exact=exact)
+
+    def _release_leaver(j: int, token: str) -> None:
+        """A ``leaving.<j>`` record appeared: fence our stream to the
+        leaver (all our deposits applied), meet at its leave barrier —
+        after which nothing is in flight toward it — and wait at the
+        ``-fin`` barrier for its mass handoff to land.  The baseline is
+        UNCHANGED: the leaver's mass moved into member windows."""
+        nonlocal my_out, frac, exact
+        stage = f"leave-{j}-{token}"
+        _bb.record("peer_leaving", peer=f"rank{j}", rank=rank, step=steps)
+        try:
+            h = peers.get(j)
+            if h is not None:
+                h.flush(cfg.barrier_timeout_s)
+            barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
+            # the leaver drains its window and hands its mass off
+            # between these two barriers
+            barrier.wait(stage + "-fin", timeout_s=cfg.barrier_timeout_s)
+        except (RuntimeError, TimeoutError, OSError) as e:
+            exact = False
+            _log.warn("rank %d: leave rendezvous %r degraded (%s: %s)",
+                      rank, stage, type(e).__name__, e)
+        members.discard(j)
+        left.add(j)
+        barrier.exclude.add(j)
+        peers.pop(j, None)  # the caller's finally closes it
+        plan = _make_plan()
+        my_out = list(plan.out_neighbors(rank))
+        frac = 1.0 / (len(my_out) + 1)
+        _mt.set("bf_peer_state", float(_res.LEFT), peer=f"rank{j}")
+        _bb.record("peer_leave", peer=f"rank{j}", rank=rank,
+                   members=sorted(members))
+
+    def _poll_membership() -> bool:
+        """Handle membership records at a round boundary (leaves first —
+        their rendezvous must not race an admission), then report
+        whether a member finished the run (global end for joiners whose
+        own duration clock started late)."""
+        mview = _mship.scan(barrier.path, n)
+        for j, token in sorted(mview.leaving.items()):
+            if j == rank or j in left or j in dead or j not in members:
+                continue
+            if ("leaving", j, token) in handled:
+                continue
+            handled.add(("leaving", j, token))
+            _release_leaver(j, token)
+        for j, token in sorted(mview.announced.items()):
+            if j == rank or j in members:
+                continue
+            if ("member", j, token) in handled:
+                continue
+            handled.add(("member", j, token))
+            _admit_joiner(j, token)
+        for m in sorted(members - dead):
+            if m != rank and os.path.exists(
+                    os.path.join(barrier.path, f"stopped.{m}")):
+                return True
+        return False
+
+    def _graceful_leave() -> None:
+        """This rank's graceful drain: the intentional counterpart of
+        dying.  Fence own streams, announce intent, wait for every
+        member to fence theirs (the leave barrier — nothing in flight
+        toward this window afterwards), drain the window, hand the
+        ENTIRE (x, p) to live out-neighbors in drain-flagged deposits,
+        record ``left``, and confirm at the ``-fin`` barrier so the
+        members know the handoff landed.  The audit stays exact: the
+        mass is conserved among the remaining members."""
+        nonlocal x, p
+        token = _mship.new_token()
+        stage = f"leave-{rank}-{token}"
+        _bb.record("leave_begin", rank=rank, step=steps)
+        # our regular deposits must be applied before the members fence
+        for jj in sorted(k for k in peers if k in members - dead):
+            peers[jj].flush(cfg.barrier_timeout_s)
+        _mship.write_record(barrier.path, "leaving", rank, token)
+        barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
+        # every member fenced its stream to us before entering the
+        # barrier: nothing is in flight toward this window anymore
+        for j in range(n):
+            if j == rank:
+                continue
+            buf, fresh = win.read(j, consume=True)
+            if fresh > 0:
+                x += buf[:-1]
+                p += buf[-1]
+        live = sorted((members - dead) - {rank})
+        plan = _make_plan()
+        tgt = [j for j in plan.out_neighbors(rank) if j in live] or live
+        if not tgt:
+            raise RuntimeError("graceful leave with no live member to "
+                               "hand push-sum mass to")
+        share = np.empty(d + 1, np.float64)
+        share[:-1] = x
+        share[-1] = p
+        share /= float(len(tgt))
+        for j in tgt:
+            _ensure_peer(j).deposit_async(rank, share, accumulate=True,
+                                          drain=True)
+        for j in tgt:
+            peers[j].flush(cfg.barrier_timeout_s)  # handoff APPLIED
+        x[:] = 0.0
+        p = 0.0
+        win.set_self(np.zeros(d + 1))
+        _mship.write_record(barrier.path, "left", rank, token)
+        _mship.clear_record(barrier.path, "leaving", rank)
+        barrier.wait(stage + "-fin", timeout_s=cfg.barrier_timeout_s)
+        _mt.inc("bf_leaves_total", 1.0)
+        _bb.record("leave_done", rank=rank, handed_to=tgt,
+                   step=steps)
 
     def _wait_resilient(stage: str) -> None:
         """Barrier that learns its exclusion set: when ranks die between
@@ -1533,9 +2072,149 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             exact = False
             barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
 
+    # ---------------------------------------------------------- startup
+    if join:
+        # the job is already running: the startup barriers are history.
+        # Clear records from this rank's previous life, publish our
+        # window address, and discover the roster from the records —
+        # every rank that published an address minus tombstones and
+        # completed leavers.
+        for kind in ("dead", "left", "leaving", "member"):
+            _mship.clear_record(barrier.path, kind, rank)
+        transport.publish(barrier, rank)
+        # poll, not a single scan: on a loaded host (or with the joiner
+        # racing the members' own startup) the winaddr records may lag
+        # this process by seconds
+        roster_deadline = time.perf_counter() + cfg.barrier_timeout_s
+        while True:
+            mview = _mship.scan(barrier.path, n)
+            members = mview.current_members() - {rank}
+            if members:
+                break
+            if time.perf_counter() > roster_deadline:
+                raise RuntimeError(
+                    f"joiner rank {rank} found no live member records "
+                    f"in {barrier.path} within {cfg.barrier_timeout_s}s")
+            time.sleep(0.05)
+        transport.collect(barrier, sorted(members))
+        meta = open_window(0, f"{name}:meta", n, 2)
+        barrier.exclude = set(range(n)) - members - {rank}
+        # WARM-START from a neighbor's window: one atomic ``read_self``
+        # of a live member's published (x, p) snapshot — the pair is
+        # published under the window's self mutex, so the joiner's
+        # first state is round-consistent by construction.  No
+        # checkpoint file is read anywhere.
+        t_ws = time.perf_counter()
+        z = None
+        src = None
+        ws_deadline = t_ws + cfg.barrier_timeout_s
+        while z is None and time.perf_counter() < ws_deadline:
+            for nb in sorted(members):
+                try:
+                    s = _ensure_peer(nb).read_self()
+                except (RuntimeError, OSError, ConnectionError):
+                    continue
+                if s[-1] > 0.0:
+                    z = s[:-1] / s[-1]
+                    src = nb
+                    break
+            if z is None:
+                time.sleep(0.01)
+        if z is None:
+            raise RuntimeError(
+                f"joiner rank {rank} could not warm-start: no member "
+                "published an (x, p) window snapshot within "
+                f"{cfg.barrier_timeout_s}s (was the job started "
+                "elastic — initial_members= — so members publish?)")
+        x = np.asarray(z, np.float64).copy()
+        p = 1.0  # fresh push-sum weight: mass enters the system HERE
+        warm_s = time.perf_counter() - t_ws
+        _mt.observe("bf_join_warmstart_seconds", warm_s)
+        _bb.record("join_warmstart", rank=rank, source=src,
+                   warmstart_s=round(warm_s, 6))
+        # announce, then meet the members at the admission rendezvous:
+        # they fence, everyone measures local mass while nothing is in
+        # flight, and the baseline is re-established over the grown set
+        token = _mship.new_token()
+        members.add(rank)
+        ever_joined.add(rank)
+        _mship.write_record(barrier.path, "member", rank, token)
+        stage = f"join-{rank}-{token}"
+        try:
+            # The admission wait must survive the roster going stale
+            # under it: a member the joiner discovered can drain (or
+            # die) before it ever polls this join record, and the
+            # joiner would otherwise wait the full timeout for a rank
+            # that is gone.  Wait in short slices, re-scanning the
+            # records between them and excluding completed leavers /
+            # tombstones — slow members (step time stretching the
+            # 16-step record poll) still only degrade the rendezvous,
+            # never kill the joiner.
+            deadline = time.perf_counter() + cfg.barrier_timeout_s
+            while True:
+                try:
+                    barrier.wait(stage, timeout_s=min(
+                        2.0, max(0.1, deadline - time.perf_counter())))
+                    break
+                except TimeoutError:
+                    if time.perf_counter() >= deadline:
+                        raise
+                    mv = _mship.scan(barrier.path, n)
+                    gone = (mv.dead | set(mv.left)) & members - {rank}
+                    if gone:
+                        members -= gone
+                        barrier.exclude |= gone
+            baseline_mass = _mass_rendezvous(stage)
+        except (TimeoutError, OSError, ValueError) as e:
+            baseline_mass = None
+            exact = False
+            _log.warn("rank %d: own join rendezvous degraded (%s: %s); "
+                      "continuing without an exact baseline",
+                      rank, type(e).__name__, e)
+        plan = _make_plan()
+        my_out = list(plan.out_neighbors(rank))
+        frac = 1.0 / (len(my_out) + 1)
+        for j in my_out:
+            _ensure_peer(j)
+    else:
+        if rank == 0:
+            # per-rank (steps, last_loss) land here so the report can
+            # carry every rank's step count across the process boundary
+            meta = create_window(f"{name}:meta", n, 2)
+        transport.publish(barrier, rank)
+        barrier.exclude |= set(range(n)) - members
+        barrier.wait("created")
+        transport.collect(barrier, sorted(members))
+        if rank != 0:
+            meta = open_window(0, f"{name}:meta", n, 2)
+        if elastic:
+            # every initial member starts with p = 1, so the baseline
+            # is exact by construction; admissions re-measure it
+            baseline_mass = float(len(members))
+        plan = _make_plan() if elastic else topology
+        my_out = list(plan.out_neighbors(rank))
+        frac = 1.0 / (len(my_out) + 1)
+        for j in my_out:
+            _ensure_peer(j)
+    if elastic:
+        # publish the initial snapshot so a joiner can warm-start even
+        # before this rank's first full round lands
+        self_buf[:-1] = x
+        self_buf[-1] = p
+        win.set_self(self_buf)
+    leave_deadline = leave_after_s
+
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < duration_s:
-        _chaos.check_step(rank, steps)
+        try:
+            _chaos.check_step(rank, steps)
+        except _chaos.ChaosLeave:
+            _graceful_leave()
+            return None
+        if (elastic and leave_deadline is not None
+                and time.perf_counter() - t0 >= leave_deadline):
+            _graceful_leave()
+            return None
         if cfg is not None and steps % 16 == 0:
             # throttled: n-1 stat() calls against a possibly-NFS barrier
             # dir have no place on every hot-loop round; 16 rounds adds
@@ -1545,15 +2224,25 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             newly = _tombstoned()
             if newly:
                 _heal_and_rebase(newly)
+            if elastic and _poll_membership():
+                break  # a member finished: converge at the stop barrier
         if rec is not None:
             rec.begin("collective", key=("async_dsgd_mp", rank, steps),
                       op="async_dsgd_round", cid="async_dsgd_round",
                       step=steps, rank=rank, peers=my_out)
-        for k in range(len(in_nbrs)):
+        for k in my_slots:
+            if elastic and k == rank:
+                continue
             buf, fresh = win.read(k, consume=True)
             if fresh > 0:
                 x += buf[:-1]
                 p += buf[-1]
+        if elastic:
+            # publish a coherent (x, p) snapshot: what a JOINING peer
+            # warm-starts from
+            self_buf[:-1] = x
+            self_buf[-1] = p
+            win.set_self(self_buf)
         z = x / p
         loss, grads = loss_and_grad(rank, steps, packer.unpack(z))
         losses.append(float(loss))
@@ -1567,7 +2256,14 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         withheld = 0
         for j in my_out:
             if cfg is not None:
-                h = peers[j].health
+                try:
+                    # a replan can add an edge never opened before, and
+                    # the peer may have died since: an open failure here
+                    # is peer evidence, not a crash
+                    h = _ensure_peer(j).health
+                except (RuntimeError, TimeoutError, OSError):
+                    failed.append(j)
+                    continue
                 if h is not None:
                     state = h.poll()
                     if state == _res.REJOINED:
@@ -1594,8 +2290,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             # step; the payload buffer is snapshotted at enqueue, so its
             # reuse on the next iteration is safe
             try:
-                peers[j].deposit_async(peer_slot[j], payload,
-                                       accumulate=True)
+                _ensure_peer(j).deposit_async(_slot_in(j), payload,
+                                              accumulate=True)
             except (RuntimeError, TimeoutError, OSError):
                 if cfg is None:
                     raise
@@ -1647,7 +2343,9 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     # no rank deposits after this barrier, so the drain below is exact
     _wait_resilient("stopped")
     wall = time.perf_counter() - t0
-    for k in range(len(in_nbrs)):
+    for k in my_slots:
+        if elastic and k == rank:
+            continue
         buf, fresh = win.read(k, consume=True)
         if fresh > 0:
             x += buf[:-1]
@@ -1661,12 +2359,11 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     if rank == 0:
         wins = {rank: win}
         wins.update(peers)
-        alive = [r for r in range(n) if r not in dead]
+        alive = sorted(members - dead)
         for r in alive:
             if r not in wins:
-                wins[r] = open_window(
-                    r, f"{name}:{r}",
-                    max(len(list(topology.in_neighbors(r))), 1), d + 1)
+                wins[r] = open_window(r, f"{name}:{r}", _peer_slots(r),
+                                      d + 1)
         total_mass = 0.0
         zs = np.empty((len(alive), d))
         for i, r in enumerate(alive):
@@ -1693,6 +2390,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             consensus_gap=float(np.abs(zs - zs.mean(axis=0)).max()),
             dead_ranks=sorted(dead),
             baseline_mass=baseline_mass if exact else None,
+            left_ranks=sorted(left),
+            joined_ranks=sorted(ever_joined),
         )
     # owners unlink only after the audit has read every segment (the
     # caller's finally frees everything this process opened)

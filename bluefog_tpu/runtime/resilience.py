@@ -38,10 +38,24 @@ The state machine, plainly::
    REJOINED <-------- DEAD
             ok/beat
 
+   LEFT  --------> JOINING --------> HEALTHY      (elastic membership)
+       mark_joining()        admit()
+   HEALTHY/SUSPECT -----------------> LEFT        (graceful drain)
+                      mark_left()
+
 A DEAD peer is healed out of the gossip (mixing weights re-normalized
 over the survivors — :func:`bluefog_tpu.topology.heal`); a beat from a
 DEAD peer moves it to REJOINED, and the gossip loop re-admits it at the
 next round boundary (``admit()`` completes the cycle back to HEALTHY).
+
+Elastic membership (intentional change) adds the second lane: a slot
+that has not joined yet — or whose peer drained gracefully — is LEFT
+(inert, never promoted by silence); a join announcement moves it to
+JOINING (warm-starting, sticky like REJOINED), and the same round-
+boundary ``admit()`` completes admission.  ``mark_left`` is the graceful
+counterpart of ``mark_dead``: a leaver's push-sum mass was HANDED OFF to
+its out-neighbors, not written off, so the audit treats the two
+terminally differently (see :mod:`bluefog_tpu.runtime.async_windows`).
 """
 
 from __future__ import annotations
@@ -60,6 +74,8 @@ __all__ = [
     "SUSPECT",
     "DEAD",
     "REJOINED",
+    "JOINING",
+    "LEFT",
     "STATE_NAMES",
     "Backoff",
     "BudgetExhausted",
@@ -73,12 +89,21 @@ HEALTHY = 0
 SUSPECT = 1
 DEAD = 2
 REJOINED = 3
+# elastic membership (intentional change, the complement of failure):
+# JOINING — a NEW peer announced itself and is warm-starting; like
+# REJOINED it is sticky until the gossip loop's admit() at a round
+# boundary (weights change between rounds, never inside one).  LEFT — a
+# peer drained gracefully (mass handed off, not written off) or has not
+# joined yet; sticky until a new join announcement.
+JOINING = 4
+LEFT = 5
 
 STATE_NAMES = {HEALTHY: "healthy", SUSPECT: "suspect", DEAD: "dead",
-               REJOINED: "rejoined"}
+               REJOINED: "rejoined", JOINING: "joining", LEFT: "left"}
 
 _STATE_EVENT = {SUSPECT: "peer_suspect", DEAD: "peer_dead",
-                REJOINED: "peer_rejoin"}
+                REJOINED: "peer_rejoin", JOINING: "peer_join",
+                LEFT: "peer_leave"}
 
 
 class BudgetExhausted(RuntimeError):
@@ -214,9 +239,11 @@ class _HealthCore:
 
     def poll(self, now: Optional[float] = None) -> int:
         """Time-based evaluation: silence promotes HEALTHY -> SUSPECT ->
-        DEAD.  REJOINED is sticky until :meth:`admit` (the gossip loop
-        re-admits at a round boundary, not mid-round)."""
-        if self.state in (DEAD, REJOINED):
+        DEAD.  REJOINED and JOINING are sticky until :meth:`admit` (the
+        gossip loop re-admits at a round boundary, not mid-round); LEFT
+        is sticky until a new join announcement — an absent peer is not
+        a silent one."""
+        if self.state in (DEAD, REJOINED, JOINING, LEFT):
             return self.state
         now = self._clock() if now is None else now
         silent = now - self.last_ok
@@ -230,12 +257,26 @@ class _HealthCore:
         """Hard evidence (reconnect budget exhausted, process reaped)."""
         self._set(DEAD, reason=reason)
 
+    def mark_joining(self, **fields) -> None:
+        """A join announcement arrived (membership record / first HELLO
+        of a new peer): the slot enters the admission pipeline.  Sticky
+        until the gossip loop's :meth:`admit` at a round boundary."""
+        self.last_ok = self._clock()
+        self._set(JOINING, **fields)
+
+    def mark_left(self, **fields) -> None:
+        """The peer drained gracefully (or the slot has not joined yet).
+        Terminal-but-revivable: unlike DEAD, a LEFT peer's push-sum mass
+        was handed off, not written off, and a later join announcement
+        (:meth:`mark_joining`) revives the slot."""
+        self._set(LEFT, **fields)
+
     def admit(self) -> None:
-        """Complete a REJOINED peer's cycle back to HEALTHY (called by
-        the gossip loop at the round boundary where it restores the
+        """Complete a REJOINED/JOINING peer's cycle to HEALTHY (called
+        by the gossip loop at the round boundary where it restores the
         peer's mixing weights)."""
         self.last_ok = self._clock()
-        if self.state in (REJOINED, DEAD, SUSPECT):
+        if self.state in (REJOINED, JOINING, DEAD, SUSPECT):
             self._set(HEALTHY, admitted=True)
 
 
@@ -271,12 +312,21 @@ class HealthBoard:
 
     def __init__(self, n_ranks: int, *, suspect_after_s: float = 0.5,
                  dead_after_s: float = 1.5,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 members: Optional[Set[int]] = None):
+        """``members`` (elastic runs) names the slots that participate
+        from the start; the rest begin LEFT — capacity reserved for
+        later joiners, never promoted to SUSPECT/DEAD by their silence.
+        Default: every slot is a member (the fixed-fleet behavior)."""
         self._mu = threading.Lock()
         self._cores = [
             _HealthCore(f"rank{r}", suspect_after_s, dead_after_s, clock)
             for r in range(n_ranks)
         ]
+        if members is not None:
+            absent = set(range(n_ranks)) - {int(r) for r in members}
+            for r in absent:
+                self._cores[r].state = LEFT  # initial, not a transition
 
     def beat(self, rank: int) -> None:
         with self._mu:
@@ -302,6 +352,16 @@ class HealthBoard:
             return {r for r, c in enumerate(self._cores)
                     if c.state == REJOINED}
 
+    def joining_ranks(self) -> Set[int]:
+        with self._mu:
+            return {r for r, c in enumerate(self._cores)
+                    if c.state == JOINING}
+
+    def left_ranks(self) -> Set[int]:
+        with self._mu:
+            return {r for r, c in enumerate(self._cores)
+                    if c.state == LEFT}
+
     def admit(self, rank: int) -> None:
         with self._mu:
             self._cores[rank].admit()
@@ -309,6 +369,14 @@ class HealthBoard:
     def mark_dead(self, rank: int, reason: str = "") -> None:
         with self._mu:
             self._cores[rank].mark_dead(reason)
+
+    def mark_joining(self, rank: int) -> None:
+        with self._mu:
+            self._cores[rank].mark_joining()
+
+    def mark_left(self, rank: int) -> None:
+        with self._mu:
+            self._cores[rank].mark_left()
 
     def transitions(self, rank: int) -> List[Tuple[float, int, int]]:
         with self._mu:

@@ -20,7 +20,10 @@ Wire protocol **v2** (little-endian).  Every frame starts
 
   0 DEPOSIT      name | slot i32, flags u8, dtype u8, n_elems i64 | payload
                  flags bit0 = accumulate, bit1 = deferred-ack (no status
-                 reply; errors latch per connection until FLUSH).
+                 reply; errors latch per connection until FLUSH), bit2 =
+                 drain (a graceful leaver's final mass handoff — still an
+                 accumulate on the table; the owner records it so the
+                 membership audit can prove the handoff landed).
                  reply (unless deferred): status i64.
   1 GET_SELF     as v1: reply status i64 | dtype u8, n_elems i64 | payload
   2 READ_SLOT    as v1 (flags bit0 = consume; status carries fresh-count)
@@ -144,6 +147,14 @@ _OP_HEARTBEAT = 7
 
 _FLAG_ACCUMULATE = 1
 _FLAG_DEFERRED_ACK = 2
+# bit2: this deposit is a LEAVER'S FINAL MASS HANDOFF (graceful drain).
+# Semantically still an accumulate — the flag exists so the owner's
+# forensics can tell a drain apart from ordinary gossip: the leaver's
+# push-sum mass must be CONSERVED in the audit (unlike a corpse's, which
+# is written off), and the flagged deposit is the wire evidence the
+# handoff happened.  Recorded as a `drain_deposit` blackbox event and
+# the `bf_drain_deposits_total` counter on the receiving host.
+_FLAG_DRAIN = 4
 
 # HELLO feature bits (server replies with the granted intersection)
 FEATURE_BATCH = 1
@@ -624,6 +635,15 @@ class _Handler(socketserver.BaseRequestHandler):
             _bb.record("tcp_deposit", slot=slot, bytes=nbytes,
                        window=name_b.decode("utf-8", "replace"),
                        peer=self.client_address[0])
+            if flags & _FLAG_DRAIN:
+                # a graceful leaver handed its push-sum mass to this
+                # owner: the audit-relevant membership event, recorded
+                # where the receiving side's forensics will look
+                _mt.inc("bf_drain_deposits_total", 1.0,
+                        peer=self.client_address[0])
+                _bb.record("drain_deposit", slot=slot,
+                           window=name_b.decode("utf-8", "replace"),
+                           peer=self.client_address[0])
         return rc
 
     def _handle_batch(self, ops, sock) -> bool:
@@ -1429,12 +1449,16 @@ class DepositStream:
                 f"pipelined deposits to {self._peer} failed: {self._err}")
 
     def deposit_async(self, name: bytes, slot: int, arr: np.ndarray, *,
-                      accumulate: bool = True, copy: bool = True) -> None:
+                      accumulate: bool = True, copy: bool = True,
+                      drain: bool = False) -> None:
         """Enqueue one deposit into the peer's window ``name`` (bytes);
         returns immediately.  ``copy=True`` (default) snapshots ``arr``
         into a pooled buffer so the caller may overwrite it right away;
         pass ``copy=False`` only when the buffer is immutable until
-        :meth:`flush` returns.  Errors (including those from earlier
+        :meth:`flush` returns.  ``drain=True`` marks the deposit as a
+        graceful leaver's final mass handoff (wire flag bit2 — the owner
+        records it for the membership audit; the value semantics are
+        unchanged).  Errors (including those from earlier
         fire-and-forget deposits) raise here or at flush."""
         a = np.ascontiguousarray(arr)
         if a.dtype not in _DTYPE_IDS:
@@ -1455,7 +1479,9 @@ class DepositStream:
             # lossy codecs allocate fresh wire arrays; the source is free
             views, wire = wire_codec.encode(
                 a, self._codec, topk_ratio=self._topk_ratio)
-        item = _Item(name, slot, _FLAG_ACCUMULATE if accumulate else 0,
+        flags = (_FLAG_ACCUMULATE if accumulate else 0) | (
+            _FLAG_DRAIN if drain else 0)
+        item = _Item(name, slot, flags,
                      _DTYPE_IDS[a.dtype], self._codec, a.size, views,
                      wire, dense_bytes, pooled)
         t0 = time.perf_counter()
@@ -1794,11 +1820,13 @@ class PipelinedRemoteWindow:
         return self.stream.ack_latencies
 
     def deposit_async(self, slot: int, arr: np.ndarray, *,
-                      accumulate: bool = True, copy: bool = True) -> None:
+                      accumulate: bool = True, copy: bool = True,
+                      drain: bool = False) -> None:
         """Fire-and-forget deposit (see :meth:`DepositStream.
         deposit_async`); fence with :meth:`flush`."""
         self.stream.deposit_async(self._name_b, slot, arr,
-                                  accumulate=accumulate, copy=copy)
+                                  accumulate=accumulate, copy=copy,
+                                  drain=drain)
 
     def flush(self, timeout_s: Optional[float] = None) -> None:
         """Fence: every prior :meth:`deposit_async` is applied on the

@@ -22,6 +22,7 @@ from bluefog_tpu.topology.graphs import (
     GetRecvWeights,
     GetSendWeights,
     heal,
+    replan,
 )
 from bluefog_tpu.topology.dynamic import (
     GetDynamicOnePeerSendRecvRanks,

@@ -25,7 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -43,6 +44,7 @@ __all__ = [
     "GetRecvWeights",
     "GetSendWeights",
     "heal",
+    "replan",
 ]
 
 
@@ -58,12 +60,22 @@ class Topology:
       weights: ``(n, n)`` float64 row-stochastic matrix, orientation per the
         module docstring.
       name: human-readable tag used in logs / timeline spans.
+      inactive: ranks that are currently NOT participating (healed-out
+        corpses, drained leavers, not-yet-joined slots): their rows are
+        inert identity self-loops and no active row references them.
+        Rank indices stay valid across membership change — the
+        join/rejoin path needs stable numbering — and :func:`heal` /
+        :func:`replan` use this set to keep the derived ``name`` a
+        single collapsed suffix instead of an ever-growing chain.
     """
 
     weights: np.ndarray
     name: str = "custom"
+    inactive: FrozenSet[int] = frozenset()
 
     def __post_init__(self):
+        object.__setattr__(self, "inactive",
+                           frozenset(int(r) for r in self.inactive))
         w = np.asarray(self.weights, dtype=np.float64)
         if w.ndim != 2 or w.shape[0] != w.shape[1]:
             raise ValueError(f"weights must be square, got shape {w.shape}")
@@ -357,6 +369,17 @@ def GetSendWeights(topo: Topology, rank: int) -> Tuple[float, Dict[int, float]]:
     return topo.self_weight(rank), {i: float(topo.weights[i, rank]) for i in topo.out_neighbors(rank)}
 
 
+# a healed/replanned name carries exactly ONE provenance suffix; repeated
+# membership change collapses it instead of accreting "+heal(...)+heal(...)"
+# into every metric label and blackbox event of a long churn run
+_PROVENANCE_RE = re.compile(r"(\+(heal|replan)\([^)]*\))+$")
+
+
+def _base_name(name: str) -> str:
+    """Strip any existing ``+heal(...)``/``+replan(...)`` suffix chain."""
+    return _PROVENANCE_RE.sub("", name)
+
+
 def heal(topo: Topology, dead_ranks) -> Topology:
     """Re-normalize the mixing weights over the ranks that survive
     ``dead_ranks`` — the self-healing step the fault-tolerant gossip
@@ -378,7 +401,13 @@ def heal(topo: Topology, dead_ranks) -> Topology:
     (typically ``heal(topo, dead - {rejoined})`` at a round boundary).
 
     ``heal(topo, [])`` returns ``topo`` unchanged; killing every rank is
-    a ``ValueError`` (there is no one left to average)."""
+    a ``ValueError`` (there is no one left to average).
+
+    Composition: ``heal(heal(t, a), b)`` equals ``heal(t, a | b)`` — the
+    renormalization preserves relative proportions, so healing is
+    order-free over the union of dead sets — and the derived ``name``
+    carries ONE collapsed ``+heal([union])`` suffix (never a chain), with
+    the union tracked on :attr:`Topology.inactive`."""
     dead = frozenset(int(r) for r in dead_ranks)
     if not dead:
         return topo
@@ -387,7 +416,8 @@ def heal(topo: Topology, dead_ranks) -> Topology:
     if bad:
         raise ValueError(f"dead ranks {sorted(bad)} out of range for "
                          f"size-{n} topology")
-    if len(dead) >= n:
+    all_dead = dead | topo.inactive
+    if len(all_dead) >= n:
         raise ValueError("cannot heal a topology with every rank dead")
     w = topo.weights.copy()
     for r in dead:
@@ -395,7 +425,7 @@ def heal(topo: Topology, dead_ranks) -> Topology:
         w[:, r] = 0.0
         w[r, r] = 1.0
     for i in range(n):
-        if i in dead:
+        if i in all_dead:
             continue
         s = w[i].sum()
         if s <= 0.0:
@@ -403,4 +433,68 @@ def heal(topo: Topology, dead_ranks) -> Topology:
         else:
             w[i] /= s
     return Topology(weights=w,
-                    name=f"{topo.name}+heal({sorted(dead)})")
+                    name=f"{_base_name(topo.name)}+heal({sorted(all_dead)})",
+                    inactive=all_dead)
+
+
+# the replan constructor ladder: the best graph family per live-member
+# count m, balancing spectral gap against degree caps as the fleet grows
+# and shrinks — a tiny fleet affords the one-step exact averager, a large
+# one caps out-degree at ~log2(m) with the exponential family
+_REPLAN_FULL_MAX = 4
+
+
+def _replan_graph(m: int) -> Topology:
+    if m == 1:
+        return Topology(weights=np.ones((1, 1)), name="self")
+    if m <= _REPLAN_FULL_MAX:
+        return FullyConnectedGraph(m)
+    return ExponentialGraph(m, base=2)
+
+
+def replan(topo: Topology, members, *, name: Optional[str] = None
+           ) -> Topology:
+    """Build a FRESH mixing plan over the *current* member set — the
+    generalization of :func:`heal` for intentional membership change
+    (ranks joining and leaving a running job, not just dying).
+
+    Where ``heal`` renormalizes the existing edge structure over the
+    survivors (inert self-loop padding for the dead — right for an
+    unplanned death mid-round), ``replan`` re-optimizes: it constructs a
+    new graph over the ``m = len(members)`` live ranks (one-step exact
+    averaging for tiny fleets, the exponential-2 family — out-degree
+    ``~log2(m)``, strong connectivity, healthy spectral gap — beyond),
+    then embeds it into the full ``n x n`` index space so rank numbering
+    stays stable: non-members become inert identity self-loops, exactly
+    the shape the rejoin/admission path expects.
+
+    Determinism is the coordination-free contract: the plan depends ONLY
+    on ``(topo.size, sorted(members))``, so every rank computing
+    ``replan`` from the same member list converges on the SAME matrix
+    with no extra rendezvous.  ``replan(replan(t, m1), m2) ==
+    replan(t, m2)`` — replanning is memoryless over member sets.
+
+    ``members`` must be a non-empty subset of ``range(topo.size)``.  The
+    result's :attr:`Topology.inactive` is the complement and the name is
+    a single collapsed ``+replan(n=m)`` suffix."""
+    n = topo.size
+    mem = sorted({int(r) for r in members})
+    if not mem:
+        raise ValueError("cannot replan over an empty member set")
+    bad = [r for r in mem if not (0 <= r < n)]
+    if bad:
+        raise ValueError(f"member ranks {bad} out of range for "
+                         f"size-{n} topology")
+    m = len(mem)
+    small = _replan_graph(m)
+    w = np.zeros((n, n))
+    idx = np.array(mem)
+    w[np.ix_(idx, idx)] = small.weights
+    mem_set = frozenset(mem)
+    for r in range(n):
+        if r not in mem_set:
+            w[r, r] = 1.0
+    return Topology(
+        weights=w,
+        name=name or f"{_base_name(topo.name)}+replan(n={m})",
+        inactive=frozenset(range(n)) - frozenset(mem))

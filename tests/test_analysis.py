@@ -672,8 +672,80 @@ class TestResilienceLint:
     def test_resilience_pass_runs_in_sweep_and_repo_is_clean(self):
         # the bflint-tpu sweep includes the pass (BF-RES100 info) and
         # the repo's own runtime — including DepositStream._recover and
-        # run_supervised's restart loop — lints clean
+        # run_supervised's restart loop — lints clean, for BOTH rules
+        # (unbounded retries AND mid-round admissions)
         report = run_all(size=8, trace=False)
         assert report.has("BF-RES100"), report.format(verbose=True)
         assert not [d for d in report.diagnostics
-                    if d.code == "BF-RES001"], report.format()
+                    if d.code in ("BF-RES001", "BF-RES002")], \
+            report.format()
+
+
+class TestAdmissionLint:
+    """BF-RES002: an admission path without a round-boundary/quiesce
+    marker is an error — re-admitting a peer mid-round changes the
+    mixing weights under in-flight deposits (the torn state the exact
+    mass audit exists to catch)."""
+
+    def test_seeded_violation_midround_admission(self):
+        from bluefog_tpu.analysis.resilience_lint import (
+            check_admission_paths)
+
+        src = (
+            "def readmit_peer(board, peer):\n"
+            "    if board.state(peer) == 3:\n"
+            "        board.admit(peer)\n"
+        )
+        diags = check_admission_paths(src, filename="seeded.py")
+        assert any(d.code == "BF-RES002" and d.severity == "error"
+                   for d in diags), [d.format() for d in diags]
+
+    def test_fenced_admission_is_clean(self):
+        # the blessed shape: fence/flush (or a heal/replan/barrier) in
+        # the same function marks the round boundary
+        from bluefog_tpu.analysis.resilience_lint import (
+            check_admission_paths)
+
+        src = (
+            "def gossip_round(board, peer, peers):\n"
+            "    for h in peers:\n"
+            "        h.flush()\n"
+            "    board.admit(peer)\n"
+        )
+        assert not check_admission_paths(src, filename="clean.py")
+
+    def test_heal_vocabulary_marks_the_boundary(self):
+        from bluefog_tpu.analysis.resilience_lint import (
+            check_admission_paths)
+
+        src = (
+            "def boundary(board, topo, dead, rejoined):\n"
+            "    plan = heal(topo, dead - rejoined)\n"
+            "    for j in rejoined:\n"
+            "        board.admit(j)\n"
+            "    return plan\n"
+        )
+        assert not check_admission_paths(src, filename="healclean.py")
+
+    def test_state_machine_primitive_is_exempt(self):
+        # the definition of admit() itself cannot mention its caller's
+        # barrier — the rule is for callers
+        from bluefog_tpu.analysis.resilience_lint import (
+            check_admission_paths)
+
+        src = (
+            "class Core:\n"
+            "    def admit(self):\n"
+            "        self._set(0, admitted=True)\n"
+        )
+        assert not check_admission_paths(src, filename="prim.py")
+
+    def test_functions_without_admission_ignored(self):
+        from bluefog_tpu.analysis.resilience_lint import (
+            check_admission_paths)
+
+        src = (
+            "def plain(x):\n"
+            "    return x + 1\n"
+        )
+        assert not check_admission_paths(src, filename="plain.py")
