@@ -1,0 +1,158 @@
+"""Serving read-path bench: snapshot latency, publish overhead, fan-out.
+
+Measures the three costs the serve-while-training tier adds:
+
+1. **publish** — what the TRAINING loop pays per round-stamped publish
+   (the double-buffer copy + swap; this is the only serving cost on the
+   hot path);
+2. **snapshot** — end-to-end `SNAPSHOT` wire read latency (p50/p99) of
+   a model-sized group under a live publisher racing it across round
+   boundaries (every reply is audited round-consistent via the in-band
+   `round` stamp leaf);
+3. **fan-out** — N concurrent subscribers on one server: delivered
+   rounds/s per subscriber and the slow-reader skip behavior, while the
+   publisher's cadence stays fixed (readers must never throttle it).
+
+Self-contained and fast (~15 s), no jax, rc=0 off-TPU.
+
+Run:
+  python benchmarks/serving_bench.py [--dim 1000000] [--subs 8]
+      [--out BENCH_serving.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _pct(xs, q):
+    if not xs:
+        return float("nan")
+    return float(np.percentile(np.asarray(xs), q))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=1_000_000,
+                    help="model-vector elements (f64)")
+    ap.add_argument("--subs", type=int, default=8,
+                    help="concurrent subscribers in the fan-out phase")
+    ap.add_argument("--reads", type=int, default=200,
+                    help="snapshot reads in the latency phase")
+    ap.add_argument("--out", default=None, help="write JSON here")
+    args = ap.parse_args()
+
+    from bluefog_tpu.serving import table
+    from bluefog_tpu.serving.client import SnapshotClient
+    from bluefog_tpu.serving.subscriber import Subscriber
+    from bluefog_tpu.runtime.window_server import WindowServer
+
+    tbl = table()
+    group = f"serving_bench_{os.getpid()}"
+    x = np.random.default_rng(0).standard_normal(args.dim)
+    p = np.array([1.0])
+
+    # ------------------------------------------------- 1. publish cost
+    t_pub = []
+    for rnd in range(30):
+        t0 = time.perf_counter()
+        tbl.publish(group, rnd, {"x": x, "p": p,
+                                 "round": np.array([float(rnd)])})
+        t_pub.append(time.perf_counter() - t0)
+    pub_ms = {"p50_ms": 1e3 * _pct(t_pub, 50),
+              "p99_ms": 1e3 * _pct(t_pub, 99)}
+
+    srv = WindowServer()
+    addr = srv.start("127.0.0.1")
+
+    # a publisher thread keeps rolling rounds under the readers
+    stop = threading.Event()
+    round_box = [30]
+
+    def publisher():
+        while not stop.is_set():
+            rnd = round_box[0]
+            tbl.publish(group, rnd, {"x": x, "p": p,
+                                     "round": np.array([float(rnd)])})
+            round_box[0] = rnd + 1
+            time.sleep(0.002)
+
+    pub_thread = threading.Thread(target=publisher, daemon=True)
+    pub_thread.start()
+
+    # ------------------------------------------- 2. snapshot latency
+    client = SnapshotClient(addr, group)
+    lat = []
+    torn = 0
+    for _ in range(args.reads):
+        t0 = time.perf_counter()
+        snap = client.snapshot(min_round=0)
+        lat.append(time.perf_counter() - t0)
+        if int(snap.leaves["round"][0]) != snap.round:
+            torn += 1
+    client.close()
+    nbytes = x.nbytes + p.nbytes + 8
+    snap_res = {
+        "p50_ms": 1e3 * _pct(lat, 50), "p99_ms": 1e3 * _pct(lat, 99),
+        "MB_per_s": (nbytes / max(_pct(lat, 50), 1e-9)) / 1e6,
+        "torn_replies": torn,
+    }
+
+    # ------------------------------------------------- 3. fan-out
+    counts = [0] * args.subs
+    subs = []
+
+    def make_cb(i):
+        def cb(snap):
+            counts[i] += 1
+        return cb
+
+    t0 = time.perf_counter()
+    r0 = round_box[0]
+    for i in range(args.subs):
+        subs.append(Subscriber(addr, group, every=1,
+                               on_snapshot=make_cb(i), queue_max=2))
+    time.sleep(5.0)
+    dt = time.perf_counter() - t0
+    rounds_rolled = round_box[0] - r0
+    fan_res = {
+        "subscribers": args.subs,
+        "publisher_rounds_per_s": rounds_rolled / dt,
+        "delivered_per_sub_per_s": [round(c / dt, 1) for c in counts],
+        "skipped_rounds": [s.skipped_rounds for s in subs],
+    }
+    for s in subs:
+        s.close()
+    stop.set()
+    pub_thread.join(timeout=5)
+    srv.stop()
+    tbl.drop(group)
+
+    result = {
+        "dim": args.dim,
+        "leaf_bytes": int(nbytes),
+        "publish": pub_ms,
+        "snapshot_read": snap_res,
+        "fanout": fan_res,
+    }
+    print(json.dumps(result, indent=2))
+    if torn:
+        print("FAIL: torn (round-inconsistent) snapshot replies", torn,
+              file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

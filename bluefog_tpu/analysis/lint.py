@@ -339,6 +339,10 @@ def resilience_pass(report: LintReport, size: int) -> None:
         os.path.abspath(__file__))))
     targets = sorted(glob.glob(os.path.join(
         root, "bluefog_tpu", "runtime", "*.py")))
+    # the serving tier's readers carry their own reconnect loops — the
+    # same bounded-retry discipline applies to the read path
+    targets += sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "serving", "*.py")))
     targets.append(os.path.join(root, "bluefog_tpu", "utils", "failure.py"))
     targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
@@ -353,6 +357,35 @@ def resilience_pass(report: LintReport, size: int) -> None:
         f"resilience-lint scanned {n} file(s) for unbounded "
         "reconnect/retry loops",
         pass_name="resilience-lint", subject="runtime"))
+
+
+def serving_pass(report: LintReport, size: int) -> None:
+    """BF-SRV source lint over the surfaces that consume round-stamped
+    snapshots: the serving tier itself plus every example/benchmark that
+    could copy its read shape.  Consuming a snapshot without checking
+    its round stamp / retriable status is an error — see
+    :mod:`bluefog_tpu.analysis.serving_lint`."""
+    import glob
+
+    from bluefog_tpu.analysis.serving_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "serving", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-SRV100",
+        f"serving-lint scanned {n} file(s) for round-stamp-blind "
+        "snapshot consumers",
+        pass_name="serving-lint", subject="serving"))
 
 
 _EXAMPLE_CONSTRUCTORS = (
@@ -437,6 +470,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     collective_id_pass(report, size)
     window_pass(report, size)
     resilience_pass(report, size)
+    serving_pass(report, size)
     examples_pass(report, size)
     if trace:
         comm_lint_pass(report, size)

@@ -1,0 +1,192 @@
+"""BF-SRV lint: snapshot consumers must check the round stamp.
+
+The serving tier's whole contract is the ROUND STAMP: a
+:meth:`~bluefog_tpu.serving.client.SnapshotClient.snapshot` read returns
+leaves that are all-of-one-round, and the retriable failure modes —
+:class:`~bluefog_tpu.serving.snapshots.RoundRolled` on a pinned read,
+staleness against a required ``min_round`` — are how a consumer knows
+the model it is about to serve is the model it thinks it is.  Code that
+reads a snapshot and uses the leaves WITHOUT ever looking at the round
+(or delegating the check by passing ``min_round=``/``pin_round=``, or
+handling the retriable exceptions) serves an unverified model: it will
+happily serve round-0 garbage during warm-up, silently regress to a
+stale round after a trainer restart, and can never implement a
+staleness SLO.  Not a crash — a quietly wrong prediction service.
+Exactly the kind of bug a lint should catch at review time.
+
+The rule, per function (AST source lint, like
+:mod:`bluefog_tpu.analysis.window_lint`):
+
+- **snapshot-consuming sites** are calls of an attribute named
+  ``snapshot`` on a name bound from a ``SnapshotClient(...)``
+  construction in the same function, or — in modules that import
+  ``bluefog_tpu.serving`` — any ``.snapshot(...)`` attribute call (the
+  import gate keeps the unrelated ``metrics.export.snapshot()`` API out
+  of scope);
+- a site is **checked** when the call itself carries a ``min_round=``
+  or ``pin_round=`` keyword (the client enforces the bound), or the
+  enclosing function references the round-stamp vocabulary — an
+  attribute or name with ``round``/``rounds`` as a whole snake-case
+  word (``snap.round``, ``min_round``, ``staleness_rounds``; NOT
+  ``background``/``workaround``, whose embedded substring must not
+  suppress the error) — or handles ``RoundRolled`` /
+  ``SnapshotUnavailable``.
+
+**BF-SRV001** (error): a snapshot-consuming site with none of the
+above.  **BF-SRV100** (info): scan summary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_snapshot_consumers", "check_file"]
+
+_CLIENT_CTORS = ("SnapshotClient",)
+_RETRIABLE_NAMES = ("RoundRolled", "SnapshotUnavailable")
+_CHECK_KWARGS = ("min_round", "pin_round")
+# 'round(s)' as a whole snake-case word: an embedded substring
+# ('background', 'workaround') must not count as a stamp check
+_ROUND_WORD = re.compile(r"(?:^|_)rounds?(?:_|$)")
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _imports_serving(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any("bluefog_tpu.serving" in (a.name or "")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "serving" in mod and "bluefog_tpu" in mod:
+                return True
+            if mod == "bluefog_tpu" and any(
+                    a.name == "serving" for a in node.names):
+                return True
+    return False
+
+
+def _mentions_round(fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        ident = None
+        if isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, ast.Name):
+            ident = sub.id
+        if ident and _ROUND_WORD.search(ident.lower()):
+            return True
+        if isinstance(sub, ast.ExceptHandler) and sub.type is not None:
+            for t in ast.walk(sub.type):
+                if isinstance(t, (ast.Name, ast.Attribute)):
+                    nm = t.id if isinstance(t, ast.Name) else t.attr
+                    if nm in _RETRIABLE_NAMES:
+                        return True
+    return False
+
+
+class _FuncScan(ast.NodeVisitor):
+    """Collect snapshot-consuming call sites within ONE function body."""
+
+    def __init__(self, serving_module: bool):
+        self._serving_module = serving_module
+        self.client_names: set = set()
+        self.sites: List[ast.Call] = []
+
+    def visit_Assign(self, node: ast.Assign):
+        v = node.value
+        if isinstance(v, ast.Call) and _call_name(v) in _CLIENT_CTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.client_names.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "snapshot":
+            bound = (isinstance(f.value, ast.Name)
+                     and f.value.id in self.client_names)
+            if bound or self._serving_module:
+                self.sites.append(node)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # nested defs scan separately
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _site_checked(call: ast.Call) -> bool:
+    return any(kw.arg in _CHECK_KWARGS for kw in call.keywords)
+
+
+def _scan_function(fn: ast.AST, name: str, filename: str,
+                   serving_module: bool) -> List[Diagnostic]:
+    scan = _FuncScan(serving_module)
+    for stmt in fn.body:  # type: ignore[attr-defined]
+        scan.visit(stmt)
+    unchecked = [c for c in scan.sites if not _site_checked(c)]
+    if not unchecked:
+        return []
+    if _mentions_round(fn):
+        return []
+    line = min(c.lineno for c in unchecked)
+    return [Diagnostic(
+        "error", "BF-SRV001",
+        f"{name} (at {filename}:{line}) consumes a snapshot without "
+        "checking its round stamp or retriable status — read "
+        "`snap.round` (compare against a cursor / staleness bound), "
+        "pass min_round=/pin_round=, or handle RoundRolled/"
+        "SnapshotUnavailable; a blind consumer serves warm-up garbage "
+        "and stale models silently",
+        pass_name="serving-lint", subject=name)]
+
+
+def check_snapshot_consumers(source: str, *, filename: str = "<source>"
+                             ) -> List[Diagnostic]:
+    """Lint one Python source blob for round-stamp-blind consumers."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-SRV003",
+            f"could not parse {filename}: {e}",
+            pass_name="serving-lint", subject=filename)]
+    serving_module = _imports_serving(tree)
+    short = os.path.basename(filename)
+    diags: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            diags.extend(_scan_function(node, node.name, short,
+                                        serving_module))
+    mod = ast.Module(body=[s for s in tree.body
+                           if not isinstance(s, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef,
+                                                 ast.ClassDef))],
+                     type_ignores=[])
+    diags.extend(_scan_function(mod, "<module>", short, serving_module))
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return [Diagnostic(
+            "warning", "BF-SRV003", f"could not read {path}: {e}",
+            pass_name="serving-lint", subject=os.path.basename(path))]
+    return check_snapshot_consumers(src, filename=path)

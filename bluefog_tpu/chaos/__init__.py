@@ -11,6 +11,11 @@ choke points the resilience layer defends —
   connection *after* a batch applied but *before* its ack left, which is
   precisely the ambiguity the stream-epoch replay protocol exists to
   resolve;
+- **reader faults** at the serving read path (the same transport's
+  ``SNAPSHOT``/``SUBSCRIBE`` ops and sync reads): ``read:*`` cuts,
+  tears mid-frame, or stalls a read reply on the serving host;
+  ``sub:*`` does the same to a subscription's push sender — the
+  surfaces :mod:`bluefog_tpu.serving`'s retry/resume machinery defends;
 - **process faults** for multi-process runs: SIGKILL / SIGSTOP a rank at
   a deterministic step or wall-clock offset (a SIGSTOPped process
   arranges its own SIGCONT through a tiny helper child, so one spec line
@@ -31,7 +36,7 @@ Spec grammar (``;``-separated rules)::
 
     spec  := rule (';' rule)*
     rule  := site ':' fault (':' key '=' value)*
-    site  := 'server' | 'ack' | 'client' | 'any' | 'rank<N>'
+    site  := 'server' | 'ack' | 'client' | 'read' | 'sub' | 'any' | 'rank<N>'
     fault := 'drop' | 'truncate' | 'delay' | 'stall'          (socket)
            | 'sigkill' | 'sigstop' | 'die'                    (process/thread)
            | 'leave' | 'join'                                 (membership churn)
@@ -50,6 +55,8 @@ Examples::
     ack:drop:after_frames=3            # apply batch 3, drop before ack
     client:truncate:after_frames=5     # send half a frame, then cut
     server:delay:ms=20:prob=0.1:seed=7 # 10% of frames delayed 20 ms
+    read:truncate:every=7              # tear every 7th read reply mid-frame
+    sub:stall:s=1:every=13             # stall every 13th snapshot push 1 s
     rank2:sigkill:at_step=8            # rank 2 SIGKILLs itself at step 8
     rank1:sigstop:after_s=0.8:for_s=1  # freeze rank 1 for 1 s
     rank2:die:at_step=8                # thread-mode death (ChaosKill)

@@ -55,7 +55,13 @@ _ENV = "BLUEFOG_TPU_CHAOS"
 
 _SOCKET_FAULTS = ("drop", "truncate", "delay", "stall")
 _RANK_FAULTS = ("sigkill", "sigstop", "die", "stall", "leave", "join")
-_SOCKET_SITES = ("server", "ack", "client", "any")
+# 'read' fires where the server is about to send a sync-read / SNAPSHOT
+# reply (drop = vanish, truncate = reply torn mid-frame, stall = wedged
+# owner); 'sub' fires in the per-subscription push sender (stall = slow
+# push channel, drop/truncate = the reader's connection cut, torn for
+# truncate).  Together they are the READ-path fault surface, the twin of
+# the PR-5 deposit-path sites.
+_SOCKET_SITES = ("server", "ack", "client", "read", "sub", "any")
 
 _INT_KEYS = ("after_frames", "every", "times", "seed", "at_step")
 _FLOAT_KEYS = ("prob", "ms", "s", "after_s", "for_s")

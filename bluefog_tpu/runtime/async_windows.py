@@ -62,6 +62,7 @@ from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import (membership as _mship, native,
                                  resilience as _res)
+from bluefog_tpu.serving import snapshots as _snapshots
 from bluefog_tpu.topology.graphs import (Topology, heal as _heal,
                                          replan as _replan)
 from bluefog_tpu.utils import log as _log, timeline as _timeline
@@ -852,6 +853,7 @@ def run_async_dsgd(
     resilience: Optional[_res.ResilienceConfig] = None,
     join_at_s: Optional[Dict[int, Sequence[float]]] = None,
     leave_at_s: Optional[Dict[int, float]] = None,
+    snapshot_every: int = 0,
 ) -> DSGDReport:
     """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
     over the passive-target windows: the execution model of the reference's
@@ -921,6 +923,16 @@ def run_async_dsgd(
         with no coordination), and the audit is exact over the churn:
         ``report.total_mass + report.died_mass == len(initial members) +
         len(admissions)`` (= ``report.baseline_mass``).
+      snapshot_every: when > 0, every rank publishes a ROUND-STAMPED
+        ``(round, x, p)`` snapshot (plus an in-band ``round`` stamp
+        leaf) into the process-global serving table every Nth step,
+        under group ``f"{name}:{rank}"`` — the serve-while-training
+        read path (:mod:`bluefog_tpu.serving`; any
+        :class:`~bluefog_tpu.runtime.window_server.WindowServer` in
+        this process serves it).  The publish is atomic (double-
+        buffered swap under the table lock), so a reader can never
+        observe ``x`` and ``p`` from different rounds.  0 (default)
+        publishes nothing.
     """
     n = topology.size
     packer = TreePacker(params0, np.float64)
@@ -1167,6 +1179,18 @@ def run_async_dsgd(
                                 payload, accumulate=True)
                         x *= frac
                         p *= frac
+                        if snapshot_every and steps[r] % snapshot_every == 0:
+                            # serve-while-training publish: the post-step
+                            # (x, p) pair — z = x/p is invariant to the
+                            # frac scaling above, so this IS round
+                            # steps[r]'s model estimate — swapped in
+                            # atomically with its round stamp (an
+                            # in-band `round` leaf rides along so wire
+                            # readers can audit the stamp end to end)
+                            _snapshots.table().publish(
+                                f"{name}:{r}", steps[r],
+                                {"x": x, "p": np.array([p]),
+                                 "round": np.array([float(steps[r])])})
                         if rec is not None:
                             rec.end("collective",
                                     key=("async_dsgd", r, steps[r]),
@@ -1287,6 +1311,9 @@ def run_async_dsgd(
         gap = float(np.abs(zs - zs.mean(axis=0)).max())
     else:
         gap = float("inf")  # chaos killed every rank
+    if snapshot_every:
+        for r in range(n):
+            _snapshots.table().drop(f"{name}:{r}")
     report = DSGDReport(
         wall_time_s=wall,
         steps_per_rank=list(steps),
@@ -1523,7 +1550,12 @@ class _TcpTransport:
                     reconnect=cfg.backoff_kwargs(),
                     heartbeat_interval_s=cfg.heartbeat_interval_s or None,
                     suspect_after_s=cfg.suspect_after_s,
-                    dead_after_s=cfg.dead_after_s)
+                    dead_after_s=cfg.dead_after_s,
+                    # the runner's own sync READS (warm-start read_self,
+                    # meta/audit reads) retry torn/timed-out replies on
+                    # a fresh connection under the same bounded budget —
+                    # reader-side faults must not fail a training rank
+                    sync_retry=cfg.backoff_kwargs())
             else:
                 rw = PipelinedRemoteWindow(self._addrs[owner], wname,
                                            codec=self._codec)
@@ -1554,6 +1586,7 @@ def run_async_dsgd_rank(
     join: bool = False,
     leave_after_s: Optional[float] = None,
     initial_members: Optional[Sequence[int]] = None,
+    snapshot_every: int = 0,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -1631,6 +1664,16 @@ def run_async_dsgd_rank(
     rendezvous can time out each other and degrade the exactness claim,
     loudly, exactly as overlapping failures do).
 
+    ``snapshot_every > 0`` additionally publishes this rank's
+    round-stamped ``(round, x, p)`` snapshot into the process-global
+    serving table every Nth step (group ``f"{name}:{rank}"``) — with
+    ``transport="tcp"`` the rank's own :class:`~bluefog_tpu.runtime.
+    window_server.WindowServer` then serves it to
+    :class:`~bluefog_tpu.serving.client.SnapshotClient` readers and
+    :class:`~bluefog_tpu.serving.subscriber.Subscriber` push channels:
+    the serve-while-training read path, fully decoupled from the
+    training loop (see ``docs/serving.md``).
+
     Returns a :class:`DSGDReport` on rank 0 (``losses`` filled only at index
     ``rank`` — other ranks' loss curves stay in their processes), ``None``
     elsewhere (including joiners and leavers).
@@ -1694,8 +1737,11 @@ def run_async_dsgd_rank(
             create_window=_create, open_window=_open,
             resilience=resilience if transport == "tcp" else None,
             join=join, leave_after_s=leave_after_s,
-            initial_members=initial_members)
+            initial_members=initial_members,
+            snapshot_every=snapshot_every)
     finally:
+        if snapshot_every:
+            _snapshots.table().drop(f"{name}:{rank}")
         for w in opened:
             try:
                 w.free()
@@ -1708,7 +1754,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         lr, duration_s, skew_s, name, poll_interval_s, win,
                         transport, create_window, open_window,
                         resilience=None, join=False, leave_after_s=None,
-                        initial_members=None):
+                        initial_members=None, snapshot_every=0):
     n = topology.size
     packer = TreePacker(params0, np.float64)
     d = packer.size
@@ -2306,6 +2352,15 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                 p += payload[-1]
         if failed:
             _heal_and_rebase(set(failed))
+        if snapshot_every and steps % snapshot_every == 0:
+            # serve-while-training publish: the retained post-step
+            # (x, p) — z = x/p is invariant to the frac split — swapped
+            # in atomically under its round stamp; this rank's
+            # WindowServer serves it to SNAPSHOT/SUBSCRIBE readers
+            _snapshots.table().publish(
+                f"{name}:{rank}", steps,
+                {"x": x, "p": np.array([p]),
+                 "round": np.array([float(steps)])})
         if rec is not None:
             rec.end("collective", key=("async_dsgd_mp", rank, steps),
                     op="async_dsgd_round", cid="async_dsgd_round",

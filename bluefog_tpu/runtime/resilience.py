@@ -79,6 +79,7 @@ __all__ = [
     "STATE_NAMES",
     "Backoff",
     "BudgetExhausted",
+    "read_backoff",
     "PeerHealth",
     "HealthBoard",
     "ResilienceConfig",
@@ -108,6 +109,18 @@ _STATE_EVENT = {SUSPECT: "peer_suspect", DEAD: "peer_dead",
 
 class BudgetExhausted(RuntimeError):
     """A :class:`Backoff`'s retry budget (or deadline) ran out."""
+
+
+def read_backoff(overrides=None) -> "Backoff":
+    """The READ path's standard bounded retry schedule — one source of
+    truth for the sync window client, the snapshot client, and the
+    subscriber (all pure-read retries, so the same posture fits):
+    0.05 s base doubling to a 1 s cap, ±50 % jitter, budget 6.
+    ``overrides`` is a dict of :class:`Backoff` kwargs (what callers
+    accept as their ``retry=``/``reconnect=`` knobs)."""
+    return Backoff(**{**dict(base_s=0.05, cap_s=1.0, factor=2.0,
+                             jitter=0.5, budget=6),
+                      **(overrides or {})})
 
 
 class Backoff:

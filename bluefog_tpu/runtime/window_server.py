@@ -61,6 +61,39 @@ Wire protocol **v2** (little-endian).  Every frame starts
                  so heartbeat replies share the deposit stream's ack
                  channel without ambiguity.  Requires the HEARTBEAT
                  feature bit.
+  8 SNAPSHOT     name = snapshot GROUP | want_round i64, count u16, then
+                 ``count`` leaf names (``name_len u16, name``); count 0
+                 requests every leaf.  Serves the process-global
+                 round-stamped snapshot table
+                 (:mod:`bluefog_tpu.serving.snapshots`).  reply
+                 status i64 = the round served (>= 0), then ``count u16``
+                 and per leaf ``name_len u16, dtype u8, n_elems i64,
+                 name, payload`` — or a negative error: ``-107`` round
+                 rolled (RETRIABLE: the pinned ``want_round`` is no
+                 longer current — re-pin and retry) / ``-108`` no
+                 snapshot published yet.  Every leaf in one reply is
+                 from ONE round: the server copies them under the
+                 table's swap lock, so a reader can never observe a
+                 torn mix of rounds.
+  9 SUBSCRIBE    name = snapshot GROUP | sub_id u64, epoch u32,
+                 every u32, cursor i64.  Binds this connection as the
+                 live push channel of subscriber lineage ``sub_id`` —
+                 the STREAM_ATTACH epoch pattern on the read path: a
+                 strictly-newer epoch quiesces the superseded
+                 connection's sender, a stale one gets ``-105``.  reply
+                 status i64 = 0 (accepted), after which the connection
+                 is SERVER-PUSH: frames ``round i64, skipped u32,
+                 count u16`` + leaves (encoded as in SNAPSHOT replies);
+                 ``round = -1`` frames are idle keepalives.  The
+                 per-subscription sender pushes the LATEST published
+                 round whenever it is >= last_delivered + every —
+                 slow-reader policy is SKIP-TO-LATEST (training is
+                 never throttled by a reader; ``skipped`` counts the
+                 due rounds the reader missed) — and resumes strictly
+                 after ``cursor`` on reconnect, so a resumed subscriber
+                 misses or duplicates nothing it was promised (the
+                 client-held cursor is the delivery truth, exactly as
+                 the applied high-water mark is for deposits).
 
 Version negotiation is LOUD, never silent: a v2 server answers a v1-magic
 frame with one ``status = -101`` reply and drops the connection (the v1
@@ -115,6 +148,7 @@ from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import native, resilience, wire_codec
 from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS, _fallback
+from bluefog_tpu.serving import snapshots as _snap
 
 __all__ = ["WindowServer", "RemoteWindow", "PipelinedRemoteWindow",
            "DepositStream", "PROTOCOL_VERSION"]
@@ -135,6 +169,13 @@ _ACK = struct.Struct("<Iq")           # seq, status
 _ATTACH = struct.Struct("<QI")        # stream_id, epoch
 _HB = struct.Struct("<I")             # heartbeat seq
 _HB_MARK = 0x8000_0000                # ack-frame seq bit: heartbeat reply
+_SNAP_REQ = struct.Struct("<qH")      # want_round, requested-leaf count
+_LEAF_NAME = struct.Struct("<H")      # one requested leaf name length
+_SNAP_CNT = struct.Struct("<H")       # leaves in a snapshot reply
+_SNAP_LEAF = struct.Struct("<HBq")    # name_len, dtype, n_elems
+_SUB_REQ = struct.Struct("<QIIq")     # sub_id, epoch, every, cursor
+_PUSH = struct.Struct("<qIH")         # round (-1 = keepalive), skipped,
+                                      # leaf count
 
 _OP_DEPOSIT = 0
 _OP_GET_SELF = 1
@@ -144,6 +185,16 @@ _OP_DEPOSIT_BATCH = 4
 _OP_FLUSH = 5
 _OP_STREAM_ATTACH = 6
 _OP_HEARTBEAT = 7
+_OP_SNAPSHOT = 8
+_OP_SUBSCRIBE = 9
+
+# subscription push cadence when nothing is being published: an idle
+# server must look different from a wedged one to the reader's idle
+# timeout (keepalive round = -1)
+_SUB_KEEPALIVE_S = 1.0
+# bounds a SNAPSHOT request can claim before any allocation happens
+_MAX_SNAP_LEAVES = 4096
+_MAX_LEAF_NAME = 4096
 
 _FLAG_ACCUMULATE = 1
 _FLAG_DEFERRED_ACK = 2
@@ -162,8 +213,11 @@ FEATURE_CODEC_F32 = 2
 FEATURE_CODEC_TOPK = 4
 FEATURE_HEARTBEAT = 8
 FEATURE_RESUME = 16   # STREAM_ATTACH + idempotent reconnect replay
+FEATURE_SNAPSHOT = 32   # round-stamped consistent snapshot reads (op 8)
+FEATURE_SUBSCRIBE = 64  # resumable push subscriptions (op 9)
 _SERVER_FEATURES = (FEATURE_BATCH | FEATURE_CODEC_F32 | FEATURE_CODEC_TOPK
-                    | FEATURE_HEARTBEAT | FEATURE_RESUME)
+                    | FEATURE_HEARTBEAT | FEATURE_RESUME
+                    | FEATURE_SNAPSHOT | FEATURE_SUBSCRIBE)
 
 _CODEC_FEATURE = {wire_codec.CODEC_NONE: 0,
                   wire_codec.CODEC_F32: FEATURE_CODEC_F32,
@@ -181,6 +235,8 @@ _ERR_CODEC = -102    # codec not granted for this connection / bad payload
 _ERR_TOO_LARGE = -104  # claimed length exceeds any legal encoding
 _ERR_STALE_EPOCH = -105  # attach/batch from a superseded stream epoch
 _ERR_BUSY = -106     # previous stream generation could not be quiesced
+_ERR_ROUND_ROLLED = -107  # RETRIABLE: pinned snapshot round superseded
+_ERR_NO_SNAPSHOT = -108   # group/leaf has no published snapshot (yet)
 
 _ERR_TEXT = {
     _ERR_GEOMETRY: "size/dtype mismatch with the window's geometry",
@@ -195,6 +251,12 @@ _ERR_TEXT = {
                        "zombie)"),
     _ERR_BUSY: ("previous stream generation still draining; attach "
                 "again after backoff"),
+    _ERR_ROUND_ROLLED: ("snapshot round rolled: the pinned round is no "
+                        "longer current (retriable — re-pin at the "
+                        "table's new round and re-read)"),
+    _ERR_NO_SNAPSHOT: ("no round-stamped snapshot published for this "
+                       "group/leaf (retriable while the publisher warms "
+                       "up; terminal for a misspelled name)"),
 }
 
 
@@ -492,6 +554,169 @@ class _ApplyWorker:
                 return  # peer gone; the recv loop will notice too
 
 
+def _leaf_views(leaves: List[Tuple[str, np.ndarray]]) -> List:
+    """Encode ``[(name, array), ...]`` as SNAPSHOT/PUSH leaf entries
+    (``_SNAP_LEAF`` + name + payload per leaf).  Callers prepend their
+    own count — the snapshot table only ever holds wire-supported
+    dtypes (publish validates f32/f64), so nothing is skipped here."""
+    views: List = []
+    for name, arr in leaves:
+        nb = name.encode()
+        views.append(_SNAP_LEAF.pack(len(nb), _DTYPE_IDS[arr.dtype],
+                                     arr.size))
+        views.append(nb)
+        views.append(memoryview(arr).cast("B"))
+    return views
+
+
+def _recv_leaves(sock: socket.socket, count: int) -> Dict[str, np.ndarray]:
+    """Decode ``count`` leaf entries (the :func:`_leaf_views` wire
+    twin): the ONE reader for SNAPSHOT replies and subscription push
+    frames, so the two clients cannot drift apart on the leaf format."""
+    leaves: Dict[str, np.ndarray] = {}
+    for _ in range(count):
+        name_len, dtype_id, n_elems = _SNAP_LEAF.unpack(
+            _recv_exact(sock, _SNAP_LEAF.size))
+        name = _recv_exact(sock, name_len).decode("utf-8", "replace")
+        out = np.empty(n_elems, _DTYPES[dtype_id])
+        _recv_into(sock, memoryview(out).cast("B"))
+        leaves[name] = out
+    return leaves
+
+
+class _SubSender:
+    """Per-subscription background pusher: blocks in the snapshot
+    table's publish wait and pushes the LATEST due round to its reader.
+
+    Slow-reader policy is SKIP-TO-LATEST: the sender never queues more
+    than the one snapshot it is currently serializing, so a reader that
+    cannot keep up receives fewer, newer snapshots (``skipped`` counts
+    the due rounds it missed) and NOTHING here can backpressure the
+    training loop — publish never waits on any subscriber.  A reader
+    that stops draining its socket eventually blocks this thread in
+    ``sendall``; that wedges only this subscription (its own thread, no
+    shared locks held across the send), and the next epoch's attach —
+    or the reader's death reaching TCP — tears it down.  Keepalive
+    frames (round = -1) flow when nothing is published, so a live-but-
+    idle server never trips the reader's silence detector."""
+
+    def __init__(self, handler, sock, wmu, group: str, every: int,
+                 cursor: int, peer: str, sid: int, epoch: int):
+        self._handler = handler
+        self._sock = sock
+        self._wmu = wmu
+        self._group = group
+        self._every = max(1, int(every))
+        # the client-held cursor is the delivery truth: nothing at or
+        # below it is ever pushed again, which is the no-duplicates half
+        # of resumable subscriptions (the no-misses half is that pushes
+        # always carry the latest round ABOVE it)
+        self._last_round = int(cursor)
+        self._peer = peer
+        self.sid = sid
+        self.epoch = epoch
+        self._closed = threading.Event()
+        # start one generation BEHIND the table: a subscriber attaching
+        # AFTER the latest publish (replica restart, converged trainer)
+        # must still receive the current round if its cursor is below
+        # it — the first wait_newer then returns immediately and the
+        # due-ness rule decides, instead of waiting for a future
+        # publish that may never come
+        gen = _snap.table().generation(group)
+        self._gen = gen - 1 if gen > 0 else 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"bf-sub:{peer}")
+        self._thread.start()
+
+    def close(self) -> bool:
+        """Stop the sender (idempotent; callable from any thread).
+        Closing the socket kicks a sender blocked mid-``sendall``."""
+        self._closed.set()
+        for fn in (lambda: self._sock.shutdown(socket.SHUT_RDWR),
+                   self._sock.close):
+            try:
+                fn()
+            except OSError:
+                pass
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5)
+            return not self._thread.is_alive()
+        return True
+
+    def _send(self, views) -> bool:
+        try:
+            with self._wmu:
+                _sendmsg_all(self._sock, views)
+            return True
+        except (OSError, ConnectionError):
+            return False
+
+    def _keepalive_due(self) -> bool:
+        return time.monotonic() - self._last_send >= _SUB_KEEPALIVE_S
+
+    def _loop(self) -> None:
+        tbl = _snap.table()
+        self._last_send = time.monotonic()
+        while not self._closed.is_set():
+            gen = tbl.wait_newer(self._group, self._gen,
+                                 timeout_s=_SUB_KEEPALIVE_S)
+            if self._closed.is_set():
+                return
+            if gen is None:
+                if not self._send([_PUSH.pack(-1, 0, 0)]):
+                    return
+                self._last_send = time.monotonic()
+                continue
+            self._gen = gen
+            try:
+                rnd, leaves = tbl.read(self._group)
+            except _snap.SnapshotUnavailable:
+                continue  # dropped between notify and read
+            if self._last_round >= 0 and rnd < self._last_round + self._every:
+                # not due yet (every-Nth-round contract) — but a steady
+                # stream of not-due publishes must not starve the
+                # keepalive cadence, or a healthy connection trips the
+                # reader's idle timeout (large strides make pushes
+                # arbitrarily rarer than publishes)
+                if self._keepalive_due():
+                    if not self._send([_PUSH.pack(-1, 0, 0)]):
+                        return
+                    self._last_send = time.monotonic()
+                continue
+            skipped = (max(0, (rnd - self._last_round) - self._every)
+                       if self._last_round >= 0 else 0)
+            act = _chaos.fire("sub", peer=self._peer, group=self._group)
+            if act is not None:
+                if act[0] in ("delay", "stall"):
+                    time.sleep(act[1])
+                elif act[0] in ("drop", "truncate"):
+                    # an injected reader-side outage: cut the push
+                    # channel (after half a frame for 'truncate' — the
+                    # torn-mid-frame case the resuming reader must
+                    # survive without consuming the fragment)
+                    if act[0] == "truncate":
+                        views = ([_PUSH.pack(rnd, skipped, len(leaves))]
+                                 + _leaf_views(leaves))
+                        self._send(views[:max(1, len(views) // 2)])
+                    self.close()
+                    return
+            views = ([_PUSH.pack(rnd, skipped, len(leaves))]
+                     + _leaf_views(leaves))
+            if not self._send(views):
+                return
+            self._last_send = time.monotonic()
+            self._last_round = rnd
+            if skipped:
+                _mt.inc("bf_sub_skipped_rounds_total", float(skipped),
+                        peer=self._peer, group=self._group)
+            # how far the fleet moved while this reader consumed the
+            # push: a persistently positive age is the slow-reader
+            # signature (skip-to-latest keeps it bounded, not zero)
+            _mt.set("bf_snapshot_age_rounds",
+                    float(max(0, tbl.current_round(self._group) - rnd)),
+                    peer=self._peer, group=self._group)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def setup(self):
         self.server.track(self.request)  # type: ignore[attr-defined]
@@ -516,6 +741,8 @@ class _Handler(socketserver.BaseRequestHandler):
         # DepositStream lineage binding (STREAM_ATTACH); None = unbound
         self._stream_sid: Optional[int] = None
         self._stream_epoch = 0
+        # subscription push sender (SUBSCRIBE); None = plain connection
+        self._sub: Optional[_SubSender] = None
 
     def _send(self, data) -> None:
         with self._wmu:
@@ -528,8 +755,27 @@ class _Handler(socketserver.BaseRequestHandler):
     def finish(self):
         if self._worker is not None:
             self._worker.close()
+        if self._sub is not None:
+            self._sub.close()
+            self.server.note_sub(-1)  # type: ignore[attr-defined]
+            self._sub = None
         self.server.untrack(self.request)  # type: ignore[attr-defined]
         _bb.record("tcp_disconnect", peer=self.client_address[0])
+
+    def quiesce_sub(self) -> bool:
+        """Fence a superseded SUBSCRIBE connection: close its socket and
+        stop its push sender, so the old epoch can push nothing after
+        the successor's accept reply.  Idempotent vs ``finish``."""
+        for fn in (lambda: self.request.shutdown(socket.SHUT_RDWR),
+                   self.request.close):
+            try:
+                fn()
+            except OSError:
+                pass
+        s = self._sub
+        if s is not None:
+            return s.close()
+        return True
 
     def quiesce(self) -> bool:
         """Fence a superseded connection: close its socket and DRAIN its
@@ -726,6 +972,83 @@ class _Handler(socketserver.BaseRequestHandler):
         worker.submit_batch(seq, jobs)
         return True
 
+    def _handle_snapshot(self, sock, name_len: int) -> bool:
+        """One SNAPSHOT request: all requested leaves from ONE round or
+        a retriable negative status; returns False to drop the
+        connection (unparseable request, or an injected read fault)."""
+        group = self._recv_name(sock, name_len).decode("utf-8", "replace")
+        want_round, count = _SNAP_REQ.unpack(
+            _recv_exact(sock, _SNAP_REQ.size))
+        if count > _MAX_SNAP_LEAVES:
+            self._send(_STATUS.pack(_ERR_BAD_OP))
+            return False
+        names: List[str] = []
+        for _ in range(count):
+            (ln,) = _LEAF_NAME.unpack(_recv_exact(sock, _LEAF_NAME.size))
+            if ln > _MAX_LEAF_NAME:
+                self._send(_STATUS.pack(_ERR_BAD_OP))
+                return False
+            names.append(
+                self._recv_name(sock, ln).decode("utf-8", "replace"))
+        try:
+            rnd, leaves = _snap.table().read(
+                group, names or None,
+                want_round=want_round if want_round >= 0 else -1)
+        except _snap.RoundRolled:
+            _mt.inc("bf_reads_total", 1.0, op="snapshot", status="rolled")
+            self._send(_STATUS.pack(_ERR_ROUND_ROLLED))
+            return True
+        except _snap.SnapshotUnavailable:
+            _mt.inc("bf_reads_total", 1.0, op="snapshot", status="none")
+            self._send(_STATUS.pack(_ERR_NO_SNAPSHOT))
+            return True
+        views = ([_STATUS.pack(rnd), _SNAP_CNT.pack(len(leaves))]
+                 + _leaf_views(leaves))
+        act = _chaos.fire("read", op="snapshot",
+                          peer=self.client_address[0])
+        if act is not None:
+            if act[0] in ("delay", "stall"):
+                time.sleep(act[1])
+            elif act[0] == "truncate":
+                # a TORN reply frame, then the cut: the client must
+                # detect it and retry a fresh read, never consume the
+                # fragment as a snapshot
+                self._send_views(views[:max(1, len(views) // 2)])
+                return False
+            elif act[0] == "drop":
+                return False
+        self._send_views(views)
+        _mt.inc("bf_reads_total", 1.0, op="snapshot", status="ok")
+        _bb.record("tcp_snapshot", group=group, round=rnd,
+                   leaves=len(leaves), peer=self.client_address[0])
+        return True
+
+    def _handle_subscribe(self, sock, name_len: int) -> bool:
+        """One SUBSCRIBE request: bind this connection as the push
+        channel of a subscriber lineage and start its sender."""
+        group = self._recv_name(sock, name_len).decode("utf-8", "replace")
+        sid, epoch, every, cursor = _SUB_REQ.unpack(
+            _recv_exact(sock, _SUB_REQ.size))
+        if self._sub is not None:
+            # one subscription per connection: a second SUBSCRIBE on the
+            # same socket would interleave two push streams' framing
+            self._send(_STATUS.pack(_ERR_BAD_OP))
+            return False
+        rc = self.server.attach_sub(sid, epoch, self)  # type: ignore
+        if rc < 0:
+            self._send(_STATUS.pack(rc))
+            return False
+        self._send(_STATUS.pack(0))
+        self._sub = _SubSender(self, sock, self._wmu, group,
+                               every, cursor, self.client_address[0],
+                               sid=sid, epoch=epoch)
+        self.server.note_sub(1)  # type: ignore[attr-defined]
+        ev = "sub_resume" if epoch > 1 else "sub_attach"
+        _bb.record(ev, group=group, sub_id=sid, epoch=epoch,
+                   cursor=cursor, every=max(1, every),
+                   peer=self.client_address[0])
+        return True
+
     def handle(self):
         ops = self.server.ops  # type: ignore[attr-defined]
         sock = self.request
@@ -784,6 +1107,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     continue
                 if op == _OP_DEPOSIT_BATCH:
                     if not self._handle_batch(ops, sock):
+                        return
+                    continue
+                if op == _OP_SNAPSHOT:
+                    if not self._handle_snapshot(sock, name_len):
+                        return
+                    continue
+                if op == _OP_SUBSCRIBE:
+                    if not self._handle_subscribe(sock, name_len):
                         return
                     continue
                 if op == _OP_FLUSH:
@@ -846,6 +1177,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     self._send(_STATUS.pack(err))
                     continue
                 out = self._out_buf(dtype, n_elems)[:n_elems]
+                op_name = "get_self" if op == _OP_GET_SELF else "read_slot"
                 if op == _OP_GET_SELF:
                     rc = ops.read_self(name, out)
                 else:
@@ -853,12 +1185,24 @@ class _Handler(socketserver.BaseRequestHandler):
                 if rc < 0:
                     self._send(_STATUS.pack(rc))
                     continue
-                self._send_views([
-                    _STATUS.pack(rc), _SELF_HDR.pack(dtype, n_elems),
-                    memoryview(out).cast("B")])
+                reply = [_STATUS.pack(rc), _SELF_HDR.pack(dtype, n_elems),
+                         memoryview(out).cast("B")]
+                act = _chaos.fire("read", op=op_name,
+                                  peer=self.client_address[0])
+                if act is not None:
+                    if act[0] in ("delay", "stall"):
+                        time.sleep(act[1])
+                    elif act[0] == "truncate":
+                        # status + a fragment of the payload, then the
+                        # cut: the reader observes a reply torn mid-frame
+                        self._send_views(reply[:2])
+                        return
+                    elif act[0] == "drop":
+                        return
+                self._send_views(reply)
+                _mt.inc("bf_reads_total", 1.0, op=op_name, status="ok")
                 _bb.record(
-                    "tcp_read",
-                    op="get_self" if op == _OP_GET_SELF else "read_slot",
+                    "tcp_read", op=op_name,
                     slot=slot, window=name.decode("utf-8", "replace"),
                     peer=self.client_address[0])
         except (ConnectionError, OSError):
@@ -884,6 +1228,48 @@ class _Server(socketserver.ThreadingTCPServer):
         # when the connection died before its negative ack got out.
         self._streams: Dict[int, list] = {}
         self._streams_mu = threading.Lock()
+        # Subscriber lineage state: sub_id -> [epoch, handler,
+        # last_activity].  Same epoch discipline as deposit streams, on
+        # the read path: a reconnecting subscriber's newer epoch
+        # quiesces the superseded push sender, a zombie can never keep
+        # pushing beside its successor.
+        self._subs: Dict[int, list] = {}
+        self._subs_mu = threading.Lock()
+        self._live_subs = 0
+
+    # -------------------------------------------------- subscriber lineage
+    def attach_sub(self, sid: int, epoch: int, handler) -> int:
+        """Bind ``handler`` as the live push connection of subscriber
+        ``sid`` at ``epoch``; quiesces the superseded connection before
+        accepting.  0 on success, ``-105`` when the epoch is not
+        strictly newer."""
+        with self._subs_mu:
+            st = self._subs.get(sid)
+            if st is not None and epoch <= st[0]:
+                return _ERR_STALE_EPOCH
+            old = st[1] if st is not None else None
+        if old is not None and old is not handler:
+            # outside the lock: quiesce joins the old sender thread
+            old.quiesce_sub()
+        with self._subs_mu:
+            st = self._subs.get(sid)
+            if st is None:
+                if len(self._subs) >= self._MAX_STREAMS:
+                    oldest = min(self._subs,
+                                 key=lambda k: self._subs[k][2])
+                    del self._subs[oldest]
+                st = self._subs[sid] = [0, None, time.monotonic()]
+            if epoch <= st[0]:
+                return _ERR_STALE_EPOCH  # lost an attach race
+            st[0] = epoch
+            st[1] = handler
+            st[2] = time.monotonic()
+        return 0
+
+    def note_sub(self, delta: int) -> None:
+        with self._subs_mu:
+            self._live_subs = max(0, self._live_subs + delta)
+            _mt.set("bf_subscribers", float(self._live_subs))
 
     # ------------------------------------------------------ stream lineage
     def attach_stream(self, sid: int, epoch: int, handler) -> int:
@@ -1047,48 +1433,132 @@ class RemoteWindow:
     across the DCN, ``read_self`` the passive ``win_get``.  One persistent
     connection per handle; NOT thread-safe (one handle per rank thread,
     like an MPI endpoint).  For hot deposit paths prefer
-    :class:`PipelinedRemoteWindow`, which overlaps the wire with compute."""
+    :class:`PipelinedRemoteWindow`, which overlaps the wire with compute.
+
+    Every operation runs under a per-op DEADLINE (``timeout_s``): a
+    wedged owner surfaces as a loud :class:`TimeoutError` naming the op,
+    never an indefinitely hung reader thread.  ``retry=`` (``True`` for
+    the defaults, or a dict of :class:`~bluefog_tpu.runtime.resilience.
+    Backoff` kwargs) additionally reconnects and retries *idempotent
+    reads* — ``read_self`` and non-consuming ``read`` — under a bounded
+    backoff; a consuming ``read`` and ``deposit`` are never silently
+    re-issued (re-running them is not idempotent: a retried consume
+    whose first reply died would silently drop the consumed mass, and a
+    retried accumulate would double-apply).  When the budget exhausts
+    (or a non-retriable op fails), the error LATCHES like a
+    :class:`DepositStream`'s: every later call on this handle raises it
+    immediately instead of re-hammering a dead owner."""
 
     def __init__(self, address: Tuple[str, int], name: str,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, *, retry=None):
         self.name = name
         self._name_b = name.encode()
-        self._sock = socket.create_connection(address, timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (address[0], int(address[1]))
+        self._timeout_s = float(timeout_s)
+        self._retry_cfg = (dict(retry) if isinstance(retry, dict)
+                           else ({} if retry else None))
+        self._err: Optional[str] = None
+        self._sock = self._connect()
 
-    def _request(self, op: int, slot: int, flags: int, dtype_id: int,
-                 n_elems: int, payload_view=None) -> int:
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # the connect timeout persists as the PER-OP deadline: recv on a
+        # wedged owner raises instead of parking this thread forever
+        sock.settimeout(self._timeout_s)
+        return sock
+
+    def _reconnect(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._connect()
+
+    def _fail(self, msg: str) -> None:
+        if self._err is None:
+            self._err = msg
+        _bb.record("tcp_sync_error", window=self.name, error=msg[:200])
+
+    def _raise_if_err(self) -> None:
+        if self._err is not None:
+            raise RuntimeError(
+                f"sync window client for {self.name!r} failed earlier "
+                f"and is latched: {self._err}")
+
+    def _roundtrip(self, op: int, slot: int, flags: int, dtype_id: int,
+                   n_elems: int, payload_view=None, *,
+                   recv_array: bool = False):
         pre = (_HDR.pack(_MAGIC, op, len(self._name_b)) + self._name_b +
                _BODY.pack(slot, flags, dtype_id, n_elems))
         views = [pre] if payload_view is None else [pre, payload_view]
-        try:
-            _sendmsg_all(self._sock, views)
-            (rc,) = _STATUS.unpack(_recv_exact(self._sock, _STATUS.size))
-        except ConnectionError:
-            raise ConnectionError(
-                f"window server for {self.name!r} closed the connection "
-                "mid-request (server stopped, or a protocol version "
-                "mismatch — v1 servers drop unrecognized v2 frames)")
-        return rc
-
-    def _recv_array(self) -> np.ndarray:
-        dtype, n_elems = _SELF_HDR.unpack(
+        _sendmsg_all(self._sock, views)
+        (rc,) = _STATUS.unpack(_recv_exact(self._sock, _STATUS.size))
+        if rc < 0 or not recv_array:
+            return rc, None
+        dtype, got = _SELF_HDR.unpack(
             _recv_exact(self._sock, _SELF_HDR.size))
         # single-allocation receive: the destination array IS the receive
         # buffer (no intermediate bytes + frombuffer().copy())
-        out = np.empty(n_elems, _DTYPES[dtype])
+        out = np.empty(got, _DTYPES[dtype])
         _recv_into(self._sock, memoryview(out).cast("B"))
-        return out
+        return rc, out
+
+    def _request(self, op: int, slot: int, flags: int, dtype_id: int,
+                 n_elems: int, payload_view=None, *,
+                 recv_array: bool = False, idempotent: bool = False):
+        self._raise_if_err()
+        op_desc = {_OP_DEPOSIT: "deposit", _OP_GET_SELF: "read_self",
+                   _OP_READ_SLOT: "read"}.get(op, f"op{op}")
+        try:
+            return self._roundtrip(op, slot, flags, dtype_id, n_elems,
+                                   payload_view, recv_array=recv_array)
+        except (TimeoutError, ConnectionError, OSError) as e:
+            first = e
+        if idempotent and self._retry_cfg is not None:
+            # a timed-out or torn reply leaves the connection desynced:
+            # every retry starts from a FRESH connection, under the
+            # bounded backoff — reads are pure, so re-issuing is safe
+            bo = resilience.read_backoff(self._retry_cfg)
+            last: BaseException = first
+            for delay in bo:
+                _bb.record("torn_read_retry", window=self.name,
+                           op=op_desc, error=str(last)[:200])
+                _mt.inc("bf_read_retries_total", 1.0, op=op_desc)
+                time.sleep(delay)
+                try:
+                    self._reconnect()
+                    return self._roundtrip(op, slot, flags, dtype_id,
+                                           n_elems, payload_view,
+                                           recv_array=recv_array)
+                except (TimeoutError, ConnectionError, OSError) as e2:
+                    last = e2
+            self._fail(f"{op_desc} retry budget exhausted after "
+                       f"{bo.attempts} attempt(s): {last}")
+            self._raise_if_err()
+        if isinstance(first, TimeoutError):
+            self._fail(f"{op_desc} deadline ({self._timeout_s}s) "
+                       "expired — the owner is wedged or unreachable")
+            raise TimeoutError(
+                f"remote {op_desc} of {self.name!r} timed out after "
+                f"{self._timeout_s}s (wedged owner?)") from first
+        self._fail(f"connection lost mid-{op_desc}: {first}")
+        raise ConnectionError(
+            f"window server for {self.name!r} closed the connection "
+            "mid-request (server stopped, or a protocol version "
+            "mismatch — v1 servers drop unrecognized v2 frames)"
+        ) from first
 
     def deposit(self, slot: int, arr: np.ndarray, *,
                 accumulate: bool = True) -> int:
         a = np.ascontiguousarray(arr)
         if a.dtype not in _DTYPE_IDS:
             raise TypeError(f"RemoteWindow supports f32/f64, got {a.dtype}")
-        rc = self._request(_OP_DEPOSIT, slot,
-                           _FLAG_ACCUMULATE if accumulate else 0,
-                           _DTYPE_IDS[a.dtype], a.size,
-                           memoryview(a).cast("B"))
+        rc, _ = self._request(_OP_DEPOSIT, slot,
+                              _FLAG_ACCUMULATE if accumulate else 0,
+                              _DTYPE_IDS[a.dtype], a.size,
+                              memoryview(a).cast("B"))
         if rc < 0:
             raise RuntimeError(
                 f"remote deposit into {self.name!r}[{slot}] failed ({rc}): "
@@ -1097,23 +1567,25 @@ class RemoteWindow:
         return rc
 
     def read_self(self, n_elems: int, dtype=np.float64) -> np.ndarray:
-        rc = self._request(_OP_GET_SELF, 0, 0,
-                           _DTYPE_IDS[np.dtype(dtype)], n_elems)
+        rc, out = self._request(_OP_GET_SELF, 0, 0,
+                                _DTYPE_IDS[np.dtype(dtype)], n_elems,
+                                recv_array=True, idempotent=True)
         if rc < 0:
             raise RuntimeError(
                 f"remote read_self of {self.name!r} failed ({rc}): "
                 + _err_text(rc))
-        return self._recv_array()
+        return out
 
     def read(self, slot: int, n_elems: int, dtype=np.float64, *,
              consume: bool = True) -> Tuple[np.ndarray, int]:
-        rc = self._request(_OP_READ_SLOT, slot, 1 if consume else 0,
-                           _DTYPE_IDS[np.dtype(dtype)], n_elems)
+        rc, out = self._request(_OP_READ_SLOT, slot, 1 if consume else 0,
+                                _DTYPE_IDS[np.dtype(dtype)], n_elems,
+                                recv_array=True, idempotent=not consume)
         if rc < 0:
             raise RuntimeError(
                 f"remote read of {self.name!r}[{slot}] failed ({rc}): "
                 + _err_text(rc))
-        return self._recv_array(), rc
+        return out, rc
 
     def close(self) -> None:
         try:
@@ -1768,7 +2240,12 @@ class PipelinedRemoteWindow:
                  heartbeat_interval_s: Optional[float] = None,
                  suspect_after_s: Optional[float] = None,
                  dead_after_s: Optional[float] = None,
-                 stream: Optional[DepositStream] = None):
+                 stream: Optional[DepositStream] = None,
+                 sync_retry=None):
+        """``sync_retry`` configures the SYNC connection's bounded
+        retry for idempotent reads (see :class:`RemoteWindow`); it is
+        independent of ``stream=`` because every handle owns its sync
+        connection even when the deposit stream is shared."""
         self.name = name
         self._name_b = name.encode()
         if stream is not None and any(
@@ -1784,7 +2261,8 @@ class PipelinedRemoteWindow:
                 "max_in_flight/max_queue_items/reconnect/"
                 "heartbeat_interval_s/suspect_after_s/dead_after_s — "
                 "configure the shared DepositStream itself")
-        self._sync = RemoteWindow(address, name, timeout_s)
+        self._sync = RemoteWindow(address, name, timeout_s,
+                                  retry=sync_retry)
         self._owns_stream = stream is None
         if stream is not None:
             self.stream = stream

@@ -1,0 +1,62 @@
+"""Serve-while-training read fabric (the window transport's read path).
+
+The Bluefog premise (arXiv:2111.04287) is a continuously-gossiping fleet
+whose model is always *live*; this package is the tier that serves that
+live model to traffic while it trains:
+
+- :mod:`bluefog_tpu.serving.snapshots` — the publish primitive: a
+  process-global, double-buffered :class:`~bluefog_tpu.serving.
+  snapshots.SnapshotTable` the dsgd loops publish round-stamped
+  ``(round, x, p)`` snapshots into, served over the wire by every
+  :class:`~bluefog_tpu.runtime.window_server.WindowServer` in the
+  process (``SNAPSHOT`` / ``SUBSCRIBE`` ops).
+- :mod:`bluefog_tpu.serving.client` — :class:`SnapshotClient`: pull one
+  round-consistent snapshot (bounded retries, round-pinning, torn-read
+  recovery).
+- :mod:`bluefog_tpu.serving.subscriber` — :class:`Subscriber`: "push me
+  every Nth round", resumable across disconnects via a client-held
+  cursor + the stream-epoch pattern, reconnecting under a bounded
+  :class:`~bluefog_tpu.runtime.resilience.Backoff`.
+- :mod:`bluefog_tpu.serving.replica` — :class:`ServingReplica`: a
+  subscriber that de-biases ``z = x / p`` into model parameters and
+  tracks its own staleness, the shape a prediction server embeds.
+
+Consistency contract, in one line: every snapshot a reader ever holds is
+all-of-one-round (torn mixes are impossible by construction), and every
+retriable failure (round rolled, torn frame, reconnect) is loud and
+bounded — see ``docs/serving.md``.
+
+Import discipline: this ``__init__`` loads only the snapshot table (the
+training-side dependency); the client/subscriber/replica classes load
+lazily so importing the publish path never drags the wire client in.
+"""
+
+from bluefog_tpu.serving.snapshots import (RoundRolled, SnapshotTable,
+                                           SnapshotUnavailable, table)
+
+__all__ = [
+    "RoundRolled",
+    "Snapshot",
+    "SnapshotClient",
+    "SnapshotTable",
+    "SnapshotUnavailable",
+    "ServingReplica",
+    "Subscriber",
+    "table",
+]
+
+_LAZY = {
+    "Snapshot": "bluefog_tpu.serving.client",
+    "SnapshotClient": "bluefog_tpu.serving.client",
+    "Subscriber": "bluefog_tpu.serving.subscriber",
+    "ServingReplica": "bluefog_tpu.serving.replica",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

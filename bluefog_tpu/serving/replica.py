@@ -1,0 +1,154 @@
+"""A serving replica: subscribe to a training rank, serve its model.
+
+:class:`ServingReplica` is the deployment shape the serving tier exists
+for — a prediction server that follows a continuously-training model
+with bounded staleness and zero coupling to the training loop:
+
+- it rides a :class:`~bluefog_tpu.serving.subscriber.Subscriber`
+  (resumable, bounded reconnect, skip-to-latest), so replica death or
+  slowness never perturbs training;
+- every adopted snapshot is round-stamped and round-consistent — the
+  replica de-biases ``z = x / p`` (the push-sum estimate; a torn mix of
+  ``x`` and ``p`` from different rounds is impossible by construction)
+  and, given a ``template``, unpacks ``z`` back into the model pytree
+  through :class:`~bluefog_tpu.runtime.async_windows.TreePacker`;
+- :meth:`staleness_rounds` quantifies "how live is what I am serving":
+  with a healthy link and ``every=N`` it stays <= N plus delivery lag,
+  which is the serving tier's freshness SLO (the example asserts it
+  while training runs).
+
+Many replicas fan out from one trainer (one subscription each); scale
+out reads by pointing replicas at different ranks of the fleet — every
+rank serves its own snapshot group, and push-sum keeps them within the
+consensus gap of each other.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.serving.client import Snapshot
+from bluefog_tpu.serving.subscriber import Subscriber
+
+__all__ = ["ServingReplica"]
+
+
+class ServingReplica:
+    """Follow one training rank's published model with bounded staleness.
+
+    Args:
+      address: the rank's ``WindowServer`` address.
+      group: its snapshot group (``f"{name}:{rank}"`` for the dsgd
+        runners).
+      template: optional model pytree; when given, :meth:`params`
+        returns the de-biased snapshot unpacked to this structure
+        (otherwise the flat ``z`` vector).
+      every: subscription stride — the freshness/traffic trade-off.
+      cursor / reconnect / idle_timeout_s: forwarded to the
+        :class:`~bluefog_tpu.serving.subscriber.Subscriber`.
+    """
+
+    def __init__(self, address: Tuple[str, int], group: str,
+                 template=None, *, every: int = 1, cursor: int = -1,
+                 reconnect=True, idle_timeout_s: float = 5.0,
+                 timeout_s: float = 10.0):
+        self.group = group
+        self._packer = None
+        if template is not None:
+            from bluefog_tpu.runtime.async_windows import TreePacker
+
+            self._packer = TreePacker(template, np.float64)
+        self._cv = threading.Condition()
+        self._round = -1
+        self._z: Optional[np.ndarray] = None
+        self._adopted_at = 0.0
+        self.adopted = 0
+        self._sub = Subscriber(
+            address, group, every=every, cursor=cursor,
+            on_snapshot=self._adopt, reconnect=reconnect,
+            idle_timeout_s=idle_timeout_s, timeout_s=timeout_s,
+            queue_max=2)
+
+    # ------------------------------------------------------------- intake
+    def _adopt(self, snap: Snapshot) -> None:
+        # round-stamp discipline (BF-SRV001): adopt only forward, and
+        # de-bias from leaves that are one-round-consistent by contract
+        if snap.round <= self._round:
+            return
+        x = snap.leaves.get("x")
+        p = snap.leaves.get("p")
+        if x is not None and p is not None and float(p[0]) > 0.0:
+            z = x / float(p[0])
+        elif x is not None:
+            z = x
+        else:  # a non-dsgd publisher: single-leaf convention
+            z = next(iter(snap.leaves.values()))
+        with self._cv:
+            self._z = z
+            self._round = snap.round
+            self._adopted_at = time.monotonic()
+            self.adopted += 1
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------ serving
+    @property
+    def round(self) -> int:
+        """Round stamp of the weights currently being served (-1 until
+        the first snapshot lands)."""
+        return self._round
+
+    @property
+    def error(self) -> Optional[str]:
+        return self._sub.error
+
+    def wait_ready(self, timeout_s: float = 30.0) -> int:
+        """Block until the first snapshot is adopted; returns its round.
+        Surfaces a subscription failure (rejection, exhausted reconnect
+        budget) as soon as it happens — the wait polls the subscriber's
+        latched error because its failure path notifies only its own
+        condition variable, not this replica's."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._round < 0 and self._sub.error is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"replica for {self.group!r} received no "
+                        f"snapshot within {timeout_s}s")
+                self._cv.wait(timeout=min(0.1, remaining))
+            if self._round < 0:
+                raise RuntimeError(
+                    f"replica for {self.group!r} failed before its first "
+                    f"snapshot: {self._sub.error}")
+            return self._round
+
+    def params(self, *, as_jax: bool = False):
+        """The currently-served model: the de-biased snapshot, unpacked
+        to the template pytree when one was given."""
+        with self._cv:
+            if self._z is None:
+                raise RuntimeError(
+                    f"replica for {self.group!r} has no snapshot yet "
+                    "(wait_ready() first)")
+            z = self._z
+        if self._packer is None:
+            return z
+        return self._packer.unpack(z, as_jax=as_jax)
+
+    def staleness_rounds(self, current_round: int) -> int:
+        """How many rounds behind ``current_round`` (the trainer's live
+        round, from its snapshot table or a fresh SNAPSHOT read) the
+        served weights are.  The replica records it on the
+        ``bf_snapshot_age_rounds`` gauge."""
+        age = max(0, int(current_round) - self._round)
+        _mt.set("bf_snapshot_age_rounds", float(age), group=self.group,
+                peer="replica")
+        return age
+
+    def close(self) -> None:
+        self._sub.close()

@@ -1,0 +1,240 @@
+"""Round-stamped consistent snapshots: the publish half of the serving tier.
+
+The async training loops make progress with NO barrier anywhere — which
+is exactly why a naive reader of their state can observe a *torn* model:
+``x`` from round ``k`` next to ``p`` from round ``k+1`` de-biases to a
+point on no rank's trajectory.  The warm-start path already dodged this
+for the single ``(x, p)`` vector by publishing both under one window
+mutex; serving real traffic needs the general form: a trainer publishes
+an arbitrary *set of named leaves* stamped with one round number, and a
+reader either gets ALL of them from that one publish or a retriable
+error — never a mix.
+
+:class:`SnapshotTable` is that primitive:
+
+- **Double-buffered per group.**  ``publish(group, round, leaves)``
+  copies every leaf into the group's *inactive* buffer (no reader can be
+  touching it — readers only ever copy from the active buffer, and only
+  under the table lock), then swaps the active index *under the table
+  lock*.  The heavy copy therefore never blocks readers, and the swap —
+  the only part readers can contend with — is O(1).
+- **Copy-under-lock reads.**  ``read`` snapshots the requested leaves
+  while holding the table lock, so a publish can never land mid-read:
+  within one ``read`` every leaf carries the same round stamp, by
+  construction.  ``want_round`` pins a round across *multiple* reads
+  (chunked consumers): if the table moved on, the read fails with
+  :class:`RoundRolled` — retriable, the caller re-pins at the new round.
+- **Publish generations.**  Every publish bumps a per-group generation
+  and notifies waiters; subscription senders block in
+  :meth:`SnapshotTable.wait_newer` instead of polling, and use the
+  generation delta to count the rounds a slow reader skipped.
+
+One process-global table (:func:`table`) mirrors the window fabric's
+process-global window table: the dsgd loops publish into it, and ANY
+:class:`~bluefog_tpu.runtime.window_server.WindowServer` in the process
+serves it over the wire (``SNAPSHOT`` / ``SUBSCRIBE`` ops) — the read
+path needs no extra server object.
+
+Training is never blocked by readers beyond the swap/copy lock: there is
+no per-reader state here, no reader ack, nothing a dead or wedged reader
+can hold.  That asymmetry is the serving tier's whole fault model.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.metrics import comm as _mt
+
+__all__ = [
+    "RoundRolled",
+    "SnapshotTable",
+    "SnapshotUnavailable",
+    "table",
+]
+
+
+class SnapshotUnavailable(RuntimeError):
+    """No snapshot to serve (group never published, or an unknown leaf
+    was requested).  Retriable early in a job's life — the first publish
+    is usually seconds away — terminal for a misspelled group/leaf."""
+
+
+class RoundRolled(RuntimeError):
+    """A ``want_round``-pinned read found the table already swapped to a
+    newer round.  Always retriable: re-read without the pin (or pin the
+    round the exception names) and continue.
+
+    :attr:`current_round` carries the round the table holds now."""
+
+    def __init__(self, group: str, want_round: int, current_round: int):
+        super().__init__(
+            f"snapshot round rolled for group {group!r}: wanted round "
+            f"{want_round}, table now holds {current_round} — re-pin and "
+            "retry (the publisher moved on mid-consume)")
+        self.group = group
+        self.want_round = want_round
+        self.current_round = current_round
+
+
+class _Group:
+    """One publisher's double-buffered snapshot slot."""
+
+    __slots__ = ("buffers", "rounds", "active", "gen", "write_mu",
+                 "published_at")
+
+    def __init__(self):
+        self.buffers: List[Dict[str, np.ndarray]] = [{}, {}]
+        self.rounds = [-1, -1]
+        self.active = 0
+        self.gen = 0            # publish count; 0 = never published
+        self.write_mu = threading.Lock()  # serializes publishers
+        self.published_at = 0.0
+
+
+class SnapshotTable:
+    """Round-stamped, double-buffered snapshot store (see module doc)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._groups: Dict[str, _Group] = {}
+
+    # ------------------------------------------------------------- publish
+    def _group(self, group: str) -> _Group:
+        with self._mu:
+            g = self._groups.get(group)
+            if g is None:
+                g = self._groups[group] = _Group()
+            return g
+
+    def publish(self, group: str, round_: int,
+                leaves: Dict[str, np.ndarray]) -> None:
+        """Atomically publish ``leaves`` as round ``round_`` of ``group``.
+
+        Leaves are COPIED (the caller's buffers are free immediately —
+        the dsgd hot loops reuse theirs every step) into the inactive
+        buffer, then the active index swaps under the read lock.  A
+        concurrent :meth:`read` sees either entirely the previous round
+        or entirely this one."""
+        if not leaves:
+            raise ValueError("a snapshot needs at least one leaf")
+        g = self._group(group)
+        key = (group, round_)
+        _bb.begin("snapshot_publish", key=key, group=group, round=round_)
+        with g.write_mu:
+            tgt = 1 - g.active
+            buf = g.buffers[tgt]
+            for name, arr in leaves.items():
+                a = np.ascontiguousarray(arr)
+                if a.dtype not in (np.dtype(np.float32),
+                                   np.dtype(np.float64)):
+                    raise TypeError(
+                        f"snapshot leaf {name!r} must be f32/f64 (the "
+                        f"wire dtype table), got {a.dtype}")
+                dst = buf.get(name)
+                if (dst is None or dst.shape != a.shape
+                        or dst.dtype != a.dtype):
+                    buf[name] = a.copy()
+                else:
+                    np.copyto(dst, a)
+            for stale in [n for n in buf if n not in leaves]:
+                del buf[stale]
+            g.rounds[tgt] = int(round_)
+            # the swap is the atomic publish: readers copy the active
+            # buffer under this same lock, so none can be mid-copy of
+            # the buffer we just wrote, and none can observe the swap
+            # mid-read
+            with self._cv:
+                g.active = tgt
+                g.gen += 1
+                g.published_at = time.monotonic()
+                self._cv.notify_all()
+        _bb.end("snapshot_publish", key=key, group=group, round=round_)
+        _mt.inc("bf_snapshot_publishes_total", 1.0, group=group)
+
+    # --------------------------------------------------------------- read
+    def read(self, group: str, names: Optional[Sequence[str]] = None, *,
+             want_round: int = -1
+             ) -> Tuple[int, List[Tuple[str, np.ndarray]]]:
+        """Read leaves of ``group``'s current snapshot, all from ONE
+        round.  ``names=None`` reads every leaf (sorted).  ``want_round
+        >= 0`` pins the round: raises :class:`RoundRolled` (retriable)
+        if the table holds a different one.  Returns
+        ``(round, [(name, copy), ...])``."""
+        with self._mu:
+            g = self._groups.get(group)
+            if g is None or g.gen == 0:
+                raise SnapshotUnavailable(
+                    f"no snapshot published for group {group!r} yet")
+            idx = g.active
+            rnd = g.rounds[idx]
+            if want_round >= 0 and rnd != want_round:
+                raise RoundRolled(group, want_round, rnd)
+            buf = g.buffers[idx]
+            if names is None:
+                picked = sorted(buf)
+            else:
+                missing = [n for n in names if n not in buf]
+                if missing:
+                    raise SnapshotUnavailable(
+                        f"group {group!r} round {rnd} has no leaf "
+                        f"{missing[0]!r} (has {sorted(buf)})")
+                picked = list(names)
+            # the copies happen UNDER the lock: that is the torn-read
+            # guarantee (the publisher's swap waits for us)
+            out = [(n, buf[n].copy()) for n in picked]
+        return rnd, out
+
+    # --------------------------------------------------------- bookkeeping
+    def current_round(self, group: str) -> int:
+        """Latest published round of ``group`` (-1 = never published)."""
+        with self._mu:
+            g = self._groups.get(group)
+            return g.rounds[g.active] if g is not None and g.gen else -1
+
+    def generation(self, group: str) -> int:
+        """Publish count of ``group`` (0 = never published)."""
+        with self._mu:
+            g = self._groups.get(group)
+            return g.gen if g is not None else 0
+
+    def wait_newer(self, group: str, gen: int,
+                   timeout_s: Optional[float] = None) -> Optional[int]:
+        """Block until ``group``'s generation exceeds ``gen``; returns
+        the new generation, or None on timeout.  The subscription
+        senders live in this wait instead of polling."""
+        def newer() -> bool:
+            g = self._groups.get(group)
+            return g is not None and g.gen > gen
+
+        with self._cv:
+            if not self._cv.wait_for(newer, timeout=timeout_s):
+                return None
+            return self._groups[group].gen
+
+    def groups(self) -> List[str]:
+        with self._mu:
+            return sorted(g for g, st in self._groups.items() if st.gen)
+
+    def drop(self, group: str) -> None:
+        """Remove a group (job teardown; unblocks nothing — waiters time
+        out on their own keepalive cadence)."""
+        with self._mu:
+            self._groups.pop(group, None)
+
+
+# one process-global table, like the window fabric's window table: any
+# WindowServer in the process serves what any loop in the process
+# publishes
+_TABLE = SnapshotTable()
+
+
+def table() -> SnapshotTable:
+    """The process-global snapshot table."""
+    return _TABLE

@@ -1,0 +1,295 @@
+"""Resumable push subscriptions: "push me every Nth round's weights".
+
+:class:`Subscriber` is the streaming reader of the serving tier — it
+holds one SUBSCRIBE connection to a trainer's
+:class:`~bluefog_tpu.runtime.window_server.WindowServer` and receives
+round-stamped snapshots as the model trains.  The fault model is the
+whole design:
+
+- **Resumable.**  The subscriber owns a stable 64-bit lineage id and a
+  per-connection epoch (the deposit streams' STREAM_ATTACH pattern, on
+  the read path).  Its CURSOR — the highest round it fully received —
+  is the delivery truth: on reconnect it re-subscribes with
+  ``(sub_id, epoch+1, cursor)``, the server quiesces any zombie sender
+  of the old epoch and resumes strictly above the cursor.  A frame torn
+  mid-push never advances the cursor, so its round is re-delivered;
+  rounds at or below the cursor are never pushed again.  Net contract:
+  across any number of disconnects, delivered rounds are strictly
+  increasing — nothing promised is missed or duplicated.
+- **Bounded reconnect.**  Outages are retried under a
+  :class:`~bluefog_tpu.runtime.resilience.Backoff` with a mandatory
+  budget; exhaustion LATCHES the error (like a
+  :class:`~bluefog_tpu.runtime.window_server.DepositStream`) and the
+  subscriber reports dead instead of hammering a gone trainer forever.
+- **Silence detection.**  The server keepalives an idle subscription
+  (~1 s cadence); ``idle_timeout_s`` of total silence therefore means a
+  wedged/partitioned server, and triggers the same bounded reconnect.
+- **Slow consumers skip, never block.**  Delivery is into a bounded
+  deque that drops the OLDEST pending snapshot (the client-side twin of
+  the server's skip-to-latest policy); a slow ``on_snapshot`` callback
+  delays only this subscriber.
+
+The subscriber never writes after the SUBSCRIBE request — the
+connection is one-way server-push, so a dead subscriber costs the
+trainer at most one sender thread until TCP notices.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import socket
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.runtime import resilience
+from bluefog_tpu.serving.client import Snapshot
+
+__all__ = ["Subscriber"]
+
+
+def _wire():
+    from bluefog_tpu.runtime import window_server as ws
+
+    return ws
+
+
+class Subscriber:
+    """Background push-subscription reader (see module docstring).
+
+    Args:
+      address: the trainer's ``WindowServer`` address.
+      group: snapshot group to follow (the dsgd loops publish
+        ``f"{name}:{rank}"``).
+      every: deliver at most every Nth round (the server skips the
+        rest; ``skipped_rounds`` accounts for slow-reader skips beyond
+        that stride).
+      cursor: resume point — the highest round already consumed in a
+        previous life (-1 = fresh).
+      on_snapshot: optional callback invoked on THIS subscriber's
+        thread for every delivered :class:`Snapshot`; with or without
+        it, snapshots are also queued for :meth:`get`.
+      reconnect: ``True`` (default) / dict of Backoff kwargs / ``False``
+        (first outage is terminal).
+      idle_timeout_s: silence (no push, no keepalive) treated as a dead
+        connection.
+      queue_max: bounded delivery queue; overflow drops the oldest.
+    """
+
+    def __init__(self, address: Tuple[str, int], group: str, *,
+                 every: int = 1, cursor: int = -1,
+                 on_snapshot: Optional[Callable[[Snapshot], None]] = None,
+                 reconnect=True, idle_timeout_s: float = 5.0,
+                 timeout_s: float = 10.0, queue_max: int = 16):
+        self.group = group
+        self._group_b = group.encode()
+        self._addr = (address[0], int(address[1]))
+        self._every = max(1, int(every))
+        self.cursor = int(cursor)
+        self._on_snapshot = on_snapshot
+        self._reconnect_cfg = (dict(reconnect)
+                               if isinstance(reconnect, dict)
+                               else ({} if reconnect else None))
+        self._idle_timeout_s = float(idle_timeout_s)
+        self._timeout_s = float(timeout_s)
+        self.sub_id = int.from_bytes(os.urandom(8), "little") or 1
+        self._epoch = 0
+        self.delivered = 0
+        self.skipped_rounds = 0
+        self.resumes = 0
+        self._err: Optional[str] = None
+        self._closed = threading.Event()
+        self._cv = threading.Condition()
+        self._q: collections.deque = collections.deque(
+            maxlen=max(1, int(queue_max)))
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"bf-subscriber:{group}")
+        self._thread.start()
+
+    # ----------------------------------------------------------- consumer
+    @property
+    def error(self) -> Optional[str]:
+        return self._err
+
+    def get(self, timeout_s: Optional[float] = None) -> Optional[Snapshot]:
+        """Pop the oldest queued snapshot (None on timeout).  Raises the
+        latched error once the subscription is dead AND drained."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._cv:
+            while True:
+                if self._q:
+                    return self._q.popleft()
+                if self._err is not None:
+                    raise RuntimeError(
+                        f"subscription to {self.group!r} failed: "
+                        f"{self._err}")
+                if self._closed.is_set():
+                    return None
+                wait = (None if deadline is None
+                        else deadline - time.monotonic())
+                if wait is not None and wait <= 0:
+                    return None
+                self._cv.wait(timeout=wait)
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._cv:
+            self._cv.notify_all()
+        sock = getattr(self, "_sock", None)
+        if sock is not None:
+            for fn in (lambda: sock.shutdown(socket.SHUT_RDWR),
+                       sock.close):
+                try:
+                    fn()
+                except OSError:
+                    pass
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------ plumbing
+    def _fail(self, msg: str) -> None:
+        with self._cv:
+            if self._err is None:
+                self._err = msg
+            self._cv.notify_all()
+        _bb.record("sub_error", group=self.group, error=msg[:200])
+
+    def _subscribe_once(self) -> socket.socket:
+        """One connect + HELLO + SUBSCRIBE; raises on any failure."""
+        ws = _wire()
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._timeout_s)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            want = ws.FEATURE_SUBSCRIBE
+            ws._sendmsg_all(sock, [
+                ws._HDR.pack(ws._MAGIC, ws._OP_HELLO, 0),
+                ws._HELLO.pack(ws.PROTOCOL_VERSION, want)])
+            (granted,) = ws._STATUS.unpack(
+                ws._recv_exact(sock, ws._STATUS.size))
+            if granted < 0 or not granted & want:
+                raise RuntimeError(
+                    f"window server at {self._addr[0]}:{self._addr[1]} "
+                    f"does not serve subscriptions (HELLO reply "
+                    f"{int(granted)})")
+            self._epoch += 1
+            ws._sendmsg_all(sock, [
+                ws._HDR.pack(ws._MAGIC, ws._OP_SUBSCRIBE,
+                             len(self._group_b)), self._group_b,
+                ws._SUB_REQ.pack(self.sub_id, self._epoch, self._every,
+                                 self.cursor)])
+            (rc,) = ws._STATUS.unpack(ws._recv_exact(sock,
+                                                     ws._STATUS.size))
+            if rc < 0:
+                raise RuntimeError(
+                    f"subscribe to {self.group!r} rejected ({int(rc)}): "
+                    + ws._err_text(int(rc)))
+            # steady state: the idle timeout is the silence detector —
+            # the server keepalives ~1 Hz, so this only fires on a
+            # wedged/partitioned server
+            sock.settimeout(self._idle_timeout_s)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        return sock
+
+    def _deliver(self, snap: Snapshot, skipped: int) -> None:
+        self.delivered += 1
+        self.skipped_rounds += int(skipped)
+        with self._cv:
+            self._q.append(snap)  # bounded: overflow drops the OLDEST
+            self._cv.notify_all()
+        if self._on_snapshot is not None:
+            try:
+                self._on_snapshot(snap)
+            except Exception as e:  # noqa: BLE001 — a consumer bug must
+                # surface as this subscription's error, not kill the
+                # thread silently
+                self._fail(f"on_snapshot callback raised: "
+                           f"{type(e).__name__}: {e}")
+
+    def _read_frames(self, sock: socket.socket) -> None:
+        """Pump push frames until the connection dies; the cursor only
+        advances after a FULL frame arrived, so torn frames are never
+        consumed and their round is re-delivered after resume."""
+        ws = _wire()
+        while not self._closed.is_set():
+            hdr = ws._recv_exact(sock, ws._PUSH.size)
+            rnd, skipped, count = ws._PUSH.unpack(hdr)
+            leaves = ws._recv_leaves(sock, count)
+            if rnd < 0:
+                continue  # keepalive
+            if rnd <= self.cursor:
+                # the server must never re-push a consumed round; a
+                # frame that does is a protocol violation worth loud
+                # forensics, and is NOT delivered twice
+                _bb.record("sub_duplicate_round", group=self.group,
+                           round=rnd, cursor=self.cursor)
+                continue
+            self.cursor = rnd
+            self._deliver(Snapshot(self.group, rnd, leaves), skipped)
+
+    def _loop(self) -> None:
+        bo: Optional[resilience.Backoff] = None
+        while not self._closed.is_set():
+            try:
+                sock = self._subscribe_once()
+            except RuntimeError as e:
+                self._fail(str(e))  # rejection: retrying cannot fix it
+                return
+            except (TimeoutError, ConnectionError, OSError) as e:
+                if not self._sleep_backoff(bo := (bo or self._new_bo()),
+                                           str(e)):
+                    return
+                continue
+            self._sock = sock
+            if self._epoch > 1:
+                self.resumes += 1
+                _bb.record("sub_resume", group=self.group,
+                           sub_id=self.sub_id, epoch=self._epoch,
+                           cursor=self.cursor, side="client")
+                _mt.inc("bf_sub_resumes_total", 1.0, group=self.group)
+            bo = None  # a live subscription resets the outage budget
+            try:
+                self._read_frames(sock)
+            except (TimeoutError, ConnectionError, OSError, ValueError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if self._closed.is_set():
+                return
+            if not self._sleep_backoff(bo := (bo or self._new_bo()),
+                                       "push connection lost"):
+                return
+
+    def _new_bo(self) -> resilience.Backoff:
+        return resilience.read_backoff(self._reconnect_cfg)
+
+    def _sleep_backoff(self, bo: resilience.Backoff, why: str) -> bool:
+        """One bounded backoff step; False when the subscription is done
+        (closed, reconnect off, or budget exhausted — latched)."""
+        if self._closed.is_set():
+            return False
+        if self._reconnect_cfg is None:
+            self._fail(f"subscription connection lost ({why}); "
+                       "reconnect disabled")
+            return False
+        try:
+            delay = bo.next_delay()
+        except resilience.BudgetExhausted:
+            self._fail(f"reconnect budget exhausted after {bo.attempts} "
+                       f"attempt(s) ({why}) — trainer unreachable")
+            return False
+        _mt.observe("bf_reconnect_backoff_seconds", delay,
+                    peer=f"{self._addr[0]}:{self._addr[1]}")
+        return not self._closed.wait(delay)

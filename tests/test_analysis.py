@@ -749,3 +749,127 @@ class TestAdmissionLint:
             "    return x + 1\n"
         )
         assert not check_admission_paths(src, filename="plain.py")
+
+
+# ---------------------------------------------------------------------------
+# BF-SRV: snapshot consumers must check the round stamp
+# ---------------------------------------------------------------------------
+
+
+class TestServingLint:
+    def test_seeded_violation_blind_consumer(self):
+        # the exact bug the rule exists for: pull a snapshot, serve its
+        # leaves, never look at the round — warm-up garbage and stale
+        # models get served silently
+        from bluefog_tpu.analysis.serving_lint import (
+            check_snapshot_consumers)
+
+        src = (
+            "import bluefog_tpu.serving as serving\n"
+            "\n"
+            "def serve(client, inp):\n"
+            "    snap = client.snapshot()\n"
+            "    return snap.leaves['x'] @ inp\n"
+        )
+        diags = check_snapshot_consumers(src, filename="seeded.py")
+        assert any(d.code == "BF-SRV001" and d.severity == "error"
+                   for d in diags), [d.format() for d in diags]
+
+    def test_round_checked_consumer_is_clean(self):
+        from bluefog_tpu.analysis.serving_lint import (
+            check_snapshot_consumers)
+
+        src = (
+            "import bluefog_tpu.serving as serving\n"
+            "\n"
+            "def serve(client, inp, cursor):\n"
+            "    snap = client.snapshot()\n"
+            "    if snap.round <= cursor:\n"
+            "        return None\n"
+            "    return snap.leaves['x'] @ inp\n"
+        )
+        assert not check_snapshot_consumers(src, filename="clean.py")
+
+    def test_min_round_kwarg_delegates_the_check(self):
+        # min_round=/pin_round= on the call IS the check (the client
+        # enforces the bound); no further vocabulary required
+        from bluefog_tpu.analysis.serving_lint import (
+            check_snapshot_consumers)
+
+        src = (
+            "def serve(addr, inp, floor):\n"
+            "    c = SnapshotClient(addr, 'job:0')\n"
+            "    snap = c.snapshot(min_round=floor)\n"
+            "    return snap.leaves['x'] @ inp\n"
+        )
+        assert not check_snapshot_consumers(src, filename="kwarg.py")
+
+    def test_retriable_handler_counts_as_checking(self):
+        from bluefog_tpu.analysis.serving_lint import (
+            check_snapshot_consumers)
+
+        src = (
+            "import bluefog_tpu.serving as serving\n"
+            "\n"
+            "def serve(client, inp):\n"
+            "    try:\n"
+            "        snap = client.snapshot()\n"
+            "    except serving.SnapshotUnavailable:\n"
+            "        return None\n"
+            "    return snap.leaves['x'] @ inp\n"
+        )
+        assert not check_snapshot_consumers(src, filename="handler.py")
+
+    def test_unrelated_snapshot_apis_not_flagged(self):
+        # metrics.export.snapshot() (and anything else named snapshot)
+        # is out of scope unless the module imports bluefog_tpu.serving
+        # or the receiver is a SnapshotClient
+        from bluefog_tpu.analysis.serving_lint import (
+            check_snapshot_consumers)
+
+        src = (
+            "def export(registry):\n"
+            "    return registry.snapshot()\n"
+        )
+        assert not check_snapshot_consumers(src, filename="metrics.py")
+
+    def test_serving_pass_runs_in_sweep(self):
+        # the bflint-tpu sweep includes the serving pass (BF-SRV100
+        # info) and reports NO BF-SRV findings on the repo as committed
+        report = run_all(size=8, trace=False)
+        assert report.has("BF-SRV100"), report.format(verbose=True)
+        assert report.ok, report.format()
+        assert not [d for d in report.warnings
+                    if d.code.startswith("BF-SRV")], report.format()
+
+    def test_round_substring_does_not_suppress(self):
+        # 'background'/'workaround' contain 'round' as a substring —
+        # they are NOT a round-stamp check and must not silence the rule
+        from bluefog_tpu.analysis.serving_lint import (
+            check_snapshot_consumers)
+
+        src = (
+            "import bluefog_tpu.serving as serving\n"
+            "\n"
+            "def serve(client, background, workaround):\n"
+            "    snap = client.snapshot()\n"
+            "    return snap.leaves['x'] + background + workaround\n"
+        )
+        diags = check_snapshot_consumers(src, filename="substr.py")
+        assert any(d.code == "BF-SRV001" for d in diags), \
+            [d.format() for d in diags]
+
+    def test_rounds_plural_word_counts(self):
+        from bluefog_tpu.analysis.serving_lint import (
+            check_snapshot_consumers)
+
+        src = (
+            "import bluefog_tpu.serving as serving\n"
+            "\n"
+            "def serve(client, replica, live):\n"
+            "    snap = client.snapshot()\n"
+            "    if replica.staleness_rounds(live) > 4:\n"
+            "        return None\n"
+            "    return snap.leaves['x']\n"
+        )
+        assert not check_snapshot_consumers(src, filename="plural.py")

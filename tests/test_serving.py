@@ -1,0 +1,791 @@
+"""Serve-while-training read fabric (round-consistent snapshots,
+resumable subscriptions, reader fault tolerance).
+
+Covers the tentpole surfaces of `bluefog_tpu.serving` + the wire-v2
+SNAPSHOT/SUBSCRIBE ops (`runtime/window_server.py`):
+
+- the torn-read fuzzer: concurrent publishes racing SNAPSHOT reads
+  across round boundaries never yield mixed-round leaves (60+ seeded
+  cases — the double-buffer swap-under-lock contract);
+- round pinning: a pinned read that lost its race gets the RETRIABLE
+  round-rolled status, never a torn or silently-newer snapshot;
+- resumable subscriptions: every-Nth-round stride, reconnect-and-resume
+  across injected connection cuts with no missed or duplicated
+  promised round (cursor + epoch quiesce), slow-reader skip-to-latest
+  that never throttles the publisher;
+- reader fault injection: the new `read:*`/`sub:*` chaos sites tear
+  replies mid-frame, stall and cut them — clients recover under
+  bounded backoff; the synchronous read path gets a real deadline,
+  idempotent-read retry, and DepositStream-style error latching;
+- malformed/truncated SNAPSHOT and SUBSCRIBE frame fuzz (the PR-4
+  harness shape): garbage never takes the serving process down;
+- the acceptance scenario: 3 tcp dsgd ranks + 4 subscriber processes
+  under reader kills/stalls/torn frames — every delivered snapshot
+  passes an exact round-stamp audit, and training's push-sum mass
+  audit is identical to a chaos-free run.
+
+Like the transport tests, everything here runs against whichever window
+table the host has (native or pure-Python fallback).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests._util import REPO as _REPO, clean_env, uniq as _uniq
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolated():
+    from bluefog_tpu import chaos
+
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _serve():
+    from bluefog_tpu.runtime.window_server import WindowServer
+
+    srv = WindowServer()
+    addr = srv.start("127.0.0.1")
+    return srv, addr
+
+
+def _stamped(rnd: float, dim: int = 64):
+    v = float(rnd)
+    return {"x": np.full(dim, v), "p": np.array([v + 1.0]),
+            "round": np.array([v])}
+
+
+# ---------------------------------------------------------------------------
+# snapshot table + SNAPSHOT wire op
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotTable:
+    def test_publish_read_round_consistent(self):
+        from bluefog_tpu.serving import (RoundRolled, SnapshotUnavailable,
+                                         table)
+
+        tbl = table()
+        g = _uniq("tbl")
+        with pytest.raises(SnapshotUnavailable):
+            tbl.read(g)
+        tbl.publish(g, 3, _stamped(3))
+        rnd, leaves = tbl.read(g)
+        assert rnd == 3
+        got = dict(leaves)
+        assert (got["x"] == 3.0).all() and got["round"][0] == 3.0
+        # leaf subset + unknown leaf
+        rnd, leaves = tbl.read(g, ["p"])
+        assert rnd == 3 and leaves[0][0] == "p"
+        with pytest.raises(SnapshotUnavailable):
+            tbl.read(g, ["nope"])
+        # pin the live round: fine; pin a stale one: retriable roll
+        assert tbl.read(g, want_round=3)[0] == 3
+        tbl.publish(g, 4, _stamped(4))
+        with pytest.raises(RoundRolled):
+            tbl.read(g, want_round=3)
+        assert tbl.current_round(g) == 4
+        assert tbl.generation(g) == 2
+        tbl.drop(g)
+
+    def test_non_float_leaves_rejected(self):
+        from bluefog_tpu.serving import table
+
+        g = _uniq("tbl_dtype")
+        with pytest.raises(TypeError, match="f32/f64"):
+            table().publish(g, 0, {"x": np.arange(4, dtype=np.int32)})
+
+    def test_reader_copy_is_isolated_from_later_publishes(self):
+        from bluefog_tpu.serving import table
+
+        tbl = table()
+        g = _uniq("tbl_copy")
+        tbl.publish(g, 0, _stamped(0))
+        _, leaves = tbl.read(g)
+        held = dict(leaves)["x"]
+        for rnd in range(1, 4):
+            tbl.publish(g, rnd, _stamped(rnd))
+        assert (held == 0.0).all()  # a served copy can never mutate
+        tbl.drop(g)
+
+
+class TestSnapshotWire:
+    def test_hello_grants_serving_features(self):
+        from bluefog_tpu.runtime import window_server as ws
+
+        srv, addr = _serve()
+        try:
+            with socket.create_connection(addr, timeout=10) as s:
+                s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_HELLO, 0)
+                          + ws._HELLO.pack(
+                              ws.PROTOCOL_VERSION,
+                              ws.FEATURE_SNAPSHOT | ws.FEATURE_SUBSCRIBE))
+                (granted,) = ws._STATUS.unpack(s.recv(8))
+            assert granted & ws.FEATURE_SNAPSHOT
+            assert granted & ws.FEATURE_SUBSCRIBE
+        finally:
+            srv.stop()
+
+    def test_snapshot_roundtrip_and_min_round(self):
+        from bluefog_tpu.serving import SnapshotUnavailable, table
+        from bluefog_tpu.serving.client import SnapshotClient
+
+        tbl = table()
+        g = _uniq("wire")
+        srv, addr = _serve()
+        try:
+            c = SnapshotClient(addr, g)
+            # nothing published yet: retriable, and wait_s bounds it
+            with pytest.raises(SnapshotUnavailable):
+                c.snapshot()
+            tbl.publish(g, 5, _stamped(5))
+            snap = c.snapshot()
+            assert snap.round == 5
+            assert (snap["x"] == 5.0).all()
+            assert int(snap["round"][0]) == 5
+            # min_round: stale serves rejected after the wait budget
+            with pytest.raises(SnapshotUnavailable, match="stale"):
+                c.snapshot(min_round=9, wait_s=0.2)
+            tbl.publish(g, 9, _stamped(9))
+            assert c.snapshot(min_round=9).round == 9
+            c.close()
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_pinned_read_rolls_retriably(self):
+        from bluefog_tpu.serving import RoundRolled, table
+        from bluefog_tpu.serving.client import SnapshotClient
+
+        tbl = table()
+        g = _uniq("pin")
+        srv, addr = _serve()
+        try:
+            tbl.publish(g, 1, _stamped(1))
+            c = SnapshotClient(addr, g)
+            assert c.snapshot(pin_round=1).round == 1
+            tbl.publish(g, 2, _stamped(2))
+            with pytest.raises(RoundRolled):
+                c.snapshot(pin_round=1)
+            # the protocol: re-pin at the new round and continue
+            assert c.snapshot(pin_round=2).round == 2
+            c.close()
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_torn_read_fuzzer_never_mixes_rounds(self):
+        """THE consistency test: a publisher rolling rounds as fast as
+        it can races concurrent SNAPSHOT reads; every reply must be
+        entirely one round (every leaf value equals the reply's round
+        stamp).  60 seeded interleavings."""
+        from bluefog_tpu.serving import SnapshotUnavailable, table
+        from bluefog_tpu.serving.client import SnapshotClient
+
+        tbl = table()
+        g = _uniq("fuzz_torn")
+        srv, addr = _serve()
+        dim = 512
+        reads = [0]
+        try:
+            c = SnapshotClient(addr, g)
+            for seed in range(60):
+                rng = np.random.default_rng(seed)
+                rounds = int(rng.integers(10, 40))
+
+                def publisher():
+                    for rnd in range(rounds):
+                        v = float(rnd)
+                        tbl.publish(g, rnd, {
+                            "x": np.full(dim, v), "p": np.array([v]),
+                            "round": np.array([v])})
+                        if rng.random() < 0.3:
+                            time.sleep(float(rng.random()) * 5e-4)
+
+                t = threading.Thread(target=publisher)
+                t.start()
+                while t.is_alive():
+                    try:
+                        snap = c.snapshot()
+                    except SnapshotUnavailable:
+                        continue
+                    r = float(snap.round)
+                    x = snap["x"]
+                    # all-of-one-round, exactly: any torn mix would
+                    # break one of these equalities
+                    assert float(snap["round"][0]) == r, seed
+                    assert float(snap["p"][0]) == r, seed
+                    assert x[0] == r and (x == x[0]).all(), seed
+                    reads[0] += 1
+                t.join()
+                tbl.drop(g)  # next seed restarts its round counter
+            assert reads[0] >= 120, f"only {reads[0]} racing reads"
+            c.close()
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_client_survives_torn_reply(self):
+        """Chaos read:truncate tears the reply mid-frame: the client
+        must record a torn_read_retry and recover on a fresh
+        connection, never consume the fragment."""
+        from bluefog_tpu import chaos
+        from bluefog_tpu.serving import table
+        from bluefog_tpu.serving.client import SnapshotClient
+
+        tbl = table()
+        g = _uniq("torn_reply")
+        srv, addr = _serve()
+        try:
+            tbl.publish(g, 7, _stamped(7))
+            chaos.configure("read:truncate:after_frames=1")
+            c = SnapshotClient(addr, g,
+                               retry=dict(base_s=0.01, cap_s=0.05,
+                                          budget=5, seed=0))
+            snap = c.snapshot()
+            assert snap.round == 7 and (snap["x"] == 7.0).all()
+            c.close()
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+
+# ---------------------------------------------------------------------------
+# satellite: sync reads — deadline, bounded retry, latched errors
+# ---------------------------------------------------------------------------
+
+
+class TestSyncReadResilience:
+    def _win(self, name, val=3.5):
+        from bluefog_tpu.runtime.async_windows import AsyncWindow
+
+        win = AsyncWindow(name, n_slots=1, n_elems=4, dtype=np.float64)
+        win.set_self(np.full(4, val))
+        return win
+
+    def test_wedged_owner_times_out_not_hangs(self):
+        from bluefog_tpu import chaos
+        from bluefog_tpu.runtime.window_server import RemoteWindow
+
+        name = _uniq("sync_stall")
+        win = self._win(name)
+        srv, addr = _serve()
+        try:
+            chaos.configure("read:stall:s=30:after_frames=1")
+            rw = RemoteWindow(addr, name, timeout_s=0.6)
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError, match="wedged owner"):
+                rw.read_self(4)
+            assert time.monotonic() - t0 < 10  # a deadline, not a hang
+            # and the error LATCHED: the next call refuses immediately
+            with pytest.raises(RuntimeError, match="latched"):
+                rw.read_self(4)
+            rw.close()
+        finally:
+            srv.stop()
+            win.free()
+
+    def test_idempotent_read_retries_through_stall(self):
+        from bluefog_tpu import chaos
+        from bluefog_tpu.runtime.window_server import RemoteWindow
+
+        name = _uniq("sync_retry")
+        win = self._win(name, 9.25)
+        srv, addr = _serve()
+        try:
+            # the FIRST reply stalls past the deadline; the retry's
+            # fresh connection is frame 2 and sails through
+            chaos.configure("read:stall:s=30:after_frames=1")
+            rw = RemoteWindow(addr, name, timeout_s=0.6,
+                              retry=dict(base_s=0.01, cap_s=0.05,
+                                         budget=4, seed=0))
+            got = rw.read_self(4)
+            np.testing.assert_allclose(got, 9.25)
+            # a truncated reply is recovered the same way
+            chaos.configure("read:truncate:after_frames=1")
+            got, fresh = rw.read(0, 4, consume=False)
+            assert fresh == 0
+            rw.close()
+        finally:
+            srv.stop()
+            win.free()
+
+    def test_budget_exhaustion_latches(self):
+        from bluefog_tpu import chaos
+        from bluefog_tpu.runtime.window_server import RemoteWindow
+
+        name = _uniq("sync_latch")
+        win = self._win(name)
+        srv, addr = _serve()
+        try:
+            chaos.configure("read:drop:every=1")  # every read reply dies
+            rw = RemoteWindow(addr, name, timeout_s=1.0,
+                              retry=dict(base_s=0.01, cap_s=0.02,
+                                         budget=2, seed=0))
+            with pytest.raises(RuntimeError, match="budget"):
+                rw.read_self(4)
+            with pytest.raises(RuntimeError, match="latched"):
+                rw.read_self(4)
+            rw.close()
+        finally:
+            srv.stop()
+            win.free()
+
+    def test_consuming_read_is_never_silently_retried(self):
+        from bluefog_tpu import chaos
+        from bluefog_tpu.runtime.window_server import RemoteWindow
+
+        name = _uniq("sync_consume")
+        win = self._win(name)
+        srv, addr = _serve()
+        try:
+            chaos.configure("read:drop:after_frames=1")
+            rw = RemoteWindow(addr, name, timeout_s=1.0,
+                              retry=dict(base_s=0.01, budget=4))
+            # a consume read is NOT idempotent: the drop surfaces as a
+            # connection error instead of a silent re-consume
+            with pytest.raises((ConnectionError, RuntimeError)):
+                rw.read(0, 4, consume=True)
+            rw.close()
+        finally:
+            srv.stop()
+            win.free()
+
+
+# ---------------------------------------------------------------------------
+# subscriptions
+# ---------------------------------------------------------------------------
+
+
+class TestSubscriptions:
+    def test_every_nth_round_stride(self):
+        from bluefog_tpu.serving import table
+        from bluefog_tpu.serving.subscriber import Subscriber
+
+        tbl = table()
+        g = _uniq("sub_nth")
+        srv, addr = _serve()
+        got = []
+        try:
+            sub = Subscriber(addr, g, every=3,
+                             on_snapshot=lambda s: got.append(s.round))
+            time.sleep(0.2)
+            for rnd in range(30):
+                tbl.publish(g, rnd, _stamped(rnd))
+                time.sleep(0.01)
+            time.sleep(0.5)
+            sub.close()
+            assert got, "no rounds delivered"
+            assert got == sorted(set(got))  # strictly increasing
+            for a, b in zip(got, got[1:]):
+                assert b - a >= 3, got  # the promised stride
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_reconnect_resumes_exactly_once_per_promised_round(self):
+        """Chaos cuts the push channel repeatedly; the subscriber's
+        cursor + the epoch quiesce must make delivery exactly-once:
+        rounds strictly increasing across every resume, no duplicates,
+        and delivery continues after each cut."""
+        from bluefog_tpu import chaos
+        from bluefog_tpu.serving import table
+        from bluefog_tpu.serving.subscriber import Subscriber
+
+        tbl = table()
+        g = _uniq("sub_resume")
+        srv, addr = _serve()
+        got = []
+        try:
+            chaos.configure("sub:drop:every=5")
+            sub = Subscriber(addr, g, every=1,
+                             on_snapshot=lambda s: got.append(s.round),
+                             reconnect=dict(base_s=0.02, cap_s=0.1,
+                                            budget=8, seed=1),
+                             idle_timeout_s=2.0)
+            time.sleep(0.2)
+            for rnd in range(40):
+                tbl.publish(g, rnd, _stamped(rnd))
+                time.sleep(0.03)
+            time.sleep(1.0)
+            resumes = sub.resumes
+            err = sub.error
+            sub.close()
+            assert err is None, err
+            assert resumes >= 1, "chaos never forced a resume"
+            assert len(got) >= 8, got
+            assert got == sorted(set(got)), (
+                f"duplicated/regressed rounds across resumes: {got}")
+            # delivery continued AFTER the last injected cut
+            assert got[-1] >= 30, got
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_slow_reader_skips_but_never_blocks_publisher(self):
+        from bluefog_tpu.serving import table
+        from bluefog_tpu.serving.subscriber import Subscriber
+
+        tbl = table()
+        g = _uniq("sub_slow")
+        srv, addr = _serve()
+        got = []
+        rounds = 20
+        # MODEL-SIZED frames: small ones vanish into kernel socket
+        # buffers and a lagging reader is invisible — 8 MB per push is
+        # what makes the sender actually fall behind a slow consumer
+        big = np.zeros(1 << 20, np.float64)
+
+        def slow(snap):
+            got.append(snap.round)
+            time.sleep(0.05)  # a consumer ~10x slower than the publisher
+
+        try:
+            sub = Subscriber(addr, g, every=1, on_snapshot=slow,
+                             queue_max=2)
+            time.sleep(0.2)
+            t0 = time.monotonic()
+            for rnd in range(rounds):
+                v = float(rnd)
+                big[0] = v
+                tbl.publish(g, rnd, {"x": big, "p": np.array([v]),
+                                     "round": np.array([v])})
+                time.sleep(0.005)
+            publish_wall = time.monotonic() - t0
+            # skip-to-latest: the publisher's cadence is ITS OWN — a
+            # reader at 50 ms/frame must not stretch 20 publishes
+            # toward its ~1 s pace
+            assert publish_wall < 2.0, publish_wall
+            time.sleep(1.5)
+            skipped = sub.skipped_rounds
+            sub.close()
+            assert got == sorted(set(got)), got
+            assert skipped > 0, "slow reader never skipped"
+            assert len(got) < rounds, "a slow consumer cannot see all"
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_late_subscriber_catches_up_to_current_round(self):
+        """A subscriber attaching AFTER the latest publish (replica
+        restart, converged trainer) must still receive the current
+        round when its cursor is below it — not wait forever for a
+        future publish."""
+        from bluefog_tpu.serving import table
+        from bluefog_tpu.serving.subscriber import Subscriber
+
+        tbl = table()
+        g = _uniq("sub_late")
+        srv, addr = _serve()
+        try:
+            for rnd in range(6):
+                tbl.publish(g, rnd, _stamped(rnd))
+            # all publishing is DONE before the subscriber exists
+            sub = Subscriber(addr, g, every=1)
+            snap = sub.get(timeout_s=5.0)
+            assert snap is not None and snap.round == 5, snap
+            sub.close()
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_keepalives_flow_while_pushes_not_due(self):
+        """A steady stream of NOT-DUE publishes (large stride) must not
+        starve the keepalive cadence: the reader's idle timeout on a
+        healthy connection would otherwise churn reconnects forever."""
+        from bluefog_tpu.serving import table
+        from bluefog_tpu.serving.subscriber import Subscriber
+
+        tbl = table()
+        g = _uniq("sub_idle")
+        srv, addr = _serve()
+        try:
+            sub = Subscriber(addr, g, every=1000, idle_timeout_s=1.2,
+                             reconnect=dict(base_s=0.02, budget=4))
+            deadline = time.monotonic() + 3.0
+            rnd = 0
+            while time.monotonic() < deadline:
+                tbl.publish(g, rnd, _stamped(rnd))
+                rnd += 1
+                time.sleep(0.15)  # publishes flow, pushes never due
+            assert sub.error is None, sub.error
+            assert sub.resumes == 0, "idle timeout tripped on a " \
+                "healthy connection"
+            sub.close()
+        finally:
+            srv.stop()
+            tbl.drop(g)
+
+    def test_replica_surfaces_subscription_failure_fast(self):
+        from bluefog_tpu.serving.replica import ServingReplica
+
+        srv, addr = _serve()
+        srv.stop()  # nothing listening: the subscription must die fast
+        rep = ServingReplica(addr, _uniq("rep_dead"),
+                             reconnect=dict(base_s=0.01, cap_s=0.02,
+                                            budget=2))
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="failed before"):
+            rep.wait_ready(timeout_s=30.0)
+        # the latched error surfaced promptly, not at the full timeout
+        assert time.monotonic() - t0 < 10
+        rep.close()
+
+    def test_subscriber_latches_when_trainer_gone(self):
+        from bluefog_tpu.serving.subscriber import Subscriber
+
+        srv, addr = _serve()
+        srv.stop()  # nothing listening anymore
+        sub = Subscriber(addr, _uniq("sub_dead"),
+                         reconnect=dict(base_s=0.01, cap_s=0.02,
+                                        budget=3, seed=0))
+        with pytest.raises(RuntimeError, match="budget|unreachable"):
+            # get() surfaces the latched terminal error
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                sub.get(timeout_s=0.5)
+        sub.close()
+
+
+# ---------------------------------------------------------------------------
+# malformed / truncated frame fuzz (the PR-4 harness, read-path ops)
+# ---------------------------------------------------------------------------
+
+
+def _valid_snapshot_request(ws, group_b):
+    return (ws._HDR.pack(ws._MAGIC, ws._OP_SNAPSHOT, len(group_b))
+            + group_b + ws._SNAP_REQ.pack(-1, 2)
+            + ws._LEAF_NAME.pack(1) + b"x"
+            + ws._LEAF_NAME.pack(1) + b"p")
+
+
+def _valid_subscribe_request(ws, group_b):
+    return (ws._HDR.pack(ws._MAGIC, ws._OP_SUBSCRIBE, len(group_b))
+            + group_b + ws._SUB_REQ.pack(77, 1, 1, -1))
+
+
+def test_fuzz_malformed_snapshot_and_subscribe_frames():
+    """Truncated, bit-flipped, and absurd-length SNAPSHOT/SUBSCRIBE
+    frames must never take the serving process down: at worst the one
+    connection drops, and a fresh reader right after works."""
+    from bluefog_tpu.runtime import window_server as ws
+    from bluefog_tpu.serving import table
+    from bluefog_tpu.serving.client import SnapshotClient
+
+    tbl = table()
+    g = _uniq("fuzz_frames")
+    gb = g.encode()
+    srv, addr = _serve()
+    rng = np.random.default_rng(23)
+    tbl.publish(g, 4, _stamped(4))
+    try:
+        for trial in range(60):
+            base = (_valid_snapshot_request(ws, gb) if trial % 2 == 0
+                    else _valid_subscribe_request(ws, gb))
+            blob = bytearray(base)
+            mode = trial % 3
+            if mode == 0:  # truncate anywhere
+                blob = blob[:int(rng.integers(1, len(blob)))]
+            elif mode == 1:  # flip bytes after the magic
+                for _ in range(int(rng.integers(1, 6))):
+                    i = int(rng.integers(ws._HDR.size, len(blob)))
+                    blob[i] = int(rng.integers(0, 256))
+            else:  # absurd claimed leaf counts / name lengths
+                off = ws._HDR.size + len(gb)
+                blob[off:off + ws._SNAP_REQ.size] = ws._SNAP_REQ.pack(
+                    int(rng.integers(-1, 2)), 0xFFFF)
+            with socket.create_connection(addr, timeout=10) as s:
+                s.settimeout(5)
+                try:
+                    s.sendall(blob)
+                    s.shutdown(socket.SHUT_WR)
+                    while s.recv(4096):
+                        pass
+                except OSError:
+                    pass  # torn connection either way — allowed
+        # fully functional for a fresh reader afterwards
+        c = SnapshotClient(addr, g)
+        snap = c.snapshot(min_round=4)
+        assert snap.round == 4 and (snap["x"] == 4.0).all()
+        c.close()
+    finally:
+        srv.stop()
+        tbl.drop(g)
+
+
+def test_chaos_spec_covers_reader_sites():
+    """`bfchaos-tpu` validates the new read-path sites."""
+    from bluefog_tpu.chaos import cli, parse_spec
+
+    rules = parse_spec("read:truncate:every=7;sub:stall:s=0.25:every=3;"
+                       "read:stall:s=2:prob=0.05;sub:drop:after_frames=9")
+    assert [r.site for r in rules] == ["read", "sub", "read", "sub"]
+    assert cli.main(["--spec", "read:drop:every=4;sub:truncate:every=6",
+                     "--explain"]) == 0
+    assert cli.main(["--spec", "reed:drop", "--explain"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: training + serving under reader chaos, end to end
+# ---------------------------------------------------------------------------
+
+
+_READER_CHAOS = ("read:truncate:every=5;read:stall:s=0.2:every=9;"
+                 "sub:truncate:every=17;sub:stall:s=0.25:every=7")
+
+
+def test_chaos_acceptance_serving_under_reader_faults():
+    """3 tcp training ranks + 4 subscriber processes; reader-side chaos
+    tears/stalls reads and pushes on the serving hosts while the test
+    SIGKILLs one subscriber and SIGSTOP/SIGCONTs another.  Every
+    delivered snapshot passes an exact round-stamp audit in the
+    subscriber processes; the training job's exact mass audit is
+    IDENTICAL to a chaos-free run (total == n, nobody dead); surviving
+    subscribers resume with nothing missed or duplicated."""
+    import signal
+    import tempfile
+
+    worker = os.path.join(_REPO, "tests", "_mp_serving_worker.py")
+    n = 3
+    with tempfile.TemporaryDirectory() as bdir:
+        name = _uniq("serve_mp")
+        tr_env = clean_env()
+        tr_env["BLUEFOG_TPU_CHAOS"] = _READER_CHAOS
+        trainers = [
+            subprocess.Popen(
+                [sys.executable, worker, "train", str(r), str(n), bdir,
+                 "6.0", name],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=tr_env, cwd=_REPO)
+            for r in range(n)
+        ]
+        sub_targets = [0, 1, 2, 0]
+        subs = [
+            subprocess.Popen(
+                [sys.executable, worker, "subscribe", str(i), str(n),
+                 bdir, "4.0", name, str(sub_targets[i])],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=clean_env(), cwd=_REPO)
+            for i in range(4)
+        ]
+        try:
+            # wait for training to actually start (the 'created' barrier
+            # file appears just before the loops run), then inject the
+            # reader-death schedule the chaos spec cannot express
+            deadline = time.monotonic() + 120
+            while not os.path.exists(os.path.join(bdir, "created.0")):
+                assert time.monotonic() < deadline, "trainers never started"
+                time.sleep(0.1)
+            time.sleep(1.5)
+            subs[2].kill()                      # reader death
+            time.sleep(0.3)
+            os.kill(subs[3].pid, signal.SIGSTOP)  # reader stall...
+            time.sleep(1.2)
+            os.kill(subs[3].pid, signal.SIGCONT)  # ...and thaw
+
+            t_out = []
+            for p in trainers:
+                out, _ = p.communicate(timeout=180)
+                t_out.append(out)
+            s_out = []
+            for p in subs:
+                out, _ = p.communicate(timeout=180)
+                s_out.append(out)
+        except subprocess.TimeoutExpired:
+            for p in trainers + subs:
+                p.kill()
+            pytest.fail("serving acceptance timed out")
+        # --- training untouched by reader chaos: exact audit, rc 0 ---
+        for r, (p, out) in enumerate(zip(trainers, t_out)):
+            assert p.returncode == 0, f"trainer {r} failed:\n{out}"
+            assert f"TRAIN_OK {r}" in out, out
+        assert "AUDIT mass=" in t_out[0], t_out[0]
+        # --- the killed reader died; everyone else audited clean ---
+        assert subs[2].returncode == -9, subs[2].returncode
+        resumed = 0
+        for i in (0, 1, 3):
+            assert subs[i].returncode == 0, \
+                f"subscriber {i} failed:\n{s_out[i]}"
+            assert f"SERVE_OK {i}" in s_out[i], s_out[i]
+            for tok in s_out[i].split():
+                if tok.startswith("resumes="):
+                    resumed += int(tok.split("=")[1])
+        # the sub-site chaos cut push channels: somebody resumed, and
+        # (asserted in-worker) without a missed or duplicated round
+        assert resumed >= 1, s_out
+
+
+def test_serving_replica_example_self_asserts():
+    """The example IS the acceptance demo for the staleness bound: it
+    asserts every delivered snapshot round-consistent and the served
+    model at most K rounds stale while training progresses."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples",
+                                      "serving_replica.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=clean_env(), cwd=_REPO, timeout=180)
+    assert proc.returncode == 0, proc.stdout
+    assert "serving_replica: OK" in proc.stdout, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# thread-mode publisher integration
+# ---------------------------------------------------------------------------
+
+
+def test_thread_dsgd_publishes_round_stamped_snapshots():
+    """run_async_dsgd(snapshot_every=) publishes atomically per round;
+    a concurrent wire reader sees only stamped, self-consistent
+    (x, p, round) triples and the mass audit stays exact."""
+    from bluefog_tpu import topology as T
+    from bluefog_tpu.runtime.async_windows import run_async_dsgd
+    from bluefog_tpu.serving import SnapshotUnavailable
+    from bluefog_tpu.serving.client import SnapshotClient
+
+    name = _uniq("thread_pub")
+    srv, addr = _serve()
+    seen = []
+    stop = threading.Event()
+
+    def reader():
+        c = SnapshotClient(addr, f"{name}:0",
+                           retry=dict(base_s=0.01, budget=4, seed=0))
+        while not stop.is_set():
+            try:
+                snap = c.snapshot()
+            except (SnapshotUnavailable, RuntimeError, OSError):
+                time.sleep(0.01)
+                continue
+            assert int(snap["round"][0]) == snap.round, snap.round
+            assert float(snap["p"][0]) > 0.0
+            seen.append(snap.round)
+            time.sleep(0.01)
+        c.close()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        def loss_and_grad(r, step, params):
+            w = np.asarray(params["w"], np.float64)
+            return 0.5 * float(w @ w), {"w": w}
+
+        report = run_async_dsgd(
+            T.RingGraph(3), {"w": np.ones(6, np.float32)},
+            loss_and_grad, lr=0.01, duration_s=1.5,
+            skew=[0.002] * 3, name=name, snapshot_every=1)
+        assert abs(report.total_mass - 3.0) < 1e-9
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        srv.stop()
+    assert seen and seen == sorted(seen), seen[:10]
+    assert seen[-1] > seen[0], "reader never observed training progress"
