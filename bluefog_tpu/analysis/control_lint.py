@@ -1,0 +1,132 @@
+"""BF-CTL lint: controller actuation only at round boundaries.
+
+The communication control plane's safety argument
+(:mod:`bluefog_tpu.control`, docs/control.md) is that a plan change can
+never corrupt the exact push-sum mass audit BECAUSE it is actuated only
+between rounds — the mixing weights, gossip cadence, and wire codec all
+switch at a quiesce point where nothing of the actuating rank is in
+flight under the old plan.  Mid-round actuation breaks that: a round's
+deposits would split under one fraction and be re-kept under another,
+exactly the torn state BF-RES002 forbids for membership admission.
+
+The rule (AST source lint, the BF-RES002 pattern on the control-plane
+invariant):
+
+- an **actuation site** is a call whose name is actuation-like
+  (``apply_plan``, ``set_comm_every``, ``set_codec``, or any name
+  containing ``actuate``) — the primitives through which a
+  :class:`~bluefog_tpu.control.CommPlan` reaches runtime behavior;
+- any function containing an actuation site must also reference the
+  round-boundary/quiesce vocabulary (``round``/``boundary``, a
+  ``barrier``/``rendezvous`` wait, a ``flush``/``fence``, ``quiesce``,
+  or the ``heal``/``replan`` call that IS the boundary's weight
+  change) — a function that actuates without any of these markers is
+  actuating mid-round;
+- the actuation primitives themselves (a method NAMED ``apply_plan``/
+  ``set_codec``/``set_comm_every``/``*actuate*``) are exempt: the rule
+  is for callers.
+
+**BF-CTL001** (error): an actuation call with no round-boundary/quiesce
+marker in its enclosing function.  **BF-CTL100** (info): scan summary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_actuation_paths", "check_file"]
+
+_ACTUATE_NAMES = ("apply_plan", "set_comm_every", "set_codec")
+_ACTUATE_WORDS = ("actuate",)
+# the same vocabulary BF-RES002 accepts for admission (the two rules
+# protect the same invariant: state changes only between rounds) — but
+# matched as WHOLE snake-case words, the serving-lint discipline:
+# `background` must not pass as "round", `self.health` as "heal", or
+# `flushed_bytes` as "flush"
+_BOUNDARY_RE = re.compile(
+    r"(^|_)(round|boundary|barrier|rendezvous|flush|fence|quiesce|heal|"
+    r"replan)(_|$|\d)")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_actuation(name: str) -> bool:
+    low = name.lower()
+    return low in _ACTUATE_NAMES or any(w in low for w in _ACTUATE_WORDS)
+
+
+def _mentions_boundary(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, ast.Call):
+            ident = _call_name(sub)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ident = sub.name
+        if ident and _BOUNDARY_RE.search(ident.lower()):
+            return True
+    return False
+
+
+def check_actuation_paths(source: str, *, filename: str = "<source>"
+                          ) -> List[Diagnostic]:
+    """BF-CTL001: every controller-actuation call site must carry a
+    round-boundary / quiesce marker in its enclosing function."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-CTL003",
+            f"could not parse {filename}: {e}",
+            pass_name="control-lint", subject=filename)]
+    short = os.path.basename(filename)
+    diags: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _is_actuation(node.name):
+            continue  # the actuation primitive itself, not a caller
+        sites = [sub.lineno for sub in ast.walk(node)
+                 if isinstance(sub, ast.Call)
+                 and _is_actuation(_call_name(sub))]
+        if not sites:
+            continue
+        if _mentions_boundary(node):
+            continue
+        diags.append(Diagnostic(
+            "error", "BF-CTL001",
+            f"controller actuation at {short}:{min(sites)} inside "
+            f"{node.name!r} has no round-boundary/quiesce marker — "
+            "actuating a CommPlan mid-round changes mixing weights/"
+            "cadence/codec under in-flight deposits, the exact torn "
+            "state the mass audit exists to catch; actuate only behind "
+            "a barrier/fence/flush/heal/replan at a round boundary",
+            pass_name="control-lint",
+            subject=f"{short}:{min(sites)}"))
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return [Diagnostic(
+            "warning", "BF-CTL003", f"could not read {path}: {e}",
+            pass_name="control-lint", subject=os.path.basename(path))]
+    return check_actuation_paths(src, filename=path)

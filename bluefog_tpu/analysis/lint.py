@@ -105,6 +105,19 @@ def topology_pass(report: LintReport, size: int) -> None:
         replanned = T.replan(base, members)
         report.extend(check_topology(
             replanned, name=f"replan[n={size},m={m}]"))
+        # the control plane's penalized rebuilds: every plan the
+        # controller can actuate (slow sets up to half the members,
+        # every densify level) must itself verify — the ring spine's
+        # strong-connectivity promise is a checked invariant, not a
+        # comment
+        for densify in (0, 1, 2):
+            for n_slow in (1, max(1, m // 2)):
+                slow = members[:n_slow]
+                penalized = T.replan_penalized(
+                    base, members, slow=slow, densify=densify)
+                report.extend(check_topology(
+                    penalized,
+                    name=f"ctl[m={m},slow={n_slow},densify={densify}]"))
 
 
 def dynamic_pass(report: LintReport, size: int) -> None:
@@ -359,6 +372,36 @@ def resilience_pass(report: LintReport, size: int) -> None:
         pass_name="resilience-lint", subject="runtime"))
 
 
+def control_pass(report: LintReport, size: int) -> None:
+    """BF-CTL source lint over the surfaces that actuate communication
+    plans: the control plane itself, the runtime loops it is wired
+    into, and every example/benchmark that could copy the shape.  A
+    controller actuation outside a round-boundary/quiesce context is an
+    error — see :mod:`bluefog_tpu.analysis.control_lint`."""
+    import glob
+
+    from bluefog_tpu.analysis.control_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "control", "*.py")))
+    targets += sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "runtime", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-CTL100",
+        f"control-lint scanned {n} file(s) for mid-round plan actuation",
+        pass_name="control-lint", subject="control"))
+
+
 def serving_pass(report: LintReport, size: int) -> None:
     """BF-SRV source lint over the surfaces that consume round-stamped
     snapshots: the serving tier itself plus every example/benchmark that
@@ -471,6 +514,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     window_pass(report, size)
     resilience_pass(report, size)
     serving_pass(report, size)
+    control_pass(report, size)
     examples_pass(report, size)
     if trace:
         comm_lint_pass(report, size)

@@ -43,11 +43,13 @@ Spec grammar (``;``-separated rules)::
 
 Socket-rule keys: ``after_frames=N`` (fire once when the site's frame
 counter reaches N), ``every=K`` (every K-th frame), ``prob=P`` (seeded
-coin per frame), ``times=T`` (max firings; 0 = unlimited), ``seed=S``,
-``ms=M`` (delay milliseconds), ``s=S`` (stall seconds).  Rank-rule keys:
-``at_step=N`` (fired from the rank loop's :func:`check_step`),
-``after_s=T`` (armed as a timer by :func:`arm`), ``for_s=T`` (sigstop
-duration / stall length via ``s=``).
+coin per frame), ``rate=P`` (the LOSSY-LINK spelling of the same seeded
+coin: a link that loses ~P of its frames, deterministic per seed —
+``server:drop:rate=0.05`` is a 5%-loss link), ``times=T`` (max firings;
+0 = unlimited), ``seed=S``, ``ms=M`` (delay milliseconds), ``s=S``
+(stall seconds).  Rank-rule keys: ``at_step=N`` (fired from the rank
+loop's :func:`check_step`), ``after_s=T`` (armed as a timer by
+:func:`arm`), ``for_s=T`` (sigstop duration / stall length via ``s=``).
 
 Examples::
 
@@ -55,6 +57,7 @@ Examples::
     ack:drop:after_frames=3            # apply batch 3, drop before ack
     client:truncate:after_frames=5     # send half a frame, then cut
     server:delay:ms=20:prob=0.1:seed=7 # 10% of frames delayed 20 ms
+    server:drop:rate=0.05:seed=3       # a 5%-loss lossy link (seeded)
     read:truncate:every=7              # tear every 7th read reply mid-frame
     sub:stall:s=1:every=13             # stall every 13th snapshot push 1 s
     rank2:sigkill:at_step=8            # rank 2 SIGKILLs itself at step 8

@@ -34,8 +34,10 @@ fault := drop | truncate | delay | stall            (socket sites)
        | sigkill | sigstop | die | stall            (rank sites)
        | leave | join                               (membership churn)
 
-socket keys: after_frames=N  every=K  prob=P  times=T  seed=S
+socket keys: after_frames=N  every=K  prob=P  rate=P  times=T  seed=S
              ms=M (delay)    s=S (stall)
+             (rate= is the lossy-link spelling of prob=: a link that
+             loses ~P of its frames, deterministic per seed)
 rank keys:   at_step=N  after_s=T  for_s=T (sigstop thaw / stall length)
              (leave needs at_step=; join needs after_s=)
 
@@ -48,6 +50,7 @@ examples:
   ack:drop:after_frames=3          apply batch 3, drop before the ack
   client:truncate:after_frames=5   send half a frame, then cut
   server:delay:ms=20:prob=0.1      delay 10%% of frames by 20 ms
+  server:drop:rate=0.05:seed=3     a 5%%-loss lossy link (seeded)
   read:truncate:every=7            tear every 7th read reply mid-frame
   read:stall:s=2:prob=0.05         wedge 5%% of read replies for 2 s
   sub:drop:after_frames=10         cut a push subscription at frame 10

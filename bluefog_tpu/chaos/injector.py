@@ -64,7 +64,7 @@ _RANK_FAULTS = ("sigkill", "sigstop", "die", "stall", "leave", "join")
 _SOCKET_SITES = ("server", "ack", "client", "read", "sub", "any")
 
 _INT_KEYS = ("after_frames", "every", "times", "seed", "at_step")
-_FLOAT_KEYS = ("prob", "ms", "s", "after_s", "for_s")
+_FLOAT_KEYS = ("prob", "rate", "ms", "s", "after_s", "for_s")
 
 
 class ChaosKill(Exception):
@@ -105,6 +105,10 @@ class Rule:
     after_frames: Optional[int] = None
     every: Optional[int] = None
     prob: Optional[float] = None
+    # the LOSSY-LINK trigger: an independent seeded coin per frame, like
+    # ``prob`` but named for what it models — a link that loses ~rate of
+    # its frames, deterministically per seed.  One of prob/rate per rule.
+    rate: Optional[float] = None
     times: Optional[int] = None      # None -> default per trigger kind
     seed: int = 0
     ms: float = 0.0                  # delay milliseconds
@@ -190,8 +194,18 @@ def _parse_rule(text: str, index: int) -> Rule:
             f"rule {text!r}: 'join' schedules when a rank ATTACHES to "
             "the running job and needs after_s= (queried by the elastic "
             "runner via join_times(), not executed as a fault)")
-    if rule.prob is not None and not (0.0 <= rule.prob <= 1.0):
-        raise ChaosSpecError(f"rule {text!r}: prob must be in [0, 1]")
+    if rule.prob is not None and rule.rate is not None:
+        raise ChaosSpecError(
+            f"rule {text!r}: prob= and rate= are the same trigger "
+            "(a seeded per-frame coin); give one, not both")
+    for k in ("prob", "rate"):
+        v = getattr(rule, k)
+        if v is not None and not (0.0 <= v <= 1.0):
+            raise ChaosSpecError(f"rule {text!r}: {k} must be in [0, 1]")
+    if rule.rate is not None and rule.site == "rank":
+        raise ChaosSpecError(
+            f"rule {text!r}: rate= is a socket-site trigger (a lossy "
+            "link); rank faults are scheduled with at_step=/after_s=")
     return rule
 
 
@@ -255,6 +269,9 @@ class Injector:
                     hit = self._counters[i] % max(r.every, 1) == 0
                 elif r.prob is not None:
                     hit = self._rngs[i].random() < r.prob
+                elif r.rate is not None:
+                    # lossy link: an independent seeded coin per frame
+                    hit = self._rngs[i].random() < r.rate
                 if not hit:
                     continue
                 self._record(r, i, **ctx)

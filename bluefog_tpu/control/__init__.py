@@ -1,0 +1,63 @@
+"""Self-tuning communication control plane: telemetry in, behavior out.
+
+Every knob this repo exposes — topology degree, gossip cadence, wire
+compression — was frozen at launch until this package: one injected
+slow peer or lossy link degraded the whole fleet to the worst link's
+pace (the Bluefog premise, arXiv:2111.04287, is the opposite: progress
+THROUGH heterogeneity).  This package closes the loop from the
+observability layers (metrics PR 2, blackbox PR 3, resilience PR 5) to
+runtime behavior:
+
+- :class:`~bluefog_tpu.control.controller.CommController` — a per-rank
+  controller consuming the telemetry the runtime already produces
+  (:class:`~bluefog_tpu.metrics.health.MixingTracker` measured-vs-
+  predicted contraction, consensus disagreement, peer health states,
+  the :class:`~bluefog_tpu.runtime.window_server.DepositStream` ack
+  EWMA + reconnect counters) and emitting a round-stamped
+  :class:`~bluefog_tpu.control.plan.CommPlan`;
+- evidence DISSEMINATION is coordinator-free: barrier-directory
+  ``ctlev.<rank>`` records (the membership pattern) in MP mode, an
+  in-process :class:`~bluefog_tpu.control.evidence.EvidenceBoard` in
+  thread mode, with wire evidence kept fresh between deposits by the
+  heartbeat piggyback;
+- decisions are DETERMINISTIC functions of the disseminated evidence
+  with hysteresis + cooldowns (every rank converges on the same plan —
+  byte-identical, property-tested — and oscillating telemetry cannot
+  flap it);
+- actuation happens ONLY at round boundaries (the BF-CTL001 lint
+  enforces the call-site discipline), so the exact push-sum mass audit
+  holds through every plan change: a plan moves edges, stretches
+  cadence, or retunes the wire codec — it never creates or destroys
+  mass.
+
+The decision table, the dissemination protocol, and the actuation
+contract are documented in ``docs/control.md``; the A/B chaos bench
+(``benchmarks/control_bench.py`` -> ``BENCH_control.json``) shows the
+controller beating the frozen config under injected slow-peer +
+lossy-link scenarios.  Wire the controller into a run with the
+``control=ControlConfig(...)`` argument of
+:func:`~bluefog_tpu.runtime.async_windows.run_async_dsgd` /
+:func:`~bluefog_tpu.runtime.async_windows.run_async_dsgd_rank`.
+"""
+
+from bluefog_tpu.control.controller import (CommController, decide_plan,
+                                            plan_topology)
+from bluefog_tpu.control.evidence import (Evidence, EvidenceBoard,
+                                          canonicalize, clear_evidence,
+                                          read_evidence, write_evidence)
+from bluefog_tpu.control.plan import CODEC_LADDER, CommPlan, ControlConfig
+
+__all__ = [
+    "CODEC_LADDER",
+    "CommController",
+    "CommPlan",
+    "ControlConfig",
+    "Evidence",
+    "EvidenceBoard",
+    "canonicalize",
+    "clear_evidence",
+    "decide_plan",
+    "plan_topology",
+    "read_evidence",
+    "write_evidence",
+]

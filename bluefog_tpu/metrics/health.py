@@ -145,25 +145,65 @@ class MixingTracker:
         if rounds_per_update < 1:
             raise ValueError(
                 f"rounds_per_update must be >= 1, got {rounds_per_update}")
+        self._rounds_per_update = int(rounds_per_update)
         if schedule is not None:
-            per_round = self._predict(schedule)
-            if per_round is not None:
-                self.predicted = per_round ** rounds_per_update
-            reg = _reg.current()
-            if reg is not None and self.predicted is not None:
-                reg.gauge(
-                    "bf_mixing_contraction_predicted",
-                    "|lambda_2(W)|^rounds_per_update — static "
-                    "spectral-gap bound at the feed cadence",
-                ).set(self.predicted, **self.labels)
+            self.rebase(schedule)
+
+    def rebase(self, schedule, *,
+               rounds_per_update: Optional[int] = None) -> Optional[float]:
+        """Re-anchor the prediction to a NEW mixing schedule/matrix — the
+        call every membership or control-plan boundary owes this tracker.
+
+        The prediction is |lambda_2(W)| of the topology in effect; after
+        a ``heal``/``replan``/penalized control rebuild the old matrix's
+        eigenvalue is simply the wrong baseline, and the
+        ``bf_mixing_excess`` alarm would compare measured contraction
+        against a topology that no longer exists (a healed ring looks
+        permanently broken; a densified plan looks spuriously healthy).
+        ``rounds_per_update`` re-anchors the feed-cadence exponent too:
+        a controller that stretches the gossip cadence halves the
+        GOSSIP rounds per feed window, and a prediction still assuming
+        gossip-every-step would read the stretch as a mixing failure.
+        Returns the new predicted contraction (None when the schedule
+        cannot be analyzed — the previous baseline is then kept)."""
+        if rounds_per_update is not None:
+            if rounds_per_update < 1:
+                raise ValueError(
+                    f"rounds_per_update must be >= 1, got "
+                    f"{rounds_per_update}")
+            self._rounds_per_update = int(rounds_per_update)
+        per_round = self._predict(schedule)
+        if per_round is not None:
+            self.predicted = per_round ** self._rounds_per_update
+        reg = _reg.current()
+        if reg is not None and self.predicted is not None:
+            reg.gauge(
+                "bf_mixing_contraction_predicted",
+                "|lambda_2(W)|^rounds_per_update — static "
+                "spectral-gap bound at the feed cadence",
+            ).set(self.predicted, **self.labels)
+        return self.predicted
 
     @staticmethod
     def _predict(schedule) -> Optional[float]:
         try:
             from bluefog_tpu.analysis.topology_check import spectral_gap
 
-            matrix = (schedule.mixing_matrix()
-                      if hasattr(schedule, "mixing_matrix") else schedule)
+            if hasattr(schedule, "mixing_matrix"):
+                matrix = schedule.mixing_matrix()
+            elif hasattr(schedule, "weights"):
+                # a Topology: a healed/replanned one carries inert
+                # identity rows for its inactive ranks, whose eigenvalue
+                # 1 would swamp |lambda_2| — the contraction the live
+                # fleet actually gets is the ACTIVE submatrix's
+                matrix = np.asarray(schedule.weights)
+                inactive = getattr(schedule, "inactive", None)
+                if inactive:
+                    live = [r for r in range(matrix.shape[0])
+                            if r not in inactive]
+                    matrix = matrix[np.ix_(live, live)]
+            else:
+                matrix = schedule
             return float(1.0 - spectral_gap(matrix))
         except Exception:
             return None

@@ -48,6 +48,8 @@ __all__ = [
     "GT_COLLECTIVE_ID_RANGES",
     "CommunicationType",
     "decentralized_optimizer",
+    "set_comm_every",
+    "get_comm_every",
     "DistributedNeighborAllreduceOptimizer",
     "DistributedGradientAllreduceOptimizer",
     "DistributedHierarchicalNeighborAllreduceOptimizer",
@@ -72,6 +74,54 @@ class _DecentralizedState(NamedTuple):
     base_state: Any
     count: jnp.ndarray       # update counter (drives num_steps_per_communication)
     comm_count: jnp.ndarray  # communication-round counter (drives dynamic schedules)
+
+
+class _DecentralizedStateDyn(NamedTuple):
+    """State of ``runtime_cadence=True`` optimizers: the local-SGD gate
+    rides along as a TRACED int32 operand (``comm_every``), so a
+    runtime controller retunes the gossip cadence between steps by
+    rewriting one scalar in the state — zero recompilation, which is
+    what lets a :class:`bluefog_tpu.control.CommPlan`'s cadence land on
+    a jitted SPMD step at a round boundary."""
+
+    base_state: Any
+    count: jnp.ndarray
+    comm_count: jnp.ndarray
+    comm_every: jnp.ndarray  # int32 scalar: gossip every k-th step
+
+
+def set_comm_every(state, k):
+    """Retune a ``runtime_cadence=True`` optimizer's local-SGD gate to
+    ``k`` (gossip every k-th step; 1 = every step).  Returns the updated
+    state — pure data, same pytree structure, so the next jitted
+    ``update`` call reuses the compiled program.  Round-boundary
+    actuation: call between steps, never inside one."""
+    if not isinstance(state, _DecentralizedStateDyn):
+        raise TypeError(
+            "set_comm_every needs a runtime_cadence=True optimizer state "
+            f"(got {type(state).__name__}; pass runtime_cadence=True to "
+            "decentralized_optimizer)")
+    # np.int32 -> a STRONG-typed scalar aval identical to init's, and
+    # device_put onto the OLD leaf's sharding — a retune must never
+    # force the jitted step to re-lower (a fresh uncommitted scalar
+    # where the carried state leaf was replicated over the mesh would)
+    new = jnp.asarray(np.int32(max(int(k), 1)))
+    old = state.comm_every
+    if isinstance(old, jax.Array):
+        try:
+            new = jax.device_put(new, old.sharding)
+        except (AttributeError, ValueError):
+            pass  # abstract/traced state (inside jit): aval match suffices
+    return state._replace(comm_every=new)
+
+
+def get_comm_every(state) -> int:
+    """The current local-SGD gate of a ``runtime_cadence=True`` state."""
+    if not isinstance(state, _DecentralizedStateDyn):
+        raise TypeError(
+            "get_comm_every needs a runtime_cadence=True optimizer state "
+            f"(got {type(state).__name__})")
+    return int(state.comm_every)
 
 
 def _as_schedules(topology) -> Sequence[GossipSchedule]:
@@ -100,6 +150,7 @@ def decentralized_optimizer(
     machine_topology=None,
     backend: str = "auto",
     max_rotations: Optional[int] = None,
+    runtime_cadence: bool = False,
 ) -> optax.GradientTransformation:
     """Wrap ``base`` so each update also performs decentralized averaging.
 
@@ -127,6 +178,15 @@ def decentralized_optimizer(
         full n-1 decomposition; exceeding D active rotations NaN-poisons
         the output (see
         :func:`bluefog_tpu.ops.collectives.neighbor_allreduce_aperiodic`).
+      runtime_cadence: make the local-SGD gate a TRACED runtime operand:
+        the state carries ``comm_every`` (initialized from
+        ``num_steps_per_communication``) and :func:`set_comm_every`
+        retunes it between steps with ZERO recompilation — the hook a
+        runtime communication controller (:mod:`bluefog_tpu.control`)
+        actuates gossip cadence through at round boundaries.  The gate
+        is then always a ``lax.cond`` (even at cadence 1), so the
+        compiled program differs from the static form; gossip-mode
+        communication types only.
 
     Returns an ``optax.GradientTransformation`` whose ``update`` REQUIRES
     ``params``; the returned updates fold the communication in, so plain
@@ -162,8 +222,19 @@ def decentralized_optimizer(
         mscheds = _as_schedules(machine_topology)
         if len(mscheds) != 1:
             raise ValueError("hierarchical mode takes a single machine topology")
+    if runtime_cadence and ct in (CommunicationType.allreduce,
+                                  CommunicationType.empty):
+        raise ValueError(
+            "runtime_cadence applies to the gossip communication types "
+            "(there is no local-SGD gate to retune on "
+            f"{ct.value!r})")
 
     def init_fn(params):
+        if runtime_cadence:
+            return _DecentralizedStateDyn(
+                base.init(params), jnp.zeros((), jnp.int32),
+                jnp.zeros((), jnp.int32),
+                jnp.asarray(max(1, num_steps_per_communication), jnp.int32))
         return _DecentralizedState(
             base.init(params), jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)
         )
@@ -220,7 +291,17 @@ def decentralized_optimizer(
         def local_step(p):
             return optax.apply_updates(p, updates)
 
-        if k <= 1 or ct in (CommunicationType.allreduce, CommunicationType.empty):
+        if runtime_cadence:
+            # the gate is a TRACED operand: (count+1) % comm_every == 0
+            # with comm_every read from the state, so set_comm_every
+            # retunes the cadence between steps without recompiling
+            do_comm = (state.count + 1) % jnp.maximum(
+                state.comm_every, 1) == 0
+            new_params = lax.cond(do_comm, comm_step, local_step, params)
+            new_comm_count = state.comm_count + do_comm.astype(jnp.int32)
+            comm_inc = do_comm.astype(jnp.float32)
+        elif k <= 1 or ct in (CommunicationType.allreduce,
+                              CommunicationType.empty):
             new_params = comm_step(params)
             new_comm_count = state.comm_count + 1
             comm_inc = 1.0
@@ -252,6 +333,9 @@ def decentralized_optimizer(
             new_updates, "optimizer_step", fields={"opt": ct.value},
             traced={"step": state.count.astype(jnp.float32)},
             axis_name=axis_name if isinstance(axis_name, str) else None)
+        if runtime_cadence:
+            return new_updates, _DecentralizedStateDyn(
+                base_state, new_count, new_comm_count, state.comm_every)
         return new_updates, _DecentralizedState(base_state, new_count, new_comm_count)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -271,6 +355,7 @@ def DistributedNeighborAllreduceOptimizer(
     num_steps_per_communication: int = 1,
     backend: str = "auto",
     max_rotations: Optional[int] = None,
+    runtime_cadence: bool = False,
 ) -> optax.GradientTransformation:
     """Reference ``bf.DistributedNeighborAllreduceOptimizer`` (confirmed in
     BASELINE.json): decentralized gossip averaging of parameters each step."""
@@ -279,6 +364,7 @@ def DistributedNeighborAllreduceOptimizer(
         communication_type=CommunicationType.neighbor_allreduce,
         atc=atc, num_steps_per_communication=num_steps_per_communication,
         backend=backend, max_rotations=max_rotations,
+        runtime_cadence=runtime_cadence,
     )
 
 

@@ -59,7 +59,12 @@ import numpy as np
 
 from bluefog_tpu import chaos as _chaos
 from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.control import (CommController as _CommController,
+                                 ControlConfig as _ControlConfig,
+                                 EvidenceBoard as _EvidenceBoard,
+                                 evidence as _ctlev)
 from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.metrics.health import MixingTracker as _MixingTracker
 from bluefog_tpu.runtime import (membership as _mship, native,
                                  resilience as _res)
 from bluefog_tpu.serving import snapshots as _snapshots
@@ -364,6 +369,26 @@ class AsyncWindow:
     def flush(self, timeout_s: Optional[float] = None) -> None:
         """Fence for :meth:`deposit_async` — a no-op here (deposits land
         before the call returns on the in-process/shm transports)."""
+
+    def ack_ewma(self) -> Optional[float]:
+        """Wire-transport parity: in-process/shm deposits have no ack
+        channel, so there is no latency evidence here (always None) —
+        the controller's thread-mode evidence is deposit staleness
+        instead."""
+        return None
+
+    @property
+    def reconnects(self) -> int:
+        """Wire-transport parity: memory never reconnects."""
+        return 0
+
+    def set_codec(self, codec: Optional[str]) -> None:
+        """Wire-transport parity: the in-process/shm path has no wire,
+        so only ``None``/``"none"`` (no compression) is accepted."""
+        if codec not in (None, "none"):
+            raise ValueError(
+                f"in-process/shm windows have no wire codec; cannot set "
+                f"{codec!r}")
 
     def read(self, slot: int, *, consume: bool = True
              ) -> Tuple[np.ndarray, int]:
@@ -838,6 +863,11 @@ class DSGDReport:
     # through the JOINING path at least once
     left_ranks: List[int] = field(default_factory=list)
     joined_ranks: List[int] = field(default_factory=list)
+    # self-tuning control plane (control= runs): the highest-version
+    # CommPlan any rank converged on, and how many plan changes the
+    # reporting rank's controller made (bluefog_tpu.control)
+    control_plan: Optional[object] = None
+    plan_changes: int = 0
 
 
 def run_async_dsgd(
@@ -854,6 +884,8 @@ def run_async_dsgd(
     join_at_s: Optional[Dict[int, Sequence[float]]] = None,
     leave_at_s: Optional[Dict[int, float]] = None,
     snapshot_every: int = 0,
+    control: Optional[_ControlConfig] = None,
+    stop_after_steps: Optional[int] = None,
 ) -> DSGDReport:
     """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
     over the passive-target windows: the execution model of the reference's
@@ -933,6 +965,28 @@ def run_async_dsgd(
         buffered swap under the table lock), so a reader can never
         observe ``x`` and ``p`` from different rounds.  0 (default)
         publishes nothing.
+      control: opt into the SELF-TUNING communication control plane
+        (:mod:`bluefog_tpu.control`).  Each rank-thread runs a
+        :class:`~bluefog_tpu.control.CommController`; evidence (per-
+        peer deposit staleness, health states, local disagreement,
+        measured-vs-predicted mixing) is shared through an in-process
+        :class:`~bluefog_tpu.control.EvidenceBoard` and every rank
+        converges on the same round-stamped
+        :class:`~bluefog_tpu.control.CommPlan` (decisions are
+        deterministic in the disseminated evidence, with hysteresis +
+        cooldowns).  Plans are actuated ONLY at round boundaries:
+        slow peers' edges drop to the ring spine, the graph densifies
+        when measured mixing lags the spectral-gap prediction, and
+        gossip cadence stretches/shrinks.  Enabling the controller
+        hands topology management to the deterministic replan family
+        (``topology`` then defines capacity and rank numbering;
+        windows take one landing slot per capacity rank, as elastic
+        runs do).  The exact mass audit holds through every plan
+        change — a plan moves edges, never mass.
+      stop_after_steps: when set, the run ends (all ranks drain) as
+        soon as ANY rank completes this many steps — the
+        time-to-target mode the control A/B bench measures; otherwise
+        ``duration_s`` alone gates the run.
     """
     n = topology.size
     packer = TreePacker(params0, np.float64)
@@ -966,14 +1020,16 @@ def run_async_dsgd(
         raise ValueError("every rank has a join schedule; at least one "
                          "initial member must seed the warm-start chain")
 
-    # Slot scheme: elastic runs take one landing slot PER CAPACITY RANK
-    # (slot index == source rank) — stable under arbitrary membership
-    # change, which dense in-neighbor slot maps are not (a replanned
-    # graph has edges the original topology had no slot for).  Fixed
-    # fleets keep the dense in-degree sizing: at ~log2(n) slots per
-    # rank it is O(n log n · d) total where capacity slots are
-    # O(n² · d) — a real memory difference when d is model-sized.
-    if elastic:
+    # Slot scheme: elastic AND control-plane runs take one landing slot
+    # PER CAPACITY RANK (slot index == source rank) — stable under
+    # arbitrary membership change and under controller replans, which
+    # dense in-neighbor slot maps are not (a replanned/penalized graph
+    # has edges the original topology had no slot for).  Fixed fleets
+    # keep the dense in-degree sizing: at ~log2(n) slots per rank it is
+    # O(n log n · d) total where capacity slots are O(n² · d) — a real
+    # memory difference when d is model-sized.
+    cap_slots = elastic or control is not None
+    if cap_slots:
         wins = _create_windows(name, [n] * n, d + 1)
         slot_of = None
     else:
@@ -995,9 +1051,16 @@ def run_async_dsgd(
         dead_after_s=(resilience.dead_after_s
                       if resilience is not None else 2.0),
         members=members0 if elastic else None)
-        if (resilience is not None or elastic) else None)
+        if (resilience is not None or elastic or control is not None)
+        else None)
     died = [False] * n
     died_mass = [0.0] * n
+    # self-tuning control plane: the in-process evidence board every
+    # rank's controller publishes to / decides from, and the per-rank
+    # outcomes the report carries
+    ctl_board = _EvidenceBoard() if control is not None else None
+    final_plans: list = [None] * n
+    ctl_changes = [0] * n
 
     # shared membership truth; each rank re-derives its plan from it at
     # round boundaries, so every loop converges on the same replan with
@@ -1042,17 +1105,87 @@ def run_async_dsgd(
         is_member = r in members0
         leave_deadline = leaves.get(r)
 
-        my_slots = (range(n) if elastic else range(len(in_nbrs[r])))
+        my_slots = (range(n) if cap_slots else range(len(in_nbrs[r])))
 
-        def consume(x, p):
+        # ---------------------------------------- control plane (opt-in)
+        ctl = (_CommController(r, n, config=control)
+               if control is not None else None)
+        tracker: Optional[_MixingTracker] = None
+        my_in: List[int] = list(in_nbrs[r])
+        gossip_every = 1
+        # per-peer deposit-staleness clocks: the thread-mode lag signal
+        # (seconds since the peer's last fresh deposit — the in-process
+        # analog of the wire path's ack EWMA)
+        last_fresh: Dict[int, float] = {}
+
+        def consume(x, p, observe: bool = False):
+            dis = None
+            z0 = None
+            if observe and ctl is not None:
+                z0 = x / p
+            now = time.perf_counter()
             for k in my_slots:
-                if elastic and k == r:
+                if cap_slots and k == r:
                     continue
                 buf, fresh = wins[r].read(k, consume=True)
                 if fresh > 0:
+                    if z0 is not None:
+                        if buf[-1] > 0:
+                            dj = float(np.linalg.norm(
+                                buf[:-1] / buf[-1] - z0))
+                            dis = dj if dis is None else max(dis, dj)
+                        last_fresh[k] = now
                     x += buf[:-1]
                     p += buf[-1]
+            if observe and ctl is not None and dis is not None:
+                ctl.note_disagreement(dis)
             return p
+
+        def harvest_evidence_at_round_boundary():
+            """Per-peer observations for this evidence window, sampled
+            once per window (the staleness clocks are instantaneous
+            ages, so sampling them every round would only overwrite the
+            same value at O(n·deg) lock traffic).  Lag evidence covers
+            only CURRENT in-neighbors — a peer whose edges the plan
+            dropped stops accumulating staleness against ranks it no
+            longer feeds (its ring successor keeps reporting, which is
+            what lets hysteresis release it on recovery) — and
+            observations about ranks outside the surface are FORGOTTEN,
+            so a frozen last look at a corpse or a dropped peer cannot
+            be republished forever."""
+            ctl.retain_peers(k for k in my_in if k != r)
+            now = time.perf_counter()
+            states = (board.states() if board is not None else {})
+            for k in my_in:
+                if k == r:
+                    continue
+                ctl.note_peer(
+                    k, lag_s=now - last_fresh.setdefault(k, now),
+                    state=states.get(k))
+
+        def actuate_plan_at_round_boundary(active):
+            """Install the controller's current plan AT THIS ROUND
+            BOUNDARY: in-process deposits are synchronous, so between
+            rounds nothing of this rank's is in flight — the quiesce
+            the plan-change contract requires.  Returns the plan's
+            mixing topology; rebases the mixing tracker so the
+            bf_mixing_excess baseline tracks the topology actually in
+            effect."""
+            nonlocal tracker, gossip_every
+            plan_topo = ctl.apply_plan(topology=topology, members=active)
+            gossip_every = ctl.plan.gossip_every
+            # the feed-window exponent tracks the CADENCE in effect: a
+            # stretched gossip_every halves the gossip rounds per
+            # evidence window, and a prediction still assuming
+            # gossip-every-step would read the stretch as broken mixing
+            rpu = max(1, round(control.evidence_every / gossip_every))
+            if tracker is None:
+                tracker = _MixingTracker(
+                    plan_topo, rounds_per_update=rpu, rank=str(r))
+            else:
+                tracker.rebase(plan_topo, rounds_per_update=rpu)
+            ctl_changes[r] = ctl.plan.version
+            return plan_topo
 
         try:
             x = x0.copy()
@@ -1143,9 +1276,46 @@ def run_async_dsgd(
                                                           _res.JOINING):
                                         board.admit(j)
                             known_active = active
-                            plan = _plan(active)
+                            plan = (actuate_plan_at_round_boundary(active)
+                                    if ctl is not None else _plan(active))
                             my_out = list(plan.out_neighbors(r))
+                            my_in = list(plan.in_neighbors(r))
                             frac = 1.0 / (len(my_out) + 1)
+                        elif (ctl is not None and steps[r] > 0
+                              and steps[r] % control.evidence_every == 0):
+                            # control round boundary: fold this window's
+                            # mixing measurement in, publish evidence,
+                            # decide over the disseminated records, and
+                            # actuate when the plan version advanced
+                            harvest_evidence_at_round_boundary()
+                            d_now = ctl.disagreement
+                            if tracker is not None and d_now is not None:
+                                measured = tracker.update(d_now)
+                                excess = None
+                                if (measured is not None
+                                        and tracker.predicted is not None
+                                        and measured < 1.0):
+                                    # the excess alarm is interpretable
+                                    # only while gossip is actually
+                                    # contracting; at the SGD gradient/
+                                    # gossip equilibrium the growth band
+                                    # governs instead
+                                    excess = measured - tracker.predicted
+                                ctl.note_mixing_excess(excess)
+                            ctl_board.publish(ctl.evidence(steps[r]))
+                            # a corpse's frozen record must not keep
+                            # voting (the MP path filters by tombstones;
+                            # the thread-mode truth is the died[] wills)
+                            evs = [ev for ev in ctl_board.snapshot()
+                                   if not died[ev.rank]]
+                            new_plan = ctl.decide(steps[r], evs)
+                            if new_plan.version != ctl_changes[r]:
+                                ctl_changes[r] = new_plan.version
+                                plan = actuate_plan_at_round_boundary(
+                                    active)
+                                my_out = list(plan.out_neighbors(r))
+                                my_in = list(plan.in_neighbors(r))
+                                frac = 1.0 / (len(my_out) + 1)
                         # per-round blackbox markers: a begin without its
                         # end in a dump names the round the loop wedged in
                         if rec is not None:
@@ -1154,7 +1324,7 @@ def run_async_dsgd(
                                       op="async_dsgd_round",
                                       cid="async_dsgd_round",
                                       step=steps[r], rank=r, peers=my_out)
-                        p = consume(x, p)
+                        p = consume(x, p, observe=True)
                         if elastic:
                             # publish a coherent (x, p) snapshot: what a
                             # JOINING peer warm-starts from
@@ -1170,15 +1340,20 @@ def run_async_dsgd(
                         packer.pack(grads, out=gvec)
                         gvec *= lr * p
                         x -= gvec
-                        payload[:-1] = x
-                        payload[-1] = p
-                        payload *= frac
-                        for j in my_out:
-                            wins[j].deposit(
-                                r if elastic else slot_of[j][r],
-                                payload, accumulate=True)
-                        x *= frac
-                        p *= frac
+                        if ctl is None or steps[r] % gossip_every == 0:
+                            # the plan's local-SGD cadence: on a
+                            # non-gossip step the whole (x, p) stays
+                            # local (no split, no deposits) — mass
+                            # trivially conserved
+                            payload[:-1] = x
+                            payload[-1] = p
+                            payload *= frac
+                            for j in my_out:
+                                wins[j].deposit(
+                                    r if cap_slots else slot_of[j][r],
+                                    payload, accumulate=True)
+                            x *= frac
+                            p *= frac
                         if snapshot_every and steps[r] % snapshot_every == 0:
                             # serve-while-training publish: the post-step
                             # (x, p) pair — z = x/p is invariant to the
@@ -1200,6 +1375,10 @@ def run_async_dsgd(
                             rec.record("optimizer_step", step=steps[r],
                                        rank=r, loss=float(loss))
                         steps[r] += 1
+                        if (stop_after_steps is not None
+                                and steps[r] >= stop_after_steps):
+                            stop.set()  # time-to-target reached
+                            break
                         if skew[r] > 0 or poll_interval_s > 0:
                             time.sleep(skew[r] + poll_interval_s)
                 except _chaos.ChaosLeave:
@@ -1211,6 +1390,8 @@ def run_async_dsgd(
                     p = consume(x, p)
                     finals[r] = x / p
                     wins[r].set_self(np.concatenate([x, [p]]))
+                    if ctl is not None:
+                        final_plans[r] = ctl.plan
                     return
 
                 # -------------------------------------- GRACEFUL DRAIN
@@ -1295,8 +1476,8 @@ def run_async_dsgd(
             # grave mass is its last will, died_mass); everyone else's
             # final set_self is the truth
             total_mass += float(wins[r].read_self()[-1])
-        for k in (range(n) if elastic else range(len(in_nbrs[r]))):
-            if elastic and k == r:
+        for k in (range(n) if cap_slots else range(len(in_nbrs[r]))):
+            if cap_slots and k == r:
                 continue
             buf, fresh = wins[r].read(k, consume=False)
             if fresh > 0:
@@ -1334,6 +1515,12 @@ def run_async_dsgd(
             if board is not None else None),
         left_ranks=sorted(left_final),
         joined_ranks=sorted(ever_joined),
+        # the highest-version plan any rank converged on (deterministic
+        # decisions mean ranks differ only in how far their evidence
+        # view had propagated when the run ended)
+        control_plan=max((pl for pl in final_plans if pl is not None),
+                         key=lambda pl: pl.version, default=None),
+        plan_changes=max(ctl_changes) if control is not None else 0,
     )
     for w in wins:
         w.free()
@@ -1461,6 +1648,31 @@ class _RemoteHandle:
         sync client or when resilience is off)."""
         return getattr(self._rw, "health", None)
 
+    def ack_ewma(self) -> Optional[float]:
+        """Per-peer ack-latency EWMA (seconds) of the underlying
+        pipelined stream — the controller's slow-peer evidence.  None on
+        the sync client or before the first ack."""
+        fn = getattr(self._rw, "ack_ewma", None)
+        return None if fn is None else fn()
+
+    @property
+    def reconnects(self) -> int:
+        """Completed reconnect+replay cycles (lossy-link evidence); 0 on
+        the sync client."""
+        return int(getattr(self._rw, "reconnects", 0))
+
+    def set_codec(self, codec: Optional[str]) -> None:
+        """Round-boundary wire-codec retune (controller actuation); a
+        no-op request for ``None`` on the sync client, an error for a
+        real codec there (the sync wire has no codec negotiation)."""
+        fn = getattr(self._rw, "set_codec", None)
+        if fn is not None:
+            fn(codec)
+        elif codec not in (None, "none"):
+            raise ValueError(
+                "the synchronous window client has no wire codec; "
+                f"cannot set {codec!r}")
+
     def flush(self, timeout_s: Optional[float] = None) -> None:
         """Fence for :meth:`deposit_async` (no-op on the sync client)."""
         fn = getattr(self._rw, "flush", None)
@@ -1493,7 +1705,8 @@ class _TcpTransport:
 
     def __init__(self, bind_host: str = "0.0.0.0", *, pipeline: bool = True,
                  wire_codec: Optional[str] = None,
-                 resilience: Optional[_res.ResilienceConfig] = None):
+                 resilience: Optional[_res.ResilienceConfig] = None,
+                 stream_options: Optional[Dict] = None):
         from bluefog_tpu.runtime.window_server import WindowServer
 
         self._server = WindowServer()
@@ -1501,6 +1714,11 @@ class _TcpTransport:
         self._pipeline = pipeline
         self._codec = wire_codec
         self._resilience = resilience
+        # per-peer DepositStream tuning (max_in_flight / max_queue_items
+        # / timeout_s): a BOUNDED queue is how a deployment opts into
+        # honest backpressure — the producer then feels a slow peer
+        # instead of buffering unboundedly toward it
+        self._stream_options = dict(stream_options or {})
         self._addrs: Dict[int, Tuple[str, int]] = {}
 
     def create(self, wname: str, n_slots: int, n_elems: int) -> AsyncWindow:
@@ -1555,10 +1773,12 @@ class _TcpTransport:
                     # meta/audit reads) retry torn/timed-out replies on
                     # a fresh connection under the same bounded budget —
                     # reader-side faults must not fail a training rank
-                    sync_retry=cfg.backoff_kwargs())
+                    sync_retry=cfg.backoff_kwargs(),
+                    **self._stream_options)
             else:
                 rw = PipelinedRemoteWindow(self._addrs[owner], wname,
-                                           codec=self._codec)
+                                           codec=self._codec,
+                                           **self._stream_options)
         else:
             rw = RemoteWindow(self._addrs[owner], wname)
         return _RemoteHandle(rw, n_slots, n_elems)
@@ -1587,6 +1807,9 @@ def run_async_dsgd_rank(
     leave_after_s: Optional[float] = None,
     initial_members: Optional[Sequence[int]] = None,
     snapshot_every: int = 0,
+    control: Optional[_ControlConfig] = None,
+    stop_after_steps: Optional[int] = None,
+    stream_options: Optional[Dict] = None,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -1674,15 +1897,65 @@ def run_async_dsgd_rank(
     the serve-while-training read path, fully decoupled from the
     training loop (see ``docs/serving.md``).
 
+    ``control`` (tcp transport; every rank of the job must pass the
+    SAME config, like the elastic arguments) opts into the self-tuning
+    communication control plane (:mod:`bluefog_tpu.control`): each
+    process runs a :class:`~bluefog_tpu.control.CommController` fed by
+    its deposit streams' ack-EWMA/heartbeat telemetry, health states,
+    reconnect deltas, and local mixing measurements; evidence records
+    disseminate through ``ctlev.<rank>`` files in the barrier
+    directory (the membership-record pattern), decisions are
+    deterministic in the disseminated records (hysteresis +
+    cooldowns), and plans actuate only at round boundaries — slow or
+    lossy peers' edges drop to the ring spine, cadence
+    stretches/shrinks, the wire codec backs off.  Enabling the
+    controller hands topology management to the deterministic replan
+    family (``topology`` defines capacity/rank numbering; windows take
+    one landing slot per capacity rank), and the exact mass audit
+    holds through every plan change.  A ``control.max_codec_level > 0``
+    requires opening the streams at that ceiling via ``wire_codec=``
+    (lossy — keep 0 whenever the exact audit matters).
+
+    ``stop_after_steps`` ends this rank's loop after that many steps
+    (time-to-target mode; ``duration_s`` stays the hard cap);
+    ``stream_options`` forwards DepositStream tuning
+    (``max_in_flight``/``max_queue_items``) through the tcp transport —
+    a BOUNDED queue is how a deployment opts into honest backpressure
+    instead of buffering unboundedly toward a slow peer.
+
     Returns a :class:`DSGDReport` on rank 0 (``losses`` filled only at index
     ``rank`` — other ranks' loss curves stay in their processes), ``None``
     elsewhere (including joiners and leavers).
     """
+    if control is not None and transport != "tcp":
+        raise ValueError(
+            "the communication control plane rides the tcp transport's "
+            "telemetry (ack EWMA, heartbeats, reconnect counters); "
+            f"transport={transport!r} has none")
+    if control is not None and resilience is None:
+        raise ValueError(
+            "the communication control plane needs "
+            "resilience=ResilienceConfig(...): heartbeats are what keep "
+            "a penalized (idle) stream's ack EWMA fresh — without them "
+            "the lag evidence freezes at its worst value and hysteresis "
+            "could never release a recovered peer")
+    if control is not None and control.max_codec_level > 0:
+        from bluefog_tpu.control import CODEC_LADDER
+
+        if wire_codec != CODEC_LADDER[control.max_codec_level]:
+            raise ValueError(
+                "control.max_codec_level="
+                f"{control.max_codec_level} needs the streams opened at "
+                f"that ceiling: pass wire_codec="
+                f"{CODEC_LADDER[control.max_codec_level]!r} (the "
+                "controller backs OFF from the negotiated ceiling; it "
+                "cannot step above it)")
     if transport == "shm":
         tx = _ShmTransport()
     elif transport == "tcp":
         tx = _TcpTransport(tcp_bind, pipeline=True, wire_codec=wire_codec,
-                           resilience=resilience)
+                           resilience=resilience,
+                           stream_options=stream_options)
     elif transport == "tcp-sync":
         # the pre-pipelining wire shape (one blocking round-trip per
         # deposit) — kept selectable for A/B measurement and bisection
@@ -1713,7 +1986,8 @@ def run_async_dsgd_rank(
         # in-neighbor slot maps are not); fixed fleets keep the dense
         # in-degree sizing, whose memory is O(in_degree · d) per rank
         # instead of O(capacity · d).
-        if join or leave_after_s is not None or initial_members is not None:
+        if (join or leave_after_s is not None or initial_members is not None
+                or control is not None):
             n_slots = topology.size
         else:
             n_slots = max(len(list(topology.in_neighbors(rank))), 1)
@@ -1738,7 +2012,8 @@ def run_async_dsgd_rank(
             resilience=resilience if transport == "tcp" else None,
             join=join, leave_after_s=leave_after_s,
             initial_members=initial_members,
-            snapshot_every=snapshot_every)
+            snapshot_every=snapshot_every, control=control,
+            stop_after_steps=stop_after_steps)
     finally:
         if snapshot_every:
             _snapshots.table().drop(f"{name}:{rank}")
@@ -1754,7 +2029,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         lr, duration_s, skew_s, name, poll_interval_s, win,
                         transport, create_window, open_window,
                         resilience=None, join=False, leave_after_s=None,
-                        initial_members=None, snapshot_every=0):
+                        initial_members=None, snapshot_every=0,
+                        control=None, stop_after_steps=None):
     n = topology.size
     packer = TreePacker(params0, np.float64)
     d = packer.size
@@ -1811,19 +2087,30 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     self_buf = np.empty(d + 1, np.float64)
     peers: Dict[int, object] = {}
 
-    # slot scheme (must agree across every rank of the job): elastic =
-    # slot index == source rank over capacity slots; fixed fleet = the
+    # ------------------------------------------- control plane (opt-in)
+    ctl = (_CommController(rank, n, config=control)
+           if control is not None else None)
+    tracker: Optional[_MixingTracker] = None
+    gossip_every = 1
+    if ctl is not None:
+        _ctlev.clear_evidence(barrier.path, rank)  # previous life's record
+
+    # slot scheme (must agree across every rank of the job, which the
+    # shared arguments guarantee): elastic AND control-plane runs use
+    # slot index == source rank over capacity slots (stable under
+    # membership change and controller replans); fixed fleets keep the
     # dense in-neighbor mapping of the original topology
+    cap_slots = elastic or control is not None
     in_nbrs = list(topology.in_neighbors(rank))
-    my_slots = (range(n) if elastic else range(len(in_nbrs)))
+    my_slots = (range(n) if cap_slots else range(len(in_nbrs)))
 
     def _peer_slots(j: int) -> int:
-        return (n if elastic
+        return (n if cap_slots
                 else max(len(list(topology.in_neighbors(j))), 1))
 
     def _slot_in(j: int) -> int:
         """Our landing slot in peer j's window."""
-        return (rank if elastic
+        return (rank if cap_slots
                 else list(topology.in_neighbors(j)).index(rank))
 
     def _ensure_peer(j: int):
@@ -1833,14 +2120,31 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         return peers[j]
 
     def _make_plan():
-        """The mixing plan over the CURRENT member set: a fresh replan
-        for elastic fleets (re-optimized degree caps and spectral gap as
-        n changes), the PR-5 renormalizing heal for fixed ones.
-        Deterministic in (members, dead), so every rank that has seen
-        the same records converges on the same matrix with no extra
+        """The mixing plan over the CURRENT member set AT THIS ROUND
+        BOUNDARY: the controller's penalized rebuild when the control
+        plane is on (heals and membership change then keep the plan's
+        penalties), a fresh replan for elastic fleets (re-optimized
+        degree caps and spectral gap as n changes), the PR-5
+        renormalizing heal for fixed ones.  Deterministic in (members,
+        dead, CommPlan) — and the CommPlan itself is deterministic in
+        the disseminated evidence — so every rank that has seen the
+        same records converges on the same matrix with no extra
         coordination."""
+        nonlocal tracker, gossip_every
         t0p = time.perf_counter()
-        if elastic:
+        if ctl is not None:
+            plan = ctl.apply_plan(topology=topology, members=members - dead)
+            gossip_every = ctl.plan.gossip_every
+            # feed-window exponent follows the cadence in effect (a
+            # stretched gossip_every halves the gossip rounds per
+            # evidence window — see MixingTracker.rebase)
+            rpu = max(1, round(control.evidence_every / gossip_every))
+            if tracker is None:
+                tracker = _MixingTracker(
+                    plan, rounds_per_update=rpu, rank=str(rank))
+            else:
+                tracker.rebase(plan, rounds_per_update=rpu)
+        elif elastic:
             plan = _replan(topology, members - dead)
         else:
             plan = _heal(topology, dead)
@@ -1853,12 +2157,71 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         nothing is in flight (inside a quiesce-rendezvous)."""
         local = p
         for k in my_slots:
-            if elastic and k == rank:
+            if cap_slots and k == rank:
                 continue
             buf, fresh = win.read(k, consume=False)
             if fresh > 0:
                 local += float(buf[-1])
         return local
+
+    def _ctl_round_boundary() -> None:
+        """Control-plane work at a round boundary: harvest the streams'
+        wire telemetry, publish this rank's evidence record, decide
+        over the disseminated records, and — when the plan version
+        advanced — actuate (new penalized mixing plan, cadence, codec)
+        before the next round's deposits leave.  The quiesce contract:
+        nothing this changes is consulted mid-round, and a plan moves
+        edges/cadence/codec, never mass, so the exact audit holds
+        through it."""
+        nonlocal my_out, frac, gossip_every
+        # a corpse or a leaver is off this rank's observation surface:
+        # forget its sticky observations, or the frozen last look would
+        # be republished in every future record (a dead peer's SUSPECT
+        # state must not keep voting)
+        for j in sorted(dead | left):
+            ctl.forget_peer(j)
+        for j, h in sorted(peers.items()):
+            if j in dead or j in left:
+                continue
+            hp = getattr(h, "health", None)
+            ctl.note_peer(
+                j, lag_s=h.ack_ewma(),
+                state=hp.state if hp is not None else None,
+                reconnects_total=h.reconnects)
+        d_now = ctl.disagreement
+        if tracker is not None and d_now is not None:
+            measured = tracker.update(d_now)
+            excess = None
+            if (measured is not None and tracker.predicted is not None
+                    and measured < 1.0):
+                # interpretable only while gossip is contracting; at
+                # the SGD gradient/gossip equilibrium the growth band
+                # governs instead
+                excess = measured - tracker.predicted
+            ctl.note_mixing_excess(excess)
+        _ctlev.write_evidence(barrier.path, ctl.evidence(steps))
+        prev_version = ctl.plan.version
+        # a corpse's stale record must not keep voting: filter by the
+        # disseminated death view (tombstones), which every rank
+        # converges on — so the filtered record set converges too
+        evs = [ev for ev in _ctlev.read_evidence(barrier.path, n)
+               if ev.rank not in dead]
+        new_plan = ctl.decide(steps, evs)
+        if new_plan.version == prev_version:
+            return
+        plan_topo = _make_plan()  # routes through ctl.apply_plan
+        my_out = list(plan_topo.out_neighbors(rank))
+        frac = 1.0 / (len(my_out) + 1)
+        gossip_every = new_plan.gossip_every
+        if control.max_codec_level > 0:
+            # retune wire aggressiveness within the negotiated ceiling
+            for j, h in sorted(peers.items()):
+                if j in dead:
+                    continue
+                try:
+                    h.set_codec(new_plan.codec)
+                except (RuntimeError, OSError, ValueError):
+                    pass  # a dying handle's codec no longer matters
 
     def _mass_rendezvous(stage: str) -> float:
         """Second half of a quiesce-rendezvous: publish local mass, meet
@@ -2237,7 +2600,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             # every initial member starts with p = 1, so the baseline
             # is exact by construction; admissions re-measure it
             baseline_mass = float(len(members))
-        plan = _make_plan() if elastic else topology
+        plan = (_make_plan() if (elastic or ctl is not None)
+                else topology)
         my_out = list(plan.out_neighbors(rank))
         frac = 1.0 / (len(my_out) + 1)
         for j in my_out:
@@ -2251,7 +2615,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     leave_deadline = leave_after_s
 
     t0 = time.perf_counter()
-    while time.perf_counter() - t0 < duration_s:
+    while (time.perf_counter() - t0 < duration_s
+           and (stop_after_steps is None or steps < stop_after_steps)):
         try:
             _chaos.check_step(rank, steps)
         except _chaos.ChaosLeave:
@@ -2272,17 +2637,27 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                 _heal_and_rebase(newly)
             if elastic and _poll_membership():
                 break  # a member finished: converge at the stop barrier
+        if ctl is not None and steps > 0 \
+                and steps % control.evidence_every == 0:
+            _ctl_round_boundary()
         if rec is not None:
             rec.begin("collective", key=("async_dsgd_mp", rank, steps),
                       op="async_dsgd_round", cid="async_dsgd_round",
                       step=steps, rank=rank, peers=my_out)
+        z_pre = (x / p) if ctl is not None else None
+        dis = None
         for k in my_slots:
-            if elastic and k == rank:
+            if cap_slots and k == rank:
                 continue
             buf, fresh = win.read(k, consume=True)
             if fresh > 0:
+                if z_pre is not None and buf[-1] > 0:
+                    dj = float(np.linalg.norm(buf[:-1] / buf[-1] - z_pre))
+                    dis = dj if dis is None else max(dis, dj)
                 x += buf[:-1]
                 p += buf[-1]
+        if ctl is not None and dis is not None:
+            ctl.note_disagreement(dis)
         if elastic:
             # publish a coherent (x, p) snapshot: what a JOINING peer
             # warm-starts from
@@ -2295,6 +2670,20 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         packer.pack(grads, out=gvec)
         gvec *= lr * p
         x -= gvec
+        if ctl is not None and steps % gossip_every != 0:
+            # the plan's local-SGD cadence: a non-gossip step keeps the
+            # whole (x, p) local — no split, no deposits, mass
+            # trivially conserved
+            if rec is not None:
+                rec.end("collective", key=("async_dsgd_mp", rank, steps),
+                        op="async_dsgd_round", cid="async_dsgd_round",
+                        step=steps, rank=rank)
+                rec.record("optimizer_step", step=steps, rank=rank,
+                           loss=float(loss))
+            steps += 1
+            if skew_s > 0 or poll_interval_s > 0:
+                time.sleep(skew_s + poll_interval_s)
+            continue
         payload[:-1] = x
         payload[-1] = p
         payload *= frac
@@ -2399,7 +2788,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     _wait_resilient("stopped")
     wall = time.perf_counter() - t0
     for k in my_slots:
-        if elastic and k == rank:
+        if cap_slots and k == rank:
             continue
         buf, fresh = win.read(k, consume=True)
         if fresh > 0:
@@ -2447,6 +2836,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             baseline_mass=baseline_mass if exact else None,
             left_ranks=sorted(left),
             joined_ranks=sorted(ever_joined),
+            control_plan=ctl.plan if ctl is not None else None,
+            plan_changes=ctl.plan_changes if ctl is not None else 0,
         )
     # owners unlink only after the audit has read every segment (the
     # caller's finally frees everything this process opened)

@@ -353,6 +353,14 @@ class HealthBoard:
         with self._mu:
             return self._cores[rank].state
 
+    def states(self) -> Dict[int, int]:
+        """One consistent snapshot of every slot's state under a single
+        lock acquisition — what the communication controller's per-round
+        evidence collection reads (N ``state()`` calls would each see a
+        different instant)."""
+        with self._mu:
+            return {r: c.state for r, c in enumerate(self._cores)}
+
     def dead_ranks(self) -> Set[int]:
         """Ranks currently DEAD (REJOINED ranks are NOT in this set —
         the healer re-admits them)."""

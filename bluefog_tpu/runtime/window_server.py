@@ -1668,6 +1668,10 @@ class DepositStream:
         self._peer = f"{address[0]}:{address[1]}"
         self._timeout_s = float(timeout_s)
         self._codec = wire_codec.CODEC_IDS[codec or "none"]
+        # the negotiation ceiling: HELLO requests this codec's feature
+        # bit plus every less aggressive one, so set_codec() can walk
+        # the whole ladder at or below it after connect
+        self._codec_max = self._codec
         self._topk_ratio = float(topk_ratio)
         self._max_in_flight = max(1, int(max_in_flight))
         self._max_queue = max(1, int(max_queue_items))
@@ -1710,6 +1714,17 @@ class DepositStream:
         # bench/observability: recent (send -> ack) latencies in seconds
         self.ack_latencies: collections.deque = collections.deque(
             maxlen=4096)
+        # per-peer ack-latency EWMA — the slow-peer evidence the
+        # communication controller consumes programmatically (a gauge a
+        # decision loop can read without parsing histogram buckets).
+        # Heartbeat RTTs fold into the SAME average, so an idle stream's
+        # evidence does not go stale between deposits (the heartbeat
+        # piggyback half of evidence collection).  Written by the ack
+        # thread, read by the producer thread: a single float store is
+        # atomic under the GIL.
+        self._ack_ewma: Optional[float] = None
+        self._ack_ewma_alpha = 0.2
+        self._reconnects = 0
         self._sock = self._connect_once(self._timeout_s)
         self._sender = threading.Thread(
             target=self._send_loop, daemon=True,
@@ -1729,7 +1744,15 @@ class DepositStream:
         sock = socket.create_connection(self._addr, timeout=timeout_s)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            want = FEATURE_BATCH | _CODEC_FEATURE[self._codec]
+            # request the CEILING codec's feature bit and every rung
+            # below it, so a mid-run set_codec() step-down (and back up
+            # to the ceiling) never needs a renegotiation — and the
+            # want is stable across reconnects regardless of the codec
+            # in effect when the connection died
+            want = FEATURE_BATCH
+            for cid, bit in _CODEC_FEATURE.items():
+                if cid <= self._codec_max:
+                    want |= bit
             if self._resume:
                 want |= FEATURE_RESUME
             if self._hb_interval > 0:
@@ -1867,6 +1890,7 @@ class DepositStream:
                 self._conn_broken = False
                 self._cv.notify_all()
             self._hb_last = time.monotonic()
+            self._reconnects += 1
             _mt.inc("bf_reconnects_total", 1.0, peer=self._peer)
             if replayed:
                 _mt.inc("bf_replayed_batches_total", float(replayed),
@@ -1919,6 +1943,49 @@ class DepositStream:
         if self._err is not None:
             raise RuntimeError(
                 f"pipelined deposits to {self._peer} failed: {self._err}")
+
+    # ------------------------------------------------------ wire telemetry
+    def _note_latency(self, seconds: float) -> None:
+        prev = self._ack_ewma
+        a = self._ack_ewma_alpha
+        ewma = seconds if prev is None else (a * seconds + (1.0 - a) * prev)
+        self._ack_ewma = ewma
+        _mt.set("bf_peer_ack_ewma_seconds", ewma, peer=self._peer)
+
+    def ack_ewma(self) -> Optional[float]:
+        """EWMA (seconds) over this peer's deposit-ack latencies and
+        heartbeat RTTs — the programmatic slow-peer signal (the gauge
+        twin is ``bf_peer_ack_ewma_seconds{peer=}``).  None until the
+        first ack/heartbeat reply arrives."""
+        return self._ack_ewma
+
+    @property
+    def reconnects(self) -> int:
+        """Completed reconnect+replay cycles on this stream (the
+        programmatic twin of ``bf_reconnects_total{peer=}`` — lossy-link
+        evidence for the communication controller)."""
+        return self._reconnects
+
+    def set_codec(self, codec: Optional[str]) -> None:
+        """Retune wire-compression aggressiveness at a ROUND BOUNDARY:
+        subsequent :meth:`deposit_async` calls encode with ``codec``
+        (``None``/``"none"``, ``"f32"``, ``"topk"``).  The stream
+        negotiates feature bits for its CONSTRUCTION codec and every
+        less aggressive one at HELLO, so the controller can step
+        anywhere at or below that ceiling — but never above it (the
+        server was never asked for the capability; open the stream with
+        the most aggressive codec the run may ever use and back OFF
+        from there).  Call from the producer thread only (the same
+        thread that deposits), so no in-flight item changes encoding
+        under its ack."""
+        want = wire_codec.CODEC_IDS[codec or "none"]
+        if want > self._codec_max:
+            raise ValueError(
+                f"codec {codec!r} exceeds the ceiling negotiated at "
+                f"connect ({wire_codec.CODEC_NAMES[self._codec_max]!r}); "
+                "open the stream with the most aggressive codec the run "
+                "may ever use — the controller backs OFF from there")
+        self._codec = want
 
     def deposit_async(self, name: bytes, slot: int, arr: np.ndarray, *,
                       accumulate: bool = True, copy: bool = True,
@@ -2168,8 +2235,10 @@ class DepositStream:
             if seq & _HB_MARK:
                 t0 = self._hb_sent.pop(seq & ~_HB_MARK, None)
                 if t0 is not None:
+                    rtt = time.perf_counter() - t0
                     _mt.observe("bf_peer_heartbeat_rtt_seconds",
-                                time.perf_counter() - t0, peer=self._peer)
+                                rtt, peer=self._peer)
+                    self._note_latency(rtt)
                 if self.health is not None:
                     self.health.note_ok()
                 continue
@@ -2183,6 +2252,7 @@ class DepositStream:
             if entry is not None:
                 lat = time.perf_counter() - entry[0]
                 self.ack_latencies.append(lat)
+                self._note_latency(lat)
                 _mt.observe("bf_tcp_ack_latency_seconds", lat,
                             peer=self._peer)
                 _mt.set("bf_tcp_inflight_batches",
@@ -2236,6 +2306,7 @@ class PipelinedRemoteWindow:
                  topk_ratio: Optional[float] = None,
                  max_in_flight: Optional[int] = None,
                  max_queue_items: Optional[int] = None,
+                 max_batch_bytes: Optional[int] = None,
                  reconnect=None,
                  heartbeat_interval_s: Optional[float] = None,
                  suspect_after_s: Optional[float] = None,
@@ -2250,7 +2321,8 @@ class PipelinedRemoteWindow:
         self._name_b = name.encode()
         if stream is not None and any(
                 v is not None for v in (codec, topk_ratio, max_in_flight,
-                                        max_queue_items, reconnect,
+                                        max_queue_items, max_batch_bytes,
+                                        reconnect,
                                         heartbeat_interval_s,
                                         suspect_after_s, dead_after_s)):
             # a shared stream carries ITS configuration; accepting these
@@ -2258,7 +2330,7 @@ class PipelinedRemoteWindow:
             # riding an uncompressed stream)
             raise ValueError(
                 "stream= is mutually exclusive with codec/topk_ratio/"
-                "max_in_flight/max_queue_items/reconnect/"
+                "max_in_flight/max_queue_items/max_batch_bytes/reconnect/"
                 "heartbeat_interval_s/suspect_after_s/dead_after_s — "
                 "configure the shared DepositStream itself")
         self._sync = RemoteWindow(address, name, timeout_s,
@@ -2274,6 +2346,8 @@ class PipelinedRemoteWindow:
                 max_in_flight=4 if max_in_flight is None else max_in_flight,
                 max_queue_items=(1024 if max_queue_items is None
                                  else max_queue_items),
+                max_batch_bytes=(16 << 20 if max_batch_bytes is None
+                                 else max_batch_bytes),
                 reconnect=reconnect,
                 heartbeat_interval_s=(0.0 if heartbeat_interval_s is None
                                       else heartbeat_interval_s),
@@ -2296,6 +2370,21 @@ class PipelinedRemoteWindow:
     @property
     def ack_latencies(self):
         return self.stream.ack_latencies
+
+    def ack_ewma(self) -> Optional[float]:
+        """The stream's per-peer ack-latency EWMA (seconds; None before
+        the first ack) — see :meth:`DepositStream.ack_ewma`."""
+        return self.stream.ack_ewma()
+
+    @property
+    def reconnects(self) -> int:
+        """Completed reconnect+replay cycles on the underlying stream."""
+        return self.stream.reconnects
+
+    def set_codec(self, codec: Optional[str]) -> None:
+        """Retune the stream's wire-codec aggressiveness (round-boundary
+        actuation; see :meth:`DepositStream.set_codec`)."""
+        self.stream.set_codec(codec)
 
     def deposit_async(self, slot: int, arr: np.ndarray, *,
                       accumulate: bool = True, copy: bool = True,

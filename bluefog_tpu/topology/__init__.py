@@ -23,6 +23,7 @@ from bluefog_tpu.topology.graphs import (
     GetSendWeights,
     heal,
     replan,
+    replan_penalized,
 )
 from bluefog_tpu.topology.dynamic import (
     GetDynamicOnePeerSendRecvRanks,

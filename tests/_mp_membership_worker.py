@@ -29,6 +29,12 @@ import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# the joiner's warm-start audit reads the join_warmstart event out of
+# the flight-recorder ring AFTER the whole run: at the default 4096
+# capacity a long run's gossip traffic (deposit/read/round events every
+# step) evicts the startup-time event and the audit flakes under load —
+# give the ring enough headroom to hold the full run
+os.environ.setdefault("BLUEFOG_TPU_BLACKBOX_CAPACITY", "65536")
 
 import numpy as np
 

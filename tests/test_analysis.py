@@ -9,6 +9,7 @@ identified as silent at runtime: overlapping collective-id leases
 ppermute (deadlock / double-delivery on a real mesh).
 """
 
+import os
 import subprocess
 import sys
 
@@ -749,6 +750,104 @@ class TestAdmissionLint:
             "    return x + 1\n"
         )
         assert not check_admission_paths(src, filename="plain.py")
+
+
+# ---------------------------------------------------------------------------
+# BF-CTL: controller actuation only at round boundaries
+# ---------------------------------------------------------------------------
+
+
+class TestControlLint:
+    """BF-CTL001: a CommPlan actuation (apply_plan / set_comm_every /
+    set_codec / *actuate*) outside a round-boundary/quiesce context is
+    an error — the BF-RES002 invariant on the control plane."""
+
+    def test_seeded_violation_midround_actuation(self):
+        from bluefog_tpu.analysis.control_lint import check_actuation_paths
+
+        src = (
+            "def retune(ctl, topo, members):\n"
+            "    topo2 = ctl.apply_plan(topology=topo, members=members)\n"
+            "    return topo2\n"
+        )
+        diags = check_actuation_paths(src, filename="seeded.py")
+        assert any(d.code == "BF-CTL001" and d.severity == "error"
+                   for d in diags), [d.format() for d in diags]
+
+    def test_seeded_violation_midround_codec_and_cadence(self):
+        from bluefog_tpu.analysis.control_lint import check_actuation_paths
+
+        for call in ("stream.set_codec('f32')",
+                     "set_comm_every(state, 4)"):
+            src = f"def tune(stream, state):\n    {call}\n"
+            diags = check_actuation_paths(src, filename="seeded2.py")
+            assert any(d.code == "BF-CTL001" for d in diags), call
+
+    def test_boundary_vocabulary_is_clean(self):
+        from bluefog_tpu.analysis.control_lint import check_actuation_paths
+
+        src = (
+            "def actuate_at_round_boundary(ctl, topo, members, peers):\n"
+            "    for h in peers:\n"
+            "        h.flush()\n"
+            "    return ctl.apply_plan(topology=topo, members=members)\n"
+        )
+        assert not check_actuation_paths(src, filename="clean.py")
+
+    def test_boundary_vocabulary_matches_whole_words_only(self):
+        # `background` must not pass as "round", `self.health` as
+        # "heal", `flushed_bytes` as "flush" — the serving-lint
+        # whole-word discipline applies here too
+        from bluefog_tpu.analysis.control_lint import check_actuation_paths
+
+        src = (
+            "def tune(ctl, topo, members, background, flushed_bytes):\n"
+            "    if ctl.health and background:\n"
+            "        return ctl.apply_plan(topology=topo,\n"
+            "                              members=members)\n"
+        )
+        diags = check_actuation_paths(src, filename="sneaky.py")
+        assert any(d.code == "BF-CTL001" for d in diags), \
+            [d.format() for d in diags]
+        # while real snake-case markers still pass
+        src_ok = (
+            "def tune_at_round_boundary(ctl, topo, members):\n"
+            "    return ctl.apply_plan(topology=topo, members=members)\n"
+        )
+        assert not check_actuation_paths(src_ok, filename="ok.py")
+
+    def test_actuation_primitive_itself_is_exempt(self):
+        from bluefog_tpu.analysis.control_lint import check_actuation_paths
+
+        src = (
+            "class CommController:\n"
+            "    def apply_plan(self, *, topology, members):\n"
+            "        return plan_topology(topology, members, self.plan)\n"
+        )
+        assert not check_actuation_paths(src, filename="prim.py")
+
+    def test_functions_without_actuation_ignored(self):
+        from bluefog_tpu.analysis.control_lint import check_actuation_paths
+
+        assert not check_actuation_paths(
+            "def plain(x):\n    return x + 1\n", filename="plain.py")
+
+    def test_repo_control_surfaces_clean(self):
+        """The sweep's own targets — the control package and the
+        runtime loops it is wired into — carry no BF-CTL001."""
+        import glob
+
+        from bluefog_tpu.analysis.control_lint import check_file
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        targets = sorted(glob.glob(os.path.join(
+            root, "bluefog_tpu", "control", "*.py")))
+        targets += sorted(glob.glob(os.path.join(
+            root, "bluefog_tpu", "runtime", "*.py")))
+        assert targets
+        errs = [d for p in targets for d in check_file(p)
+                if d.severity == "error"]
+        assert not errs, [d.format() for d in errs]
 
 
 # ---------------------------------------------------------------------------

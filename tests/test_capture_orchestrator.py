@@ -43,6 +43,7 @@ def test_run_stage_failure_and_stderr_tail(cap, capsys):
     assert "partial" in line["tail"]
 
 
+@pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_run_stage_timeout_keeps_partial_output(cap, capsys):
     # the flat cost IS the timeout; it must still comfortably exceed
     # interpreter startup on a loaded box or the child never prints

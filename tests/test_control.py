@@ -1,0 +1,671 @@
+"""Self-tuning communication control plane (bluefog_tpu.control).
+
+1. plan/evidence — canonical byte encodings, clamping, json round
+   trips (NaN included), newest-round-wins canonicalization, torn/
+   missing barrier-dir records tolerated;
+2. convergence — the PROPERTY the coordinator-free design rests on:
+   N independent controllers fed the same disseminated records (in any
+   order) produce byte-identical CommPlans, over seeded random
+   evidence;
+3. no-flap — hysteresis holds the plan steady under telemetry
+   oscillating around a single threshold, and cooldowns bound the
+   change rate under genuinely oscillating regimes;
+4. decision table — slow-set enter/exit (lag ratio, reconnect deltas,
+   the max_slow_frac cap), densify ladder, codec backoff, cadence
+   band;
+5. penalized replan — determinism, ring-spine strong connectivity,
+   degree reduction, composition/memorylessness, provenance-name
+   collapse;
+6. wire telemetry — DepositStream ack EWMA accessor + reconnect
+   counter + codec-ceiling discipline against a live WindowServer;
+7. integration — thread-mode run_async_dsgd(control=...) with one
+   deliberately slow rank: the fleet converges on a plan that drops
+   the slow rank's edges and the EXACT mass audit holds through every
+   plan change; a slow-marked MP tcp scenario does the same under a
+   chaos lossy link (tests/_mp_control_worker.py).
+
+Everything deterministic: seeded RNGs, counter triggers, pure decision
+functions.
+"""
+
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests._util import REPO as _REPO, clean_env, uniq as _uniq
+
+from bluefog_tpu.control import (CODEC_LADDER, CommController, CommPlan,
+                                 ControlConfig, Evidence, EvidenceBoard,
+                                 canonicalize, decide_plan, plan_topology,
+                                 read_evidence, write_evidence)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolated():
+    from bluefog_tpu import chaos
+
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# 1. plan / evidence encodings
+# ---------------------------------------------------------------------------
+
+
+class TestCommPlan:
+    def test_bytes_roundtrip(self):
+        p = CommPlan(version=3, round=40, slow=(5, 1), densify=1,
+                     gossip_every=2, codec_level=1)
+        q = CommPlan.from_bytes(p.to_bytes())
+        assert q == p
+        assert q.slow == (1, 5)  # normalized sorted
+
+    def test_canonical_bytes_are_key_sorted_json(self):
+        blob = CommPlan(version=1, round=2).to_bytes()
+        d = json.loads(blob)
+        assert list(d) == sorted(d)
+
+    def test_clamping(self):
+        p = CommPlan(densify=99, gossip_every=0, codec_level=99)
+        assert p.densify == 2
+        assert p.gossip_every == 1
+        assert p.codec_level == len(CODEC_LADDER) - 1
+
+    def test_codec_property(self):
+        assert CommPlan(codec_level=0).codec is None
+        assert CommPlan(codec_level=1).codec == "f32"
+        assert CommPlan(codec_level=2).codec == "topk"
+
+
+class TestControlConfig:
+    def test_hysteresis_bands_enforced(self):
+        with pytest.raises(ValueError, match="slow_exit < slow_enter"):
+            ControlConfig(slow_enter=2.0, slow_exit=2.0)
+        with pytest.raises(ValueError, match="densify"):
+            ControlConfig(densify_enter=0.01, densify_exit=0.02)
+        with pytest.raises(ValueError, match="grow_lo < grow_hi"):
+            ControlConfig(grow_hi=0.5, grow_lo=0.9)
+        with pytest.raises(ValueError, match="cooldown"):
+            ControlConfig(cooldown_rounds=0)
+        with pytest.raises(ValueError, match="max_codec_level"):
+            ControlConfig(max_codec_level=7)
+
+
+class TestEvidence:
+    def test_json_roundtrip_including_nan(self):
+        ev = Evidence(rank=2, round=17, lag_s={3: 0.25, 1: 0.001},
+                      states={3: 1}, reconnects={3: 2},
+                      mixing_excess=float("nan"),
+                      consensus_growth=1.25)
+        back = Evidence.from_json(ev.to_json())
+        assert back.rank == 2 and back.round == 17
+        assert back.lag_s == {3: 0.25, 1: 0.001}
+        assert back.reconnects == {3: 2}
+        assert math.isnan(back.mixing_excess)
+        assert back.consensus_growth == 1.25
+        # canonical: two encodings of the same record are identical
+        assert back.to_json() == ev.to_json()
+
+    def test_canonicalize_newest_round_per_rank_sorted(self):
+        evs = [Evidence(rank=1, round=5), Evidence(rank=0, round=9),
+               Evidence(rank=1, round=8), Evidence(rank=2, round=1)]
+        out = canonicalize(evs)
+        assert [e.rank for e in out] == [0, 1, 2]
+        assert out[1].round == 8
+
+    def test_records_roundtrip_and_torn_tolerated(self, tmp_path):
+        d = str(tmp_path)
+        write_evidence(d, Evidence(rank=0, round=3, lag_s={1: 0.1}))
+        write_evidence(d, Evidence(rank=2, round=4))
+        # a torn/garbage record and a missing one must both be skipped
+        with open(os.path.join(d, "ctlev.1"), "w") as f:
+            f.write('{"rank": 1, "rou')
+        out = read_evidence(d, 4)
+        assert sorted(e.rank for e in out) == [0, 2]
+        assert out[0].lag_s == {1: 0.1}
+
+    def test_board_newest_round_wins(self):
+        b = EvidenceBoard()
+        b.publish(Evidence(rank=1, round=8, lag_s={0: 0.5}))
+        b.publish(Evidence(rank=1, round=4, lag_s={0: 0.1}))
+        (ev,) = b.snapshot()
+        assert ev.round == 8 and ev.lag_s == {0: 0.5}
+
+    def test_state_constants_match_resilience(self):
+        # control is an import-leaf package, so it spells the two
+        # health states it consumes locally — pin them to the canonical
+        # values
+        from bluefog_tpu.control import controller as C
+        from bluefog_tpu.runtime import resilience as res
+
+        assert C._ST_SUSPECT == res.SUSPECT
+        assert C._ST_DEAD == res.DEAD
+
+
+# ---------------------------------------------------------------------------
+# 2. plan convergence (the coordinator-free property)
+# ---------------------------------------------------------------------------
+
+
+def _random_evidence(rng, n, round_):
+    evs = []
+    for r in range(n):
+        lag = {j: rng.choice([0.001, 0.003, 0.05, 0.4])
+               for j in range(n) if j != r and rng.random() < 0.8}
+        rec = {j: rng.choice([0, 0, 0, 1, 3])
+               for j in lag if rng.random() < 0.3}
+        evs.append(Evidence(
+            rank=r, round=round_ + rng.randrange(3), lag_s=lag,
+            states={j: rng.choice([0, 0, 1]) for j in lag},
+            reconnects=rec,
+            mixing_excess=rng.choice([float("nan"), -0.05, 0.3]),
+            consensus_growth=rng.choice([float("nan"), 0.5, 0.9, 1.3])))
+    return evs
+
+
+class TestPlanConvergence:
+    def test_same_records_byte_identical_plans(self):
+        """N ranks, same disseminated records (any order) -> the SAME
+        CommPlan, byte for byte — over 30 seeded random evidence
+        multisets and three decision generations each."""
+        n = 8
+        cfg = ControlConfig(cooldown_rounds=2, min_lag_s=0.002,
+                            max_codec_level=2)
+        for trial in range(30):
+            rng = random.Random(1000 + trial)
+            ctls = [CommController(r, n, config=cfg) for r in range(n)]
+            for gen in range(3):
+                rnd = 10 + gen * 10
+                evs = _random_evidence(rng, n, rnd)
+                blobs = set()
+                for c in ctls:
+                    shuffled = list(evs)
+                    rng2 = random.Random(trial * 100 + c.rank)
+                    rng2.shuffle(shuffled)
+                    blobs.add(c.decide(rnd, shuffled).to_bytes())
+                assert len(blobs) == 1, (trial, gen, blobs)
+
+    def test_decide_plan_is_pure(self):
+        rng = random.Random(7)
+        evs = _random_evidence(rng, 4, 10)
+        cfg = ControlConfig()
+        prev = CommPlan()
+        a = decide_plan(prev, 10, evs, cfg)
+        b = decide_plan(prev, 10, tuple(reversed(evs)), cfg)
+        assert a.to_bytes() == b.to_bytes()
+
+
+# ---------------------------------------------------------------------------
+# 3. no-flap: hysteresis + cooldown
+# ---------------------------------------------------------------------------
+
+
+def _lag_evidence(n, round_, lag_of_3):
+    return [Evidence(rank=r, round=round_,
+                     lag_s={3: lag_of_3, (r + 1) % n: 0.01})
+            for r in range(n) if r != 3]
+
+
+class TestNoFlap:
+    def test_oscillation_inside_band_never_flaps(self):
+        """Telemetry oscillating INSIDE the hysteresis band (above exit,
+        below enter) holds the plan at its current state forever —
+        both before the peer ever entered and after it entered."""
+        n, cfg = 4, ControlConfig(cooldown_rounds=1, min_lag_s=0.001,
+                                  slow_enter=4.0, slow_exit=2.0)
+        c = CommController(0, n, config=cfg)
+        # fleet median ~0.01 -> enter at 0.04, exit at 0.02
+        for k in range(40):  # oscillate in (exit, enter): no entry ever
+            c.decide(k, _lag_evidence(n, k, 0.025 + 0.01 * (k % 2)))
+        assert c.plan.version == 0, c.plan
+        # drive it IN (above enter), then oscillate inside the band:
+        # it entered once and STAYS in — no release, no re-entry churn
+        c.decide(50, _lag_evidence(n, 50, 0.5))
+        assert c.plan.slow == (3,) and c.plan.version == 1
+        for k in range(51, 90):
+            c.decide(k, _lag_evidence(n, k, 0.025 + 0.01 * (k % 2)))
+        assert c.plan.slow == (3,)
+        assert c.plan.version == 1, "plan flapped inside the band"
+
+    def test_cooldown_bounds_change_rate(self):
+        """Even telemetry oscillating ACROSS both bands cannot change
+        the plan more often than once per cooldown window."""
+        n, cool = 4, 8
+        cfg = ControlConfig(cooldown_rounds=cool, min_lag_s=0.001)
+        c = CommController(0, n, config=cfg)
+        for k in range(80):
+            lag = 0.5 if (k // 2) % 2 == 0 else 0.001  # wild swings
+            c.decide(k, _lag_evidence(n, k, lag))
+        assert c.plan_changes <= 80 // cool + 1, c.plan_changes
+
+    def test_cooldown_refuses_early_change(self):
+        cfg = ControlConfig(cooldown_rounds=16, min_lag_s=0.001)
+        c = CommController(0, 4, config=cfg)
+        p1 = c.decide(10, _lag_evidence(4, 10, 0.5))
+        assert p1.version == 1
+        p2 = c.decide(12, _lag_evidence(4, 12, 0.001))  # inside cooldown
+        assert p2 is p1
+        p3 = c.decide(10 + 16, _lag_evidence(4, 26, 0.001))
+        assert p3.version == 2 and p3.slow == ()
+
+
+# ---------------------------------------------------------------------------
+# 4. decision table
+# ---------------------------------------------------------------------------
+
+
+class TestDecisionTable:
+    CFG = ControlConfig(cooldown_rounds=1, min_lag_s=0.001,
+                        max_codec_level=2)
+
+    def test_slow_enter_by_lag_ratio_median_of_reporters(self):
+        plan = decide_plan(CommPlan(), 10,
+                           _lag_evidence(4, 10, 0.5), self.CFG)
+        assert plan.slow == (3,)
+
+    def test_one_confused_reporter_cannot_convict(self):
+        evs = [Evidence(rank=0, round=10, lag_s={3: 9.0, 1: 0.01}),
+               Evidence(rank=1, round=10, lag_s={3: 0.01, 2: 0.01}),
+               Evidence(rank=2, round=10, lag_s={3: 0.01, 0: 0.01})]
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.slow == ()  # median over reporters is healthy
+
+    def test_slow_enter_by_reconnect_delta(self):
+        evs = [Evidence(rank=r, round=10, lag_s={(r + 1) % 4: 0.01},
+                        reconnects={3: 1}) for r in range(3)]
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.slow == (3,)
+
+    def test_release_requires_clean_lag_and_no_reconnects(self):
+        prev = CommPlan(version=1, round=0, slow=(3,))
+        # still reconnecting -> held
+        evs = [Evidence(rank=r, round=10, lag_s={3: 0.001, 1: 0.01},
+                        reconnects={3: 1}) for r in (0, 2)]
+        plan = decide_plan(prev, 10, evs, self.CFG)
+        assert plan.slow == (3,)
+        # clean lag below exit AND quiet wire -> released
+        evs = [Evidence(rank=r, round=20, lag_s={3: 0.001, 1: 0.01})
+               for r in (0, 2)]
+        plan = decide_plan(prev, 20, evs, self.CFG)
+        assert plan.slow == ()
+
+    def test_max_slow_frac_cap_prefers_worst(self):
+        # three peers far above the healthy fleet median, but the cap
+        # only lets the worst two of the eight LIVE reporters be
+        # penalized (the reporter count is the live-fleet proxy —
+        # capacity would let a shrunk elastic fleet be penalized
+        # wholesale)
+        lags = {1: 0.5, 2: 0.9, 3: 0.7, 4: 0.001,
+                5: 0.001, 6: 0.001, 7: 0.001}
+        evs = [Evidence(rank=r, round=10,
+                        lag_s={j: v for j, v in lags.items() if j != r})
+               for r in range(8)]
+        plan = decide_plan(CommPlan(), 10, evs,
+                           ControlConfig(cooldown_rounds=1,
+                                         min_lag_s=0.001,
+                                         max_slow_frac=0.25))
+        assert plan.slow == (2, 3)  # worst two of eight (cap = 2)
+        # a lone reporter may still penalize ONE peer (cap floors at 1)
+        plan1 = decide_plan(CommPlan(), 10, [evs[0]],
+                            ControlConfig(cooldown_rounds=1,
+                                          min_lag_s=0.001,
+                                          max_slow_frac=0.25))
+        assert plan1.slow == (2,)
+
+    def test_slow_enter_by_majority_suspicion(self):
+        # a wedged peer can have an unremarkable ack EWMA (the last ack
+        # before the wedge was fast): a MAJORITY of reporters holding it
+        # SUSPECT/DEAD is entry evidence in its own right
+        evs = [Evidence(rank=r, round=10, lag_s={(r + 1) % 4: 0.01},
+                        states={3: 1}) for r in range(3)]
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.slow == (3,)
+        # a single suspicious reporter among three is not a majority
+        evs = [Evidence(rank=0, round=10, lag_s={1: 0.01}, states={3: 1}),
+               Evidence(rank=1, round=10, lag_s={2: 0.01}),
+               Evidence(rank=2, round=10, lag_s={0: 0.01})]
+        assert decide_plan(CommPlan(), 10, evs, self.CFG).slow == ()
+
+    def test_any_suspicion_holds_a_penalized_peer(self):
+        prev = CommPlan(version=1, round=0, slow=(3,))
+        evs = [Evidence(rank=r, round=20, lag_s={3: 0.001, 1: 0.01},
+                        states={3: 1} if r == 0 else {})
+               for r in (0, 2)]
+        plan = decide_plan(prev, 20, evs, self.CFG)
+        assert plan.slow == (3,)  # one suspicious reporter holds it in
+
+    def test_densify_ladder_up_and_down(self):
+        cfg = self.CFG
+        evs = [Evidence(rank=0, round=10, lag_s={1: 0.01},
+                        mixing_excess=0.5)]
+        p1 = decide_plan(CommPlan(), 10, evs, cfg)
+        assert p1.densify == 1
+        evs = [Evidence(rank=0, round=20, lag_s={1: 0.01},
+                        mixing_excess=0.0)]
+        p2 = decide_plan(p1, 20, evs, cfg)
+        assert p2.densify == 0
+
+    def test_codec_backs_off_when_consensus_grows(self):
+        prev = CommPlan(version=1, round=0, codec_level=2)
+        evs = [Evidence(rank=0, round=10, lag_s={1: 0.01},
+                        consensus_growth=1.5)]
+        plan = decide_plan(prev, 10, evs, self.CFG)
+        assert plan.codec_level == 1
+        assert plan.gossip_every == 1
+
+    def test_codec_rearms_toward_ceiling_when_contracting(self):
+        prev = CommPlan(version=1, round=0, codec_level=0)
+        evs = [Evidence(rank=0, round=10, lag_s={1: 0.01},
+                        consensus_growth=0.5)]
+        plan = decide_plan(prev, 10, evs, self.CFG)
+        assert plan.codec_level == 1
+
+    def test_codec_never_exceeds_config_ceiling(self):
+        cfg = ControlConfig(cooldown_rounds=1, min_lag_s=0.001,
+                            max_codec_level=0)
+        prev = CommPlan(version=1, round=0, codec_level=2)
+        evs = [Evidence(rank=0, round=10, lag_s={1: 0.01},
+                        consensus_growth=0.5)]
+        plan = decide_plan(prev, 10, evs, cfg)
+        assert plan.codec_level == 0
+
+    def test_cadence_stretches_only_under_slow_links(self):
+        # contracting comfortably + NO slow links: cadence stays 1
+        evs = [Evidence(rank=0, round=10, lag_s={1: 0.01},
+                        consensus_growth=0.5)]
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.gossip_every == 1
+        # contracting comfortably + a slow link: stretch
+        evs = _lag_evidence(4, 20, 0.5)
+        evs = [Evidence(rank=e.rank, round=e.round, lag_s=e.lag_s,
+                        consensus_growth=0.5) for e in evs]
+        plan2 = decide_plan(CommPlan(), 20, evs, self.CFG)
+        assert plan2.slow == (3,) and plan2.gossip_every == 2
+
+    def test_cadence_shrinks_when_consensus_grows(self):
+        prev = CommPlan(version=1, round=0, gossip_every=4)
+        evs = [Evidence(rank=0, round=10, lag_s={1: 0.01},
+                        consensus_growth=1.5)]
+        plan = decide_plan(prev, 10, evs, self.CFG)
+        assert plan.gossip_every == 2
+
+    def test_empty_or_stale_evidence_keeps_plan(self):
+        prev = CommPlan(version=2, round=0, slow=(1,))
+        assert decide_plan(prev, 50, [], self.CFG) is prev
+
+
+# ---------------------------------------------------------------------------
+# 5. penalized replan
+# ---------------------------------------------------------------------------
+
+
+class TestPenalizedReplan:
+    def test_deterministic_and_memoryless(self):
+        from bluefog_tpu import topology as T
+
+        base = T.ExponentialTwoGraph(8)
+        a = T.replan_penalized(base, [0, 2, 4, 6], slow=[4], densify=1)
+        b = T.replan_penalized(T.replan(base, [0, 1]), [0, 2, 4, 6],
+                               slow=[4, 7], densify=1)  # 7 not a member
+        np.testing.assert_allclose(a.weights, b.weights)
+        # ONE collapsed provenance suffix, never a chain
+        assert b.name.count("+") == 1 and "+ctl(" in b.name
+
+    def test_no_penalty_no_densify_is_replan(self):
+        from bluefog_tpu import topology as T
+
+        base = T.ExponentialTwoGraph(8)
+        mem = [0, 1, 3, 5, 7]
+        np.testing.assert_allclose(
+            T.replan_penalized(base, mem).weights,
+            T.replan(base, mem).weights)
+
+    def test_slow_peer_degree_reduced_to_ring_spine(self):
+        from bluefog_tpu import topology as T
+
+        base = T.ExponentialTwoGraph(8)
+        full = T.replan_penalized(base, range(8))
+        pen = T.replan_penalized(base, range(8), slow=[3])
+        assert pen.in_degree(3) == 1 and pen.out_degree(3) == 1
+        assert pen.in_degree(3) < full.in_degree(3)
+        # the spine: sorted-member ring edges 2->3 and 3->4 survive
+        assert pen.weights[3, 2] > 0 and pen.weights[4, 3] > 0
+
+    def test_every_plan_strongly_connected_and_stochastic(self):
+        """Seeded sweep over member sets, slow sets, and densify
+        levels: every actuatable plan passes the full topology verifier
+        (row-stochastic, strongly connected active submatrix, inert
+        inactive rows)."""
+        from bluefog_tpu import topology as T
+        from bluefog_tpu.analysis.topology_check import check_topology
+
+        base = T.ExponentialTwoGraph(9)
+        rng = random.Random(42)
+        for _ in range(40):
+            m = rng.randrange(1, 10)
+            members = sorted(rng.sample(range(9), m))
+            n_slow = rng.randrange(0, m + 1)
+            slow = rng.sample(members, n_slow)
+            topo = T.replan_penalized(base, members, slow=slow,
+                                      densify=rng.randrange(3))
+            errs = [d for d in check_topology(topo)
+                    if d.severity == "error"]
+            assert not errs, (members, slow, [d.message for d in errs])
+
+    def test_plan_topology_ignores_nonmember_slow(self):
+        from bluefog_tpu import topology as T
+
+        base = T.ExponentialTwoGraph(6)
+        plan = CommPlan(version=1, slow=(2, 5))
+        topo = plan_topology(base, [0, 1, 2, 3], plan)
+        assert topo.inactive == frozenset({4, 5})
+        assert topo.in_degree(2) == 1  # member slow applied
+
+
+# ---------------------------------------------------------------------------
+# 6. wire telemetry (ack EWMA, reconnect counter, codec ceiling)
+# ---------------------------------------------------------------------------
+
+
+class TestWireTelemetry:
+    def test_ack_ewma_and_reconnects_accessors(self):
+        from bluefog_tpu.runtime.window_server import (DepositStream,
+                                                       WindowServer)
+        from bluefog_tpu.runtime.async_windows import AsyncWindow
+
+        srv = WindowServer()
+        srv.start("127.0.0.1")
+        wname = _uniq("ctl_ewma")
+        win = AsyncWindow(wname, 2, 8, np.float64)
+        try:
+            st = DepositStream(srv.address)
+            assert st.ack_ewma() is None  # no ack yet
+            assert st.reconnects == 0
+            for _ in range(4):
+                st.deposit_async(wname.encode(), 0,
+                                 np.ones(8, np.float64))
+            st.flush(10.0)
+            ewma = st.ack_ewma()
+            assert ewma is not None and 0 < ewma < 5.0
+            st.close()
+        finally:
+            win.free()
+            srv.stop()
+
+    def test_set_codec_ceiling_discipline(self):
+        from bluefog_tpu.runtime.window_server import (DepositStream,
+                                                       WindowServer)
+
+        srv = WindowServer()
+        srv.start("127.0.0.1")
+        try:
+            st = DepositStream(srv.address)  # ceiling: none
+            st.set_codec(None)  # stepping down/level is always fine
+            with pytest.raises(ValueError, match="ceiling"):
+                st.set_codec("f32")
+            st.close()
+            st2 = DepositStream(srv.address, codec="topk")
+            st2.set_codec("f32")   # whole ladder below the ceiling
+            st2.set_codec(None)
+            st2.set_codec("topk")  # back up to the ceiling
+            st2.close()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 7. integration
+# ---------------------------------------------------------------------------
+
+
+def _zero_grad(n_ranks):
+    def loss_and_grad(rank, step, params):
+        import jax
+
+        return 0.0, jax.tree_util.tree_map(
+            lambda a: np.zeros_like(a), params)
+
+    return loss_and_grad
+
+
+class TestThreadIntegration:
+    def test_slow_rank_penalized_audit_exact(self):
+        """One rank 50x slower than the rest: every controller
+        converges on a plan with that rank's edges dropped, the run's
+        EXACT mass audit holds through all plan changes, and the fast
+        ranks still reach consensus."""
+        from bluefog_tpu import topology as T
+        from bluefog_tpu.runtime.async_windows import run_async_dsgd
+
+        rep = run_async_dsgd(
+            T.ExponentialTwoGraph(4),
+            {"w": np.arange(8.0, dtype=np.float32)}, _zero_grad(4),
+            duration_s=4.0, skew=[0.002, 0.002, 0.002, 0.25],
+            name=_uniq("ctl_thread"),
+            control=ControlConfig(evidence_every=4, cooldown_rounds=8,
+                                  min_lag_s=0.02))
+        assert abs(rep.total_mass - 4.0) < 1e-9 * 4, rep.total_mass
+        assert rep.control_plan is not None
+        assert 3 in rep.control_plan.slow, rep.control_plan
+        assert rep.plan_changes >= 1
+        assert rep.consensus_gap < 1e-6, rep.consensus_gap
+        # the slow rank still made progress (ring spine, not eviction)
+        assert min(rep.steps_per_rank) >= 1
+
+    def test_stop_after_steps_time_to_target(self):
+        from bluefog_tpu import topology as T
+        from bluefog_tpu.runtime.async_windows import run_async_dsgd
+
+        rep = run_async_dsgd(
+            T.FullyConnectedGraph(3),
+            {"w": np.zeros(4, np.float32)}, _zero_grad(3),
+            duration_s=30.0, skew=[0.001] * 3,
+            name=_uniq("ctl_target"), stop_after_steps=25)
+        assert rep.wall_time_s < 20.0  # ended on steps, not duration
+        assert max(rep.steps_per_rank) >= 25
+        assert abs(rep.total_mass - 3.0) < 1e-9 * 3
+
+    def test_chaos_killed_rank_evidence_stops_voting(self):
+        """Control + resilience + a chaos thread death: the corpse's
+        frozen evidence record is filtered out of every later decide
+        (the MP tombstone discipline, thread-mode twin), the survivors
+        keep a working plan, and the audit stays exact:
+        total + died == n."""
+        from bluefog_tpu import chaos, topology as T
+        from bluefog_tpu.runtime.async_windows import run_async_dsgd
+        from bluefog_tpu.runtime.resilience import ResilienceConfig
+
+        chaos.configure("rank2:die:at_step=30")
+        rep = run_async_dsgd(
+            T.FullyConnectedGraph(4),
+            {"w": np.arange(8.0, dtype=np.float32)}, _zero_grad(4),
+            duration_s=3.0, skew=[0.002, 0.002, 0.002, 0.2],
+            name=_uniq("ctl_corpse"),
+            resilience=ResilienceConfig(suspect_after_s=0.2,
+                                        dead_after_s=0.6),
+            control=ControlConfig(evidence_every=4, cooldown_rounds=8,
+                                  min_lag_s=0.02))
+        assert rep.dead_ranks == [2]
+        assert abs(rep.total_mass + rep.died_mass - 4.0) < 1e-9 * 4
+        assert rep.control_plan is not None
+
+    def test_control_requires_tcp_in_mp_mode(self, tmp_path):
+        from bluefog_tpu import topology as T
+        from bluefog_tpu.runtime.async_windows import (FileBarrier,
+                                                       run_async_dsgd_rank)
+
+        with pytest.raises(ValueError, match="tcp"):
+            run_async_dsgd_rank(
+                T.FullyConnectedGraph(2), 0, {"w": np.zeros(2)},
+                _zero_grad(2), barrier=FileBarrier(str(tmp_path), 2, 0),
+                transport="shm", control=ControlConfig())
+
+    def test_control_requires_resilience_in_mp_mode(self, tmp_path):
+        # heartbeats are what keep a penalized (idle) stream's lag
+        # evidence fresh — control without them could never release a
+        # recovered peer, so the combination is rejected up front
+        from bluefog_tpu import topology as T
+        from bluefog_tpu.runtime.async_windows import (FileBarrier,
+                                                       run_async_dsgd_rank)
+
+        with pytest.raises(ValueError, match="resilience"):
+            run_async_dsgd_rank(
+                T.FullyConnectedGraph(2), 0, {"w": np.zeros(2)},
+                _zero_grad(2), barrier=FileBarrier(str(tmp_path), 2, 0),
+                transport="tcp", control=ControlConfig())
+
+    def test_codec_ceiling_requires_matching_wire_codec(self, tmp_path):
+        from bluefog_tpu import topology as T
+        from bluefog_tpu.runtime.async_windows import (FileBarrier,
+                                                       run_async_dsgd_rank)
+        from bluefog_tpu.runtime.resilience import ResilienceConfig
+
+        with pytest.raises(ValueError, match="wire_codec"):
+            run_async_dsgd_rank(
+                T.FullyConnectedGraph(2), 0, {"w": np.zeros(2)},
+                _zero_grad(2), barrier=FileBarrier(str(tmp_path), 2, 0),
+                transport="tcp", resilience=ResilienceConfig(),
+                control=ControlConfig(max_codec_level=2))
+
+
+_WORKER = os.path.join(_REPO, "tests", "_mp_control_worker.py")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_mp_lossy_link_controller_drops_edges_audit_exact(tmp_path):
+    """The MP acceptance scenario: 4 rank PROCESSES over the tcp
+    transport, rank 3's server behind a chaos lossy/slow link
+    (``server:delay:rate=`` + ``server:drop:rate=``).  The controllers
+    converge on a plan that reduces rank 3 to the ring spine (evidence
+    disseminated through barrier-dir records; ack-EWMA/heartbeat
+    telemetry), every rank reaches its step target, and rank 0's EXACT
+    push-sum mass audit holds — the plan moved edges, never mass."""
+    bdir = str(tmp_path)
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(r), "4", bdir, "45.0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=clean_env(), cwd=_REPO) for r in range(4)]
+    deadline = time.time() + 170
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(5.0,
+                                               deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("control MP workers timed out")
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {r} failed:\n{out}"
+    assert "CTL_MP_OK 0" in outs[0]

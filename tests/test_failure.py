@@ -51,6 +51,7 @@ class TestHeartbeat:
                 time.sleep(0.01)
         assert hb.hangs_detected == 1
 
+    @pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
     def test_run_with_restart_recovers_from_hang(self, tmp_path):
         """The full loop: train 3 steps, checkpoint, hang; the watchdog
         raises; run_with_restart restores step 3's checkpoint and the second

@@ -452,6 +452,7 @@ def _spawn(rank, capacity, bdir, duration, mode):
 
 
 @pytest.mark.chaos
+@pytest.mark.duration_budget(150)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_mp_fourth_rank_joins_and_one_drains_audit_exact(tmp_path):
     """The acceptance scenario: 3 rank PROCESSES run dsgd over the tcp
     transport; a 4th process attaches mid-run — warm-starting from a

@@ -351,6 +351,50 @@ class TestHealth:
         with pytest.raises(ValueError, match="rounds_per_update"):
             mhealth.MixingTracker(sched, rounds_per_update=0)
 
+    def test_mixing_tracker_rebase_after_heal(self):
+        """Regression for the stale-prediction bug: ``predicted`` was
+        computed once at construction, so after a heal/replan the
+        bf_mixing_excess alarm compared measured contraction against
+        the OLD topology's |lambda_2|.  rebase(schedule) re-anchors it
+        — heal a ring, the excess gauge re-baselines — and understands
+        Topology.inactive (the healed matrix's inert identity rows must
+        not read as |lambda_2| = 1)."""
+        from bluefog_tpu.analysis.topology_check import spectral_gap
+        from bluefog_tpu.topology import heal
+
+        ring = RingGraph(6)
+        reg = mreg.metrics_start()
+        tracker = mhealth.MixingTracker(ring)
+        lam2_ring = 1.0 - spectral_gap(ring.weights)
+        assert tracker.predicted == pytest.approx(lam2_ring)
+        tracker.update(10.0)
+        tracker.update(9.0)
+        excess_before = reg.snapshot()["bf_mixing_excess"]
+        assert excess_before == pytest.approx(0.9 - lam2_ring)
+        # rank 2 dies; the healed path graph mixes SLOWER (bigger
+        # |lambda_2|) — without rebase, the old baseline would read the
+        # healthy healed fleet as permanently broken
+        healed = heal(ring, [2])
+        new_pred = tracker.rebase(healed)
+        live = sorted(set(range(6)) - {2})
+        sub = healed.weights[np.ix_(live, live)]
+        lam2_healed = 1.0 - spectral_gap(sub)
+        assert new_pred == pytest.approx(lam2_healed)
+        assert lam2_ring < new_pred < 1.0  # active submatrix, not the
+        # inert identity row's eigenvalue 1
+        tracker.update(8.7)
+        snap = reg.snapshot()
+        assert snap["bf_mixing_contraction_predicted"] == pytest.approx(
+            lam2_healed)
+        assert snap["bf_mixing_excess"] == pytest.approx(
+            8.7 / 9.0 - lam2_healed)
+        # a controller stretching the gossip cadence re-anchors the
+        # feed-window exponent through the same call
+        assert tracker.rebase(healed, rounds_per_update=3) \
+            == pytest.approx(lam2_healed ** 3)
+        with pytest.raises(ValueError, match="rounds_per_update"):
+            tracker.rebase(healed, rounds_per_update=0)
+
     def test_heartbeat_age_gauge(self):
         from bluefog_tpu.utils.failure import Heartbeat
 

@@ -35,6 +35,7 @@ def _clean_env():
 
 
 @pytest.mark.parametrize("nproc", [2])
+@pytest.mark.duration_budget(240)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_cluster_spans_processes(nproc):
     port = _free_port()
     procs = [
@@ -59,6 +60,7 @@ def test_cluster_spans_processes(nproc):
         assert f"MP_WORKER_OK {pid}" in out, f"worker {pid} output:\n{out}"
 
 
+@pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_rendezvous_timeout_kills_the_process():
     """An explicitly requested cluster that cannot rendezvous must never
     degrade to silent single-process training.  In this jaxlib the

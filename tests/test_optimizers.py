@@ -125,6 +125,64 @@ def test_num_steps_per_communication():
     assert np.abs(w_long - 3.5).max() < 1.0
 
 
+def test_runtime_cadence_matches_static_and_retunes_without_retrace():
+    """The local-SGD gate as a TRACED runtime operand
+    (``runtime_cadence=True``): (1) at a fixed cadence the trajectory is
+    IDENTICAL to the static ``num_steps_per_communication`` form; (2)
+    ``set_comm_every`` retunes the gate between steps with zero
+    recompilation — the hook a communication controller actuates gossip
+    cadence through at round boundaries."""
+    from bluefog_tpu.optim import get_comm_every, set_comm_every
+
+    bf.init()
+    ctx = bf.get_context()
+    mesh, spec = ctx.mesh, P("bf")
+
+    def make(dynamic):
+        return DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), topology=ExponentialTwoGraph(N),
+            axis_name="bf", atc=True, num_steps_per_communication=4,
+            runtime_cadence=dynamic)
+
+    w_static = run_quadratic(make(False), steps=12)
+    w_dyn = run_quadratic(make(True), steps=12)
+    np.testing.assert_allclose(w_dyn, w_static, rtol=1e-5)
+
+    # live retune: k=4 -> k=1 mid-run, same compiled step throughout
+    opt = make(True)
+
+    @jax.jit
+    def step(w, s):
+        def body(v, sv):
+            upd, sv2 = opt.update(v - targets()[0] * 0, sv, v)
+            return optax.apply_updates(v, upd), sv2
+        return shard_map(body, mesh=mesh, in_specs=(spec, P()),
+                         out_specs=(spec, P()), check_vma=False)(w, s)
+
+    w = targets()
+    st = opt.init(jnp.zeros((DIM,)))
+    for _ in range(4):
+        w, st = step(w, st)
+    cache_pre = step._cache_size()
+    comm_rounds_k4 = int(st.comm_count)
+    assert get_comm_every(st) == 4
+    st = set_comm_every(st, 1)
+    for _ in range(4):
+        w, st = step(w, st)
+    assert step._cache_size() == cache_pre  # no retrace on retune
+    # at k=4: one comm round in 4 steps; at k=1: four in four
+    assert int(st.comm_count) == comm_rounds_k4 + 4
+
+    # guards
+    with pytest.raises(TypeError, match="runtime_cadence"):
+        set_comm_every(make(False).init(jnp.zeros((DIM,))), 2)
+    with pytest.raises(ValueError, match="gossip communication types"):
+        decentralized_optimizer(
+            optax.sgd(0.1), None, "bf",
+            communication_type=CommunicationType.allreduce,
+            runtime_cadence=True)
+
+
 def test_dynamic_schedules_with_local_steps_cycle_all_phases():
     """Regression: with num_steps_per_communication=k>1 the dynamic schedule
     index must advance per communication *round*, not per step — otherwise

@@ -10,9 +10,12 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from tests._util import REPO, clean_env
 
 
+@pytest.mark.duration_budget(90)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_profile_summary_end_to_end(tmp_path):
     trace_dir = str(tmp_path / "trace")
     # capture in a FRESH process: the pytest process may already hold (or

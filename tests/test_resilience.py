@@ -275,6 +275,33 @@ class TestChaosSpec:
         assert seq1 == seq2  # same seed, same traffic -> same faults
         assert any(seq1) and not all(seq1)
 
+    def test_rate_trigger_is_a_seeded_lossy_link(self):
+        """``rate=p``: the lossy-link spelling — an independent seeded
+        coin per frame that loses ~p of them, unlimited firings by
+        default, deterministic per seed."""
+        from bluefog_tpu.chaos import Injector, parse_spec
+
+        (rule,) = parse_spec("server:drop:rate=0.25:seed=7")
+        assert rule.rate == 0.25 and rule.max_fires() == 0  # unlimited
+        spec = "server:drop:rate=0.25:seed=7"
+        inj1, inj2 = Injector(spec), Injector(spec)
+        seq1 = [inj1.fire("server") is not None for _ in range(400)]
+        seq2 = [inj2.fire("server") is not None for _ in range(400)]
+        assert seq1 == seq2  # deterministic per seed
+        losses = sum(seq1)
+        assert 60 <= losses <= 140, losses  # ~25% of 400 frames
+
+    @pytest.mark.parametrize("bad", [
+        "server:drop:rate=1.5",          # out of [0, 1]
+        "server:drop:rate=0.1:prob=0.1",  # one coin per rule
+        "rank1:die:at_step=3:rate=0.1",  # socket-site trigger only
+    ])
+    def test_rate_validation(self, bad):
+        from bluefog_tpu.chaos import ChaosSpecError, parse_spec
+
+        with pytest.raises(ChaosSpecError):
+            parse_spec(bad)
+
     def test_env_lazy_and_reset(self, monkeypatch):
         from bluefog_tpu import chaos
 
@@ -298,6 +325,7 @@ class TestChaosSpec:
         # one-shot: the corpse does not die twice
         chaos.check_step(1, 6)
 
+    @pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
     def test_cli_explain_grammar_and_env_passthrough(self):
         cli = [sys.executable, "-m", "bluefog_tpu.chaos"]
         env = clean_env()
@@ -779,6 +807,7 @@ def _run_resilience_workers(mode, nproc=3, duration="3.5", timeout=240):
 
 
 @pytest.mark.chaos
+@pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_mp_sigkill_one_of_three_survivors_heal_and_audit_exactly():
     """The acceptance scenario: one of three rank PROCESSES is SIGKILLed
     mid-dsgd.  The survivors' deposit streams fail, reconnect attempts

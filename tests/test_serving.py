@@ -643,6 +643,7 @@ _READER_CHAOS = ("read:truncate:every=5;read:stall:s=0.2:every=9;"
                  "sub:truncate:every=17;sub:stall:s=0.25:every=7")
 
 
+@pytest.mark.duration_budget(150)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_chaos_acceptance_serving_under_reader_faults():
     """3 tcp training ranks + 4 subscriber processes; reader-side chaos
     tears/stalls reads and pushes on the serving hosts while the test

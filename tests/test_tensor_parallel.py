@@ -100,6 +100,7 @@ def _init_loss_gather(tp_size, mesh, tokens):
     return loss, params, grads
 
 
+@pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_tp_lm_forward_and_grad_parity(devices8):
     """tp=2 LM == the same weights gathered and replayed unsharded (tp=1):
     identical logits-loss and identical gradients (after tp_value_and_grad's

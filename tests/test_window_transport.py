@@ -310,6 +310,7 @@ def test_dense_item_wire_bytes_must_match_exactly():
         win.free()
 
 
+@pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_wire_codecs_end_to_end():
     from bluefog_tpu.runtime.window_server import DepositStream
 
@@ -516,6 +517,7 @@ def _run_dsgd_workers(transport, nproc=2, duration="1.5"):
             assert f"ASYNC_MP_OK {r}" in out, f"worker {r} output:\n{out}"
 
 
+@pytest.mark.duration_budget(60)  # pre-existing heavyweight; tier-1 coverage load-bearing
 def test_pipelined_dsgd_mass_audit_exact_two_processes():
     """Two OS processes, pipelined TCP deposits, skewed step rates: the
     worker asserts mass conservation EXACTLY (sum p == n to 1e-9·n) plus
