@@ -50,8 +50,26 @@ from bluefog_tpu.analysis.jaxpr_lint import (
     lint_step_fn,
 )
 from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+from bluefog_tpu.analysis.lockmodel import (
+    LockModel,
+    build_model,
+    build_package_model,
+)
+from bluefog_tpu.analysis.concurrency_lint import (
+    check_model,
+    check_package,
+    check_sources,
+)
+from bluefog_tpu.analysis.doc_lint import check_transport_doc
 
 __all__ = [
+    "LockModel",
+    "build_model",
+    "build_package_model",
+    "check_model",
+    "check_package",
+    "check_sources",
+    "check_transport_doc",
     "Diagnostic",
     "LintError",
     "LintReport",

@@ -1,0 +1,367 @@
+"""Pass 8 — whole-repo concurrency lint over the package lock model.
+
+Seven earlier passes each verify ONE subsystem's invariant; this one
+verifies how the subsystems' ~40 locks and a dozen worker threads
+COMPOSE.  It consumes the :mod:`bluefog_tpu.analysis.lockmodel` built
+over the whole package and reports:
+
+**BF-CONC001** (error) — lock-order cycle: two (or more) locks acquired
+in opposite orders on different code paths.  Any thread interleaving
+that reaches both paths concurrently deadlocks; this is the ABBA shape
+the dynamic tripwire (:mod:`bluefog_tpu.utils.lockcheck`) also traps at
+runtime.  Waive an intended edge with ``# bfverify: order-ok <why>`` on
+the acquiring line.
+
+**BF-CONC002** (error) — hold-and-block: an indefinite blocking call
+(socket ``recv``/``recv_into``/``sendmsg``/``sendall``, an untimed
+``Thread.join`` or condvar ``wait``, a barrier wait, a subprocess)
+executes while holding a lock that a signal handler, watchdog, or
+daemon worker thread also acquires.  If the blocking call never
+returns, everything async that needs the lock wedges behind it — the
+PR-1 engine self-deadlock and the PR-3 recorder hardening were both
+exactly this shape.  A *reviewed* blocking hold (the apply-worker ack
+under the connection write mutex, where the ack ordering IS the flush
+fence) is waived in place: ``# bfverify: holds-ok <why>`` on the
+blocking line or on the ``with`` that takes the lock.
+
+**BF-CONC003** (warning) — unlocked thread-shared attribute: a class
+spawns a worker thread, a method reachable from the thread entry writes
+``self.X``, some non-thread method reads/writes the same ``X``, and no
+common lock is held at all those sites.  Benign single-word stores
+exist (the GIL makes them atomic) — mark the deliberate ones
+``# bfverify: shared-ok <why>`` so the next reader knows it was a
+decision, not an oversight.
+
+**BF-CONC010** (info) — a condvar ``wait()`` outside a ``while``-
+predicate loop: legal, but a spurious wakeup or a missed re-check turns
+it into a latent hang; ``wait_for`` (predicate built in) or a loop is
+the durable shape.  ``# bfverify: wait-ok <why>`` acknowledges an
+intentional one-shot wait.
+
+**BF-CONC100** (info) — scan summary (locks, edges, async contexts).
+
+The standalone ``bfverify-tpu`` CLI prints the model itself — the lock
+table, the lock-order graph (text and DOT), per-lock holder/blocker
+tables — then the findings; it exits nonzero iff any error survived its
+waivers.  The same check runs inside the ``bflint-tpu`` sweep as
+``concurrency_pass``, which is what CI (and tier-1, via
+``tests/test_analysis.py``) enforces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from bluefog_tpu.analysis.lockmodel import (LockModel, build_model,
+                                            build_package_model)
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_model", "check_package", "check_sources", "main"]
+
+_PASS = "concurrency-lint"
+
+
+def _short(path: str) -> str:
+    return os.path.basename(path)
+
+
+def _site(file: str, line: int) -> str:
+    return f"{_short(file)}:{line}"
+
+
+# ---------------------------------------------------------------------------
+# BF-CONC001: lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+def _check_cycles(model: LockModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    # length-1 "cycle": a NON-reentrant lock re-acquired while already
+    # held (directly, or through a one-level helper call) — the PR-1
+    # engine() self-deadlock shape, guaranteed to hang on first hit
+    seen_self: Set[Tuple[str, int]] = set()
+    for a in model.acquires:
+        if a.lock not in a.held:
+            continue
+        d = model.locks.get(a.lock)
+        if d is None or d.kind != "lock":
+            continue  # RLock/Condition(RLock) re-entry is legal
+        if a.via == "with" or a.via == "acquire":
+            # direct re-entry in one function is almost always a
+            # with-stack artifact of two instances; only the
+            # call-through form is the provable single-object shape
+            continue
+        key = (a.file, a.line)
+        if key in seen_self:
+            continue
+        seen_self.add(key)
+        got = model.waiver_lines.get(key)
+        if got and got[0] == "order-ok" and got[1]:
+            continue
+        diags.append(Diagnostic(
+            "error", "BF-CONC001",
+            f"non-reentrant lock {a.lock} is re-acquired while already "
+            f"held: {a.func} holds it and calls a helper "
+            f"({a.via.split(':', 1)[-1]}) that acquires it again "
+            f"({_site(a.file, a.line)}) — a plain Lock self-deadlocks "
+            "here on the first call; make it an RLock or lift the "
+            "helper call out of the critical section",
+            pass_name=_PASS, subject=f"{_short(a.file)}:{a.line}"))
+    for cycle in model.find_cycles():
+        ring = cycle + [cycle[0]]
+        sites = []
+        waiver: Optional[str] = None
+        for a, b in zip(ring, ring[1:]):
+            acq = model.edges.get((a, b))
+            if acq is None:
+                continue
+            sites.append(f"{a} -> {b} at {_site(acq.file, acq.line)} "
+                         f"in {acq.func} (via {acq.via})")
+            got = model.waiver_lines.get((acq.file, acq.line))
+            if got and got[0] == "order-ok" and got[1]:
+                waiver = got[1]
+        if waiver is not None:
+            diags.append(Diagnostic(
+                "info", "BF-CONC001W",
+                f"lock-order cycle {' -> '.join(ring)} waived in place "
+                f"(order-ok: {waiver})",
+                pass_name=_PASS, subject=" / ".join(cycle)))
+            continue
+        diags.append(Diagnostic(
+            "error", "BF-CONC001",
+            f"lock-order cycle {' -> '.join(ring)}: the same locks are "
+            "taken in opposite orders on different code paths — any "
+            "interleaving that runs both paths concurrently deadlocks. "
+            "Edges: " + "; ".join(sites) + ". Make the nesting "
+            "one-directional (or waive a proven-impossible "
+            "interleaving with `# bfverify: order-ok <why>`)",
+            pass_name=_PASS, subject=" / ".join(cycle)))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# BF-CONC002: hold-and-block
+# ---------------------------------------------------------------------------
+
+
+def _check_hold_and_block(model: LockModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for b in model.blocks:
+        shared = [h for h in b.held if h in model.async_locks]
+        if not shared:
+            continue
+        key = (b.file, b.line, b.call)
+        if key in seen:
+            continue
+        seen.add(key)
+        if b.waiver:
+            diags.append(Diagnostic(
+                "info", "BF-CONC002W",
+                f"blocking {b.call!r} at {_site(b.file, b.line)} under "
+                f"{', '.join(shared)} waived in place (holds-ok: "
+                f"{b.waiver})",
+                pass_name=_PASS, subject=b.func))
+            continue
+        ctxs = sorted(set().union(
+            *(model.async_locks[h] for h in shared)))
+        diags.append(Diagnostic(
+            "error", "BF-CONC002",
+            f"blocking call {b.call!r} at {_site(b.file, b.line)} in "
+            f"{b.func} while holding {', '.join(shared)} — also "
+            f"acquired by async context(s) {', '.join(ctxs[:4])}"
+            f"{'…' if len(ctxs) > 4 else ''}. If the call never returns "
+            "(wedged peer, full socket buffer), every watchdog/daemon "
+            "path that needs the lock wedges behind it. Move the "
+            "blocking call outside the critical section, give it a "
+            "deadline, or waive a reviewed hold with "
+            "`# bfverify: holds-ok <why>`",
+            pass_name=_PASS, subject=f"{_short(b.file)}:{b.line}"))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# BF-CONC003: thread-shared attributes without a common lock
+# ---------------------------------------------------------------------------
+
+
+def _class_thread_funcs(model: LockModel, cls_key: str) -> Set[str]:
+    """Thread-entry methods of ``module:Class`` plus everything they
+    reach through the resolved call graph (any module)."""
+    entries = model.thread_classes.get(cls_key, set())
+    reach: Set[str] = set(entries)
+    frontier = list(entries)
+    while frontier:
+        cur = frontier.pop()
+        for callee in model.calls.get(cur, ()):
+            if callee not in reach:
+                reach.add(callee)
+                frontier.append(callee)
+    return reach
+
+
+def _check_shared_state(model: LockModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    lock_attrs: Dict[Tuple[str, str], Set[str]] = {}
+    for d in model.locks.values():
+        if d.cls:
+            lock_attrs.setdefault((d.module, d.cls), set()).add(d.attr)
+    by_cls: Dict[Tuple[str, str], List] = {}
+    for a in model.attr_accesses:
+        by_cls.setdefault((a.module, a.cls), []).append(a)
+    for cls_key, entries in sorted(model.thread_classes.items()):
+        module, cls = cls_key.split(":", 1)
+        accesses = by_cls.get((module, cls), [])
+        if not accesses:
+            continue
+        thread_funcs = _class_thread_funcs(model, cls_key)
+        infra = lock_attrs.get((module, cls), set())
+        attrs = sorted({a.attr for a in accesses})
+        for attr in attrs:
+            if attr in infra:
+                continue
+            sites = [a for a in accesses if a.attr == attr]
+            t_writes = [a for a in sites
+                        if a.func in {f.split(":", 1)[1]
+                                      for f in thread_funcs}
+                        and a.write and not a.func.endswith("__init__")]
+            outside = [a for a in sites
+                       if a.func not in {f.split(":", 1)[1]
+                                         for f in thread_funcs}
+                       and not a.func.endswith("__init__")]
+            if not t_writes or not outside:
+                continue
+            if any(a.waiver for a in sites):
+                continue
+            common = None
+            for a in t_writes + outside:
+                held = set(a.held)
+                common = held if common is None else (common & held)
+            if common:
+                continue
+            w = t_writes[0]
+            o = outside[0]
+            diags.append(Diagnostic(
+                "warning", "BF-CONC003",
+                f"{cls}.{attr} is written from the worker thread "
+                f"({w.func} at {_site(w.file, w.line)}) and "
+                f"{'written' if o.write else 'read'} outside it "
+                f"({o.func} at {_site(o.file, o.line)}) with no common "
+                "lock in the model — if this is a deliberate "
+                "GIL-atomic single-word store, mark it "
+                "`# bfverify: shared-ok <why>`; otherwise take the "
+                "class's lock on both sides",
+                pass_name=_PASS, subject=f"{module}.{cls}.{attr}"))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# BF-CONC010: condvar wait outside a predicate loop
+# ---------------------------------------------------------------------------
+
+
+def _check_waits(model: LockModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for w in model.waits:
+        if w.in_while or w.waiver:
+            continue
+        diags.append(Diagnostic(
+            "info", "BF-CONC010",
+            f"condvar wait on {w.lock} at {_site(w.file, w.line)} in "
+            f"{w.func} is not inside a while-predicate loop — a "
+            "spurious wakeup or missed notify re-check becomes a hang; "
+            "prefer wait_for(predicate) or a while loop "
+            "(`# bfverify: wait-ok <why>` for an intentional one-shot)",
+            pass_name=_PASS, subject=f"{_short(w.file)}:{w.line}"))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def check_model(model: LockModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for path, err in model.parse_failures:
+        diags.append(Diagnostic(
+            "warning", "BF-CONC004",
+            f"could not parse {path}: {err}", pass_name=_PASS,
+            subject=_short(path)))
+    diags += _check_cycles(model)
+    diags += _check_hold_and_block(model)
+    diags += _check_shared_state(model)
+    diags += _check_waits(model)
+    n_alias = sum(1 for d in model.locks.values() if d.alias_of)
+    diags.append(Diagnostic(
+        "info", "BF-CONC100",
+        f"concurrency model: {len(model.locks) - n_alias} lock(s) "
+        f"(+{n_alias} alias(es)) across {len(model.files)} file(s), "
+        f"{len(model.edges)} order edge(s), "
+        f"{len(model.thread_entries)} thread entry point(s), "
+        f"{len(model.signal_handlers)} signal/excepthook handler(s)",
+        pass_name=_PASS, subject="package"))
+    return diags
+
+
+def check_package(root: Optional[str] = None
+                  ) -> Tuple[LockModel, List[Diagnostic]]:
+    """Build the model over the installed package and lint it."""
+    model = build_package_model(root)
+    return model, check_model(model)
+
+
+def check_sources(sources: Sequence[Tuple[str, str]]
+                  ) -> Tuple[LockModel, List[Diagnostic]]:
+    """Build + lint from ``(filename, source)`` pairs (tests)."""
+    model = build_model(sources)
+    return model, check_model(model)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bfverify-tpu",
+        description="Whole-repo concurrency verifier: lock-order graph, "
+                    "hold-and-block lint, thread-shared-state audit.")
+    ap.add_argument("--root", default=None,
+                    help="package root to scan (default: the installed "
+                    "bluefog_tpu package)")
+    ap.add_argument("--dot", default=None, metavar="FILE",
+                    help="write the lock-order graph as Graphviz DOT "
+                    "('-' for stdout)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print info diagnostics (incl. honored "
+                    "waivers and BF-CONC010 notes)")
+    ap.add_argument("--no-graph", action="store_true",
+                    help="skip the text lock/edge/holder tables, print "
+                    "findings only")
+    args = ap.parse_args(argv)
+
+    model, diags = check_package(args.root)
+    if args.dot:
+        dot = model.dot()
+        if args.dot == "-":
+            print(dot)
+        else:
+            with open(args.dot, "w", encoding="utf-8") as f:
+                f.write(dot + "\n")
+            print(f"lock-order graph written to {args.dot}")
+    if not args.no_graph:
+        print(model.format_text())
+        print()
+    from bluefog_tpu.analysis.report import LintReport
+
+    report = LintReport(diags)
+    print(report.format(verbose=args.verbose))
+    if report.ok:
+        print("bfverify: OK")
+        return 0
+    print("bfverify: FAILED")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""BF-DOC001: the transport doc must list every wire v2 status code.
+
+The status codes live in ONE table
+(:mod:`bluefog_tpu.runtime.wire_status`); ``docs/transport.md`` is the
+operator-facing contract for the same wire.  The doc drifted from the
+literals once already (review notes, PR 7) — this pass pins the two
+together: every code in :data:`~bluefog_tpu.runtime.wire_status.
+WIRE_V2_CODES` must appear (as its literal, e.g. ``-105``) somewhere in
+the doc, and every ``-1xx`` literal the doc mentions must be a code the
+registry defines (a documented code the wire never sends is the same
+drift in the other direction).
+
+**BF-DOC001** (error): a registry code missing from the doc, or a doc
+code missing from the registry.  **BF-DOC100** (info): summary.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_transport_doc"]
+
+_PASS = "doc-lint"
+_CODE_RE = re.compile(r"-1\d\d\b")
+
+
+def _default_doc_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "docs", "transport.md")
+
+
+def check_transport_doc(doc_path: Optional[str] = None
+                        ) -> List[Diagnostic]:
+    from bluefog_tpu.runtime import wire_status as ws
+
+    path = doc_path or _default_doc_path()
+    diags: List[Diagnostic] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        diags.append(Diagnostic(
+            "warning", "BF-DOC002",
+            f"could not read transport doc {path}: {e}",
+            pass_name=_PASS, subject=os.path.basename(path)))
+        return diags
+
+    doc_codes = {int(m) for m in _CODE_RE.findall(text)}
+    registry = set(ws.WIRE_V2_CODES)
+    for code in sorted(registry, reverse=True):
+        if code not in doc_codes:
+            name = next(k for k, v in vars(ws).items()
+                        if k.startswith("ERR_") and v == code)
+            diags.append(Diagnostic(
+                "error", "BF-DOC001",
+                f"wire status {code} ({name}: "
+                f"{ws.STATUS_TEXT[code]!r}) is not documented in "
+                f"{os.path.basename(path)} — every v2 status code in "
+                "runtime/wire_status.py must appear in the transport "
+                "doc's status table",
+                pass_name=_PASS, subject=str(code)))
+    unassigned = set(getattr(ws, "UNASSIGNED_CODES", ()))
+    for code in sorted(doc_codes, reverse=True):
+        if code not in registry and code not in unassigned:
+            diags.append(Diagnostic(
+                "error", "BF-DOC001",
+                f"{os.path.basename(path)} documents wire status "
+                f"{code}, which runtime/wire_status.py does not define "
+                "— a documented code the wire never sends is drift in "
+                "the other direction (remove it from the doc or add it "
+                "to the registry)",
+                pass_name=_PASS, subject=str(code)))
+    if not diags:
+        diags.append(Diagnostic(
+            "info", "BF-DOC100",
+            f"all {len(registry)} wire v2 status codes documented in "
+            f"{os.path.basename(path)}; no stray codes",
+            pass_name=_PASS, subject="transport.md"))
+    return diags

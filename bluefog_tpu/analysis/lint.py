@@ -402,6 +402,28 @@ def control_pass(report: LintReport, size: int) -> None:
         pass_name="control-lint", subject="control"))
 
 
+def concurrency_pass(report: LintReport, size: int) -> None:
+    """Pass 8 — BF-CONC: the whole-package concurrency model.  Builds
+    the lock-order graph over every lock in ``bluefog_tpu/`` (cycle
+    detection), the hold-and-block audit (indefinite blocking calls
+    under locks that signal handlers / watchdogs / daemon threads also
+    take), the thread-shared-state audit, and the condvar-predicate
+    check — see :mod:`bluefog_tpu.analysis.concurrency_lint` and the
+    ``bfverify-tpu`` CLI for the graph itself."""
+    from bluefog_tpu.analysis.concurrency_lint import check_package
+
+    _, diags = check_package()
+    report.extend(diags)
+
+
+def doc_pass(report: LintReport, size: int) -> None:
+    """BF-DOC: docs/transport.md must list every wire v2 status code in
+    the one registry (:mod:`bluefog_tpu.runtime.wire_status`)."""
+    from bluefog_tpu.analysis.doc_lint import check_transport_doc
+
+    report.extend(check_transport_doc())
+
+
 def serving_pass(report: LintReport, size: int) -> None:
     """BF-SRV source lint over the surfaces that consume round-stamped
     snapshots: the serving tier itself plus every example/benchmark that
@@ -515,6 +537,8 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     resilience_pass(report, size)
     serving_pass(report, size)
     control_pass(report, size)
+    concurrency_pass(report, size)
+    doc_pass(report, size)
     examples_pass(report, size)
     if trace:
         comm_lint_pass(report, size)

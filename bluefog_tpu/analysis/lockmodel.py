@@ -1,0 +1,1201 @@
+"""Whole-package lock model: who creates locks, who nests them, who blocks.
+
+The model behind Pass 8 (:mod:`bluefog_tpu.analysis.concurrency_lint`)
+and the ``bfverify-tpu`` CLI.  It is an AST-level approximation built for
+the package's own idioms — named ``threading.Lock/RLock/Condition``
+attributes (or their :mod:`bluefog_tpu.utils.lockcheck` factory twins),
+``with``-statement critical sections, daemon worker threads spawned via
+``threading.Thread(target=...)``, and signal/excepthook handlers — with
+deliberately conservative resolution: an expression that cannot be
+mapped to a known lock contributes nothing (no edge, no finding), so
+every reported fact is grounded in a real source location.
+
+What the model holds:
+
+- **Lock definitions.**  Every lock creation site, canonically named
+  ``<module>.<Class>.<attr>`` (or ``<module>.<func>.<var>`` /
+  ``<module>.<global>``).  A ``Condition(existing_lock)`` is an *alias*
+  of its underlying lock — one ordering identity, exactly as at runtime.
+  A lock passed into a constructor and stored on ``self`` is resolved
+  through the call site (``_ApplyWorker(self, ..., self._wmu, ...)``
+  makes ``_ApplyWorker._wlock`` an alias of ``_Handler._wmu``).
+- **Acquisitions** with the held-set at each site (``with`` nesting
+  inside one function, plus ONE level of call-through into helpers the
+  resolver can pin down), giving the **lock-order edge set**.
+- **Blocking calls** made while locks are held (socket receives/sends,
+  untimed joins and condvar waits, barrier waits, subprocess calls).
+- **Async contexts**: functions reachable from a ``Thread(target=...)``
+  entry point, a ``signal.signal`` handler, or a ``sys/threading
+  .excepthook`` assignment — the code that runs concurrently with (or
+  preempts) whatever the main thread is doing.
+- **Thread-shared attributes** per thread-spawning class: who writes an
+  attribute from the thread side, who touches it from outside, and the
+  locks held at every such site.
+
+Waivers: a line carrying ``# bfverify: <token> <reason>`` suppresses the
+matching finding AT that site — ``holds-ok`` (BF-CONC002), ``order-ok``
+(BF-CONC001), ``shared-ok`` (BF-CONC003), ``wait-ok`` (BF-CONC010).  The
+reason is mandatory; a bare token waives nothing.  The waiver may sit on
+the blocking call's line or on the line of the ``with`` that takes the
+held lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "AttrAccess",
+    "BlockSite",
+    "LockDef",
+    "LockModel",
+    "build_model",
+    "build_package_model",
+    "package_root",
+]
+
+_LOCK_KINDS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+_LC_KINDS = {"lock": "lock", "rlock": "rlock", "condition": "condition"}
+
+# Call names that park a thread indefinitely (no deadline of their own).
+# Socket receives/sends have no per-call timeout argument — a socket-level
+# deadline set elsewhere is invisible here, which is exactly what the
+# holds-ok waiver is for.
+_BLOCKING_NAMES = {
+    "recv", "recv_into", "recvmsg", "recvfrom", "_recv_exact",
+    "sendmsg", "sendall", "accept", "connect", "create_connection",
+    "communicate",
+}
+_SUBPROCESS_NAMES = {"run", "call", "check_call", "check_output", "Popen"}
+
+_WAIVER_RE = re.compile(
+    r"#\s*bfverify:\s*(holds-ok|order-ok|shared-ok|wait-ok)\s*(.*)")
+
+# method names the unique-method-in-module call fallback must never
+# claim: they are overwhelmingly builtin container/str operations
+# (self._leases.clear() is a list clear, not LeaseRegistry.clear)
+_CONTAINER_METHODS = frozenset({
+    "clear", "append", "extend", "pop", "popleft", "popitem", "update",
+    "add", "discard", "remove", "get", "setdefault", "keys", "values",
+    "items", "copy", "sort", "reverse", "insert", "count", "index",
+    "join", "split", "encode", "decode", "put", "put_nowait",
+    "get_nowait", "set", "release", "acquire", "wait", "notify",
+    "notify_all", "is_set", "start",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    name: str             # canonical identity (post alias resolution key)
+    kind: str             # lock | rlock | condition
+    module: str
+    cls: Optional[str]
+    attr: str
+    file: str
+    line: int
+    alias_of: Optional[str] = None   # condition over / alias of this name
+
+
+@dataclasses.dataclass(frozen=True)
+class Acq:
+    lock: str
+    func: str
+    file: str
+    line: int
+    via: str              # "with" | "acquire" | "call:<helper>"
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSite:
+    func: str
+    file: str
+    line: int
+    call: str             # e.g. "sendall", "_sendmsg_all>sendmsg"
+    held: Tuple[str, ...]
+    held_lines: Tuple[int, ...]
+    waiver: Optional[str] = None    # reason text when holds-ok waived
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrAccess:
+    module: str
+    cls: str
+    attr: str
+    func: str             # method qualname within the class
+    file: str
+    line: int
+    write: bool
+    held: Tuple[str, ...]
+    waiver: Optional[str] = None    # shared-ok reason on the line
+
+
+@dataclasses.dataclass(frozen=True)
+class WaitSite:
+    lock: str
+    func: str
+    file: str
+    line: int
+    in_while: bool
+    timed: bool
+    waiver: Optional[str] = None
+
+
+class _FuncRec:
+    """Per-function extraction record (phase A: direct facts only)."""
+
+    def __init__(self, module: str, qual: str, node: ast.AST, file: str,
+                 cls: Optional[str]):
+        self.module = module
+        self.qual = qual            # e.g. "Class.method" or "func.inner"
+        self.node = node
+        self.file = file
+        self.cls = cls
+        self.acquires: List[Acq] = []
+        self.blocks: List[BlockSite] = []
+        self.calls: List[Tuple[str, int, Tuple[str, ...], Tuple[int, ...]]]\
+            = []                    # (callee key, line, held, held_lines)
+        self.waits: List[WaitSite] = []
+        self.attr_accesses: List[AttrAccess] = []
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.qual}"
+
+
+class LockModel:
+    """The assembled whole-package model (see module docstring)."""
+
+    def __init__(self):
+        self.locks: Dict[str, LockDef] = {}
+        self.acquires: List[Acq] = []
+        self.blocks: List[BlockSite] = []
+        self.waits: List[WaitSite] = []
+        self.attr_accesses: List[AttrAccess] = []
+        # (src, dst) -> example Acq that recorded the edge
+        self.edges: Dict[Tuple[str, str], Acq] = {}
+        self.thread_entries: Set[str] = set()    # func keys
+        self.signal_handlers: Set[str] = set()
+        self.async_funcs: Set[str] = set()       # reachable closure
+        self.async_locks: Dict[str, Set[str]] = {}  # lock -> async ctxs
+        self.files: List[str] = []
+        # classes that spawn threads: module:Class -> set of entry quals
+        self.thread_classes: Dict[str, Set[str]] = {}
+        self.parse_failures: List[Tuple[str, str]] = []
+        # resolved call graph: func key -> callee keys
+        self.calls: Dict[str, List[str]] = {}
+        # (file, line) -> (token, reason) for every bfverify waiver
+        self.waiver_lines: Dict[Tuple[str, int], Tuple[str, str]] = {}
+
+    # ------------------------------------------------------------- queries
+    def resolve_alias(self, name: str) -> str:
+        seen = set()
+        while name in self.locks and self.locks[name].alias_of:
+            if name in seen:
+                break
+            seen.add(name)
+            name = self.locks[name].alias_of  # type: ignore[assignment]
+        return name
+
+    def holders(self, lock: str) -> List[Acq]:
+        return [a for a in self.acquires if a.lock == lock]
+
+    def blockers(self, lock: str) -> List[BlockSite]:
+        return [b for b in self.blocks if lock in b.held]
+
+    def find_cycles(self, max_len: Optional[int] = None) -> List[List[str]]:
+        """Elementary cycles (length >= 2) in the lock-order edge graph.
+
+        Unbounded by default — a missed long cycle is a missed deadlock,
+        and elementary paths are already capped by the node count; the
+        package graph is small and sparse enough that the full DFS is
+        cheap.  ``max_len`` exists only for callers that want a bound."""
+        adj: Dict[str, Set[str]] = {}
+        for (src, dst) in self.edges:
+            if src != dst:
+                adj.setdefault(src, set()).add(dst)
+        cap = len(adj) if max_len is None else max_len
+        out: List[List[str]] = []
+        seen: Set[frozenset] = set()
+        for start in sorted(adj):
+            stack: List[Tuple[str, List[str]]] = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == start and len(path) >= 2:
+                        key = frozenset(path)
+                        if key not in seen:
+                            seen.add(key)
+                            out.append(list(path))
+                    elif (nxt not in path and nxt > start
+                          and len(path) < cap):
+                        stack.append((nxt, path + [nxt]))
+        # pairs (A->B, B->A) too — the DFS above needs len(path) >= 2
+        # which it has for those; nothing extra to do
+        return out
+
+    # -------------------------------------------------------------- output
+    def dot(self) -> str:
+        lines = ["digraph lock_order {", "  rankdir=LR;",
+                 '  node [shape=box, fontname="monospace"];']
+        cyc_nodes = {n for c in self.find_cycles() for n in c}
+        for name in sorted(self.locks):
+            d = self.locks[name]
+            if d.alias_of:
+                continue
+            color = ' color="red"' if name in cyc_nodes else ""
+            label = f"{name}\\n({d.kind}) {os.path.basename(d.file)}:{d.line}"
+            lines.append(f'  "{name}" [label="{label}"{color}];')
+        for (src, dst), acq in sorted(self.edges.items()):
+            attr = ""
+            if src in cyc_nodes and dst in cyc_nodes:
+                attr = ' [color="red", penwidth=2]'
+            lines.append(
+                f'  "{src}" -> "{dst}"{attr};  '
+                f'// {os.path.basename(acq.file)}:{acq.line} {acq.func}')
+        lines.append("}")
+        return "\n".join(lines)
+
+    def format_text(self) -> str:
+        out: List[str] = []
+        real = [n for n in sorted(self.locks)
+                if not self.locks[n].alias_of]
+        out.append(f"locks ({len(real)}):")
+        for name in real:
+            d = self.locks[name]
+            aliases = [a for a, dd in self.locks.items()
+                       if dd.alias_of and self.resolve_alias(a) == name]
+            al = f"  (aliases: {', '.join(sorted(aliases))})" if aliases \
+                else ""
+            out.append(f"  {name:<58} {d.kind:<9} "
+                       f"{os.path.basename(d.file)}:{d.line}{al}")
+        out.append(f"\nlock-order edges ({len(self.edges)}):")
+        for (src, dst), acq in sorted(self.edges.items()):
+            out.append(f"  {src} -> {dst}   "
+                       f"[{os.path.basename(acq.file)}:{acq.line} "
+                       f"{acq.func}, via {acq.via}]")
+        cycs = self.find_cycles()
+        out.append(f"\ncycles: {len(cycs)}")
+        for c in cycs:
+            out.append("  " + " -> ".join(c + [c[0]]))
+        out.append("\nper-lock holders / blockers:")
+        for name in real:
+            hs = self.holders(name)
+            bs = self.blockers(name)
+            actx = self.async_locks.get(name, set())
+            if not hs and not bs:
+                continue
+            out.append(f"  {name}:")
+            for a in hs:
+                out.append(f"    held by {a.func} "
+                           f"({os.path.basename(a.file)}:{a.line})")
+            for ctx in sorted(actx):
+                out.append(f"    async-acquired in {ctx}")
+            for b in bs:
+                w = f"  [waived: {b.waiver}]" if b.waiver else ""
+                out.append(f"    BLOCKS under it: {b.call} in {b.func} "
+                           f"({os.path.basename(b.file)}:{b.line}){w}")
+        return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Per-module scanning
+# ---------------------------------------------------------------------------
+
+
+class _Module:
+    def __init__(self, modname: str, file: str, tree: ast.Module,
+                 src_lines: List[str]):
+        self.name = modname
+        self.file = file
+        self.tree = tree
+        self.lines = src_lines
+        self.threading_aliases: Set[str] = set()
+        self.lockcheck_aliases: Set[str] = set()
+        self.signal_aliases: Set[str] = set()
+        self.subprocess_aliases: Set[str] = set()
+        self.from_threading: Set[str] = set()    # Lock/RLock/Condition
+        self.module_aliases: Dict[str, str] = {}  # alias -> pkg module name
+        self.funcs: Dict[str, _FuncRec] = {}     # qual -> rec
+        self.classes: Dict[str, ast.ClassDef] = {}
+        # (cls, attr) -> param name, for ctor-param lock aliasing
+        self.ctor_param_attrs: Dict[Tuple[str, str], str] = {}
+        self.ctor_params: Dict[str, List[str]] = {}  # cls -> arg names
+        self.ctor_calls: List[Tuple[str, ast.Call, Optional[str],
+                                    Optional[str]]] = []
+        self.waivers: Dict[int, Tuple[str, str]] = {}  # line -> (tok, why)
+
+    def waiver_on(self, lines: Iterable[int], token: str) -> Optional[str]:
+        for ln in lines:
+            got = self.waivers.get(ln)
+            if got and got[0] == token and got[1]:
+                return got[1]
+        return None
+
+
+def _collect_waivers(src_lines: List[str]) -> Dict[int, Tuple[str, str]]:
+    out: Dict[int, Tuple[str, str]] = {}
+    for i, line in enumerate(src_lines, start=1):
+        m = _WAIVER_RE.search(line)
+        if m:
+            out[i] = (m.group(1), m.group(2).strip())
+    return out
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _lock_ctor(mod: _Module, node: ast.AST
+               ) -> Optional[Tuple[str, Optional[str], Optional[ast.AST]]]:
+    """(kind, explicit_name, condition_lock_expr) when ``node`` creates a
+    lock; None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base = f.value.id
+        if base in mod.threading_aliases and f.attr in _LOCK_KINDS:
+            cv_arg = None
+            if f.attr == "Condition":
+                if node.args:
+                    cv_arg = node.args[0]
+                for kw in node.keywords:
+                    if kw.arg == "lock":
+                        cv_arg = kw.value
+            return _LOCK_KINDS[f.attr], None, cv_arg
+        if base in mod.lockcheck_aliases and f.attr in _LC_KINDS:
+            name = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+            cv_arg = None
+            if f.attr == "condition":
+                if len(node.args) > 1:
+                    cv_arg = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg in ("lk", "lock"):
+                        cv_arg = kw.value
+            return _LC_KINDS[f.attr], name, cv_arg
+    if isinstance(f, ast.Name) and f.id in mod.from_threading \
+            and f.id in _LOCK_KINDS:
+        cv_arg = node.args[0] if (f.id == "Condition" and node.args) \
+            else None
+        return _LOCK_KINDS[f.id], None, cv_arg
+    return None
+
+
+def _scan_imports(mod: _Module, known_modules: Set[str],
+                  package: str) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                if a.name == "threading":
+                    mod.threading_aliases.add(alias)
+                elif a.name == "signal":
+                    mod.signal_aliases.add(alias)
+                elif a.name == "subprocess":
+                    mod.subprocess_aliases.add(alias)
+                elif a.name.startswith(package + "."):
+                    rel = a.name[len(package) + 1:]
+                    if rel in known_modules and a.asname:
+                        mod.module_aliases[a.asname] = rel
+        elif isinstance(node, ast.ImportFrom):
+            src = node.module or ""
+            for a in node.names:
+                alias = a.asname or a.name
+                if src == "threading":
+                    mod.from_threading.add(alias)
+                    continue
+                full = None
+                if src == package or src.startswith(package + "."):
+                    rel = src[len(package):].lstrip(".")
+                    full = f"{rel}.{a.name}" if rel else a.name
+                if full and a.name == "lockcheck":
+                    # the tripwire module itself is excluded from the
+                    # scan, so it is never in known_modules — recognize
+                    # its factory aliases unconditionally
+                    mod.lockcheck_aliases.add(alias)
+                elif full and full in known_modules:
+                    mod.module_aliases[alias] = full
+
+
+class _Resolver:
+    """Expression -> canonical lock name, within one function context."""
+
+    def __init__(self, model: LockModel, mod: _Module, cls: Optional[str],
+                 locals_map: Dict[str, str], qual: str = ""):
+        self.model = model
+        self.mod = mod
+        self.cls = cls
+        self.locals = locals_map
+        self.qual = qual
+        # attr -> name caches built lazily
+        self._by_attr: Optional[Dict[str, List[str]]] = None
+
+    def _attr_index(self) -> Dict[str, List[str]]:
+        if self._by_attr is None:
+            idx: Dict[str, List[str]] = {}
+            for name, d in self.model.locks.items():
+                if d.module == self.mod.name:
+                    idx.setdefault(d.attr, []).append(name)
+            self._by_attr = idx
+        return self._by_attr
+
+    def _by_cls_attr(self, cls: str, attr: str) -> Optional[str]:
+        for name, d in self.model.locks.items():
+            if d.module == self.mod.name and d.cls == cls and d.attr == attr:
+                return name
+        return None
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        name = self._resolve_raw(expr)
+        return self.model.resolve_alias(name) if name else None
+
+    def _resolve_raw(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.locals:
+                return self.locals[expr.id]
+            # function-local / enclosing-closure lock defs: walk the
+            # qual chain outward (rank_loop closures over a runner's
+            # local locks), then module level
+            q = self.qual
+            while q:
+                cand = f"{self.mod.name}.{q}.{expr.id}"
+                if cand in self.model.locks:
+                    return cand
+                q = q.rsplit(".", 1)[0] if "." in q else ""
+            cand = f"{self.mod.name}.{expr.id}"
+            if cand in self.model.locks:
+                return cand
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and self.cls is not None:
+                got = self._by_cls_attr(self.cls, attr)
+                if got:
+                    return got
+            matches = self._attr_index().get(attr, [])
+            if len(matches) == 1:
+                return matches[0]
+            return None
+        if isinstance(expr, ast.Subscript):
+            sl = expr.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                matches = self._attr_index().get(sl.value, [])
+                if len(matches) == 1:
+                    return matches[0]
+        return None
+
+
+def _qual_of_func(expr: ast.AST, mod: _Module, cls: Optional[str],
+                  enclosing: str) -> Optional[str]:
+    """Resolve a function-reference expression (a Thread target, a signal
+    handler) to a function key in this module."""
+    if isinstance(expr, ast.Name):
+        if enclosing and f"{enclosing}.{expr.id}" in mod.funcs:
+            return f"{mod.name}:{enclosing}.{expr.id}"
+        if expr.id in mod.funcs:
+            return f"{mod.name}:{expr.id}"
+        return None
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and cls is not None:
+            q = f"{cls}.{expr.attr}"
+            if q in mod.funcs:
+                return f"{mod.name}:{q}"
+        # unique method of that name anywhere in the module
+        cands = [q for q in mod.funcs
+                 if q.endswith("." + expr.attr) or q == expr.attr]
+        if len(cands) == 1:
+            return f"{mod.name}:{cands[0]}"
+    return None
+
+
+def _resolve_call(call: ast.Call, mod: _Module, cls: Optional[str],
+                  enclosing: str, all_funcs: Dict[str, _FuncRec]
+                  ) -> Optional[str]:
+    """Resolve a call site to a known function key (same module, or a
+    package module referenced through an import alias)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if enclosing and f"{mod.name}:{enclosing}.{f.id}" in all_funcs:
+            return f"{mod.name}:{enclosing}.{f.id}"
+        if f"{mod.name}:{f.id}" in all_funcs:
+            return f"{mod.name}:{f.id}"
+        if f"{mod.name}:{f.id}.__init__" in all_funcs:
+            return f"{mod.name}:{f.id}.__init__"  # class instantiation
+        return None
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name):
+            base = f.value.id
+            if base == "self" and cls is not None:
+                key = f"{mod.name}:{cls}.{f.attr}"
+                return key if key in all_funcs else None
+            target_mod = mod.module_aliases.get(base)
+            if target_mod is not None:
+                key = f"{target_mod}:{f.attr}"
+                return key if key in all_funcs else None
+        # obj.m(...): unique method named m in this module — plain Name
+        # receivers only (self._leases.clear() is a list clear, not a
+        # method of this package), and never a builtin container verb
+        if not isinstance(f.value, ast.Name) \
+                or f.attr in _CONTAINER_METHODS:
+            return None
+        cands = [q for q in mod.funcs if q.endswith("." + f.attr)]
+        if len(cands) == 1:
+            return f"{mod.name}:{cands[0]}"
+    return None
+
+
+def _is_timed(call: ast.Call, *, positional_timeout: bool = False) -> bool:
+    for kw in call.keywords:
+        if kw.arg and "timeout" in kw.arg:
+            return True
+    if positional_timeout and call.args:
+        return True
+    return False
+
+
+def _blocking_call(call: ast.Call, mod: _Module,
+                   resolver: _Resolver) -> Optional[str]:
+    """Name of the indefinite blocking operation this call performs, or
+    None.  Timed variants (an explicit timeout argument) do not count."""
+    name = _call_name(call)
+    f = call.func
+    if name in _BLOCKING_NAMES:
+        # an explicit timeout= makes the call bounded (socket recv/send
+        # take none, so only the ones that do — create_connection,
+        # communicate — can earn the exemption this way)
+        if _is_timed(call):
+            return None
+        return name
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in mod.subprocess_aliases \
+            and name in _SUBPROCESS_NAMES:
+        if _is_timed(call):
+            return None
+        return f"subprocess.{name}"
+    if name == "join" and isinstance(f, ast.Attribute):
+        # str.join is the big false positive: require a non-literal
+        # receiver and no timeout (positional or keyword)
+        if isinstance(f.value, ast.Constant):
+            return None
+        if _is_timed(call, positional_timeout=True):
+            return None
+        return "join"
+    if name == "wait" and isinstance(f, ast.Attribute):
+        recv = f.value
+        # barrier.wait blocks the round; condvar waits are handled by the
+        # caller (needs the resolved lock); Event.wait(timeout) is timed
+        recv_name = ""
+        if isinstance(recv, ast.Name):
+            recv_name = recv.id
+        elif isinstance(recv, ast.Attribute):
+            recv_name = recv.attr
+        if "barrier" in recv_name.lower():
+            return "barrier.wait"
+        if resolver.resolve(recv) is not None:
+            return None  # condvar wait: handled separately
+        if not _is_timed(call, positional_timeout=True):
+            return "wait"  # Event.wait() with no deadline
+        return None
+    if name == "wait_for" and isinstance(f, ast.Attribute):
+        if resolver.resolve(f.value) is not None:
+            return None  # condvar wait_for: handled separately
+        if not _is_timed(call):
+            return "wait_for"
+        return None
+    if name == "get" and isinstance(f, ast.Attribute) \
+            and not _is_timed(call):
+        recv = f.value
+        nm = recv.attr if isinstance(recv, ast.Attribute) else (
+            recv.id if isinstance(recv, ast.Name) else "")
+        if any(w in nm.lower() for w in ("queue", "jobs", "_q")):
+            return "queue.get"
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Extraction walk (phase A)
+# ---------------------------------------------------------------------------
+
+
+class _FuncWalker:
+    def __init__(self, model: LockModel, mod: _Module, rec: _FuncRec,
+                 all_funcs: Dict[str, _FuncRec]):
+        self.model = model
+        self.mod = mod
+        self.rec = rec
+        self.all_funcs = all_funcs
+        self.locals: Dict[str, str] = {}
+        self.resolver = _Resolver(model, mod, rec.cls, self.locals,
+                                  qual=rec.qual)
+        # held stack entries: (lock name, with-stmt line)
+        self.held: List[Tuple[str, int]] = []
+        self.while_depth = 0
+
+    # ------------------------------------------------------------- helpers
+    def _held_names(self) -> Tuple[str, ...]:
+        return tuple(h for h, _ in self.held)
+
+    def _held_lines(self) -> Tuple[int, ...]:
+        return tuple(ln for _, ln in self.held)
+
+    def _note_acquire(self, lock: str, line: int, via: str) -> None:
+        acq = Acq(lock=lock, func=self.rec.key, file=self.rec.file,
+                  line=line, via=via, held=self._held_names())
+        self.rec.acquires.append(acq)
+
+    def _note_block(self, call: str, line: int,
+                    held: Optional[Tuple[str, ...]] = None,
+                    held_lines: Optional[Tuple[int, ...]] = None) -> None:
+        held = self._held_names() if held is None else held
+        if not held:
+            return
+        held_lines = self._held_lines() if held_lines is None else held_lines
+        waiver = self.mod.waiver_on((line,) + held_lines, "holds-ok")
+        self.rec.blocks.append(BlockSite(
+            func=self.rec.key, file=self.rec.file, line=line, call=call,
+            held=held, held_lines=held_lines, waiver=waiver))
+
+    # ---------------------------------------------------------------- walk
+    def walk(self, stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs walked as their own records
+        if isinstance(st, ast.With):
+            pushed = 0
+            for item in st.items:
+                lock = self.resolver.resolve(item.context_expr)
+                if lock is not None:
+                    self._note_acquire(lock, st.lineno, "with")
+                    self.held.append((lock, st.lineno))
+                    pushed += 1
+                else:
+                    self._exprs(item.context_expr)
+            self.walk(st.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(st, ast.While):
+            self._exprs(st.test)
+            self.while_depth += 1
+            self.walk(st.body)
+            self.walk(st.orelse)
+            self.while_depth -= 1
+            return
+        if isinstance(st, ast.For):
+            self._exprs(st.iter)
+            # a `for` over a bounded iterable re-tests like a while for
+            # condvar purposes only when it literally loops; treat any
+            # loop as predicate context
+            self.while_depth += 1
+            self.walk(st.body)
+            self.walk(st.orelse)
+            self.while_depth -= 1
+            return
+        if isinstance(st, ast.If):
+            self._exprs(st.test)
+            self.walk(st.body)
+            self.walk(st.orelse)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body)
+            for h in st.handlers:
+                self.walk(h.body)
+            self.walk(st.orelse)
+            self.walk(st.finalbody)
+            return
+        if isinstance(st, ast.Assign):
+            # local lock aliases: x = <resolvable lock expr>, or
+            # x = d.setdefault(key, Lock()) (the keyed-mutex idiom)
+            if len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
+                v = st.value
+                if isinstance(v, ast.Call):
+                    if _call_name(v) == "setdefault" and len(v.args) == 2 \
+                            and _lock_ctor(self.mod, v.args[1]) is not None \
+                            and isinstance(v.func, ast.Attribute):
+                        base = v.func.value
+                        base_name = base.id if isinstance(base, ast.Name) \
+                            else getattr(base, "attr", "dict")
+                        cand = f"{self.mod.name}.{base_name}[]"
+                        if cand in self.model.locks:
+                            self.locals[st.targets[0].id] = cand
+                else:
+                    got = self.resolver.resolve(v)
+                    if got is not None:
+                        self.locals[st.targets[0].id] = got
+            self._attr_assign(st)
+            self._exprs(st.value)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._attr_assign(st)
+            self._exprs(st.value)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._exprs(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _attr_assign(self, st) -> None:
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self" \
+                    and self.rec.cls is not None:
+                self._note_attr(t.attr, t.lineno, write=True)
+
+    def _note_attr(self, attr: str, line: int, *, write: bool) -> None:
+        waiver = self.mod.waiver_on((line,), "shared-ok")
+        self.rec.attr_accesses.append(AttrAccess(
+            module=self.mod.name, cls=self.rec.cls or "", attr=attr,
+            func=self.rec.qual, file=self.rec.file, line=line,
+            write=write, held=self._held_names(), waiver=waiver))
+
+    def _exprs(self, node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub)
+            elif isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "self" and self.rec.cls is not None \
+                    and isinstance(sub.ctx, ast.Load):
+                self._note_attr(sub.attr, sub.lineno, write=False)
+
+    def _call(self, call: ast.Call) -> None:
+        name = _call_name(call)
+        f = call.func
+        # --- explicit acquire()
+        if name == "acquire" and isinstance(f, ast.Attribute):
+            lock = self.resolver.resolve(f.value)
+            if lock is not None:
+                blocking = True
+                for a in call.args[:1]:
+                    if isinstance(a, ast.Constant) and a.value is False:
+                        blocking = False
+                for kw in call.keywords:
+                    if kw.arg == "blocking" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is False:
+                        blocking = False
+                if blocking and not _is_timed(call):
+                    self._note_acquire(lock, call.lineno, "acquire")
+                return
+        # --- condvar wait / wait_for on a known condition lock
+        if name in ("wait", "wait_for") and isinstance(f, ast.Attribute):
+            lock = self.resolver.resolve(f.value)
+            if lock is not None:
+                timed = _is_timed(
+                    call, positional_timeout=(name == "wait"))
+                if name == "wait":
+                    waiver = self.mod.waiver_on((call.lineno,), "wait-ok")
+                    self.rec.waits.append(WaitSite(
+                        lock=lock, func=self.rec.key, file=self.rec.file,
+                        line=call.lineno, in_while=self.while_depth > 0,
+                        timed=timed, waiver=waiver))
+                if not timed:
+                    # waiting forever while holding OTHER locks blocks
+                    # them for the duration
+                    others = tuple((h, ln) for h, ln in self.held
+                                   if h != lock)
+                    if others:
+                        self._note_block(
+                            f"{name}({lock.rsplit('.', 1)[-1]})",
+                            call.lineno,
+                            held=tuple(h for h, _ in others),
+                            held_lines=tuple(ln for _, ln in others))
+                return
+        # --- thread spawn
+        if name == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    enclosing = self.rec.qual.rsplit(".", 1)[0] \
+                        if "." in self.rec.qual else self.rec.qual
+                    q = _qual_of_func(kw.value, self.mod, self.rec.cls,
+                                      enclosing)
+                    if q is None and self.rec.qual in self.mod.funcs:
+                        q = _qual_of_func(kw.value, self.mod, self.rec.cls,
+                                          self.rec.qual)
+                    if q is not None:
+                        self.model.thread_entries.add(q)
+                        if self.rec.cls is not None:
+                            self.model.thread_classes.setdefault(
+                                f"{self.mod.name}:{self.rec.cls}",
+                                set()).add(q)
+        # --- signal handler registration
+        if name == "signal" and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in self.mod.signal_aliases and \
+                len(call.args) >= 2:
+            enclosing = self.rec.qual
+            q = _qual_of_func(call.args[1], self.mod, self.rec.cls,
+                              enclosing)
+            if q is not None:
+                self.model.signal_handlers.add(q)
+        # --- blocking call while held
+        blk = _blocking_call(call, self.mod, self.resolver)
+        if blk is not None and self.held:
+            self._note_block(blk, call.lineno)
+        # --- call graph (for one-level call-through + reachability)
+        enclosing = self.rec.qual.rsplit(".", 1)[0] \
+            if "." in self.rec.qual else ""
+        callee = _resolve_call(call, self.mod, self.rec.cls, enclosing,
+                               self.all_funcs)
+        if callee is not None and callee != self.rec.key:
+            self.rec.calls.append((callee, call.lineno,
+                                   self._held_names(), self._held_lines()))
+
+
+# ---------------------------------------------------------------------------
+# Model assembly
+# ---------------------------------------------------------------------------
+
+
+def _collect_defs(model: LockModel, mod: _Module) -> None:
+    """Walk the module recording lock definitions, function records, and
+    excepthook registrations (context-aware: class / function nesting)."""
+
+    def visit(node: ast.AST, cls: Optional[str], qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                mod.classes[child.name] = child
+                visit(child, child.name, qual)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else (
+                    f"{cls}.{child.name}" if cls else child.name)
+                mod.funcs[q] = _FuncRec(mod.name, q, child, mod.file, cls)
+                if cls is not None and child.name == "__init__":
+                    mod.ctor_params[cls] = [
+                        a.arg for a in child.args.args[1:]]
+                    for st in ast.walk(child):
+                        if isinstance(st, ast.Assign) and \
+                                len(st.targets) == 1 and \
+                                isinstance(st.targets[0], ast.Attribute) \
+                                and isinstance(st.targets[0].value,
+                                               ast.Name) \
+                                and st.targets[0].value.id == "self" \
+                                and isinstance(st.value, ast.Name) \
+                                and st.value.id in mod.ctor_params[cls]:
+                            mod.ctor_param_attrs[
+                                (cls, st.targets[0].attr)] = st.value.id
+                visit(child, cls, q)
+            else:
+                _defs_in_stmt(child, cls, qual)
+                visit(child, cls, qual)
+
+    def _defs_in_stmt(node: ast.AST, cls: Optional[str],
+                      qual: str) -> None:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            got = _lock_ctor(mod, node.value)
+            if got is not None:
+                kind, explicit, cv_arg = got
+                attr = None
+                owner_cls = None
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self" and cls is not None:
+                    attr, owner_cls = t.attr, cls
+                elif isinstance(t, ast.Name):
+                    attr = t.id
+                    owner_cls = None  # module- or function-level variable
+                if attr is not None:
+                    _add_lock(kind, explicit, cv_arg, attr, owner_cls,
+                              qual, node.value.lineno)
+            # dict literals with lock values
+            if isinstance(node.value, ast.Dict):
+                _dict_locks(node.value, cls, qual)
+            # excepthook assignment = async context registration
+            if isinstance(t, ast.Attribute) and t.attr == "excepthook" \
+                    and isinstance(node.value, ast.Name):
+                q = _qual_of_func(node.value, mod, cls, qual)
+                if q is not None:
+                    model.signal_handlers.add(q)
+        elif isinstance(node, ast.Dict):
+            _dict_locks(node, cls, qual)
+        elif isinstance(node, ast.Call):
+            # d.setdefault(key, Lock()) — name by the dict variable
+            if _call_name(node) == "setdefault" and len(node.args) == 2:
+                got = _lock_ctor(mod, node.args[1])
+                if got is not None and isinstance(node.func,
+                                                  ast.Attribute):
+                    base = node.func.value
+                    base_name = base.id if isinstance(base, ast.Name) \
+                        else getattr(base, "attr", "dict")
+                    _add_lock(got[0], got[1], got[2],
+                              f"{base_name}[]", None, "",
+                              node.lineno)
+
+    def _dict_locks(d: ast.Dict, cls: Optional[str], qual: str) -> None:
+        for k, v in zip(d.keys, d.values):
+            got = _lock_ctor(mod, v)
+            if got is not None and isinstance(k, ast.Constant) \
+                    and isinstance(k.value, str):
+                _add_lock(got[0], got[1], got[2], k.value, cls, qual,
+                          v.lineno)
+            elif isinstance(v, ast.Dict):
+                _dict_locks(v, cls, qual)
+
+    cv_args: List[Tuple[str, ast.AST, Optional[str]]] = []
+
+    def _add_lock(kind: str, explicit: Optional[str],
+                  cv_arg: Optional[ast.AST], attr: str,
+                  owner_cls: Optional[str], qual: str, line: int) -> None:
+        if explicit:
+            name = explicit
+        elif owner_cls:
+            name = f"{mod.name}.{owner_cls}.{attr}"
+        elif qual:
+            name = f"{mod.name}.{qual}.{attr}"
+        else:
+            name = f"{mod.name}.{attr}"
+        if name in model.locks:
+            return
+        model.locks[name] = LockDef(
+            name=name, kind=kind, module=mod.name, cls=owner_cls,
+            attr=attr, file=mod.file, line=line)
+        if cv_arg is not None:
+            cv_args.append((name, cv_arg, owner_cls))
+
+    visit(mod.tree, None, "")
+
+    # conditions over an existing lock: resolve now that the module's
+    # defs are in — self.X arguments resolve within the owning class
+    for cv_name, arg, owner_cls in cv_args:
+        res = _Resolver(model, mod, owner_cls, {})
+        target = res.resolve(arg)
+        if target is not None and target != cv_name:
+            d = model.locks[cv_name]
+            model.locks[cv_name] = dataclasses.replace(
+                d, alias_of=target)
+
+
+def _resolve_ctor_aliases(model: LockModel, mod: _Module) -> None:
+    """``self.X = <ctor param>`` + an intra-module instantiation whose
+    matching argument is a known lock => (cls, X) aliases that lock."""
+    if not mod.ctor_param_attrs:
+        return
+    pending = {}  # (cls, attr) -> param
+    for (cls, attr), param in mod.ctor_param_attrs.items():
+        pending[(cls, attr)] = param
+    for rec in mod.funcs.values():
+        for node in ast.walk(rec.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            cls_name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if cls_name not in mod.ctor_params:
+                continue
+            params = mod.ctor_params[cls_name]
+            res = _Resolver(model, mod, rec.cls, {}, qual=rec.qual)
+            argmap: Dict[str, Optional[str]] = {}
+            for i, a in enumerate(node.args):
+                if i < len(params):
+                    argmap[params[i]] = res.resolve(a)
+            for kw in node.keywords:
+                if kw.arg:
+                    argmap[kw.arg] = res.resolve(kw.value)
+            for (cls, attr), param in list(pending.items()):
+                if cls == cls_name and argmap.get(param):
+                    alias_name = f"{mod.name}.{cls}.{attr}"
+                    if alias_name not in model.locks:
+                        model.locks[alias_name] = LockDef(
+                            name=alias_name, kind="lock", module=mod.name,
+                            cls=cls, attr=attr, file=mod.file,
+                            line=node.lineno,
+                            alias_of=argmap[param])
+
+
+def package_root() -> str:
+    """Filesystem root of the installed ``bluefog_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _iter_sources(root: str) -> List[Tuple[str, str]]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "csrc")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                if path.endswith(os.path.join("utils", "lockcheck.py")):
+                    continue  # the tripwire instrument, not a subject
+
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        out.append((path, f.read()))
+                except OSError:
+                    continue
+    return out
+
+
+def build_package_model(root: Optional[str] = None) -> LockModel:
+    """Build the model over the whole installed package tree."""
+    root = root or package_root()
+    return build_model(_iter_sources(root), rel_to=root)
+
+
+def build_model(sources: Sequence[Tuple[str, str]], *,
+                rel_to: Optional[str] = None) -> LockModel:
+    """Build a :class:`LockModel` from ``(filename, source)`` pairs.
+
+    Module names derive from the path relative to ``rel_to`` (or the
+    bare filename) — synthetic single-file tests get module name
+    ``<stem>``."""
+    model = LockModel()
+    mods: List[_Module] = []
+    known: Set[str] = set()
+    parsed: List[Tuple[str, str, ast.Module, List[str]]] = []
+    for path, src in sources:
+        if rel_to and os.path.abspath(path).startswith(
+                os.path.abspath(rel_to)):
+            rel = os.path.relpath(path, rel_to)
+        else:
+            rel = os.path.basename(path)
+        modname = rel[:-3].replace(os.sep, ".")
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            model.parse_failures.append((path, str(e)))
+            continue
+        parsed.append((modname, path, tree, src.splitlines()))
+        known.add(modname)
+    for modname, path, tree, lines in parsed:
+        mod = _Module(modname, path, tree, lines)
+        mod.waivers = _collect_waivers(lines)
+        _scan_imports(mod, known, package="bluefog_tpu")
+        mods.append(mod)
+        model.files.append(path)
+
+    # pass 1: definitions (locks, functions, ctor-param maps)
+    for mod in mods:
+        _collect_defs(model, mod)
+    # pass 1b: ctor-param lock aliasing (needs all defs)
+    for mod in mods:
+        _resolve_ctor_aliases(model, mod)
+
+    all_funcs: Dict[str, _FuncRec] = {}
+    for mod in mods:
+        for rec in mod.funcs.values():
+            all_funcs[rec.key] = rec
+
+    # pass 2: per-function extraction
+    for mod in mods:
+        for rec in mod.funcs.values():
+            w = _FuncWalker(model, mod, rec, all_funcs)
+            body = getattr(rec.node, "body", [])
+            w.walk(body)
+
+    # assemble direct facts
+    for rec in all_funcs.values():
+        model.acquires.extend(rec.acquires)
+        model.blocks.extend(rec.blocks)
+        model.waits.extend(rec.waits)
+        model.attr_accesses.extend(rec.attr_accesses)
+        model.calls[rec.key] = [c[0] for c in rec.calls]
+    for mod in mods:
+        for ln, tok_reason in mod.waivers.items():
+            model.waiver_lines[(mod.file, ln)] = tok_reason
+
+    # one-level call-through: while holding H, calling g pulls g's own
+    # direct acquisitions and blocking calls under H
+    mod_by_name = {m.name: m for m in mods}
+    for rec in all_funcs.values():
+        for callee_key, line, held, held_lines in rec.calls:
+            if not held:
+                continue
+            callee = all_funcs.get(callee_key)
+            if callee is None:
+                continue
+            short = callee_key.split(":", 1)[1]
+            for a in callee.acquires:
+                derived = Acq(lock=a.lock, func=rec.key, file=rec.file,
+                              line=line, via=f"call:{short}", held=held)
+                model.acquires.append(derived)
+            m = mod_by_name.get(rec.module)
+            for b_call, b_line in _direct_blocking(model, callee,
+                                                   mod_by_name):
+                waiver = None
+                if m is not None:
+                    waiver = m.waiver_on((line,) + held_lines, "holds-ok")
+                if waiver is None:
+                    cm = mod_by_name.get(callee.module)
+                    if cm is not None:
+                        waiver = cm.waiver_on((b_line,), "holds-ok")
+                model.blocks.append(BlockSite(
+                    func=rec.key, file=rec.file, line=line,
+                    call=f"{short}>{b_call}", held=held,
+                    held_lines=held_lines, waiver=waiver))
+
+    # edges from every acquisition's held-set
+    for a in model.acquires:
+        for h in a.held:
+            if h == a.lock:
+                continue
+            key = (h, a.lock)
+            if key not in model.edges:
+                model.edges[key] = a
+
+    # async contexts: reachability over the resolved call graph
+    entries = set(model.thread_entries) | set(model.signal_handlers)
+    reach = set(entries)
+    frontier = list(entries)
+    while frontier:
+        cur = frontier.pop()
+        rec = all_funcs.get(cur)
+        if rec is None:
+            continue
+        for callee_key, _, _, _ in rec.calls:
+            if callee_key not in reach:
+                reach.add(callee_key)
+                frontier.append(callee_key)
+    model.async_funcs = reach
+    for fkey in reach:
+        rec = all_funcs.get(fkey)
+        if rec is None:
+            continue
+        for a in rec.acquires:
+            model.async_locks.setdefault(a.lock, set()).add(fkey)
+
+    return model
+
+
+def _direct_blocking(model: LockModel, rec: _FuncRec,
+                     mod_by_name: Dict[str, _Module]
+                     ) -> List[Tuple[str, int]]:
+    """Blocking calls anywhere in ``rec``'s body, including ones made
+    while holding nothing (the caller's held-set supplies the hold)."""
+    out: List[Tuple[str, int]] = []
+    mod = mod_by_name.get(rec.module)
+    if mod is None:
+        return out
+    res = _Resolver(model, mod, rec.cls, {}, qual=rec.qual)
+    for node in ast.walk(rec.node):
+        if isinstance(node, ast.Call):
+            blk = _blocking_call(node, mod, res)
+            if blk is not None:
+                out.append((blk, node.lineno))
+    return out

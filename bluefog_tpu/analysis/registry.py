@@ -56,10 +56,10 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import threading
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from bluefog_tpu.analysis.report import Diagnostic
+from bluefog_tpu.utils import lockcheck as _lc
 
 __all__ = [
     "ID_FAMILIES",
@@ -154,7 +154,7 @@ class LeaseRegistry:
     """
 
     def __init__(self, *, collect_only_in_scope: bool = False):
-        self._lock = threading.Lock()
+        self._lock = _lc.lock("analysis.registry.LeaseRegistry._lock")
         self._leases: List[CollectiveIdLease] = []
         self._collect_only_in_scope = collect_only_in_scope
         self._scope_depth = 0

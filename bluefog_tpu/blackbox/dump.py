@@ -42,6 +42,7 @@ import traceback
 from typing import List, Optional
 
 from bluefog_tpu.blackbox import recorder as _rec
+from bluefog_tpu.utils import lockcheck as _lc
 
 __all__ = ["dump", "install", "incident_dir", "collect_attempt"]
 
@@ -127,7 +128,7 @@ def _metrics_snapshot() -> Optional[dict]:
 # interrupts — if that thread is already inside dump(), a plain mutex
 # would self-deadlock the process the tool exists to diagnose (the same
 # bug class as runtime/native.py's engine lock, fixed in PR 1)
-_dump_lock = threading.RLock()
+_dump_lock = _lc.rlock("blackbox.dump._dump_lock")
 _dump_count = 0
 # headers of earlier dumps this process wrote: escalation chains dump
 # repeatedly to the SAME per-rank path (heartbeat_timeout, then the

@@ -51,6 +51,8 @@ import contextlib
 import itertools
 import os
 import threading
+
+from bluefog_tpu.utils import lockcheck as _lc
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -112,7 +114,7 @@ class FlightRecorder:
         self.capacity = int(capacity)
         self.rank = rank
         self.created_at = time.time()
-        self._lock = threading.Lock()
+        self._lock = _lc.lock("blackbox.recorder.FlightRecorder._lock")
         self._seq = itertools.count()
         self._events: collections.deque = collections.deque(
             maxlen=self.capacity)
@@ -219,7 +221,7 @@ class FlightRecorder:
 
 
 _RECORDER: Optional[FlightRecorder] = None
-_state_lock = threading.Lock()
+_state_lock = _lc.lock("blackbox.recorder._state_lock")
 
 
 def get() -> Optional[FlightRecorder]:
@@ -281,7 +283,7 @@ def end(name: str, key=None, **fields) -> None:
 #: identical order, so the k-th neighbor_allreduce call site gets the same
 #: id on every rank — the cross-rank alignment key merge.py joins on.
 _cid_counters: Dict[str, "itertools.count"] = {}
-_cid_lock = threading.Lock()
+_cid_lock = _lc.lock("blackbox.recorder._cid_lock")
 
 
 def next_collective_id(op: str) -> str:

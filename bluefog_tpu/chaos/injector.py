@@ -32,6 +32,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.metrics import comm as _mt
 
 __all__ = [
@@ -227,7 +228,7 @@ class Injector:
     def __init__(self, spec: str):
         self.spec = spec
         self.rules = parse_spec(spec)
-        self._mu = threading.Lock()
+        self._mu = _lc.lock("chaos.injector.Injector._mu")
         self._counters: Dict[int, int] = {i: 0 for i in range(len(self.rules))}
         self._fired: Dict[int, int] = {i: 0 for i in range(len(self.rules))}
         self._rngs = [random.Random((r.seed << 8) ^ i)
@@ -387,7 +388,7 @@ class Injector:
 
 _injector: Optional[Injector] = None
 _resolved = False
-_state_mu = threading.Lock()
+_state_mu = _lc.lock("chaos.injector._state_mu")
 
 
 def configure(spec: Optional[str]) -> Optional[Injector]:

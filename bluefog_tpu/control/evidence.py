@@ -30,7 +30,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import threading
+
+from bluefog_tpu.utils import lockcheck as _lc
 from typing import Dict, List, Mapping, Optional, Tuple
 
 __all__ = ["Evidence", "EvidenceBoard", "canonicalize", "write_evidence",
@@ -159,7 +160,7 @@ class EvidenceBoard:
     filesystem.  Thread-safe; newest round per rank wins."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = _lc.lock("control.evidence.EvidenceBoard._mu")
         self._table: Dict[int, Evidence] = {}
 
     def publish(self, ev: Evidence) -> None:

@@ -20,11 +20,11 @@ from __future__ import annotations
 import atexit
 import json
 import os
-import threading
 import time
 from typing import Optional
 
 from bluefog_tpu.metrics import registry as _reg
+from bluefog_tpu.utils import lockcheck as _lc
 
 __all__ = [
     "MetricsWriter",
@@ -45,7 +45,7 @@ class MetricsWriter:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = _lc.lock("metrics.export.MetricsWriter._lock")
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         # truncate once per process per path: each run owns its file
@@ -65,7 +65,7 @@ class MetricsWriter:
 
 
 _WRITER: Optional[MetricsWriter] = None
-_writer_lock = threading.Lock()
+_writer_lock = _lc.lock("metrics.export._writer_lock")
 _step_counter = 0
 _atexit_armed = False
 

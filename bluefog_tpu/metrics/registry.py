@@ -25,6 +25,8 @@ import collections
 import math
 import os
 import threading
+
+from bluefog_tpu.utils import lockcheck as _lc
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -167,7 +169,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _lc.rlock("metrics.registry.MetricsRegistry._lock")
         self._metrics: Dict[str, _Metric] = {}
         # name -> {label_key: float | _HistState}
         self._values: Dict[str, Dict[_LabelKey, object]] = {}
@@ -264,7 +266,7 @@ class MetricsRegistry:
 
 
 _REGISTRY: Optional[MetricsRegistry] = None
-_state_lock = threading.Lock()
+_state_lock = _lc.lock("metrics.registry._state_lock")
 # set by metrics_stop(): an explicit stop must stick even when
 # BLUEFOG_TPU_METRICS is set, or the next instrumented call would lazily
 # resurrect the subsystem and re-attach the writer

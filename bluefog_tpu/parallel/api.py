@@ -37,6 +37,7 @@ from bluefog_tpu.ops.windows import WindowState
 from bluefog_tpu.parallel.context import get_context
 from bluefog_tpu.topology.graphs import Topology
 from bluefog_tpu.topology.schedule import GossipSchedule, build_schedule
+from bluefog_tpu.utils import lockcheck as _lc
 
 try:  # JAX >= 0.4.35
     from jax import shard_map as _shard_map_mod  # type: ignore
@@ -496,8 +497,8 @@ def win_update_then_collect(name: str):
     return out
 
 
-_win_mutexes: Dict[str, threading.RLock] = {}
-_win_mutexes_guard = threading.Lock()
+_win_mutexes: Dict[str, object] = {}
+_win_mutexes_guard = _lc.lock("parallel.api._win_mutexes_guard")
 _dist_held = threading.local()  # per-thread reentrancy counts per name
 
 
@@ -609,7 +610,8 @@ def win_mutex(name: str = "win", *, for_self: bool = True, ranks=None,
     client = _coordination_client()
     if client is None:
         with _win_mutexes_guard:
-            lock = _win_mutexes.setdefault(name, threading.RLock())
+            lock = _win_mutexes.setdefault(
+                name, _lc.rlock("parallel.api._win_mutexes[]"))
         with lock:
             yield
         return

@@ -58,6 +58,7 @@ import contextlib
 import numpy as np
 
 from bluefog_tpu import chaos as _chaos
+from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.control import (CommController as _CommController,
                                  ControlConfig as _ControlConfig,
@@ -113,7 +114,7 @@ class _PyWinTable:
     (BLUEFOG_TPU_NO_NATIVE / no C++ toolchain)."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = _lc.lock("runtime.async_windows._PyWinTable._mu")
         self._wins: Dict[str, dict] = {}
 
     def create(self, name, n_slots, n_elems, dtype):
@@ -122,9 +123,11 @@ class _PyWinTable:
                 return -2
             self._wins[name] = {
                 "self": np.zeros(n_elems, dtype),
-                "self_mu": threading.Lock(),
+                "self_mu": _lc.lock(
+                    "runtime.async_windows._PyWinTable.self_mu"),
                 "slots": [
-                    {"mu": threading.Lock(), "buf": np.zeros(n_elems, dtype),
+                    {"mu": _lc.lock("runtime.async_windows._PyWinTable.slot_mu"),
+                     "buf": np.zeros(n_elems, dtype),
                      "deposits": 0, "fresh": 0}
                     for _ in range(n_slots)
                 ],
@@ -199,7 +202,7 @@ class _PyWinTable:
 # wedged memory — at which point exit semantics are moot.
 _CAST_WORKERS = min(8, os.cpu_count() or 1)
 _cast_pool_obj = None
-_cast_pool_mu = threading.Lock()
+_cast_pool_mu = _lc.lock("runtime.async_windows._cast_pool_mu")
 
 
 def _cast_pool():
@@ -213,7 +216,7 @@ def _cast_pool():
 
 
 _py_table: Optional[_PyWinTable] = None
-_py_table_mu = threading.Lock()
+_py_table_mu = _lc.lock("runtime.async_windows._py_table_mu")
 
 
 def _fallback() -> _PyWinTable:
@@ -674,7 +677,7 @@ def run_async_pushsum(
     stop = threading.Event()
     steps = [0] * n
     estimates = x0.copy()
-    est_mu = threading.Lock()
+    est_mu = _lc.lock("runtime.async_windows.run_async_pushsum.est_mu")
     errors: List[BaseException] = []
     board = (_res.HealthBoard(n, suspect_after_s=resilience.suspect_after_s,
                               dead_after_s=resilience.dead_after_s)
@@ -1066,7 +1069,7 @@ def run_async_dsgd(
     # round boundaries, so every loop converges on the same replan with
     # no coordination beyond this set (replan is deterministic in the
     # member list)
-    mem_mu = threading.Lock()
+    mem_mu = _lc.lock("runtime.async_windows.run_async_dsgd.mem_mu")
     members = set(members0)
     left_final: set = set()
     ever_joined: set = set()

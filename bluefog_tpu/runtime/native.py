@@ -18,6 +18,7 @@ import subprocess
 import threading
 from typing import Callable, Optional
 
+from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.utils import log
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
@@ -27,7 +28,7 @@ _LIB_PATH = os.path.join(_CSRC, "libbf_runtime.so")
 
 _lib = None
 _lib_attempted = False
-_build_lock = threading.Lock()
+_build_lock = _lc.lock("runtime.native._build_lock")
 
 _CALLBACK_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
 
@@ -179,7 +180,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
-_load_lock = threading.Lock()
+_load_lock = _lc.lock("runtime.native._load_lock")
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -248,7 +249,7 @@ class TimelineWriter:
 # process-global (one background thread, one handle space), so the Python
 # bookkeeping that keeps ctypes trampolines alive and carries captured
 # exceptions must be process-global too.
-_handles_lock = threading.Lock()
+_handles_lock = _lc.lock("runtime.native._handles_lock")
 _handles: dict = {}  # handle -> (trampoline, holder)
 
 
@@ -364,7 +365,7 @@ class PyEngine:
     def __init__(self):
         self._q: _queue.Queue = _queue.Queue()
         self._results: dict[int, object] = {}
-        self._cv = threading.Condition()
+        self._cv = _lc.condition("runtime.native.PyEngine._cv")
         self._next = 0
         self._stop = False
         self._thread = threading.Thread(
@@ -456,7 +457,7 @@ class PyEngine:
 # RLock: engine() holds this while Engine.__init__ runs, and the fallback
 # path re-enters it through _py_engine() — a plain Lock self-deadlocks
 # whenever the native .so is unavailable
-_engine_lock = threading.RLock()
+_engine_lock = _lc.rlock("runtime.native._engine_lock")
 _PY_ENGINE: Optional[PyEngine] = None
 
 

@@ -68,6 +68,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
 
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.utils import lockcheck as _lc
 
 __all__ = [
     "HEALTHY",
@@ -331,7 +332,7 @@ class HealthBoard:
         from the start; the rest begin LEFT — capacity reserved for
         later joiners, never promoted to SUSPECT/DEAD by their silence.
         Default: every slot is a member (the fixed-fleet behavior)."""
-        self._mu = threading.Lock()
+        self._mu = _lc.lock("runtime.resilience.HealthBoard._mu")
         self._cores = [
             _HealthCore(f"rank{r}", suspect_after_s, dead_after_s, clock)
             for r in range(n_ranks)

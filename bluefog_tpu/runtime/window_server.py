@@ -146,16 +146,17 @@ import numpy as np
 from bluefog_tpu import chaos as _chaos
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
-from bluefog_tpu.runtime import native, resilience, wire_codec
+from bluefog_tpu.runtime import native, resilience, wire_codec, wire_status
 from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS, _fallback
 from bluefog_tpu.serving import snapshots as _snap
+from bluefog_tpu.utils import lockcheck as _lc
 
 __all__ = ["WindowServer", "RemoteWindow", "PipelinedRemoteWindow",
            "DepositStream", "PROTOCOL_VERSION"]
 
 _MAGIC = 0xBF_51_0E_02      # wire v2
 _MAGIC_V1 = 0xBF_51_0E_01   # recognized only to reject it loudly
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = wire_status.PROTOCOL_VERSION
 
 _HDR = struct.Struct("<IBH")          # magic, op, name_len
 _BODY = struct.Struct("<iBBq")        # slot, flags, dtype, n_elems
@@ -226,43 +227,22 @@ _CODEC_FEATURE = {wire_codec.CODEC_NONE: 0,
 # the ONE dtype-id table (async_windows owns np.dtype -> id; invert here)
 _DTYPES = {v: k for k, v in _DTYPE_IDS.items()}
 
-# error statuses (negative, disjoint from the native table's -1)
-_ERR_GEOMETRY = -2   # dtype/n_elems disagree with the window's geometry
-_ERR_NO_WINDOW = -3
-_ERR_BAD_OP = -100
-_ERR_VERSION = -101  # protocol version mismatch (v1 frame / bad HELLO)
-_ERR_CODEC = -102    # codec not granted for this connection / bad payload
-_ERR_TOO_LARGE = -104  # claimed length exceeds any legal encoding
-_ERR_STALE_EPOCH = -105  # attach/batch from a superseded stream epoch
-_ERR_BUSY = -106     # previous stream generation could not be quiesced
-_ERR_ROUND_ROLLED = -107  # RETRIABLE: pinned snapshot round superseded
-_ERR_NO_SNAPSHOT = -108   # group/leaf has no published snapshot (yet)
+# error statuses: ONE registry (runtime/wire_status.py) shared with the
+# serving clients and checked against docs/transport.md by BF-DOC001 —
+# the local _ERR_* names are aliases kept for this module's long-standing
+# internal (and test-visible) spelling
+_ERR_GEOMETRY = wire_status.ERR_GEOMETRY
+_ERR_NO_WINDOW = wire_status.ERR_NO_WINDOW
+_ERR_BAD_OP = wire_status.ERR_BAD_OP
+_ERR_VERSION = wire_status.ERR_VERSION
+_ERR_CODEC = wire_status.ERR_CODEC
+_ERR_TOO_LARGE = wire_status.ERR_TOO_LARGE
+_ERR_STALE_EPOCH = wire_status.ERR_STALE_EPOCH
+_ERR_BUSY = wire_status.ERR_BUSY
+_ERR_ROUND_ROLLED = wire_status.ERR_ROUND_ROLLED
+_ERR_NO_SNAPSHOT = wire_status.ERR_NO_SNAPSHOT
 
-_ERR_TEXT = {
-    _ERR_GEOMETRY: "size/dtype mismatch with the window's geometry",
-    _ERR_NO_WINDOW: "no such window on the serving host",
-    _ERR_BAD_OP: "unparseable request",
-    _ERR_VERSION: (f"protocol version mismatch (this client speaks "
-                   f"v{PROTOCOL_VERSION}; peer rejected the handshake)"),
-    _ERR_CODEC: "wire codec not negotiated or payload undecodable",
-    _ERR_TOO_LARGE: "claimed payload length exceeds any legal encoding",
-    _ERR_STALE_EPOCH: ("stream epoch superseded (a newer connection of "
-                       "this DepositStream attached; this one is a "
-                       "zombie)"),
-    _ERR_BUSY: ("previous stream generation still draining; attach "
-                "again after backoff"),
-    _ERR_ROUND_ROLLED: ("snapshot round rolled: the pinned round is no "
-                        "longer current (retriable — re-pin at the "
-                        "table's new round and re-read)"),
-    _ERR_NO_SNAPSHOT: ("no round-stamped snapshot published for this "
-                       "group/leaf (retriable while the publisher warms "
-                       "up; terminal for a misspelled name)"),
-}
-
-
-def _err_text(rc: int) -> str:
-    return _ERR_TEXT.get(rc, "window missing, slot out of range, or "
-                         "size/dtype mismatch")
+_err_text = wire_status.err_text
 
 
 def _routable_host() -> str:
@@ -446,7 +426,7 @@ class _ApplyWorker:
         self._jobs: "_q.Queue" = _q.Queue(maxsize=2)
         self._closed = False
         self._free: Dict[int, List[np.ndarray]] = {}
-        self._free_mu = threading.Lock()
+        self._free_mu = _lc.lock("runtime.window_server._ApplyWorker._free_mu")
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"bf-win-apply:{peer}")
         self._thread.start()
@@ -548,7 +528,10 @@ class _ApplyWorker:
             if act is not None and act[0] in ("delay", "stall"):
                 time.sleep(act[1])
             try:
-                with self._wlock:
+                # the ack-after-apply ordering under the per-connection
+                # write mutex IS the client's flush fence; a peer that
+                # stops draining wedges only its own connection
+                with self._wlock:  # bfverify: holds-ok per-connection write mutex; ack ordering is the flush fence (reviewed PR 4/9)
                     self._sock.sendall(_ACK.pack(seq, first_err or applied))
             except OSError:
                 return  # peer gone; the recv loop will notice too
@@ -645,7 +628,9 @@ class _SubSender:
 
     def _send(self, views) -> bool:
         try:
-            with self._wmu:
+            # a reader that stops draining blocks only this subscription's
+            # own sender thread; the next epoch's attach tears it down
+            with self._wmu:  # bfverify: holds-ok per-connection write mutex; a stalled reader wedges only its own subscription (reviewed PR 7/9)
                 _sendmsg_all(self._sock, views)
             return True
         except (OSError, ConnectionError):
@@ -736,7 +721,7 @@ class _Handler(socketserver.BaseRequestHandler):
         self._deferred_err = 0
         # replies can come from two threads once a batch stream starts
         # (handler: sync ops; apply worker: batch acks) — serialize writes
-        self._wmu = threading.Lock()
+        self._wmu = _lc.lock("runtime.window_server._Handler._wmu")
         self._worker: Optional[_ApplyWorker] = None  # created on 1st batch
         # DepositStream lineage binding (STREAM_ATTACH); None = unbound
         self._stream_sid: Optional[int] = None
@@ -745,11 +730,11 @@ class _Handler(socketserver.BaseRequestHandler):
         self._sub: Optional[_SubSender] = None
 
     def _send(self, data) -> None:
-        with self._wmu:
+        with self._wmu:  # bfverify: holds-ok per-connection write mutex; only this connection's handler+applier share it (reviewed PR 4/9)
             self.request.sendall(data)
 
     def _send_views(self, views) -> None:
-        with self._wmu:
+        with self._wmu:  # bfverify: holds-ok per-connection write mutex; only this connection's handler+applier share it (reviewed PR 4/9)
             _sendmsg_all(self.request, views)
 
     def finish(self):
@@ -1219,7 +1204,7 @@ class _Server(socketserver.ThreadingTCPServer):
         super().__init__(*args, **kwargs)
         self._conns: set = set()
         self._features: Dict[int, int] = {}  # id(sock) -> granted mask
-        self._conns_mu = threading.Lock()
+        self._conns_mu = _lc.lock("runtime.window_server._Server._conns_mu")
         # DepositStream lineage state: stream_id -> [epoch, applied_seq,
         # handler, last_activity, first_err].  Survives connection churn
         # — that is the whole point: the applied high-water mark is what
@@ -1227,14 +1212,15 @@ class _Server(socketserver.ThreadingTCPServer):
         # first batch error is what keeps a rejected deposit LOUD even
         # when the connection died before its negative ack got out.
         self._streams: Dict[int, list] = {}
-        self._streams_mu = threading.Lock()
+        self._streams_mu = _lc.lock(
+            "runtime.window_server._Server._streams_mu")
         # Subscriber lineage state: sub_id -> [epoch, handler,
         # last_activity].  Same epoch discipline as deposit streams, on
         # the read path: a reconnecting subscriber's newer epoch
         # quiesces the superseded push sender, a zombie can never keep
         # pushing beside its successor.
         self._subs: Dict[int, list] = {}
-        self._subs_mu = threading.Lock()
+        self._subs_mu = _lc.lock("runtime.window_server._Server._subs_mu")
         self._live_subs = 0
 
     # -------------------------------------------------- subscriber lineage
@@ -1700,7 +1686,7 @@ class DepositStream:
         self._sock_gen = 0
         self._conn_broken = False
         self._wake = threading.Event()  # interrupts backoff sleeps on close
-        self._cv = threading.Condition()
+        self._cv = _lc.condition("runtime.window_server.DepositStream._cv")
         self._queue: collections.deque = collections.deque()
         # seq -> (t_send, retained items | None, n_items, wire, dense);
         # items are retained until the ack ONLY when reconnect is on —
@@ -1885,12 +1871,24 @@ class DepositStream:
                     h.note_failure()
                 continue
             with self._cv:
+                if self._closed:
+                    # close() won the race while we were connecting: it
+                    # already closed (or is about to close) the OLD
+                    # socket it read — installing the fresh one here
+                    # would leak it and leave the ack thread parked in
+                    # recv on a socket nobody will ever close (found by
+                    # the BF-CONC003 thread-shared-state audit)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return False
                 self._sock = sock
                 self._sock_gen += 1
                 self._conn_broken = False
                 self._cv.notify_all()
             self._hb_last = time.monotonic()
-            self._reconnects += 1
+            self._reconnects += 1  # bfverify: shared-ok written only by the sender thread; readers take a GIL-atomic int snapshot
             _mt.inc("bf_reconnects_total", 1.0, peer=self._peer)
             if replayed:
                 _mt.inc("bf_replayed_batches_total", float(replayed),
@@ -1940,7 +1938,7 @@ class DepositStream:
             free.append(arr)
 
     def _raise_if_err(self) -> None:
-        if self._err is not None:
+        if self._err is not None:  # bfverify: shared-ok latch-once str ref; _fail() writes under _cv, a GIL-atomic read here can only be early, never torn
             raise RuntimeError(
                 f"pipelined deposits to {self._peer} failed: {self._err}")
 
@@ -1949,7 +1947,7 @@ class DepositStream:
         prev = self._ack_ewma
         a = self._ack_ewma_alpha
         ewma = seconds if prev is None else (a * seconds + (1.0 - a) * prev)
-        self._ack_ewma = ewma
+        self._ack_ewma = ewma  # bfverify: shared-ok single float-ref store, atomic under the GIL; only the ack thread writes
         _mt.set("bf_peer_ack_ewma_seconds", ewma, peer=self._peer)
 
     def ack_ewma(self) -> Optional[float]:
@@ -2281,8 +2279,13 @@ class DepositStream:
             self._cv.notify_all()
         self._wake.set()  # interrupt a mid-backoff reconnect sleep
         self._sender.join(timeout=5)
+        # read the socket under the lock: a reconnect mid-close must not
+        # swap in a fresh socket between our read and our close (the
+        # _recover() side refuses the swap once _closed is set)
+        with self._cv:
+            sock = self._sock
         try:
-            self._sock.close()
+            sock.close()
         except OSError:
             pass
         self._acker.join(timeout=5)

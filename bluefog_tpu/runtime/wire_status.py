@@ -1,0 +1,107 @@
+"""The ONE registry of wire v2 status codes.
+
+Three modules speak these codes — the server
+(:mod:`bluefog_tpu.runtime.window_server`), the snapshot reader
+(:mod:`bluefog_tpu.serving.client`), and the push subscriber
+(:mod:`bluefog_tpu.serving.subscriber`) — and until this table existed
+each hand-carried its own literals, which had already drifted once per
+review notes.  Import from here; never re-type a code.
+
+Dependency-free by design (stdlib only): the serving clients import it
+without pulling the server machinery, and the analysis passes import it
+without touching sockets.  The BF-DOC001 lint
+(:mod:`bluefog_tpu.analysis.doc_lint`) checks that ``docs/transport.md``
+documents every code in :data:`WIRE_V2_CODES`, so the doc cannot drift
+from this table again.
+
+Conventions: codes are negative ``i64`` statuses on the wire.  ``-1``
+(native-table op failure) and the geometry/window codes predate wire v2
+and are shared with the in-process table; ``-100`` and below are
+wire-protocol codes proper.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = [
+    "ERR_BAD_OP",
+    "ERR_BUSY",
+    "ERR_CODEC",
+    "ERR_GEOMETRY",
+    "ERR_NO_SNAPSHOT",
+    "ERR_NO_WINDOW",
+    "ERR_ROUND_ROLLED",
+    "ERR_STALE_EPOCH",
+    "ERR_TOO_LARGE",
+    "ERR_VERSION",
+    "PROTOCOL_VERSION",
+    "STATUS_TEXT",
+    "WIRE_V2_CODES",
+    "err_text",
+    "is_retriable",
+]
+
+PROTOCOL_VERSION = 2
+
+# table-level statuses (shared with the native/fallback window table)
+ERR_GEOMETRY = -2     # dtype/n_elems disagree with the window's geometry
+ERR_NO_WINDOW = -3    # no such window on the serving host
+
+# wire-protocol statuses (v2)
+ERR_BAD_OP = -100        # unparseable request
+ERR_VERSION = -101       # protocol version mismatch (v1 frame/bad HELLO)
+ERR_CODEC = -102         # codec not granted / payload undecodable
+# -103 is deliberately unassigned (a v2 draft code that never shipped);
+# keep the gap so an old peer emitting it is recognizably foreign
+ERR_TOO_LARGE = -104     # claimed length exceeds any legal encoding
+ERR_STALE_EPOCH = -105   # attach/batch/subscribe from a superseded epoch
+ERR_BUSY = -106          # previous stream generation could not quiesce
+ERR_ROUND_ROLLED = -107  # RETRIABLE: pinned snapshot round superseded
+ERR_NO_SNAPSHOT = -108   # group/leaf has no published snapshot (yet)
+
+STATUS_TEXT: Dict[int, str] = {
+    ERR_GEOMETRY: "size/dtype mismatch with the window's geometry",
+    ERR_NO_WINDOW: "no such window on the serving host",
+    ERR_BAD_OP: "unparseable request",
+    ERR_VERSION: (f"protocol version mismatch (this client speaks "
+                  f"v{PROTOCOL_VERSION}; peer rejected the handshake)"),
+    ERR_CODEC: "wire codec not negotiated or payload undecodable",
+    ERR_TOO_LARGE: "claimed payload length exceeds any legal encoding",
+    ERR_STALE_EPOCH: ("stream epoch superseded (a newer connection of "
+                      "this DepositStream attached; this one is a "
+                      "zombie)"),
+    ERR_BUSY: ("previous stream generation still draining; attach "
+               "again after backoff"),
+    ERR_ROUND_ROLLED: ("snapshot round rolled: the pinned round is no "
+                       "longer current (retriable — re-pin at the "
+                       "table's new round and re-read)"),
+    ERR_NO_SNAPSHOT: ("no round-stamped snapshot published for this "
+                      "group/leaf (retriable while the publisher warms "
+                      "up; terminal for a misspelled name)"),
+}
+
+# the v2 wire-protocol codes docs/transport.md must document (BF-DOC001)
+WIRE_V2_CODES = (ERR_BAD_OP, ERR_VERSION, ERR_CODEC, ERR_TOO_LARGE,
+                 ERR_STALE_EPOCH, ERR_BUSY, ERR_ROUND_ROLLED,
+                 ERR_NO_SNAPSHOT)
+
+# codes the doc may mention as explicitly-unassigned gaps (the doc lint
+# accepts these without requiring a registry constant)
+UNASSIGNED_CODES = (-103,)
+
+# codes a client may retry without changing anything (vs. terminal
+# protocol rejections, where retrying only relabels the real error)
+_RETRIABLE = frozenset({ERR_BUSY, ERR_ROUND_ROLLED, ERR_NO_SNAPSHOT})
+
+
+def is_retriable(rc: int) -> bool:
+    """True for statuses a well-behaved client retries (after backoff /
+    re-pin); False for terminal rejections."""
+    return rc in _RETRIABLE
+
+
+def err_text(rc: int) -> str:
+    """Human-readable explanation of a negative wire status."""
+    return STATUS_TEXT.get(rc, "window missing, slot out of range, or "
+                           "size/dtype mismatch")

@@ -36,6 +36,7 @@ import numpy as np
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import resilience
+from bluefog_tpu.runtime import wire_status
 from bluefog_tpu.serving.snapshots import RoundRolled, SnapshotUnavailable
 
 __all__ = ["Snapshot", "SnapshotClient"]
@@ -137,16 +138,18 @@ class SnapshotClient:
             req.append(nb)
         ws._sendmsg_all(sock, req)
         (rc,) = ws._STATUS.unpack(ws._recv_exact(sock, ws._STATUS.size))
-        if rc == ws._ERR_ROUND_ROLLED:
+        # status codes come from the ONE registry (wire_status), not
+        # hand-carried literals — BF-DOC001 keeps the doc in step
+        if rc == wire_status.ERR_ROUND_ROLLED:
             raise RoundRolled(self.group, pin_round, -1)
-        if rc == ws._ERR_NO_SNAPSHOT:
+        if rc == wire_status.ERR_NO_SNAPSHOT:
             raise SnapshotUnavailable(
                 f"server has no snapshot for group {self.group!r} "
                 f"(leaves {list(names) if names else 'all'})")
         if rc < 0:
             raise RuntimeError(
                 f"snapshot read of {self.group!r} failed ({rc}): "
-                + ws._err_text(int(rc)))
+                + wire_status.err_text(int(rc)))
         (count,) = ws._SNAP_CNT.unpack(
             ws._recv_exact(sock, ws._SNAP_CNT.size))
         return Snapshot(self.group, int(rc),

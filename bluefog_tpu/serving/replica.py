@@ -34,6 +34,7 @@ import numpy as np
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.serving.client import Snapshot
 from bluefog_tpu.serving.subscriber import Subscriber
+from bluefog_tpu.utils import lockcheck as _lc
 
 __all__ = ["ServingReplica"]
 
@@ -63,7 +64,7 @@ class ServingReplica:
             from bluefog_tpu.runtime.async_windows import TreePacker
 
             self._packer = TreePacker(template, np.float64)
-        self._cv = threading.Condition()
+        self._cv = _lc.condition("serving.replica.ServingReplica._cv")
         self._round = -1
         self._z: Optional[np.ndarray] = None
         self._adopted_at = 0.0

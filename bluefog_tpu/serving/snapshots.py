@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.metrics import comm as _mt
 
 __all__ = [
@@ -93,7 +94,8 @@ class _Group:
         self.rounds = [-1, -1]
         self.active = 0
         self.gen = 0            # publish count; 0 = never published
-        self.write_mu = threading.Lock()  # serializes publishers
+        self.write_mu = _lc.lock(
+            "serving.snapshots._Group.write_mu")  # serializes publishers
         self.published_at = 0.0
 
 
@@ -101,8 +103,9 @@ class SnapshotTable:
     """Round-stamped, double-buffered snapshot store (see module doc)."""
 
     def __init__(self):
-        self._mu = threading.Lock()
-        self._cv = threading.Condition(self._mu)
+        self._mu = _lc.lock("serving.snapshots.SnapshotTable._mu")
+        self._cv = _lc.condition(
+            "serving.snapshots.SnapshotTable._cv", self._mu)
         self._groups: Dict[str, _Group] = {}
 
     # ------------------------------------------------------------- publish

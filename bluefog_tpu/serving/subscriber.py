@@ -45,7 +45,8 @@ from typing import Callable, Optional, Tuple
 
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
-from bluefog_tpu.runtime import resilience
+from bluefog_tpu.runtime import resilience, wire_status
+from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.serving.client import Snapshot
 
 __all__ = ["Subscriber"]
@@ -102,7 +103,7 @@ class Subscriber:
         self.resumes = 0
         self._err: Optional[str] = None
         self._closed = threading.Event()
-        self._cv = threading.Condition()
+        self._cv = _lc.condition("serving.subscriber.Subscriber._cv")
         self._q: collections.deque = collections.deque(
             maxlen=max(1, int(queue_max)))
         self._thread = threading.Thread(
@@ -113,7 +114,7 @@ class Subscriber:
     # ----------------------------------------------------------- consumer
     @property
     def error(self) -> Optional[str]:
-        return self._err
+        return self._err  # bfverify: shared-ok latch-once str ref; _fail() writes under _cv, a GIL-atomic read can only be early
 
     def get(self, timeout_s: Optional[float] = None) -> Optional[Snapshot]:
         """Pop the oldest queued snapshot (None on timeout).  Raises the
@@ -185,9 +186,11 @@ class Subscriber:
             (rc,) = ws._STATUS.unpack(ws._recv_exact(sock,
                                                      ws._STATUS.size))
             if rc < 0:
+                # one registry for status text (runtime/wire_status);
+                # no hand-carried literals on the read path
                 raise RuntimeError(
                     f"subscribe to {self.group!r} rejected ({int(rc)}): "
-                    + ws._err_text(int(rc)))
+                    + wire_status.err_text(int(rc)))
             # steady state: the idle timeout is the silence detector —
             # the server keepalives ~1 Hz, so this only fires on a
             # wedged/partitioned server

@@ -122,7 +122,7 @@ class Heartbeat:
     # ------------------------------------------------------------------ api
     def beat(self, step=None) -> None:
         """Record progress (call once per training step; thread-safe)."""
-        self._last = time.monotonic()
+        self._last = time.monotonic()  # bfverify: shared-ok GIL-atomic float/int stores; the monitor only compares against the clock, a stale read just delays detection one poll
         self._beats += 1
         self._step = step
         try:

@@ -42,6 +42,8 @@ import json
 import os
 import threading
 import time
+
+from bluefog_tpu.utils import lockcheck as _lc
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
@@ -78,8 +80,8 @@ class Timeline:
     def __init__(self, path: str, flush_interval_s: float = 2.0):
         self.path = path
         self._events: List[dict] = []
-        self._lock = threading.Lock()
-        self._io_lock = threading.Lock()
+        self._lock = _lc.lock("utils.timeline.Timeline._lock")
+        self._io_lock = _lc.lock("utils.timeline.Timeline._io_lock")
         self._wrote_header = False
         self._finalized = False
         self._t0 = time.perf_counter()

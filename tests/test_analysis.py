@@ -972,3 +972,507 @@ class TestServingLint:
             "    return snap.leaves['x']\n"
         )
         assert not check_snapshot_consumers(src, filename="plural.py")
+
+
+# ---------------------------------------------------------------------------
+# Pass 8: whole-repo concurrency lint (BF-CONC)
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyLint:
+    """Each BF-CONC rule must CATCH its seeded violation, honor its
+    waiver, stay quiet on the healthy shape — and the repo as committed
+    must sweep clean."""
+
+    def _check(self, src, filename="seed.py"):
+        from bluefog_tpu.analysis.concurrency_lint import check_sources
+
+        return check_sources([(filename, src)])
+
+    def test_seeded_abba_cycle_is_error(self):
+        # the textbook deadlock: two locks nested in opposite orders on
+        # two code paths of the same class
+        src = (
+            "import threading\n"
+            "\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "\n"
+            "    def fwd(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "\n"
+            "    def rev(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        )
+        model, diags = self._check(src)
+        errs = [d for d in _errors(diags) if d.code == "BF-CONC001"]
+        assert errs, [d.format() for d in diags]
+        assert "opposite orders" in errs[0].message
+        # both edges are in the model, and the cycle names both locks
+        assert ("seed.S._a", "seed.S._b") in model.edges
+        assert ("seed.S._b", "seed.S._a") in model.edges
+
+    def test_consistent_order_is_clean(self):
+        # same two locks, same nesting direction everywhere: no cycle
+        src = (
+            "import threading\n"
+            "\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "\n"
+            "    def fwd(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "\n"
+            "    def also_fwd(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+        )
+        _, diags = self._check(src)
+        assert not _errors(diags), [d.format() for d in diags]
+
+    def test_long_cycle_is_not_length_capped(self):
+        # a 5-way ring of nestings (a->b->c->d->e->a) deadlocks just
+        # like ABBA; the cycle search must not silently cap the length
+        src = (
+            "import threading\n"
+            "\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "        self._c = threading.Lock()\n"
+            "        self._d = threading.Lock()\n"
+            "        self._e = threading.Lock()\n"
+            "\n"
+            "    def ab(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "\n"
+            "    def bc(self):\n"
+            "        with self._b:\n"
+            "            with self._c:\n"
+            "                pass\n"
+            "\n"
+            "    def cd(self):\n"
+            "        with self._c:\n"
+            "            with self._d:\n"
+            "                pass\n"
+            "\n"
+            "    def de(self):\n"
+            "        with self._d:\n"
+            "            with self._e:\n"
+            "                pass\n"
+            "\n"
+            "    def ea(self):\n"
+            "        with self._e:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        )
+        model, diags = self._check(src)
+        assert len(model.find_cycles()) == 1
+        errs = [d for d in _errors(diags) if d.code == "BF-CONC001"]
+        assert errs, [d.format() for d in diags]
+        assert "opposite orders" in errs[0].message
+
+    def test_self_deadlock_through_helper_is_error(self):
+        # the PR-1 engine() shape: a plain Lock re-acquired through a
+        # same-module helper called inside the critical section
+        src = (
+            "import threading\n"
+            "\n"
+            "_mu = threading.Lock()\n"
+            "\n"
+            "def helper():\n"
+            "    with _mu:\n"
+            "        pass\n"
+            "\n"
+            "def outer():\n"
+            "    with _mu:\n"
+            "        helper()\n"
+        )
+        _, diags = self._check(src)
+        errs = [d for d in _errors(diags) if d.code == "BF-CONC001"]
+        assert errs, [d.format() for d in diags]
+        assert "re-acquired" in errs[0].message
+
+    def test_rlock_reentry_is_legal(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "_mu = threading.RLock()\n"
+            "\n"
+            "def helper():\n"
+            "    with _mu:\n"
+            "        pass\n"
+            "\n"
+            "def outer():\n"
+            "    with _mu:\n"
+            "        helper()\n"
+        )
+        _, diags = self._check(src)
+        assert not _errors(diags), [d.format() for d in diags]
+
+    def test_seeded_hold_and_block_is_error(self):
+        # blocking socket recv under a lock a daemon worker also takes:
+        # a wedged peer parks the worker forever
+        src = (
+            "import threading\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self, sock):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sock = sock\n"
+            "        t = threading.Thread(target=self._watch, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _watch(self):\n"
+            "        with self._mu:\n"
+            "            self._beat = 1\n"
+            "\n"
+            "    def fetch(self):\n"
+            "        with self._mu:\n"
+            "            return self._sock.recv(4)\n"
+        )
+        model, diags = self._check(src)
+        errs = [d for d in _errors(diags) if d.code == "BF-CONC002"]
+        assert errs, [d.format() for d in diags]
+        assert "recv" in errs[0].message
+        # the model knows WHY: the lock is async-acquired by _watch
+        assert "seed:W._watch" in model.async_locks["seed.W._mu"]
+
+    def test_recv_exact_helper_counts_as_blocking(self):
+        # the package's wire reads go through the _recv_exact helper,
+        # not bare sock.recv — a lock held across it must flag exactly
+        # like the raw call (regression: the set once listed the
+        # underscore-less name and never matched)
+        src = (
+            "import threading\n"
+            "\n"
+            "def _recv_exact(sock, n):\n"
+            "    return sock.recv(n)\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self, sock):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sock = sock\n"
+            "        t = threading.Thread(target=self._watch, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _watch(self):\n"
+            "        with self._mu:\n"
+            "            self._beat = 1\n"
+            "\n"
+            "    def helper(self):\n"
+            "        return _recv_exact(self._sock, 4)\n"
+            "\n"
+            "    def fetch(self):\n"
+            "        with self._mu:\n"
+            "            return self.helper()\n"
+        )
+        _, diags = self._check(src)
+        errs = [d for d in _errors(diags) if d.code == "BF-CONC002"]
+        assert errs, [d.format() for d in diags]
+        assert "_recv_exact" in errs[0].message
+
+    def test_holds_ok_waiver_downgrades_to_info(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self, sock):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sock = sock\n"
+            "        t = threading.Thread(target=self._watch, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _watch(self):\n"
+            "        with self._mu:\n"
+            "            self._beat = 1\n"
+            "\n"
+            "    def fetch(self):\n"
+            "        with self._mu:\n"
+            "            return self._sock.recv(4)"
+            "  # bfverify: holds-ok reviewed ack fence\n"
+        )
+        _, diags = self._check(src)
+        assert not _errors(diags), [d.format() for d in diags]
+        waived = [d for d in diags if d.code == "BF-CONC002W"]
+        assert waived and "reviewed ack fence" in waived[0].message
+
+    def test_bare_waiver_without_reason_waives_nothing(self):
+        # a reasonless token must NOT suppress the finding
+        src = (
+            "import threading\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self, sock):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sock = sock\n"
+            "        t = threading.Thread(target=self._watch, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _watch(self):\n"
+            "        with self._mu:\n"
+            "            self._beat = 1\n"
+            "\n"
+            "    def fetch(self):\n"
+            "        with self._mu:\n"
+            "            return self._sock.recv(4)  # bfverify: holds-ok\n"
+        )
+        _, diags = self._check(src)
+        assert any(d.code == "BF-CONC002" for d in _errors(diags)), \
+            [d.format() for d in diags]
+
+    def test_timed_blocking_call_is_exempt(self):
+        # an explicit timeout= bounds the call: connect-with-deadline
+        # under a shared lock is a latency bug at worst, not a wedge —
+        # the same call with no deadline still flags
+        base = (
+            "import socket\n"
+            "import threading\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        t = threading.Thread(target=self._watch, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _watch(self):\n"
+            "        with self._mu:\n"
+            "            self._beat = 1\n"
+            "\n"
+            "    def dial(self, addr):\n"
+            "        with self._mu:\n"
+            "            return socket.create_connection(%s)\n"
+        )
+        _, diags = self._check(base % "addr, timeout=5.0")
+        assert not [d for d in _errors(diags) if d.code == "BF-CONC002"], \
+            [d.format() for d in diags]
+        _, diags = self._check(base % "addr")
+        assert [d for d in _errors(diags) if d.code == "BF-CONC002"], \
+            [d.format() for d in diags]
+
+    def test_blocking_without_shared_lock_is_clean(self):
+        # blocking under a lock NO async context touches: fine (the
+        # only waiter is another synchronous caller of the same API)
+        src = (
+            "import threading\n"
+            "\n"
+            "class W:\n"
+            "    def __init__(self, sock):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._sock = sock\n"
+            "\n"
+            "    def fetch(self):\n"
+            "        with self._mu:\n"
+            "            return self._sock.recv(4)\n"
+        )
+        _, diags = self._check(src)
+        assert not _errors(diags), [d.format() for d in diags]
+
+    def test_seeded_unlocked_shared_attr_is_warning(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "        t = threading.Thread(target=self._run, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _run(self):\n"
+            "        self.count = 1\n"
+            "\n"
+            "    def read(self):\n"
+            "        return self.count\n"
+        )
+        _, diags = self._check(src)
+        hits = [d for d in diags if d.code == "BF-CONC003"]
+        assert hits and hits[0].severity == "warning", \
+            [d.format() for d in diags]
+        assert "count" in hits[0].message
+
+    def test_common_lock_silences_shared_attr(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self.count = 0\n"
+            "        t = threading.Thread(target=self._run, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _run(self):\n"
+            "        with self._mu:\n"
+            "            self.count = 1\n"
+            "\n"
+            "    def read(self):\n"
+            "        with self._mu:\n"
+            "            return self.count\n"
+        )
+        _, diags = self._check(src)
+        assert not any(d.code == "BF-CONC003" for d in diags), \
+            [d.format() for d in diags]
+
+    def test_shared_ok_waiver_honored(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "        t = threading.Thread(target=self._run, daemon=True)\n"
+            "        t.start()\n"
+            "\n"
+            "    def _run(self):\n"
+            "        self.count = 1"
+            "  # bfverify: shared-ok GIL-atomic int store\n"
+            "\n"
+            "    def read(self):\n"
+            "        return self.count\n"
+        )
+        _, diags = self._check(src)
+        assert not any(d.code == "BF-CONC003" for d in diags), \
+            [d.format() for d in diags]
+
+    def test_condvar_wait_outside_while_is_info(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "class P:\n"
+            "    def __init__(self):\n"
+            "        self._cv = threading.Condition()\n"
+            "\n"
+            "    def get(self):\n"
+            "        with self._cv:\n"
+            "            self._cv.wait()\n"
+        )
+        _, diags = self._check(src)
+        hits = [d for d in diags if d.code == "BF-CONC010"]
+        assert hits and hits[0].severity == "info", \
+            [d.format() for d in diags]
+
+    def test_condvar_wait_in_while_is_clean(self):
+        src = (
+            "import threading\n"
+            "\n"
+            "class P:\n"
+            "    def __init__(self):\n"
+            "        self._cv = threading.Condition()\n"
+            "        self._ready = False\n"
+            "\n"
+            "    def get(self):\n"
+            "        with self._cv:\n"
+            "            while not self._ready:\n"
+            "                self._cv.wait()\n"
+        )
+        _, diags = self._check(src)
+        assert not any(d.code == "BF-CONC010" for d in diags), \
+            [d.format() for d in diags]
+
+    def test_condition_aliases_its_underlying_lock(self):
+        # Condition(existing_lock) is ONE ordering identity with it —
+        # cv-nested-under-its-own-lock must not fabricate an edge
+        src = (
+            "import threading\n"
+            "\n"
+            "class T:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._mu)\n"
+        )
+        model, _ = self._check(src)
+        cv = model.locks["seed.T._cv"]
+        assert model.resolve_alias("seed.T._cv") == "seed.T._mu", cv
+
+    def test_repo_sweeps_clean(self):
+        # the acceptance bar: every BF-CONC001/002 on the tree is fixed
+        # or carries a reasoned waiver; warnings triaged to zero
+        from bluefog_tpu.analysis.concurrency_lint import check_package
+
+        model, diags = check_package()
+        assert not _errors(diags), [d.format() for d in diags]
+        assert not [d for d in diags if d.severity == "warning"], \
+            [d.format() for d in diags]
+        # the model actually saw the runtime (not an empty scan)
+        assert len(model.locks) >= 30, len(model.locks)
+        assert model.thread_entries, "no thread entry points found?"
+
+    def test_concurrency_pass_runs_in_sweep(self):
+        from bluefog_tpu.analysis.lint import concurrency_pass
+
+        report = LintReport()
+        concurrency_pass(report, 4)
+        assert report.has("BF-CONC100"), report.format(verbose=True)
+        assert report.ok, report.format()
+
+    def test_bfverify_cli_exits_zero(self):
+        # the standalone CLI over the repo as committed: graph + tables
+        # print, no error findings survive, exit 0
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "bluefog_tpu.analysis.concurrency_lint", "--dot", "-"],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO, env=clean_env())
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "bfverify: OK" in proc.stdout
+        assert "digraph lock_order" in proc.stdout
+        assert "lock-order edges" in proc.stdout
+
+
+class TestDocLint:
+    def test_repo_doc_matches_registry(self):
+        from bluefog_tpu.analysis.doc_lint import check_transport_doc
+
+        diags = check_transport_doc()
+        assert not _errors(diags), [d.format() for d in diags]
+
+    def test_missing_code_is_error(self, tmp_path):
+        from bluefog_tpu.analysis.doc_lint import check_transport_doc
+        from bluefog_tpu.runtime import wire_status as ws
+
+        doc = tmp_path / "transport.md"
+        codes = [c for c in ws.WIRE_V2_CODES if c != ws.ERR_BUSY]
+        doc.write_text("status codes: " +
+                       ", ".join(str(c) for c in codes) + "\n")
+        diags = check_transport_doc(str(doc))
+        errs = [d for d in _errors(diags) if d.code == "BF-DOC001"]
+        assert errs and str(ws.ERR_BUSY) in errs[0].message, \
+            [d.format() for d in diags]
+
+    def test_stray_doc_code_is_error(self, tmp_path):
+        from bluefog_tpu.analysis.doc_lint import check_transport_doc
+        from bluefog_tpu.runtime import wire_status as ws
+
+        doc = tmp_path / "transport.md"
+        codes = list(ws.WIRE_V2_CODES) + [-199]
+        doc.write_text("status codes: " +
+                       ", ".join(str(c) for c in codes) + "\n")
+        diags = check_transport_doc(str(doc))
+        errs = [d for d in _errors(diags) if d.code == "BF-DOC001"]
+        assert errs and "-199" in errs[0].message, \
+            [d.format() for d in diags]
+
+    def test_unassigned_gap_is_tolerated(self, tmp_path):
+        # the doc may (should) mention the deliberately-unassigned -103
+        from bluefog_tpu.analysis.doc_lint import check_transport_doc
+        from bluefog_tpu.runtime import wire_status as ws
+
+        doc = tmp_path / "transport.md"
+        codes = list(ws.WIRE_V2_CODES) + list(ws.UNASSIGNED_CODES)
+        doc.write_text("status codes: " +
+                       ", ".join(str(c) for c in codes) + "\n")
+        assert not _errors(check_transport_doc(str(doc)))

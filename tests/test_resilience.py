@@ -676,6 +676,44 @@ class TestStreamReconnectReplay:
             win.free()
 
 
+def test_close_racing_recover_never_installs_fresh_socket():
+    """Regression for the close-vs-reconnect race the BF-CONC003
+    thread-shared-state audit surfaced (PR 9): if close() set _closed
+    while _recover() was mid-connect, the old code installed the fresh
+    socket anyway — close() had already read (and would close) the OLD
+    one, leaking the new socket and parking the ack thread in recv on a
+    connection nobody would ever close.  _recover must refuse the
+    install once _closed is set, closing the fresh socket itself."""
+    from bluefog_tpu.runtime.window_server import DepositStream
+
+    name = _uniq("res_close_race")
+    win, srv, port = _serve(name)
+    try:
+        st = DepositStream(("127.0.0.1", port), reconnect=_FAST)
+        fresh = []
+        real_connect = st._connect_once
+
+        def racing_connect(timeout_s):
+            sock = real_connect(timeout_s)
+            fresh.append(sock)
+            # deterministically lose the race: close() marks the stream
+            # closed at the exact moment the reconnect's connect lands
+            with st._cv:
+                st._closed = True
+            return sock
+
+        old = st._sock
+        st._connect_once = racing_connect
+        assert st._recover("seeded close race") is False
+        assert st._sock is old, "fresh socket must not be installed"
+        assert fresh and fresh[0].fileno() == -1, \
+            "refused fresh socket must be closed, not leaked"
+        st.close()
+    finally:
+        srv.stop()
+        win.free()
+
+
 # ---------------------------------------------------------------------------
 # 4. self-healing gossip (thread mode — deterministic, in-process)
 # ---------------------------------------------------------------------------
