@@ -416,6 +416,102 @@ def concurrency_pass(report: LintReport, size: int) -> None:
     report.extend(diags)
 
 
+def sharding_pass(report: LintReport, size: int) -> None:
+    """Pass 9 — BF-SHD: the unified rule table vs the three leaf
+    families it governs.  Coverage (BF-SHD001) of the repo's default
+    tables over their reference trees, window-declaration agreement
+    (BF-SHD002), and the zero-gather-on-the-hot-path invariant of the
+    sharded gossip step (BF-SHD003, by jaxpr inspection) — see
+    :mod:`bluefog_tpu.analysis.sharding_lint`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from bluefog_tpu.analysis.sharding_lint import (check_rule_coverage,
+                                                    check_shard_local,
+                                                    check_window_partition)
+    from bluefog_tpu.models.moe import moe_param_rules
+    from bluefog_tpu.ops import collectives as C
+    from bluefog_tpu.ops.windows import win_create
+    from bluefog_tpu.optim.optimizers import optimizer_state_specs
+    from bluefog_tpu.parallel.api import shard_map
+    from bluefog_tpu.parallel.tensor import tp_param_rules
+    from bluefog_tpu import topology as T
+
+    # a TP-transformer-shaped reference tree (the naming tp_param_rules
+    # is written against) — shapes small, coverage is about NAMES
+    params = {
+        "tok": {"embedding": jnp.zeros((32, 8))},
+        "block_0": {
+            "qkv_kernel": jnp.zeros((8, 3, 4)),
+            "qkv_bias": jnp.zeros((3, 4)),
+            "proj": {"kernel": jnp.zeros((4, 8)), "bias": jnp.zeros((8,))},
+            "up": {"kernel": jnp.zeros((8, 16)), "bias": jnp.zeros((16,))},
+            "down": {"kernel": jnp.zeros((16, 8)), "bias": jnp.zeros((8,))},
+            "ln1": {"scale": jnp.zeros((8,)), "bias": jnp.zeros((8,))},
+        },
+        "ln_f": {"scale": jnp.zeros((8,)), "bias": jnp.zeros((8,))},
+        "lm_head": {"kernel": jnp.zeros((8, 32))},
+    }
+    table = tp_param_rules()
+    report.extend(check_rule_coverage(table, params, name="tp_param_rules"))
+
+    moe_tree = {"block_0": {"moe": {"router": jnp.zeros((8, 4)),
+                                    "wi": jnp.zeros((4, 8, 16)),
+                                    "wo": jnp.zeros((4, 16, 8))},
+                            "ln1": {"scale": jnp.zeros((8,))}}}
+    report.extend(check_rule_coverage(moe_param_rules(), moe_tree,
+                                      name="moe_param_rules"))
+
+    # the state-tree derivation must cover a real optimizer's state
+    try:
+        optimizer_state_specs(table, params, optax.adam(1e-3))
+    except Exception as e:  # noqa: BLE001
+        report.add(Diagnostic(
+            "error", "BF-SHD001",
+            f"optimizer-state spec derivation failed over tp_param_rules: "
+            f"{type(e).__name__}: {e}",
+            pass_name="sharding", subject="opt_state"))
+
+    # window declared through the table must agree with the table
+    sched = T.build_schedule(T.ExponentialTwoGraph(size))
+    win = win_create(params, sched, _AXIS, name="lint_shd_probe",
+                     rule_table=table)
+    report.extend(check_window_partition(win, table))
+
+    # the zero-gather acceptance invariant, on the traced program
+    n_dev = len(jax.devices())
+    if n_dev < size:
+        report.add(Diagnostic(
+            "warning", "BF-SHD030",
+            f"sharding trace check skipped: jax exposes {n_dev} "
+            f"device(s), lint mesh needs {size}",
+            pass_name="sharding", subject="environment"))
+        return
+    mesh = Mesh(np.array(jax.devices()[:size]), (_AXIS,))
+    inner = {"fsdp": 2, "tp": 2}
+    specs = table.resolve_tree(params)
+
+    def gossip_step(x):
+        return C.sharded_neighbor_allreduce(
+            x, sched, _AXIS, specs=specs, inner_axes=inner)
+
+    in_spec = jax.tree_util.tree_map(lambda _: P(), params)
+    step = shard_map(gossip_step, mesh=mesh,
+                     in_specs=(in_spec,), out_specs=in_spec,
+                     check_vma=False)
+    report.extend(check_shard_local(
+        step, params, inner_axes=inner,
+        name="sharded_neighbor_allreduce[exp2]"))
+    report.add(Diagnostic(
+        "info", "BF-SHD100",
+        "rule-table coverage, window declaration, and shard-local trace "
+        "checked over the tp/moe default tables",
+        pass_name="sharding", subject="sharding"))
+
+
 def doc_pass(report: LintReport, size: int) -> None:
     """BF-DOC: docs/transport.md must list every wire v2 status code in
     the one registry (:mod:`bluefog_tpu.runtime.wire_status`)."""
@@ -542,6 +638,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     examples_pass(report, size)
     if trace:
         comm_lint_pass(report, size)
+        sharding_pass(report, size)
     return report
 
 

@@ -1,0 +1,165 @@
+"""BF-SHD lint: the ONE rule table vs the leaf families it governs.
+
+The unified sharding subsystem (:mod:`bluefog_tpu.sharding`) makes a
+single ordered ``regex -> PartitionSpec`` table the source of truth for
+params, optimizer state, and gossip window buffers.  Its failure modes
+are all silent at runtime, which is why they are lint codes:
+
+- **BF-SHD001 (error)** — coverage, both directions: a non-scalar
+  parameter matched by NO rule (the silent-replication leak: a 10 GB
+  embedding quietly copied to every chip, wire costs that dwarf the
+  model), or a rule matching NO parameter (a typo'd pattern that shards
+  nothing while its author believes it does).
+- **BF-SHD002 (warning)** — a window created with a declared partition
+  (``win_create(rule_table=)`` / ``partition=``) whose declaration
+  disagrees with the LIVE rule table's resolution: the window buffers
+  were sized/sharded under one story while the gossip wire ships under
+  another — deposits land at the wrong offsets of a differently-shaped
+  shard.
+- **BF-SHD003 (error)** — a gather on the gossip hot path: the traced
+  step contains ``all_gather``/``all_to_all`` over an INNER mesh axis.
+  Gossip-of-meshes' whole wire model is that each coordinate ships only
+  its own shard; one stray gather silently reintroduces the full-tree
+  wire (and the memory spike) the subsystem exists to remove.
+- **BF-SHD100 (info)** — scan summary.
+
+Wired into the ``bflint-tpu`` sweep as ``sharding_pass``; the
+seeded-violation tests live in ``tests/test_analysis.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+from bluefog_tpu.analysis.report import Diagnostic
+from bluefog_tpu.sharding.rules import norm_spec as _norm
+
+__all__ = [
+    "check_rule_coverage",
+    "check_window_partition",
+    "check_shard_local",
+]
+
+
+def check_rule_coverage(table, tree, *, name: str = "params"
+                        ) -> List[Diagnostic]:
+    """BF-SHD001 both directions over ``tree`` (see module doc)."""
+    diags: List[Diagnostic] = []
+    unmatched, unused = table.coverage(tree)
+    for leaf in unmatched:
+        diags.append(Diagnostic(
+            "error", "BF-SHD001",
+            f"leaf {leaf!r} is matched by NO rule — it would replicate "
+            "silently; add a rule (an explicit replicate-rule "
+            "Rule('.*', PartitionSpec()) makes replication a decision, "
+            "not a leak)",
+            pass_name="sharding", subject=name))
+    for pattern in unused:
+        diags.append(Diagnostic(
+            "error", "BF-SHD001",
+            f"rule {pattern!r} matches NO leaf — a typo'd pattern "
+            "shards nothing while reading as if it did; fix or remove it",
+            pass_name="sharding", subject=name))
+    return diags
+
+
+def check_window_partition(window, table, *, name: Optional[str] = None
+                           ) -> List[Diagnostic]:
+    """BF-SHD002: compare a window's DECLARED partition (what
+    ``win_create`` resolved at creation time) against what the live
+    ``table`` resolves NOW.  ``window`` is a
+    :class:`~bluefog_tpu.ops.windows.WindowState` (its ``self_buf``
+    supplies the leaf shapes).  An undeclared window (legacy) is
+    reported once, as a warning — an undeclared buffer cannot be
+    checked, which is itself the finding."""
+    from bluefog_tpu.ops.windows import win_partition
+    from bluefog_tpu.sharding.rules import named_leaves
+
+    subject = name or window.spec.name
+    declared = win_partition(window)
+    diags: List[Diagnostic] = []
+    if declared is None:
+        diags.append(Diagnostic(
+            "warning", "BF-SHD002",
+            f"window {subject!r} declares no partition (created without "
+            "rule_table=): its buffers cannot be checked against the "
+            "rule table — create it through the table so one rule "
+            "change re-shards the window with the params",
+            pass_name="sharding", subject=subject))
+        return diags
+    for leaf_name, leaf in named_leaves(window.self_buf):
+        shape = tuple(int(s) for s in getattr(leaf, "shape", ()) or ())
+        resolved = table.resolve(leaf_name, shape)
+        have = declared.get(leaf_name)
+        if have is None:
+            diags.append(Diagnostic(
+                "warning", "BF-SHD002",
+                f"window {subject!r} leaf {leaf_name!r} has no declared "
+                "spec (stale declaration tuple?)",
+                pass_name="sharding", subject=subject))
+        elif _norm(have) != _norm(resolved):
+            diags.append(Diagnostic(
+                "warning", "BF-SHD002",
+                f"window {subject!r} leaf {leaf_name!r}: declared "
+                f"partition {have} disagrees with the rule table's "
+                f"{resolved} — the window was created under a different "
+                "table; deposits would land on a differently-shaped "
+                "shard",
+                pass_name="sharding", subject=subject))
+    return diags
+
+
+_GATHER_PRIMS = ("all_gather", "all_to_all")
+
+
+def _walk_gathers(jaxpr, inner_axes, name, diags, counts) -> None:
+    from bluefog_tpu.analysis.jaxpr_lint import (_iter_axis_names,
+                                                 _sub_jaxprs)
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        params = dict(eqn.params)
+        if prim in _GATHER_PRIMS:
+            axes = list(_iter_axis_names(params))
+            hit = sorted(set(axes) & set(inner_axes))
+            counts[0] += 1
+            if hit:
+                diags.append(Diagnostic(
+                    "error", "BF-SHD003",
+                    f"{prim} over inner axis(es) {hit} on the gossip hot "
+                    "path: gossip-of-meshes ships shard-local only — a "
+                    "gather here silently reintroduces the full-tree "
+                    "wire (move it to the read/serving boundary: "
+                    "sharding.gather_tree / reassemble_vectors)",
+                    pass_name="sharding", subject=name))
+        for value in params.values():
+            for sub in _sub_jaxprs(value):
+                _walk_gathers(sub, inner_axes, name, diags, counts)
+
+
+def check_shard_local(fn, *example_args,
+                      inner_axes: Mapping[str, int],
+                      name: str = "gossip_step") -> List[Diagnostic]:
+    """BF-SHD003: trace ``fn`` and walk the jaxpr for
+    ``all_gather``/``all_to_all`` over any axis in ``inner_axes`` — the
+    zero-gather-on-the-hot-path acceptance invariant, checked on the
+    program, not promised in a comment."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*example_args)
+    except Exception as e:  # noqa: BLE001 — a trace failure is a finding
+        return [Diagnostic(
+            "error", "BF-SHD020",
+            f"tracing failed: {type(e).__name__}: {e}",
+            pass_name="sharding", subject=name)]
+    diags: List[Diagnostic] = []
+    counts = [0]
+    _walk_gathers(closed.jaxpr, dict(inner_axes), name, diags, counts)
+    if not any(d.severity == "error" for d in diags):
+        diags.append(Diagnostic(
+            "info", "BF-SHD103",
+            f"{name}: hot path is shard-local ({counts[0]} gather "
+            f"op(s) traced, none over inner axes {sorted(inner_axes)})",
+            pass_name="sharding", subject=name))
+    return diags

@@ -22,7 +22,37 @@ from bluefog_tpu.models.transformer import GPTConfig, TransformerLM
 from bluefog_tpu.ops.moe import expert_parallel_ffn, moe_ffn_reference
 from bluefog_tpu.parallel.rng import sharded_init
 
-__all__ = ["MoEConfig", "MoEMLP", "MoETransformerLM"]
+__all__ = ["MoEConfig", "MoEMLP", "MoETransformerLM", "moe_param_rules"]
+
+
+def moe_param_rules(ep_axis: str = "ep", tp_axis: Optional[str] = None):
+    """The unified :class:`~bluefog_tpu.sharding.RuleTable` for a
+    :class:`MoETransformerLM`'s parameters: expert weights (``wi``/``wo``)
+    sharded over ``ep_axis`` on their leading expert dim, the router
+    replicated, and — with ``tp_axis`` — the attention trunk in Megatron
+    placement against THIS model's naming (fused ``qkv/kernel`` sharded
+    on its output dim, ``proj/kernel`` row-sharded on its input dim;
+    there is no ``up``/``down`` pair, the MLP is the MoE layer) — so EP,
+    TP, the optimizer state, and the gossip windows all resolve through
+    ONE table."""
+    from jax.sharding import PartitionSpec as P
+
+    from bluefog_tpu.sharding.rules import Rule, RuleTable
+
+    rules = [
+        Rule(r"moe/w[io]$", P(ep_axis)),
+        Rule(r"moe/router$", P()),
+    ]
+    if tp_axis is not None:
+        rules.extend([
+            Rule(r"qkv/kernel$", P(None, tp_axis)),
+            Rule(r"qkv/bias$", P(tp_axis)),
+            Rule(r"proj/kernel$", P(tp_axis, None)),
+        ])
+    # explicit replicate tail: embeddings, layernorms, lm_head,
+    # row-parallel biases — replication is a decision, not a leak
+    rules.append(Rule(".*", P()))
+    return RuleTable(rules)
 
 
 @dataclasses.dataclass(frozen=True)

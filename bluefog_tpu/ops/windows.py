@@ -50,6 +50,7 @@ __all__ = [
     "WindowSpec",
     "WindowState",
     "win_create",
+    "win_partition",
     "win_free",
     "win_put",
     "win_get",
@@ -70,10 +71,20 @@ def _as_schedule(s) -> GossipSchedule:
 
 
 class WindowSpec(struct.PyTreeNode):
-    """Static window metadata (hashable side of the state)."""
+    """Static window metadata (hashable side of the state).
+
+    ``partition``: the window buffers' declared sharding — a canonical
+    ``((leaf_name, PartitionSpec), ...)`` tuple covering ``self_buf``'s
+    leaves, resolved from the ONE rule table when the window was created
+    with ``win_create(rule_table=)`` (the unified-sharding contract: a
+    window buffer is partitioned exactly like the leaf it windows; the
+    tuple form keeps the static metadata hashable for jit).  ``None``
+    means undeclared (legacy/replicated); a declaration that DISAGREES
+    with the live rule table is what the BF-SHD002 lint flags."""
 
     schedule: GossipSchedule = struct.field(pytree_node=False)
     name: str = struct.field(pytree_node=False, default="win")
+    partition: Any = struct.field(pytree_node=False, default=None)
 
 
 class WindowState(struct.PyTreeNode):
@@ -108,7 +119,8 @@ def _slot_mask(sched: GossipSchedule, axis_name: str):
 
 
 def win_create(x, schedule, axis_name: str, *, name: str = "win",
-               associated_p: bool = False) -> WindowState:
+               associated_p: bool = False, rule_table=None,
+               partition=None) -> WindowState:
     """Allocate window buffers for tensor(-tree) ``x``.
 
     Peer slots are initialized with copies of ``x`` so that a ``win_update``
@@ -123,9 +135,29 @@ def win_create(x, schedule, axis_name: str, *, name: str = "win",
     this mode the landing slots start **empty** (zeros for both tensor and
     ``p``) so the (x, p) mass pairs stay consistent: all initial mass lives
     at self with weight 1.
+
+    ``rule_table`` (a :class:`bluefog_tpu.sharding.RuleTable`): resolve
+    and DECLARE the window buffers' partitioning from the one rule table
+    — the same table that shards the parameters and optimizer state, so
+    changing a rule re-shards the window consistently.  ``partition``
+    (a matching spec pytree, or the canonical name->spec tuple) declares
+    it explicitly instead; the BF-SHD002 lint flags a declaration that
+    disagrees with the table.  Read back with :func:`win_partition`.
     """
     sched = _as_schedule(schedule)
     k = sched.num_slots
+    if rule_table is not None and partition is not None:
+        raise ValueError("pass rule_table OR partition, not both")
+    if rule_table is not None:
+        partition = rule_table.resolve_tree(x)
+    if partition is not None and not isinstance(partition, tuple):
+        from bluefog_tpu.sharding.rules import named_leaves as _nl
+
+        from jax.sharding import PartitionSpec as _P
+
+        partition = tuple(
+            (n, s) for n, s in _nl(
+                partition, is_leaf=lambda v: isinstance(v, _P)))
 
     def init_peers(leaf):
         if associated_p:
@@ -135,10 +167,23 @@ def win_create(x, schedule, axis_name: str, *, name: str = "win",
     return WindowState(
         self_buf=jax.tree_util.tree_map(jnp.asarray, x),
         peer_bufs=jax.tree_util.tree_map(init_peers, x),
-        spec=WindowSpec(schedule=sched, name=name),
+        spec=WindowSpec(schedule=sched, name=name, partition=partition),
         assoc_self=jnp.ones(()) if associated_p else None,
         assoc_peers=jnp.zeros((k,)) if associated_p else None,
     )
+
+
+def win_partition(state: WindowState):
+    """The window buffers' declared partitioning: ``{leaf_name:
+    PartitionSpec}`` resolved from the rule table at :func:`win_create`
+    time, or ``None`` when the window was created undeclared
+    (legacy/replicated).  This is the readback the BF-SHD002 lint checks
+    against the LIVE rule table — a window created under one table and
+    gossiped under another is a silent wire-shape mismatch."""
+    part = state.spec.partition
+    if part is None:
+        return None
+    return dict(part)
 
 
 def win_associated_p(state: WindowState) -> jnp.ndarray:

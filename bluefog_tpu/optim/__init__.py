@@ -12,6 +12,8 @@ from bluefog_tpu.optim.optimizers import (
     GT_COLLECTIVE_ID_RANGES,
     CommunicationType,
     decentralized_optimizer,
+    optimizer_state_specs,
+    shard_optimizer_state,
     set_comm_every,
     get_comm_every,
     DistributedNeighborAllreduceOptimizer,

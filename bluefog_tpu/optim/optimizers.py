@@ -48,6 +48,8 @@ __all__ = [
     "GT_COLLECTIVE_ID_RANGES",
     "CommunicationType",
     "decentralized_optimizer",
+    "optimizer_state_specs",
+    "shard_optimizer_state",
     "set_comm_every",
     "get_comm_every",
     "DistributedNeighborAllreduceOptimizer",
@@ -122,6 +124,46 @@ def get_comm_every(state) -> int:
             "get_comm_every needs a runtime_cadence=True optimizer state "
             f"(got {type(state).__name__})")
     return int(state.comm_every)
+
+
+def optimizer_state_specs(rule_table, params, opt_or_state, *,
+                          abstract: bool = True):
+    """Spec tree for a decentralized optimizer's state, derived from the
+    SAME :class:`~bluefog_tpu.sharding.RuleTable` that shards ``params``
+    — the state-tree rule derivation of the unified sharding subsystem.
+
+    ``opt_or_state`` is either an ``optax.GradientTransformation`` (its
+    state is built with ``jax.eval_shape`` over ``init`` — nothing is
+    materialized) or an already-built state tree.  Moment leaves
+    (``mu``/``nu``, gradient-tracking trackers, the wrapped
+    ``base_state`` of :func:`decentralized_optimizer`) inherit the spec
+    of the parameter they shadow by tree-path-suffix + shape matching,
+    so **changing one rule re-shards the param AND its optimizer state
+    consistently** (the acceptance invariant ``tests/test_sharding.py``
+    pins); scalar counters (``count``, ``comm_count``, ``comm_every``)
+    resolve replicated."""
+    from bluefog_tpu.sharding.rules import opt_state_specs
+
+    state = opt_or_state
+    if hasattr(opt_or_state, "init"):
+        if abstract:
+            state = jax.eval_shape(opt_or_state.init, params)
+        else:
+            state = opt_or_state.init(params)
+    return opt_state_specs(rule_table, params, state)
+
+
+def shard_optimizer_state(rule_table, params, state, mesh):
+    """Place an optimizer state tree onto ``mesh`` under the rule
+    table's derived specs (:func:`optimizer_state_specs`) — the
+    checkpoint-load / cold-start boundary, using the same
+    ``make_shard_and_gather_fns`` machinery as the params."""
+    from bluefog_tpu.sharding.apply import make_shard_and_gather_fns
+
+    specs = optimizer_state_specs(rule_table, params, state)
+    shard_fns, _ = make_shard_and_gather_fns(specs, mesh)
+    return jax.tree_util.tree_map(lambda fn, leaf: fn(leaf),
+                                  shard_fns, state)
 
 
 def _as_schedules(topology) -> Sequence[GossipSchedule]:

@@ -56,6 +56,7 @@ from bluefog_tpu.metrics import comm as _mt
 
 __all__ = [
     "stack_stage_params",
+    "stage_param_specs",
     "pipeline_apply",
     "pipeline_spmd_axis_perm",
     "pipeline_train_step_1f1b",
@@ -76,6 +77,31 @@ def stack_stage_params(per_layer_params, num_stages: int):
         return leaf.reshape((num_stages, L // num_stages) + leaf.shape[1:])
 
     return jax.tree_util.tree_map(regroup, per_layer_params)
+
+
+def stage_param_specs(rule_table, stacked_params, *, pp_axis: str = "pp"):
+    """Resolve a :func:`stack_stage_params` tree's placement through the
+    unified :class:`~bluefog_tpu.sharding.RuleTable` — the pipeline's
+    specs come from the SAME table as everything else, not a hand-placed
+    ``P('pp', ...)`` per call site.
+
+    Each leaf's leading stage dim is sharded over ``pp_axis``; the
+    remaining dims resolve through the table by leaf path (so a
+    tensor-sharded kernel inside a stage gets ``P('pp', ..., 'tp')``
+    from one rule).  The table's rule is matched against the leaf's
+    WITHIN-STAGE shape (leading ``(stages, layers-per-stage)`` dims
+    stripped), which is what the rule grammar names."""
+    from jax.sharding import PartitionSpec as P
+
+    from bluefog_tpu.sharding.rules import named_tree_map
+
+    def spec_of(name, leaf):
+        inner_shape = tuple(int(s) for s in leaf.shape[2:])
+        inner = rule_table.resolve(name, inner_shape)
+        # stage dim over pp, the per-stage layer dim replicated
+        return P(pp_axis, None, *tuple(inner))
+
+    return named_tree_map(spec_of, stacked_params)
 
 
 def pipeline_spmd_axis_perm(num_stages: int):

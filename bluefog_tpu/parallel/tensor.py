@@ -60,6 +60,10 @@ __all__ = [
     "TPTransformerLM",
     "tp_value_and_grad",
     "tp_correct_grads",
+    "tp_param_rules",
+    "box_specs",
+    "check_rule_agreement",
+    "PartitionDisagreement",
     "gather_tp_params",
     "unbox_params",
 ]
@@ -324,6 +328,77 @@ class TPTransformerLM(nn.Module):
 
 
 # ---------------------------------------------------------------------------
+# Rule-table resolution (the unified sharding story)
+# ---------------------------------------------------------------------------
+
+
+def tp_param_rules(tp_axis: str = "tp"):
+    """The default :class:`~bluefog_tpu.sharding.RuleTable` for
+    :class:`TPTransformerLM`'s parameter naming — the ONE table the
+    gossip stack, the optimizer state, and the window fabric resolve
+    through.  Megatron placement: qkv/up column-sharded on the output
+    feature dim, proj/down row-sharded on the input dim, everything else
+    (embeddings, layernorms, head, row-parallel biases) replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from bluefog_tpu.sharding.rules import RuleTable
+
+    return RuleTable([
+        ("qkv_kernel$", P(None, None, tp_axis)),
+        ("qkv_bias$", P(None, tp_axis)),
+        (r"up/kernel$", P(None, tp_axis)),
+        (r"up/bias$", P(tp_axis)),
+        (r"(proj|down)/kernel$", P(tp_axis, None)),
+        # explicit replicate tail: embeddings, layernorms, lm_head,
+        # row-parallel biases — replication is a decision, not a leak
+        (".*", P()),
+    ])
+
+
+def box_specs(template, tp_axis: str = "tp"):
+    """The flax-metadata view of a boxed template: each leaf's
+    ``nn.Partitioned`` axis names as a ``PartitionSpec`` (unboxed leaves
+    -> replicated).  This is the LEGACY source of shardedness — use it
+    only to compare against the rule table
+    (:func:`check_rule_agreement`), never as the resolution path."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_of(leaf):
+        if _is_box(leaf):
+            return P(*leaf.names)
+        return P()
+
+    return jax.tree_util.tree_map(spec_of, template, is_leaf=_is_box)
+
+
+class PartitionDisagreement(ValueError):
+    """The flax box metadata and the rule table disagree on a leaf —
+    the dual-source-of-truth hazard: the gradient correction would scale
+    by one story while the wire shards by the other."""
+
+
+def check_rule_agreement(template, rule_table, tp_axis: str = "tp"):
+    """Compare every boxed leaf's ``nn.Partitioned`` names against the
+    rule table's resolution; returns ``[(leaf_path, box_spec,
+    table_spec)]`` for each disagreement.  Empty list = the two sources
+    of truth agree (the state :func:`tp_value_and_grad` requires before
+    it trusts the table)."""
+    from bluefog_tpu.sharding.rules import named_leaves, norm_spec
+
+    mismatches = []
+    for name, leaf in named_leaves(template, is_leaf=_is_box):
+        val = leaf.value if _is_box(leaf) else leaf
+        shape = tuple(int(s) for s in np.shape(val))
+        resolved = rule_table.resolve(name, shape)
+        from jax.sharding import PartitionSpec as P
+
+        boxed = P(*leaf.names) if _is_box(leaf) else P()
+        if norm_spec(boxed) != norm_spec(resolved):
+            mismatches.append((name, boxed, resolved))
+    return mismatches
+
+
+# ---------------------------------------------------------------------------
 # Gradient correction + parameter gather
 # ---------------------------------------------------------------------------
 
@@ -336,14 +411,39 @@ def _box_mentions(box: nn.Partitioned, axis: str) -> bool:
     return axis in tuple(box.names)
 
 
-def tp_correct_grads(grads, template, tp_axis: str = "tp"):
+def tp_correct_grads(grads, template, tp_axis: str = "tp", *,
+                     rule_table=None):
     """Fix raw inside-``shard_map`` gradients of a tp-parallel model (see
     module docstring): sharded leaves ``/ tp_size``, replicated leaves
-    ``pmean`` over ``tp_axis``.  ``template`` is the boxed
-    (``nn.Partitioned``) parameter tree from ``model.init``, the source of
-    shardedness; ``grads`` is the matching plain tree.  Leaves whose template
-    entry is unboxed are treated as replicated."""
+    ``pmean`` over ``tp_axis``.
+
+    Shardedness is read from ``rule_table`` (the unified
+    :class:`~bluefog_tpu.sharding.RuleTable` — the resolved specs are
+    the single source of truth) when one is given; otherwise from
+    ``template``'s ``nn.Partitioned`` boxes (the legacy metadata path).
+    ``grads`` is the plain tree matching ``template``."""
     tp = _tp_size(tp_axis)
+
+    if rule_table is not None:
+        from bluefog_tpu.sharding.rules import named_tree_map, spec_mentions
+
+        def fix_spec(name, box):
+            leaf = box.value if _is_box(box) else box
+            spec = rule_table.resolve(
+                name, tuple(int(s) for s in np.shape(leaf)))
+            return spec
+
+        specs = named_tree_map(fix_spec, template, is_leaf=_is_box)
+
+        def fix(spec, g):
+            if spec_mentions(spec, tp_axis):
+                return g / tp
+            return lax.pmean(g, tp_axis)
+
+        from jax.sharding import PartitionSpec as _P
+
+        return jax.tree_util.tree_map(
+            fix, specs, grads, is_leaf=lambda s: isinstance(s, _P))
 
     def fix(box, g):
         if _is_box(box) and _box_mentions(box, tp_axis):
@@ -353,7 +453,8 @@ def tp_correct_grads(grads, template, tp_axis: str = "tp"):
     return jax.tree_util.tree_map(fix, template, grads, is_leaf=_is_box)
 
 
-def tp_value_and_grad(loss_fn, template, tp_axis: str = "tp"):
+def tp_value_and_grad(loss_fn, template, tp_axis: str = "tp", *,
+                      rule_table=None):
     """``jax.value_and_grad`` drop-in for tensor-parallel models
     differentiated *inside* ``shard_map``: ``loss_fn`` takes a **plain**
     parameter tree (apply the model with plain arrays — flax's
@@ -361,7 +462,27 @@ def tp_value_and_grad(loss_fn, template, tp_axis: str = "tp"):
     illegal under a Manual mesh), ``template`` is the boxed tree from
     ``model.init``.  Returns exact per-gossip-rank gradients (verified
     against a gathered single-shard reference in
-    tests/test_tensor_parallel.py)."""
+    tests/test_tensor_parallel.py).
+
+    ``rule_table``: resolve shardedness through the unified
+    :class:`~bluefog_tpu.sharding.RuleTable` instead of the box
+    metadata.  The two sources are compared ONCE, eagerly, at wrap time
+    (:func:`check_rule_agreement`) and a disagreement raises
+    :class:`PartitionDisagreement` — a box silently contradicting the
+    table would make the gradient correction scale by one story while
+    the wire shards by the other (the regression
+    ``tests/test_sharding.py`` plants)."""
+
+    if rule_table is not None:
+        mismatches = check_rule_agreement(template, rule_table, tp_axis)
+        if mismatches:
+            lines = "; ".join(
+                f"{name}: box={b} table={t}" for name, b, t in mismatches)
+            raise PartitionDisagreement(
+                "nn.Partitioned metadata disagrees with the rule table "
+                f"on {len(mismatches)} leaf(s): {lines} — fix the rule "
+                "or the module annotation; the table is the single "
+                "source of truth")
 
     vag = jax.value_and_grad(loss_fn)
 
@@ -370,7 +491,8 @@ def tp_value_and_grad(loss_fn, template, tp_axis: str = "tp"):
                 params, is_leaf=_is_box)):
             params = unbox_params(params)
         loss, grads = vag(params, *args, **kwargs)
-        return loss, tp_correct_grads(grads, template, tp_axis)
+        return loss, tp_correct_grads(grads, template, tp_axis,
+                                      rule_table=rule_table)
 
     return wrapped
 

@@ -459,6 +459,19 @@ class TreePacker:
     Packing does a single batched ``jax.device_get`` for the whole tree (one
     host transfer, not one per leaf); unpacking restores original shapes and
     dtypes, optionally as jax arrays.
+
+    ``sharding`` (a :class:`bluefog_tpu.sharding.mesh.ShardView`: resolved
+    spec tree + inner-mesh axes + this packer's coordinate) makes the
+    packer SPEC-AWARE: :meth:`pack` extracts only the coordinate's shard
+    of each sharded leaf (replicated leaves ride whole), so the packed
+    vector is shard-local — the wire unit of gossip-of-meshes — and
+    :meth:`unpack` restores SHARD-shaped leaves.  ``pack`` accepts either
+    the full tree (slices out the shard) or an already-shard-shaped tree
+    (copies as-is), so both the publish path (full params) and a
+    shard-local compute loop repack without gathering.  Reassembling the
+    full tree from every coordinate's vector is
+    :func:`bluefog_tpu.sharding.apply.reassemble_vectors` — the read
+    boundary, never the hot path.
     """
 
     # float dtypes (width <= 32 bit) eligible for the fused device fast
@@ -468,12 +481,22 @@ class TreePacker:
     # keeps them exact.
     _F32_SAFE = (np.dtype(np.float32), np.dtype(np.float16))
 
-    def __init__(self, template, dtype=np.float64):
+    def __init__(self, template, dtype=np.float64, *, sharding=None):
         import jax
         import jax.numpy as jnp
 
         leaves, self._treedef = jax.tree_util.tree_flatten(template)
-        self._shapes = [tuple(np.shape(l)) for l in leaves]
+        self._full_shapes = [tuple(np.shape(l)) for l in leaves]
+        self.sharding = sharding
+        if sharding is not None:
+            spec_flat = sharding.spec_leaves(template)
+            self._shapes = [tuple(sharding.leaf_shape(s, sp))
+                            for s, sp in zip(self._full_shapes, spec_flat)]
+            self._slices = [sharding.leaf_slices(s, sp)
+                            for s, sp in zip(self._full_shapes, spec_flat)]
+        else:
+            self._shapes = self._full_shapes
+            self._slices = None
         self._sizes = [int(np.prod(s, dtype=np.int64)) for s in self._shapes]
         self._dtypes = [np.dtype(getattr(l, "dtype", None) or
                                  np.asarray(l).dtype) for l in leaves]
@@ -481,10 +504,13 @@ class TreePacker:
         self.dtype = np.dtype(dtype)
         # device fusion pays on real accelerators (ONE host transfer instead
         # of per-leaf); on the CPU backend it only adds copies — there the
-        # win is parallel host casts (numpy releases the GIL in copyto)
+        # win is parallel host casts (numpy releases the GIL in copyto).
+        # Spec-aware packers stay on the host path: the shard slice is
+        # host-side numpy arithmetic by design.
         self._fusable = all(
             dt in self._F32_SAFE or dt == jnp.bfloat16.dtype
-            for dt in self._dtypes) and jax.default_backend() != "cpu"
+            for dt in self._dtypes) and jax.default_backend() != "cpu" \
+            and sharding is None
         self._device_pack = None    # built lazily, cached per instance
         self._device_unpack = None
         self._offs = np.cumsum([0] + self._sizes)
@@ -525,10 +551,21 @@ class TreePacker:
         """Cast-copy each host leaf into its slice of ``vec``.  Leaves are
         copied concurrently for large trees: np.copyto releases the GIL, so
         the dominant cost (widening casts to the f64 wire) parallelizes
-        across cores."""
+        across cores.  Spec-aware packers slice out this coordinate's
+        shard of a full-shaped leaf here (shard-shaped leaves pass
+        through); any other shape is an error, not a mis-landed write."""
         def one(i, a):
+            a = np.asarray(a)
+            if self._slices is not None:
+                if tuple(a.shape) == self._full_shapes[i]:
+                    a = np.ascontiguousarray(a[self._slices[i]])
+                elif tuple(a.shape) != self._shapes[i]:
+                    raise ValueError(
+                        f"leaf {i} shape {tuple(a.shape)} is neither the "
+                        f"full template shape {self._full_shapes[i]} nor "
+                        f"the shard shape {self._shapes[i]}")
             np.copyto(vec[self._offs[i]:self._offs[i + 1]],
-                      np.asarray(a).reshape(-1), casting="unsafe")
+                      a.reshape(-1), casting="unsafe")
 
         if len(host) > 1 and self.size >= (1 << 20) and _CAST_WORKERS > 1:
             list(_cast_pool().map(lambda ia: one(*ia), enumerate(host)))

@@ -41,6 +41,7 @@ __all__ = [
     "SnapshotTable",
     "SnapshotUnavailable",
     "ServingReplica",
+    "ShardedServingReplica",
     "Subscriber",
     "table",
 ]
@@ -50,6 +51,7 @@ _LAZY = {
     "SnapshotClient": "bluefog_tpu.serving.client",
     "Subscriber": "bluefog_tpu.serving.subscriber",
     "ServingReplica": "bluefog_tpu.serving.replica",
+    "ShardedServingReplica": "bluefog_tpu.serving.replica",
 }
 
 

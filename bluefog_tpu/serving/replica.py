@@ -36,7 +36,7 @@ from bluefog_tpu.serving.client import Snapshot
 from bluefog_tpu.serving.subscriber import Subscriber
 from bluefog_tpu.utils import lockcheck as _lc
 
-__all__ = ["ServingReplica"]
+__all__ = ["ServingReplica", "ShardedServingReplica"]
 
 
 class ServingReplica:
@@ -153,3 +153,177 @@ class ServingReplica:
 
     def close(self) -> None:
         self._sub.close()
+
+
+class ShardedServingReplica:
+    """Follow a gossip rank that is a whole pjit mesh: one subscription
+    per inner-mesh coordinate, reassembled into the full model at the
+    read boundary.
+
+    Under gossip-of-meshes each inner coordinate publishes its OWN
+    shard-local snapshot group (``f"{group}:{ci}"``, ``ci`` the
+    coordinate's index in :func:`~bluefog_tpu.sharding.inner_coords`
+    order — the same naming as the per-coordinate windows).  This
+    replica subscribes to all of them and serves the newest round for
+    which EVERY coordinate's snapshot has arrived — a round-consistent
+    full tree, reassembled through
+    :func:`~bluefog_tpu.sharding.reassemble_vectors` (spec-aware
+    :class:`~bluefog_tpu.runtime.async_windows.TreePacker` unpack +
+    :func:`~bluefog_tpu.sharding.gather_tree`).  Coordinates land at
+    independent times, so a small per-coordinate round history bridges
+    the skew; serving NEVER mixes rounds across coordinates.
+
+    Args:
+      address / group / every / cursor / reconnect / idle_timeout_s /
+        timeout_s: as :class:`ServingReplica`.
+      template: the full (unsharded) model pytree.
+      rule_table: the :class:`~bluefog_tpu.sharding.RuleTable` (or a
+        resolved spec pytree) — the same single source of truth the
+        trainer shards by.
+      axes: inner-mesh ``{axis: size}``.
+      history: per-coordinate rounds retained while waiting for the
+        stragglers (skew tolerance; default 4).
+    """
+
+    def __init__(self, address: Tuple[str, int], group: str, template,
+                 rule_table, axes, *, every: int = 1, cursor: int = -1,
+                 reconnect=True, idle_timeout_s: float = 5.0,
+                 timeout_s: float = 10.0, history: int = 4):
+        from bluefog_tpu.sharding.mesh import inner_coords
+        from bluefog_tpu.sharding.rules import RuleTable
+
+        self.group = group
+        self.template = template
+        self.axes = dict(axes)
+        if isinstance(rule_table, RuleTable):
+            self.specs = rule_table.resolve_tree(template)
+        else:
+            self.specs = rule_table
+        self._coords = inner_coords(self.axes)
+        self._names = list(self.axes.keys())
+        # template/specs/axes are fixed for the replica's lifetime, so
+        # the per-coordinate spec-aware packers (tree flatten + shard
+        # slice arithmetic) are built once here, not per params() read
+        from bluefog_tpu.runtime.async_windows import TreePacker
+        from bluefog_tpu.sharding.mesh import ShardView
+
+        self._packers = [
+            TreePacker(template, np.float64,
+                       sharding=ShardView(specs=self.specs, axes=self.axes,
+                                          coord=c))
+            for c in self._coords]
+        self._history = max(int(history), 1)
+        self._cv = _lc.condition("serving.replica.ShardedServingReplica._cv")
+        # per-coordinate {round: z}; served state is the newest COMPLETE round
+        self._pending = [dict() for _ in self._coords]
+        self._round = -1
+        self._vectors = None  # {pos_tuple: z} of the served round
+        self.adopted = 0
+        self._subs = []
+        try:
+            for ci in range(len(self._coords)):
+                self._subs.append(Subscriber(
+                    address, f"{group}:{ci}", every=every, cursor=cursor,
+                    on_snapshot=lambda s, ci=ci: self._adopt(ci, s),
+                    reconnect=reconnect, idle_timeout_s=idle_timeout_s,
+                    timeout_s=timeout_s, queue_max=2))
+        except BaseException:
+            self.close()
+            raise
+
+    # ------------------------------------------------------------- intake
+    def _adopt(self, ci: int, snap: Snapshot) -> None:
+        if snap.round <= self._round:
+            return
+        x = snap.leaves.get("x")
+        p = snap.leaves.get("p")
+        if x is not None and p is not None and float(p[0]) > 0.0:
+            z = x / float(p[0])
+        elif x is not None:
+            z = x
+        else:
+            z = next(iter(snap.leaves.values()))
+        with self._cv:
+            pend = self._pending[ci]
+            pend[snap.round] = z
+            while len(pend) > self._history:
+                del pend[min(pend)]
+            # newest round every coordinate has = the new served round
+            complete = set(self._pending[0])
+            for other in self._pending[1:]:
+                complete &= set(other)
+            complete = {r for r in complete if r > self._round}
+            if complete:
+                rnd = max(complete)
+                self._vectors = {
+                    tuple(c[nm] for nm in self._names):
+                        self._pending[i][rnd]
+                    for i, c in enumerate(self._coords)}
+                self._round = rnd
+                self.adopted += 1
+                for pend2 in self._pending:
+                    for r in [r for r in pend2 if r <= rnd]:
+                        del pend2[r]
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------ serving
+    @property
+    def round(self) -> int:
+        """Round stamp of the newest COMPLETE (all-coordinates) snapshot
+        set (-1 until one exists)."""
+        return self._round
+
+    @property
+    def error(self) -> Optional[str]:
+        for sub in self._subs:
+            if sub.error is not None:
+                return sub.error
+        return None
+
+    def wait_ready(self, timeout_s: float = 30.0) -> int:
+        """Block until a complete round is assembled; returns its round."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._round < 0 and self.error is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"sharded replica for {self.group!r} assembled no "
+                        f"complete round within {timeout_s}s")
+                self._cv.wait(timeout=min(0.1, remaining))
+            if self._round < 0:
+                raise RuntimeError(
+                    f"sharded replica for {self.group!r} failed before "
+                    f"its first complete round: {self.error}")
+            return self._round
+
+    def params(self):
+        """The served model: every coordinate's shard-local vector of
+        the SAME round, unpacked spec-aware (through the packers cached
+        at construction) and gathered to the full tree — the read
+        boundary's only gather."""
+        from bluefog_tpu.sharding.apply import gather_tree
+
+        with self._cv:
+            if self._vectors is None:
+                raise RuntimeError(
+                    f"sharded replica for {self.group!r} has no complete "
+                    "round yet (wait_ready() first)")
+            vectors = dict(self._vectors)
+        shard_trees = {}
+        for c, packer in zip(self._coords, self._packers):
+            pos = tuple(c[nm] for nm in self._names)
+            shard_trees[pos] = packer.unpack(np.asarray(vectors[pos]),
+                                             as_jax=False)
+        return gather_tree(self.template, self.specs, self.axes,
+                           shard_trees)
+
+    def staleness_rounds(self, current_round: int) -> int:
+        age = max(0, int(current_round) - self._round)
+        _mt.set("bf_snapshot_age_rounds", float(age), group=self.group,
+                peer="sharded_replica")
+        return age
+
+    def close(self) -> None:
+        for sub in self._subs:
+            sub.close()

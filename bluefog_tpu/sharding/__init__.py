@@ -1,0 +1,68 @@
+"""Unified rule-driven sharding: one table governs every leaf family.
+
+The gossip-of-meshes subsystem (ROADMAP item 2): a regex-rule ->
+``PartitionSpec`` resolution engine where ONE ordered rule table
+(:class:`RuleTable`) is the single source of truth for how
+
+- model **parameters**,
+- **optimizer state** (moment leaves inherit their parameter's spec —
+  :func:`opt_state_specs`), and
+- **gossip window buffers** (``ops.windows.win_create(rule_table=)``,
+  the spec-aware ``runtime.async_windows.TreePacker``)
+
+are partitioned over a hybrid ``(bf, fsdp/tp)`` mesh
+(:class:`GossipMesh`).  On top of it, the gossip graph connects
+*meshes*, not chips: ``neighbor_allreduce`` and the async window
+deposit/read path operate on sharded leaves shard-local — each inner
+coordinate exchanges only its own shard with the same coordinate on
+neighbor meshes, with no gather on the hot path
+(:func:`run_sharded_gossip`; asserted by the BF-SHD lint pass).
+
+See ``docs/sharding.md`` for the rule grammar, resolution order, and
+the wire model.
+"""
+
+from bluefog_tpu.sharding.apply import (gather_tree,
+                                        make_shard_and_gather_fns,
+                                        reassemble_vectors,
+                                        record_shard_savings, shard_tree,
+                                        tree_wire_bytes)
+from bluefog_tpu.sharding.gossip import (ShardedGossipReport,
+                                         run_sharded_gossip)
+from bluefog_tpu.sharding.mesh import (GossipMesh, ShardView, inner_coords,
+                                       num_shards, shard_shape, shard_slices,
+                                       shard_size_ratio)
+from bluefog_tpu.sharding.rules import (Rule, RuleTable, ShardingRuleError,
+                                        UnmatchedLeafError, UnusedRuleError,
+                                        named_leaves, named_tree_map,
+                                        norm_spec, opt_state_specs,
+                                        spec_entry_axes, spec_mentions)
+
+__all__ = [
+    "Rule",
+    "RuleTable",
+    "ShardingRuleError",
+    "UnmatchedLeafError",
+    "UnusedRuleError",
+    "named_leaves",
+    "named_tree_map",
+    "norm_spec",
+    "opt_state_specs",
+    "spec_entry_axes",
+    "spec_mentions",
+    "GossipMesh",
+    "ShardView",
+    "inner_coords",
+    "num_shards",
+    "shard_shape",
+    "shard_slices",
+    "shard_size_ratio",
+    "make_shard_and_gather_fns",
+    "shard_tree",
+    "gather_tree",
+    "reassemble_vectors",
+    "record_shard_savings",
+    "tree_wire_bytes",
+    "ShardedGossipReport",
+    "run_sharded_gossip",
+]

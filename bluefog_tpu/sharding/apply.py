@@ -1,0 +1,235 @@
+"""Apply resolved specs: shard and gather leaves on device and on host.
+
+Two symmetric halves of the ``make_shard_and_gather_fns`` pattern
+(SNIPPETS.md [3]):
+
+- **Device** (:func:`make_shard_and_gather_fns`): per-leaf callables
+  that place a leaf onto the mesh under its resolved
+  ``NamedSharding`` (shard) or pull it back replicated (gather) — the
+  checkpoint-load / eval-consolidation boundary.  Gathering is the
+  COLD path by design: the gossip hot path never calls these.
+- **Host** (:func:`shard_tree` / :func:`gather_tree`): pure-numpy
+  slicing twins for the window fabric — a :class:`ShardView`'s slice of
+  every leaf, and its inverse (reassembling a full tree from all
+  coordinates' shard trees), used by serving-snapshot reassembly and
+  warm-start reads.
+
+Plus the wire accounting shard-local gossip reports
+(:func:`record_shard_savings`): ``bf_sharded_bytes_total{leaf,axis}``
+(bytes actually moved) and ``bf_gather_bytes_saved_total`` (bytes a
+gather-then-gossip wire would have moved minus what the shard-local
+wire moved).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.sharding.mesh import (ShardView, inner_coords, num_shards,
+                                       shard_shape, shard_slices,
+                                       shard_size_ratio)
+from bluefog_tpu.sharding.rules import (RuleTable, named_leaves,
+                                        named_tree_map, spec_entry_axes)
+
+__all__ = [
+    "make_shard_and_gather_fns",
+    "shard_tree",
+    "gather_tree",
+    "reassemble_vectors",
+    "tree_wire_bytes",
+    "record_shard_savings",
+]
+
+
+def _is_spec(x) -> bool:
+    return isinstance(x, PartitionSpec)
+
+
+# ---------------------------------------------------------------------------
+# Device side
+# ---------------------------------------------------------------------------
+
+
+def make_shard_and_gather_fns(specs, mesh):
+    """``(shard_fns, gather_fns)`` pytrees of per-leaf callables.
+
+    ``shard_fns[leaf](x)`` places ``x`` on ``mesh`` under the leaf's
+    resolved spec (``jax.device_put`` with ``NamedSharding`` — XLA
+    scatters each device its shard); ``gather_fns[leaf](x)`` returns the
+    fully-replicated (host-usable) array.  ``mesh`` is a real
+    ``jax.sharding.Mesh``; use the host-side twins for AbstractMesh /
+    windows-path work."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def mk_shard(spec):
+        def shard(x):
+            return jax.device_put(jax.numpy.asarray(x),
+                                  NamedSharding(mesh, spec))
+
+        return shard
+
+    def mk_gather(spec):
+        del spec
+
+        def gather(x):
+            return np.asarray(jax.device_get(x))
+
+        return gather
+
+    shard_fns = jax.tree_util.tree_map(mk_shard, specs, is_leaf=_is_spec)
+    gather_fns = jax.tree_util.tree_map(mk_gather, specs, is_leaf=_is_spec)
+    return shard_fns, gather_fns
+
+
+# ---------------------------------------------------------------------------
+# Host side (window fabric)
+# ---------------------------------------------------------------------------
+
+
+def shard_tree(tree, view: ShardView):
+    """``view``'s shard of every leaf, as numpy arrays (host copy)."""
+    import jax
+
+    spec_flat = view.spec_leaves(tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for leaf, spec in zip(leaves, spec_flat):
+        a = np.asarray(jax.device_get(leaf))
+        # np.array (not ascontiguousarray, which promotes 0-d to (1,))
+        # keeps scalar leaves scalar-shaped for gather_tree's validation
+        out.append(np.array(a[view.leaf_slices(a.shape, spec)]))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def gather_tree(template, specs, axes: Mapping[str, int],
+                shard_trees: Mapping[Any, Any]):
+    """Inverse of :func:`shard_tree` over ALL coordinates: reassemble the
+    full tree from per-coordinate shard trees.
+
+    ``shard_trees`` maps a coordinate key — either the coord dict's
+    items as a sorted tuple, or the positional tuple in ``axes`` key
+    order — to that coordinate's shard tree (what each sub-mesh's
+    :class:`~bluefog_tpu.runtime.async_windows.TreePacker` unpacked).
+    Every coordinate must be present; shard shapes are validated against
+    the template so a mis-keyed shard cannot land at the wrong offset."""
+    import jax
+
+    names = list(axes.keys())
+    coords = inner_coords(axes)
+
+    def key_of(coord: Dict[str, int]):
+        pos = tuple(coord[n] for n in names)
+        if pos in shard_trees:
+            return pos
+        srt = tuple(sorted(coord.items()))
+        if srt in shard_trees:
+            return srt
+        raise KeyError(
+            f"missing shard for coordinate {coord} (keys tried: {pos} "
+            f"and {srt}; have {sorted(map(str, shard_trees.keys()))})")
+
+    spec_flat = ShardView(specs=specs, axes=axes,
+                          coord=coords[0]).spec_leaves(template)
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    shard_flat = {tuple(c[n] for n in names):
+                  jax.tree_util.tree_leaves(shard_trees[key_of(c)])
+                  for c in coords}
+    for pos, leaves in shard_flat.items():
+        if len(leaves) != len(t_leaves):
+            raise ValueError(
+                f"shard at {pos} has {len(leaves)} leaves, "
+                f"template {len(t_leaves)}")
+    out = []
+    for i, (tleaf, spec) in enumerate(zip(t_leaves, spec_flat)):
+        shape = tuple(int(s) for s in np.shape(tleaf))
+        dtype = getattr(tleaf, "dtype", None) or np.asarray(tleaf).dtype
+        full = np.empty(shape, dtype)
+        loc = shard_shape(shape, spec, axes)
+        for c in coords:
+            pos = tuple(c[n] for n in names)
+            piece = np.asarray(shard_flat[pos][i])
+            if tuple(piece.shape) != loc:
+                raise ValueError(
+                    f"shard {pos} leaf {i} has shape {tuple(piece.shape)}, "
+                    f"expected {loc} (spec {spec}, full {shape})")
+            full[shard_slices(shape, spec, axes, c)] = piece
+        out.append(full)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def reassemble_vectors(template, specs, axes: Mapping[str, int],
+                       vectors: Mapping[Any, np.ndarray], *,
+                       dtype=np.float64):
+    """Reassemble a full tree from per-coordinate PACKED flat vectors —
+    the serving-snapshot / warm-start read path: each sub-mesh published
+    its shard-local packed vector; this unpacks every one through a
+    spec-aware :class:`TreePacker` and gathers."""
+    from bluefog_tpu.runtime.async_windows import TreePacker
+
+    names = list(axes.keys())
+    shard_trees = {}
+    for coord in inner_coords(axes):
+        view = ShardView(specs=specs, axes=axes, coord=coord)
+        packer = TreePacker(template, dtype, sharding=view)
+        pos = tuple(coord[n] for n in names)
+        key = pos if pos in vectors else tuple(sorted(coord.items()))
+        shard_trees[pos] = packer.unpack(np.asarray(vectors[key]),
+                                         as_jax=False)
+    return gather_tree(template, specs, axes, shard_trees)
+
+
+# ---------------------------------------------------------------------------
+# Wire accounting
+# ---------------------------------------------------------------------------
+
+
+def tree_wire_bytes(tree, specs, axes: Mapping[str, int]
+                    ) -> Tuple[int, int]:
+    """``(shard_bytes, full_bytes)`` one deposit of ``tree`` moves under
+    shard-local vs gather-then-gossip wiring."""
+    import jax
+
+    spec_flat = jax.tree_util.tree_leaves(specs, is_leaf=_is_spec)
+    leaves = jax.tree_util.tree_leaves(tree)
+    shard_b = full_b = 0
+    for leaf, spec in zip(leaves, spec_flat):
+        a_shape = tuple(int(s) for s in np.shape(leaf))
+        item = np.dtype(getattr(leaf, "dtype", None)
+                        or np.asarray(leaf).dtype).itemsize
+        full = int(np.prod(a_shape, dtype=np.int64)) * item
+        full_b += full
+        shard_b += full // shard_size_ratio(spec, axes)
+    return shard_b, full_b
+
+
+def record_shard_savings(tree, specs, axes: Mapping[str, int], *,
+                         deposits: int = 1) -> Tuple[int, int]:
+    """Account one (or ``deposits``) shard-local deposits of ``tree`` on
+    the wire-savings counters; returns ``(shard_bytes, saved_bytes)``
+    per deposit.  Labels: ``leaf`` = the leaf's tree path, ``axis`` =
+    the joined mentioned axes ('' for replicated leaves)."""
+    import jax
+
+    spec_flat = jax.tree_util.tree_leaves(specs, is_leaf=_is_spec)
+    shard_total = saved_total = 0
+    for (name, leaf), spec in zip(named_leaves(tree), spec_flat):
+        a_shape = tuple(int(s) for s in np.shape(leaf))
+        item = np.dtype(getattr(leaf, "dtype", None)
+                        or np.asarray(leaf).dtype).itemsize
+        full = int(np.prod(a_shape, dtype=np.int64)) * item
+        shard = full // shard_size_ratio(spec, axes)
+        axis = "+".join(ax for entry in tuple(spec)
+                        for ax in spec_entry_axes(entry))
+        _mt.inc("bf_sharded_bytes_total", float(shard * deposits),
+                leaf=name, axis=axis)
+        if full > shard:
+            _mt.inc("bf_gather_bytes_saved_total",
+                    float((full - shard) * deposits), leaf=name, axis=axis)
+        shard_total += shard
+        saved_total += full - shard
+    return shard_total, saved_total

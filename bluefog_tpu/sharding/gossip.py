@@ -1,0 +1,222 @@
+"""Gossip-of-meshes over the window fabric: shard-local neighbor gossip.
+
+Each gossip rank is a whole inner mesh (``fsdp``/``tp`` shards); the
+gossip graph connects *meshes*, not chips.  The wire model this module
+implements — and the equivalence tests pin — is:
+
+- every inner-mesh coordinate owns its OWN window per rank
+  (``{name}:{rank}:{shard}``), sized to the SHARD-local packed vector
+  (plus the push-sum mass scalar);
+- coordinate ``c`` of rank ``r`` deposits only to coordinate ``c`` of
+  its out-neighbors — same-shard-to-same-shard, **no gather anywhere on
+  the hot path** (the full tree is reassembled only at the read/serving
+  boundary, via :func:`bluefog_tpu.sharding.apply.gather_tree`);
+- push-sum mass is carried per shard, so the exactly-once mass audit
+  holds per coordinate: ``sum_r p[r, c] == n`` under any interleaving,
+  and stays exact through a :func:`~bluefog_tpu.topology.heal`.
+
+Because gossip is element-wise, the shard-local run is numerically
+IDENTICAL (same floating-point operations in the same order per
+element) to the gathered single-chip reference — ``run_sharded_gossip``
+with ``axes={}`` *is* that reference, which is how
+``tests/test_sharding.py`` asserts 1e-12 equivalence for ring and
+exponential topologies.
+
+:func:`run_sharded_gossip` executes deterministic synchronous rounds
+(every rank deposits, then every rank consumes) so the equivalence
+claim is testable bit-for-bit; the genuinely asynchronous execution
+model with rank-dependent rates lives in
+:func:`bluefog_tpu.runtime.async_windows.run_async_dsgd`, whose
+spec-aware :class:`~bluefog_tpu.runtime.async_windows.TreePacker` uses
+the same :class:`~bluefog_tpu.sharding.mesh.ShardView` plans.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.runtime.async_windows import AsyncWindow, TreePacker
+from bluefog_tpu.sharding.apply import (gather_tree, record_shard_savings,
+                                        tree_wire_bytes)
+from bluefog_tpu.sharding.mesh import ShardView, inner_coords
+from bluefog_tpu.sharding.rules import RuleTable
+from bluefog_tpu.topology.graphs import Topology, heal as _heal
+
+__all__ = ["ShardedGossipReport", "run_sharded_gossip"]
+
+
+@dataclass
+class ShardedGossipReport:
+    """Outcome of a shard-local gossip run."""
+
+    rounds: int
+    # per-rank de-biased estimates, REASSEMBLED to full trees (None for
+    # ranks healed out) — the only gather in the run, at the read
+    # boundary
+    params: List[Any]
+    # per-coordinate mass sums over every window + unconsumed slot
+    # (exact audit: each entry == topology.size, deaths included —
+    # a healed-out rank keeps the mass it held, it is never duplicated
+    # or lost)
+    total_mass: Dict[Tuple[int, ...], float]
+    # wire accounting per deposit: shard-local bytes actually moved and
+    # bytes a gather-then-gossip wire would have added
+    shard_bytes_per_deposit: int
+    saved_bytes_per_deposit: int
+    deposits: int = 0
+    dead_ranks: List[int] = field(default_factory=list)
+
+
+def run_sharded_gossip(
+    topology: Topology,
+    params0: Sequence[Any],
+    rule_table,
+    axes: Mapping[str, int],
+    *,
+    rounds: int = 10,
+    name: str = "shard_gossip",
+    heal_after: Optional[int] = None,
+    dead_ranks: Sequence[int] = (),
+    dtype=np.float64,
+) -> ShardedGossipReport:
+    """Run ``rounds`` of shard-local push-sum gossip over ``topology``.
+
+    Args:
+      topology: the gossip graph over RANKS (each a whole inner mesh).
+      params0: one pytree per rank (``len == topology.size``), all with
+        the template structure/shapes of ``params0[0]``.
+      rule_table: the :class:`~bluefog_tpu.sharding.rules.RuleTable`
+        resolving every leaf's spec (the single source of truth), or an
+        already-resolved spec pytree.
+      axes: inner-mesh ``{axis: size}``.  ``{}`` = one shard per rank =
+        the gathered single-chip reference.
+      heal_after / dead_ranks: after round ``heal_after`` the ranks in
+        ``dead_ranks`` stop participating and survivors re-plan through
+        :func:`bluefog_tpu.topology.heal` — the per-coordinate mass
+        audit must stay exact through the change.
+    """
+    n = topology.size
+    if len(params0) != n:
+        raise ValueError(f"{len(params0)} param trees != topology size {n}")
+    template = params0[0]
+    if isinstance(rule_table, RuleTable):
+        specs = rule_table.resolve_tree(template)
+    else:
+        specs = rule_table
+    coords = inner_coords(axes)
+    views = [ShardView(specs=specs, axes=axes, coord=c) for c in coords]
+    packers = [TreePacker(template, dtype, sharding=v) for v in views]
+    d = packers[0].size
+    dead = set(int(r) for r in dead_ranks)
+    if heal_after is None and dead:
+        raise ValueError("dead_ranks without heal_after")
+
+    in_nbrs = [list(topology.in_neighbors(r)) for r in range(n)]
+    out_nbrs = [list(topology.out_neighbors(r)) for r in range(n)]
+    slot_of = [{src: k for k, src in enumerate(in_nbrs[r])} for r in range(n)]
+
+    # one window per (rank, coordinate): the shard-local landing zone
+    wins: List[List[AsyncWindow]] = []
+    try:
+        for r in range(n):
+            row = []
+            wins.append(row)
+            for ci in range(len(coords)):
+                row.append(AsyncWindow(f"{name}:{r}:{ci}",
+                                       max(len(in_nbrs[r]), 1), d + 1,
+                                       np.float64))
+    except BaseException:
+        for row in wins:
+            for w in row:
+                w.free()
+        raise
+
+    try:
+        x = [[packers[ci].pack(params0[r]).astype(np.float64)
+              for ci in range(len(coords))] for r in range(n)]
+        p = [[1.0] * len(coords) for _ in range(n)]
+        live = list(range(n))
+        my_out = [list(out_nbrs[r]) for r in range(n)]
+        deposits = 0
+
+        for k in range(rounds):
+            if heal_after is not None and k == heal_after and dead:
+                healed = _heal(topology, frozenset(dead))
+                live = [r for r in range(n) if r not in dead]
+                my_out = [list(healed.out_neighbors(r)) for r in range(n)]
+                _bb.record("sharded_gossip_heal", round=k,
+                           dead=sorted(dead))
+            # deposit phase: same-shard to same-shard, shard-sized wire
+            for r in live:
+                frac = 1.0 / (len(my_out[r]) + 1)
+                for ci in range(len(coords)):
+                    payload = np.concatenate(
+                        [x[r][ci] * frac, [p[r][ci] * frac]])
+                    for j in my_out[r]:
+                        wins[j][ci].deposit(slot_of[j][r], payload,
+                                            accumulate=True)
+                        deposits += 1
+                    x[r][ci] *= frac
+                    p[r][ci] *= frac
+            # consume phase: fold whatever landed
+            for r in live:
+                for ci in range(len(coords)):
+                    for s in range(len(in_nbrs[r])):
+                        buf, fresh = wins[r][ci].read(s, consume=True)
+                        if fresh > 0:
+                            x[r][ci] += buf[:-1]
+                            p[r][ci] += buf[-1]
+                    # publish (x, p) so same-coordinate warm-start /
+                    # serving readers see a round-consistent pair
+                    wins[r][ci].set_self(
+                        np.concatenate([x[r][ci], [p[r][ci]]]))
+
+        # ------------------------------------------------- mass audit
+        # every coordinate's mass ledger: held by live + dead ranks,
+        # plus anything never consumed (a dead rank's landing slots)
+        total_mass: Dict[Tuple[int, ...], float] = {}
+        names = list(axes.keys())
+        for ci, c in enumerate(coords):
+            tot = 0.0
+            for r in range(n):
+                tot += p[r][ci]
+                for s in range(len(in_nbrs[r])):
+                    if r in dead:
+                        buf, fresh = wins[r][ci].read(s, consume=False)
+                        if fresh > 0:
+                            tot += float(buf[-1])
+            total_mass[tuple(c[nm] for nm in names)] = tot
+
+        # ------------------------------------- read boundary (gather)
+        params: List[Any] = [None] * n
+        for r in range(n):
+            if r in dead:
+                continue
+            shard_trees = {}
+            for ci, c in enumerate(coords):
+                z = x[r][ci] / p[r][ci]
+                shard_trees[tuple(c[nm] for nm in names)] = (
+                    packers[ci].unpack(z, as_jax=False))
+            params[r] = gather_tree(template, specs, axes, shard_trees)
+
+        shard_b, full_b = tree_wire_bytes(template, specs, axes)
+        if deposits:
+            record_shard_savings(template, specs, axes, deposits=deposits)
+        return ShardedGossipReport(
+            rounds=rounds,
+            params=params,
+            total_mass=total_mass,
+            shard_bytes_per_deposit=shard_b,
+            saved_bytes_per_deposit=full_b - shard_b,
+            deposits=deposits,
+            dead_ranks=sorted(dead),
+        )
+    finally:
+        for row in wins:
+            for w in row:
+                w.free()

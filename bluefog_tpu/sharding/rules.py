@@ -1,0 +1,311 @@
+"""The rule table: ONE ordered ``regex -> PartitionSpec`` mapping as the
+single source of truth for how every leaf family is partitioned.
+
+The pattern is the ``match_partition_rules`` idiom production JAX LLM
+stacks converged on (SNIPPETS.md [3]): parameter leaves are named by their
+``'/'``-joined tree path, an ordered list of ``(regex, PartitionSpec)``
+rules is searched first-match-wins, and scalars are never partitioned.
+What this module adds over the idiom is the *unification* the gossip
+stack needs — the same table resolves
+
+- **parameters** (:meth:`RuleTable.resolve_tree`),
+- **optimizer state** (:func:`opt_state_specs` — ``m``/``v``-style moment
+  leaves inherit the spec of the parameter they shadow, by tree-path
+  suffix matching, so Adam state is never silently replicated while its
+  parameter is sharded), and
+- **gossip window buffers** (``ops.windows.win_create(rule_table=)`` and
+  the spec-aware :class:`~bluefog_tpu.runtime.async_windows.TreePacker`),
+
+so changing a single rule re-shards all three families consistently —
+the acceptance invariant ``tests/test_sharding.py`` pins.
+
+Resolution is LOUD by design: a non-scalar leaf matched by no rule
+raises :class:`UnmatchedLeafError` (the silent-replication leak is the
+failure mode — a 10 GB embedding quietly replicated over every chip),
+and :meth:`RuleTable.coverage` reports both directions (unmatched leaves
+AND dead rules) for the BF-SHD001 lint.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec
+
+__all__ = [
+    "Rule",
+    "RuleTable",
+    "ShardingRuleError",
+    "UnmatchedLeafError",
+    "UnusedRuleError",
+    "named_leaves",
+    "named_tree_map",
+    "norm_spec",
+    "opt_state_specs",
+    "spec_entry_axes",
+    "spec_mentions",
+]
+
+
+class ShardingRuleError(ValueError):
+    """Base class for rule-table resolution failures."""
+
+
+class UnmatchedLeafError(ShardingRuleError):
+    """A non-scalar leaf matched no rule — the silent-replication leak."""
+
+
+class UnusedRuleError(ShardingRuleError):
+    """A rule matched no leaf — a typo'd pattern shards nothing."""
+
+
+def _is_spec(x) -> bool:
+    return isinstance(x, PartitionSpec)
+
+
+def _keystr(k) -> str:
+    """One path component as a clean name (no brackets/quotes)."""
+    for attr in ("key", "name", "idx"):
+        v = getattr(k, attr, None)
+        if v is not None:
+            return str(v)
+    return str(k)
+
+
+def named_leaves(tree, *, sep: str = "/", is_leaf: Optional[Callable] = None
+                 ) -> List[Tuple[str, Any]]:
+    """``[(path_name, leaf)]`` with ``'/'``-joined component names —
+    the naming contract every rule pattern is written against (flax
+    param trees come out as e.g. ``block_0/up/kernel``)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+    return [(sep.join(_keystr(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree, *, sep: str = "/",
+                   is_leaf: Optional[Callable] = None):
+    """``tree_map`` where ``fn`` also receives the leaf's joined path."""
+    import jax
+
+    def wrap(path, leaf):
+        return fn(sep.join(_keystr(k) for k in path), leaf)
+
+    return jax.tree_util.tree_map_with_path(wrap, tree, is_leaf=is_leaf)
+
+
+def _leaf_shape(leaf) -> Tuple[int, ...]:
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        shape = np.shape(leaf)
+    return tuple(int(s) for s in shape)
+
+
+def _is_scalar(shape: Tuple[int, ...]) -> bool:
+    return len(shape) == 0 or int(np.prod(shape, dtype=np.int64)) == 1
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One table entry: ``re.search(pattern, leaf_path)`` -> ``spec``."""
+
+    pattern: str
+    spec: PartitionSpec
+
+    def __post_init__(self):
+        re.compile(self.pattern)  # fail at construction, not resolution
+        if isinstance(self.spec, str):
+            # a bare axis name means "shard dim 0 over it" — splatting
+            # the string would silently make per-CHARACTER axes
+            # (P('t','p') from "tp"), which then replicate on the wire
+            object.__setattr__(self, "spec", PartitionSpec(self.spec))
+        elif not isinstance(self.spec, PartitionSpec):
+            object.__setattr__(self, "spec", PartitionSpec(*self.spec))
+
+    def matches(self, name: str) -> bool:
+        return re.search(self.pattern, name) is not None
+
+
+class RuleTable:
+    """Ordered first-match-wins ``regex -> PartitionSpec`` resolution.
+
+    Args:
+      rules: ``Rule`` instances or ``(pattern, spec)`` pairs, most
+        specific first — resolution takes the FIRST match.
+      axes: optional ``{axis_name: size}`` of the inner (within-rank)
+        mesh; when given, every rule's spec is validated to mention only
+        these axes at construction, and :meth:`shard_shape` /
+        :meth:`shard_slices` become available.
+    """
+
+    def __init__(self, rules: Sequence, *,
+                 axes: Optional[Mapping[str, int]] = None):
+        self.rules: Tuple[Rule, ...] = tuple(
+            r if isinstance(r, Rule) else Rule(r[0], r[1]) for r in rules)
+        self.axes = dict(axes) if axes is not None else None
+        if self.axes is not None:
+            for r in self.rules:
+                for entry in r.spec:
+                    for ax in spec_entry_axes(entry):
+                        if ax not in self.axes:
+                            raise ShardingRuleError(
+                                f"rule {r.pattern!r} mentions axis "
+                                f"{ax!r}, not one of {sorted(self.axes)}")
+
+    # ------------------------------------------------------------ resolve
+    def resolve(self, name: str, shape: Sequence[int] = ()) -> PartitionSpec:
+        """Spec for one named leaf.  Scalars (and size-1 leaves) are never
+        partitioned; a non-scalar leaf matching no rule raises
+        :class:`UnmatchedLeafError` (first-match-wins otherwise)."""
+        shape = tuple(int(s) for s in shape)
+        if _is_scalar(shape):
+            return PartitionSpec()
+        for r in self.rules:
+            if r.matches(name):
+                if len(r.spec) > len(shape):
+                    raise ShardingRuleError(
+                        f"rule {r.pattern!r} spec {r.spec} has more entries "
+                        f"than leaf {name!r} has dims {shape}")
+                return r.spec
+        raise UnmatchedLeafError(
+            f"no partition rule matches leaf {name!r} (shape {shape}) — "
+            "add a rule (or an explicit replicate-rule, e.g. "
+            r"Rule('.*', PartitionSpec())) so replication is a decision, "
+            "not a leak")
+
+    def resolve_tree(self, tree, *, is_leaf: Optional[Callable] = None):
+        """Pytree of :class:`PartitionSpec` matching ``tree``'s structure."""
+        return named_tree_map(
+            lambda name, leaf: self.resolve(name, _leaf_shape(leaf)),
+            tree, is_leaf=is_leaf)
+
+    # ----------------------------------------------------------- coverage
+    def coverage(self, tree, *, is_leaf: Optional[Callable] = None
+                 ) -> Tuple[List[str], List[str]]:
+        """``(unmatched_leaf_names, unused_rule_patterns)`` over ``tree``
+        — both directions of the BF-SHD001 contract.  Scalar leaves are
+        exempt from matching (they resolve replicated without consuming
+        a rule), but they CAN satisfy a rule's liveness."""
+        unmatched: List[str] = []
+        used = [False] * len(self.rules)
+        for name, leaf in named_leaves(tree, is_leaf=is_leaf):
+            hit = None
+            for i, r in enumerate(self.rules):
+                if r.matches(name):
+                    hit = i
+                    break
+            if hit is not None:
+                used[hit] = True
+            elif not _is_scalar(_leaf_shape(leaf)):
+                unmatched.append(name)
+        unused = [r.pattern for r, u in zip(self.rules, used) if not u]
+        return unmatched, unused
+
+    def check(self, tree, *, is_leaf: Optional[Callable] = None) -> None:
+        """Raise unless the table and ``tree`` cover each other exactly."""
+        unmatched, unused = self.coverage(tree, is_leaf=is_leaf)
+        if unmatched:
+            raise UnmatchedLeafError(
+                f"leaves matched by no rule: {unmatched}")
+        if unused:
+            raise UnusedRuleError(
+                f"rules matching no leaf: {unused}")
+
+    def __len__(self) -> int:
+        return len(self.rules)
+
+    def __repr__(self) -> str:
+        return (f"RuleTable({len(self.rules)} rules"
+                + (f", axes={self.axes}" if self.axes is not None else "")
+                + ")")
+
+    def replaced(self, pattern: str, spec) -> "RuleTable":
+        """A new table with the rule whose pattern equals ``pattern``
+        swapped for ``spec`` (order preserved) — the one-rule-change
+        surface the re-sharding acceptance test drives."""
+        if pattern not in [r.pattern for r in self.rules]:
+            raise KeyError(f"no rule with pattern {pattern!r}")
+        return RuleTable(
+            [Rule(r.pattern, spec if r.pattern == pattern else r.spec)
+             for r in self.rules],
+            axes=self.axes)
+
+
+def spec_entry_axes(entry) -> Tuple[str, ...]:
+    """Axis names of one PartitionSpec entry (None | str | tuple) — THE
+    entry-semantics helper; every consumer (mesh arithmetic, lints,
+    gradient correction) goes through here so a change to entry shapes
+    lands once."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def norm_spec(spec) -> Tuple[Tuple[str, ...], ...]:
+    """Canonical comparable form of a spec: per-dim axis tuples with
+    trailing replicated entries trimmed (``P()``, ``P(None)``, and an
+    absent entry all mean the same thing) — the equality the
+    BF-SHD002 lint and :func:`parallel.tensor.check_rule_agreement`
+    compare under."""
+    out = [spec_entry_axes(e) for e in tuple(spec)]
+    while out and out[-1] == ():
+        out.pop()
+    return tuple(out)
+
+
+def spec_mentions(spec, axis: str) -> bool:
+    """Whether ``spec`` shards any dim over ``axis``."""
+    return any(axis in spec_entry_axes(e) for e in tuple(spec))
+
+
+# ---------------------------------------------------------------------------
+# Optimizer-state derivation: moment leaves inherit the param's spec
+# ---------------------------------------------------------------------------
+
+
+def opt_state_specs(table: RuleTable, params, opt_state, *,
+                    is_leaf: Optional[Callable] = None):
+    """Spec tree for ``opt_state`` derived from the SAME rule table that
+    shards ``params`` — the state-tree rule derivation.
+
+    Optimizer states (optax's ``ScaleByAdamState.mu/nu``, the repo's
+    ``_DecentralizedState.base_state``, gradient-tracking trackers) embed
+    one or more copies of the parameter tree under wrapper path prefixes
+    like ``0/mu``.  For each opt-state leaf:
+
+    - scalar leaves (step counters, cadence gates) -> replicated;
+    - otherwise the LONGEST parameter path that is a ``/``-component
+      suffix of the leaf's path, with a matching shape, donates its
+      resolved spec (so ``m``/``v`` inherit exactly the param's
+      partitioning — changing the param's rule re-shards its moments);
+    - a leaf shadowing no parameter falls back to direct table
+      resolution (it is a first-class leaf with its own rule), which
+      raises :class:`UnmatchedLeafError` when nothing matches.
+    """
+    param_index: List[Tuple[Tuple[str, ...], Tuple[int, ...],
+                            PartitionSpec]] = []
+    for name, leaf in named_leaves(params, is_leaf=is_leaf):
+        shape = _leaf_shape(leaf)
+        param_index.append(
+            (tuple(name.split("/")), shape, table.resolve(name, shape)))
+    # longest-suffix-first: sort by path length descending once
+    param_index.sort(key=lambda t: len(t[0]), reverse=True)
+
+    def derive(name: str, leaf) -> PartitionSpec:
+        shape = _leaf_shape(leaf)
+        if _is_scalar(shape):
+            return PartitionSpec()
+        comps = tuple(name.split("/"))
+        for ppath, pshape, pspec in param_index:
+            if (len(comps) >= len(ppath) and comps[-len(ppath):] == ppath
+                    and shape == pshape):
+                return pspec
+        return table.resolve(name, shape)
+
+    return named_tree_map(derive, opt_state, is_leaf=is_leaf)

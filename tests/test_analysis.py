@@ -1433,6 +1433,124 @@ class TestConcurrencyLint:
         assert "lock-order edges" in proc.stdout
 
 
+class TestShardingLint:
+    """BF-SHD: the unified rule table vs the leaf families it governs —
+    coverage leaks (001), window-declaration drift (002), and a gather
+    on the gossip hot path (003, by jaxpr inspection)."""
+
+    def _tree(self):
+        return {"blk": {"up": {"kernel": jnp.zeros((4, 8)),
+                               "bias": jnp.zeros((8,))},
+                        "ln": {"count": jnp.zeros(())}}}
+
+    def test_seeded_violation_unmatched_leaf(self):
+        from bluefog_tpu.analysis.sharding_lint import check_rule_coverage
+        from bluefog_tpu.sharding import RuleTable
+
+        table = RuleTable([("kernel$", P(None, "tp"))])  # no catch-all
+        diags = check_rule_coverage(table, self._tree())
+        errs = _errors(diags)
+        assert errs and all(d.code == "BF-SHD001" for d in errs)
+        assert any("up/bias" in d.message for d in errs)
+        # the scalar is exempt — it resolves replicated, not leaked
+        assert not any("count" in d.message for d in errs)
+
+    def test_seeded_violation_dead_rule(self):
+        from bluefog_tpu.analysis.sharding_lint import check_rule_coverage
+        from bluefog_tpu.sharding import RuleTable
+
+        table = RuleTable([("typod_pattern$", P("tp")), (".*", P())])
+        diags = check_rule_coverage(table, self._tree())
+        assert any(d.code == "BF-SHD001" and "typod_pattern" in d.message
+                   for d in _errors(diags))
+
+    def test_clean_coverage(self):
+        from bluefog_tpu.analysis.sharding_lint import check_rule_coverage
+        from bluefog_tpu.sharding import RuleTable
+
+        table = RuleTable([("kernel$", P(None, "tp")), (".*", P())])
+        assert not check_rule_coverage(table, self._tree())
+
+    def test_seeded_violation_window_declaration_drift(self):
+        from bluefog_tpu.analysis.sharding_lint import (
+            check_window_partition)
+        from bluefog_tpu.ops.windows import win_create
+        from bluefog_tpu.sharding import RuleTable
+
+        created_under = RuleTable([("kernel$", P(None, "tp")), (".*", P())])
+        live = RuleTable([("kernel$", P("tp", None)), (".*", P())])
+        sched = T.build_schedule(T.RingGraph(4))
+        win = win_create(self._tree(), sched, AXIS,
+                         rule_table=created_under)
+        diags = check_window_partition(win, live)
+        assert any(d.code == "BF-SHD002" and "kernel" in d.message
+                   for d in diags)
+        # same table -> clean
+        assert not check_window_partition(win, created_under)
+        # undeclared (legacy) window -> the one-shot warning
+        legacy = win_create(self._tree(), sched, AXIS)
+        diags = check_window_partition(legacy, live)
+        assert [d.code for d in diags] == ["BF-SHD002"]
+        assert "declares no partition" in diags[0].message
+
+    def test_seeded_violation_gather_on_hot_path(self, devices8):
+        from bluefog_tpu.analysis.sharding_lint import check_shard_local
+        from bluefog_tpu.parallel.tensor import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh({"bf": 4, "tp": 2}, devices=devices8)
+
+        def gathers(x):
+            return lax.all_gather(x, "tp", tiled=True)
+
+        fn = shard_map(gathers, mesh=mesh, in_specs=(P("tp"),),
+                       out_specs=P(), check_vma=False)
+        diags = check_shard_local(fn, jnp.zeros((8,)),
+                                  inner_axes={"tp": 2})
+        assert any(d.code == "BF-SHD003" for d in _errors(diags))
+
+    def test_clean_sharded_gossip_step(self, devices8):
+        from bluefog_tpu.analysis.sharding_lint import check_shard_local
+        from bluefog_tpu.parallel.tensor import make_hybrid_mesh
+        from bluefog_tpu.sharding import RuleTable
+
+        mesh = make_hybrid_mesh({"bf": 4, "tp": 2}, devices=devices8)
+        sched = T.build_schedule(T.RingGraph(4))
+        table = RuleTable([("w$", P(None, "tp")), (".*", P())])
+
+        def step(x):
+            return C.sharded_neighbor_allreduce(
+                x, sched, AXIS, rule_table=table, inner_axes={"tp": 2})
+
+        fn = shard_map(step, mesh=mesh,
+                       in_specs=({"w": P("bf", "tp")},),
+                       out_specs={"w": P("bf", "tp")}, check_vma=False)
+        diags = check_shard_local(fn, {"w": jnp.zeros((4, 8))},
+                                  inner_axes={"tp": 2})
+        assert not _errors(diags), [d.format() for d in diags]
+        assert any(d.code == "BF-SHD103" for d in diags)
+
+    def test_trace_failure_is_a_finding(self):
+        from bluefog_tpu.analysis.sharding_lint import check_shard_local
+
+        def boom(x):
+            raise RuntimeError("no trace for you")
+
+        diags = check_shard_local(boom, jnp.zeros((4,)),
+                                  inner_axes={"tp": 2})
+        assert [d.code for d in diags] == ["BF-SHD020"]
+
+    def test_repo_sharding_pass_clean(self):
+        """The sweep's own pass over the repo's default tables finds no
+        errors (repo-clean)."""
+        from bluefog_tpu.analysis import lint as L
+
+        report = LintReport()
+        L.sharding_pass(report, 8)
+        errs = [d for d in report.diagnostics if d.severity == "error"]
+        assert not errs, [d.format() for d in errs]
+        assert any(d.code == "BF-SHD100" for d in report.diagnostics)
+
+
 class TestDocLint:
     def test_repo_doc_matches_registry(self):
         from bluefog_tpu.analysis.doc_lint import check_transport_doc
