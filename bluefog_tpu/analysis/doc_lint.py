@@ -1,5 +1,6 @@
-"""BF-DOC001: the transport doc must list every wire v2 status code.
+"""BF-DOC: operator docs pinned to the live registries, both directions.
 
+**BF-DOC001** — the transport doc must list every wire v2 status code.
 The status codes live in ONE table
 (:mod:`bluefog_tpu.runtime.wire_status`); ``docs/transport.md`` is the
 operator-facing contract for the same wire.  The doc drifted from the
@@ -10,28 +11,40 @@ the doc, and every ``-1xx`` literal the doc mentions must be a code the
 registry defines (a documented code the wire never sends is the same
 drift in the other direction).
 
-**BF-DOC001** (error): a registry code missing from the doc, or a doc
-code missing from the registry.  **BF-DOC100** (info): summary.
+**BF-DOC002** — ``docs/metrics.md`` must name every ``bf_*`` metric the
+package can emit, and every ``bf_*`` name the doc mentions must exist
+in the package (same pattern, the metric registry's live names being
+the ``bf_[a-z0-9_]+`` string literals in the source — a renamed metric
+whose old doc row survives is exactly the drift the sweep previously
+could not catch).  Histogram expansion spellings in the doc
+(``<name>_p99`` etc.) normalize to their base metric.
+
+**BF-DOC000** (warning): a doc file the lint could not read.
+**BF-DOC100** / **BF-DOC101** (info): per-check agreement summaries.
 """
 
 from __future__ import annotations
 
 import os
 import re
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from bluefog_tpu.analysis.report import Diagnostic
 
-__all__ = ["check_transport_doc"]
+__all__ = ["check_transport_doc", "check_metrics_doc"]
 
 _PASS = "doc-lint"
 _CODE_RE = re.compile(r"-1\d\d\b")
+_METRIC_RE = re.compile(r"\bbf_[a-z0-9_]+\b")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 
 def _default_doc_path() -> str:
-    root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    return os.path.join(root, "docs", "transport.md")
+    return os.path.join(_repo_root(), "docs", "transport.md")
 
 
 def check_transport_doc(doc_path: Optional[str] = None
@@ -45,7 +58,7 @@ def check_transport_doc(doc_path: Optional[str] = None
             text = f.read()
     except OSError as e:
         diags.append(Diagnostic(
-            "warning", "BF-DOC002",
+            "warning", "BF-DOC000",
             f"could not read transport doc {path}: {e}",
             pass_name=_PASS, subject=os.path.basename(path)))
         return diags
@@ -81,4 +94,116 @@ def check_transport_doc(doc_path: Optional[str] = None
             f"all {len(registry)} wire v2 status codes documented in "
             f"{os.path.basename(path)}; no stray codes",
             pass_name=_PASS, subject="transport.md"))
+    return diags
+
+
+#: the registry/comm call surface that takes a metric name as its first
+#: positional argument — what makes a ``bf_*`` literal a METRIC name
+#: (the package also spells native FFI symbols ``bf_*``; those never
+#: flow through these calls)
+_METRIC_CALLS = frozenset((
+    "inc", "observe", "set", "counter", "gauge", "histogram",
+    "gauge_fn", "remove_gauge_fn"))
+
+
+def _live_metric_names(src_root: str) -> Set[str]:
+    """Every ``bf_*`` metric name the package source can emit: string
+    literals in the first-argument position of the registry/comm call
+    surface (``inc``/``observe``/``set``/``counter``/``gauge``/
+    ``histogram``/``gauge_fn``), plus the ``(name, amount)`` tuple
+    lists :func:`bluefog_tpu.metrics.comm.count` takes — metric names
+    are declared at their call sites, so this set IS the live
+    registry."""
+    import ast
+
+    names: Set[str] = set()
+
+    def visit(tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fname = (fn.attr if isinstance(fn, ast.Attribute)
+                         else fn.id if isinstance(fn, ast.Name)
+                         else None)
+                if (fname in _METRIC_CALLS and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.startswith("bf_")):
+                    names.add(node.args[0].value)
+            elif isinstance(node, ast.Tuple) and node.elts:
+                # the count() form: [("bf_name", amount), ...]
+                first = node.elts[0]
+                if (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)
+                        and _METRIC_RE.fullmatch(first.value)):
+                    names.add(first.value)
+
+    for dirpath, dirnames, filenames in os.walk(src_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    src = f.read()
+                visit(ast.parse(src))
+            except (OSError, SyntaxError):
+                continue
+    return names
+
+
+def check_metrics_doc(doc_path: Optional[str] = None,
+                      src_root: Optional[str] = None
+                      ) -> List[Diagnostic]:
+    """BF-DOC002: ``docs/metrics.md`` <-> the live ``bf_*`` metric
+    names, pinned both directions (the BF-DOC001 wire-status pattern).
+    A live metric the doc never names, or a documented name the package
+    can no longer emit (the renamed-metric stale row), is an error."""
+    from bluefog_tpu.metrics.registry import HIST_SUFFIXES
+
+    path = doc_path or os.path.join(_repo_root(), "docs", "metrics.md")
+    root = src_root or os.path.join(_repo_root(), "bluefog_tpu")
+    diags: List[Diagnostic] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        diags.append(Diagnostic(
+            "warning", "BF-DOC000",
+            f"could not read metrics doc {path}: {e}",
+            pass_name=_PASS, subject=os.path.basename(path)))
+        return diags
+
+    live = _live_metric_names(root)
+    doc_names: Set[str] = set()
+    for n in _METRIC_RE.findall(text):
+        # the doc may legitimately spell a histogram's snapshot
+        # expansion (`bf_..._seconds_p99`): normalize to the base
+        for suf in HIST_SUFFIXES:
+            if n.endswith(suf) and n[:-len(suf)] in live:
+                n = n[:-len(suf)]
+                break
+        doc_names.add(n)
+
+    for name in sorted(live - doc_names):
+        diags.append(Diagnostic(
+            "error", "BF-DOC002",
+            f"metric {name} is emitted by the package but never named "
+            f"in {os.path.basename(path)} — every live bf_* metric "
+            "needs a doc row (add it to the metrics table)",
+            pass_name=_PASS, subject=name))
+    for name in sorted(doc_names - live):
+        diags.append(Diagnostic(
+            "error", "BF-DOC002",
+            f"{os.path.basename(path)} documents {name}, which no "
+            "source file emits — a stale row for a renamed or removed "
+            "metric (fix the doc, or restore the metric)",
+            pass_name=_PASS, subject=name))
+    if not diags:
+        diags.append(Diagnostic(
+            "info", "BF-DOC101",
+            f"all {len(live)} live bf_* metrics documented in "
+            f"{os.path.basename(path)}; no stale rows",
+            pass_name=_PASS, subject="metrics.md"))
     return diags

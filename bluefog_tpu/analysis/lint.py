@@ -372,6 +372,39 @@ def resilience_pass(report: LintReport, size: int) -> None:
         pass_name="resilience-lint", subject="runtime"))
 
 
+def tracing_pass(report: LintReport, size: int) -> None:
+    """BF-TRC source lint over every span-begin surface: the whole
+    package (minus ``bluefog_tpu/tracing/`` — the primitive itself)
+    plus examples and benchmarks.  An explicit ``begin_span`` without a
+    finally-guaranteed ``finish`` or a reasoned ``# bftrace:
+    cross-thread`` waiver is an error — a wedged peer must show an OPEN
+    span, never a leaked one that reports a completed phase as stuck.
+    See :mod:`bluefog_tpu.analysis.tracing_lint`."""
+    import glob
+
+    from bluefog_tpu.analysis.tracing_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "**", "*.py"), recursive=True))
+    targets = [p for p in targets
+               if os.sep + "tracing" + os.sep not in p]
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-TRC100",
+        f"tracing-lint scanned {n} file(s) for finish-unguaranteed "
+        "span begins",
+        pass_name="tracing-lint", subject="tracing"))
+
+
 def control_pass(report: LintReport, size: int) -> None:
     """BF-CTL source lint over the surfaces that actuate communication
     plans: the control plane itself, the runtime loops it is wired
@@ -514,10 +547,14 @@ def sharding_pass(report: LintReport, size: int) -> None:
 
 def doc_pass(report: LintReport, size: int) -> None:
     """BF-DOC: docs/transport.md must list every wire v2 status code in
-    the one registry (:mod:`bluefog_tpu.runtime.wire_status`)."""
-    from bluefog_tpu.analysis.doc_lint import check_transport_doc
+    the one registry (:mod:`bluefog_tpu.runtime.wire_status`), and
+    docs/metrics.md must agree with the live ``bf_*`` metric names,
+    both directions."""
+    from bluefog_tpu.analysis.doc_lint import (check_metrics_doc,
+                                               check_transport_doc)
 
     report.extend(check_transport_doc())
+    report.extend(check_metrics_doc())
 
 
 def serving_pass(report: LintReport, size: int) -> None:
@@ -633,6 +670,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     resilience_pass(report, size)
     serving_pass(report, size)
     control_pass(report, size)
+    tracing_pass(report, size)
     concurrency_pass(report, size)
     doc_pass(report, size)
     examples_pass(report, size)

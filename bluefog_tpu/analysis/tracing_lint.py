@@ -1,0 +1,173 @@
+"""BF-TRC lint: every explicit span begin must be finish-guaranteed.
+
+The causal-tracing forensics contract (:mod:`bluefog_tpu.tracing.
+recorder`) is that a wedged peer shows an **open** span — the flush
+snapshot re-writes still-open spans every time, so the newest trace
+file always names what the process is stuck in.  That contract only
+holds when spans are discharged deterministically on every OTHER path:
+a ``begin_span`` whose ``finish`` can be skipped by an early return or
+an exception leaks a *forever-open* span, which reads as "this peer is
+wedged in phase X" when the phase actually completed — the worst kind
+of forensics, confidently wrong.
+
+The rule, per enclosing function (AST source lint, the
+:mod:`bluefog_tpu.analysis.resilience_lint` vocabulary pattern — span
+begins are host Python on socket/worker threads):
+
+- an **explicit begin** is a call named ``begin_span`` (the context
+  manager :meth:`SpanRecorder.span` discharges itself and is always
+  fine);
+- the begin is **guaranteed** when its enclosing function contains a
+  ``try``/``finally`` whose ``finally`` body calls ``finish`` — the
+  shape that discharges the span on every exit path;
+- a begin whose finish genuinely lives on ANOTHER thread by design
+  (the DepositStream wire span: begun by the sender thread, finished
+  by the ack reader when the owner's ack lands) is **waived** with an
+  explicit marker comment on the begin line::
+
+      wsp = rec.begin_span(  # bftrace: cross-thread <who finishes it>
+
+  The reason is mandatory — a bare marker is still an error.  An
+  unacked batch then shows an OPEN wire span at flush, which is the
+  contract, not a violation.
+
+**BF-TRC001** (error): an explicit ``begin_span`` in a function with no
+``finally``-guaranteed ``finish`` and no reasoned cross-thread waiver.
+**BF-TRC100** (info): scan summary.  The recorder's own module
+(``bluefog_tpu/tracing/``) is exempt — it IS the primitive.
+
+Known granularity limit (the BF-RES002/BF-CTL001 vocabulary posture):
+the guard is per FUNCTION, not per span — one ``finally: x.finish()``
+vouches for every begin in that function, so a second unguarded begin
+sharing the function escapes.  Dataflow-precise begin↔finally pairing
+is out of scope for a source lint; keep one explicit begin per
+function (the repo's real call sites do), and the open-span flush
+snapshot still surfaces any leak at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_span_discharge", "check_file"]
+
+_PASS = "tracing-lint"
+#: the waiver: '# bftrace: cross-thread <reason>' on the begin line —
+#: the reason (at least one word after the marker) is mandatory
+_WAIVER_RE = re.compile(r"#\s*bftrace:\s*cross-thread\s+\S")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _walk_shallow(node: ast.AST, *, skip_self: bool = True):
+    """Walk without descending into nested function bodies: a begin in
+    a nested def must be judged against ITS body, and a finally-finish
+    inside a nested helper must not excuse the enclosing function's
+    leaked begins."""
+    stack = (list(ast.iter_child_nodes(node))
+             if skip_self else [node])
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(sub))
+
+
+def _has_finally_finish(fn: ast.AST) -> bool:
+    for sub in _walk_shallow(fn):
+        if isinstance(sub, ast.Try) and sub.finalbody:
+            for fin in sub.finalbody:
+                for inner in ast.walk(fin):
+                    if (isinstance(inner, ast.Call)
+                            and _call_name(inner) == "finish"):
+                        return True
+    return False
+
+
+def _waived(lines: List[str], call: ast.Call) -> bool:
+    # the marker may ride the begin line itself or (black-style wrapped
+    # calls) any line of the call expression
+    end = getattr(call, "end_lineno", call.lineno)
+    for ln in range(call.lineno, end + 1):
+        if ln - 1 < len(lines) and _WAIVER_RE.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def check_span_discharge(source: str, *, filename: str = "<source>"
+                         ) -> List[Diagnostic]:
+    """Lint one Python source blob for finish-unguaranteed span begins."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-TRC002",
+            f"could not parse {filename}: {e}",
+            pass_name=_PASS, subject=filename)]
+    short = os.path.basename(filename)
+    lines = source.splitlines()
+    diags: List[Diagnostic] = []
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    covered: set = set()
+    for fn in funcs:
+        guarded = _has_finally_finish(fn)
+        # shallow: a begin inside a nested def belongs to THAT def's
+        # iteration (every def appears in ast.walk(tree)), and the
+        # outer function's guard must not vouch for it
+        for sub in _walk_shallow(fn):
+            if not (isinstance(sub, ast.Call)
+                    and _call_name(sub) == "begin_span"):
+                continue
+            covered.add(sub.lineno)
+            if guarded or _waived(lines, sub):
+                continue
+            diags.append(Diagnostic(
+                "error", "BF-TRC001",
+                f"begin_span at {short}:{sub.lineno} has no finally-"
+                "guaranteed finish in its function and no cross-thread "
+                "waiver — an early return or exception leaks a forever-"
+                "open span, and the trace then reports a WEDGED phase "
+                "that actually completed.  Use the span() context "
+                "manager, finish in a `finally`, or — when another "
+                "thread finishes it by design — mark the begin line "
+                "`# bftrace: cross-thread <who finishes it>`",
+                pass_name=_PASS, subject=f"{short}:{sub.lineno}"))
+    # module-level begins (outside any function) get the same rule
+    # against the module body
+    for sub in ast.walk(tree):
+        if (isinstance(sub, ast.Call) and _call_name(sub) == "begin_span"
+                and sub.lineno not in covered):
+            if not _waived(lines, sub):
+                diags.append(Diagnostic(
+                    "error", "BF-TRC001",
+                    f"module-level begin_span at {short}:{sub.lineno} "
+                    "can never be finally-guaranteed — wrap it in a "
+                    "function with try/finally or use the span() "
+                    "context manager",
+                    pass_name=_PASS, subject=f"{short}:{sub.lineno}"))
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return [Diagnostic(
+            "warning", "BF-TRC002", f"could not read {path}: {e}",
+            pass_name=_PASS, subject=os.path.basename(path))]
+    return check_span_discharge(src, filename=path)

@@ -47,10 +47,12 @@ class RankDump:
     metrics: Optional[dict] = None
     complete: bool = False  # saw the {"end": true} marker
     dropped: int = 0        # ring evictions reported by the end marker
+    torn: int = 0           # unparseable (torn) lines skipped in the file
 
 
 def _parse_file(path: str) -> Optional[RankDump]:
     dump: Optional[RankDump] = None
+    torn = 0
     try:
         with open(path) as f:
             for line in f:
@@ -60,7 +62,12 @@ def _parse_file(path: str) -> Optional[RankDump]:
                 try:
                     rec = json.loads(line)
                 except ValueError:
-                    continue  # torn tail of a crashed writer
+                    # torn line of a crashed/raced writer: tolerated,
+                    # but COUNTED — a skipped line may have been a
+                    # begin, and its orphaned end then needs the
+                    # truncation caveat, not silence
+                    torn += 1
+                    continue
                 if rec.get("header"):
                     dump = RankDump(rank=int(rec.get("rank", 0)), path=path,
                                     header=rec)
@@ -79,6 +86,8 @@ def _parse_file(path: str) -> Optional[RankDump]:
                     dump.dropped = int(rec.get("dropped", 0) or 0)
     except OSError:
         return None
+    if dump is not None:
+        dump.torn = torn
     return dump
 
 
@@ -244,14 +253,26 @@ def diagnose(dumps: Dict[int, RankDump],
                 if p in suspects and p != r:
                     edges.append([r, p])
 
-    # the end marker carries each ring's eviction count; a truncated ring
-    # starts its occurrence numbering at a different real round per rank
-    caveats = [
-        f"rank {r} evicted {d.dropped} event(s) from its ring: "
-        "occurrence-aligned (stepless) rounds may be offset across "
-        "ranks — trust step-carrying events first"
-        for r, d in sorted(dumps.items()) if d.dropped
-    ]
+    # orphaned stepless ends have two distinct causes, and a file can
+    # show BOTH: ring eviction (the end marker's dropped count) and
+    # file truncation (torn lines / a missing end marker).  Carry every
+    # applicable reason — "evicted" alone sends the operator chasing
+    # ring capacity when the file was also cut mid-write
+    caveats = []
+    for r, d in sorted(dumps.items()):
+        reasons = []
+        if d.dropped:
+            reasons.append(f"evicted {d.dropped} event(s) from its ring")
+        if d.torn or not d.complete:
+            parts = [p for p in (
+                f"{d.torn} torn line(s) skipped" if d.torn else "",
+                "no end marker" if not d.complete else "") if p]
+            reasons.append("dump truncated (" + ", ".join(parts) + ")")
+        if reasons:
+            caveats.append(
+                f"rank {r} " + " AND ".join(reasons) + ": "
+                "occurrence-aligned (stepless) rounds may be offset "
+                "across ranks — trust step-carrying events first")
 
     return {
         "world": world,

@@ -69,6 +69,28 @@ def _peer_lag(evidences: Sequence[Evidence]) -> Dict[int, float]:
     return {j: _median(vs) for j, vs in seen.items()}
 
 
+def _peer_net_frac(evidences: Sequence[Evidence]) -> Dict[int, float]:
+    """Per-peer fraction of the traced lag spent on the WIRE (the
+    ``net`` phase) rather than in the owner's queue/apply — median per
+    phase over the reporters that carried phase evidence, then
+    normalized.  Empty when tracing is off fleet-wide, so pre-tracing
+    evidence decides exactly as before."""
+    acc: Dict[int, Dict[str, List[float]]] = {}
+    for ev in evidences:
+        for j, m in ev.phase_s.items():
+            per = acc.setdefault(int(j), {})
+            for p, v in m.items():
+                if math.isfinite(v):
+                    per.setdefault(str(p), []).append(float(v))
+    out: Dict[int, float] = {}
+    for j, per in acc.items():
+        med = {p: _median(vs) for p, vs in per.items()}
+        total = sum(med.values())
+        if total > 0:
+            out[j] = med.get("net", 0.0) / total
+    return out
+
+
 def decide_plan(prev: CommPlan, round_: int,
                 evidences: Iterable[Evidence],
                 cfg: ControlConfig) -> CommPlan:
@@ -101,6 +123,14 @@ def decide_plan(prev: CommPlan, round_: int,
         for j, st in ev.states.items():
             if st in (_ST_SUSPECT, _ST_DEAD):
                 suspect_votes[j] = suspect_votes.get(j, 0) + 1
+    # traced phase evidence splits slow LINK from slow HOST: the codec
+    # can only divert a link-slow peer while it has headroom below the
+    # configured ceiling (otherwise the spine penalty is the fallback
+    # remedy — a convicted peer always gets SOME remedy)
+    net_frac = _peer_net_frac(evs)
+    base_codec = min(prev.codec_level, cfg.max_codec_level)
+    codec_headroom = base_codec < cfg.max_codec_level
+    diverted: List[int] = []
     slow: List[int] = []
     for j in sorted(set(lag) | set(recon) | set(suspect_votes)):
         was = j in prev.slow
@@ -119,7 +149,17 @@ def decide_plan(prev: CommPlan, round_: int,
                     or suspect_votes.get(j, 0) > 0):
                 slow.append(j)
         elif lat >= enter or lossy or suspected:
-            slow.append(j)
+            # a pure-lag conviction whose traced decomposition says the
+            # time is on the WIRE (net-dominated) is a slow LINK:
+            # compress harder instead of ring-spining the peer — the
+            # host is keeping up, the bytes are not.  Reconnect/
+            # suspicion evidence stays spine territory (a flapping or
+            # wedged peer is not fixed by a smaller payload).
+            if (not lossy and not suspected and codec_headroom
+                    and net_frac.get(j, 0.0) >= cfg.link_net_frac):
+                diverted.append(j)
+            else:
+                slow.append(j)
     # degrade links, never dissolve the fleet: keep at most
     # max_slow_frac of the LIVE fleet penalized (reporter count is the
     # live-member proxy the records themselves carry — capacity would
@@ -160,6 +200,23 @@ def decide_plan(prev: CommPlan, round_: int,
             if slow:
                 gossip_every = min(cfg.cadence_max, gossip_every * 2)
             codec_level = min(cfg.max_codec_level, codec_level + 1)
+    if diverted:
+        # the link-slow diversion must deliver an ACTUAL remedy: the
+        # plan's codec has to end up above where it started.  When the
+        # growth band just backed the codec off (compression error is
+        # suspect), compressing harder would fight that decision —
+        # the spine is the fallback, so a convicted peer always gets
+        # SOME remedy either way.
+        bumped = min(cfg.max_codec_level, codec_level + 1)
+        if codec_level > base_codec:
+            pass  # the grow_lo re-arm already raised it
+        elif bumped > base_codec:
+            codec_level = bumped
+        else:
+            slow = sorted(set(slow) | set(diverted))
+            if len(slow) > cap:
+                slow = sorted(sorted(
+                    slow, key=lambda j: (-lag.get(j, 0.0), j))[:cap])
 
     cand = CommPlan(version=prev.version + 1, round=round_,
                     slow=tuple(slow), densify=densify,
@@ -213,6 +270,7 @@ class CommController:
         self.plan_changes = 0
         self._lag: Dict[int, float] = {}
         self._states: Dict[int, int] = {}
+        self._phase: Dict[int, Dict[str, float]] = {}
         self._recon_seen: Dict[int, int] = {}   # lifetime counts per peer
         self._recon_delta: Dict[int, int] = {}  # since last evidence()
         self._mixing_excess = float("nan")
@@ -222,16 +280,27 @@ class CommController:
     # ------------------------------------------------------- local feeds
     def note_peer(self, peer: int, *, lag_s: Optional[float] = None,
                   state: Optional[int] = None,
-                  reconnects_total: Optional[int] = None) -> None:
+                  reconnects_total: Optional[int] = None,
+                  phase_s: Optional[Dict[str, float]] = None) -> None:
         """Fold one peer observation in.  ``lag_s`` is transport lag
         (wire ack EWMA / thread staleness age); ``reconnects_total`` is
         the stream's LIFETIME count — the controller differences it
-        into the per-window delta the evidence record carries."""
+        into the per-window delta the evidence record carries;
+        ``phase_s`` is the traced wire-phase decomposition of that lag
+        (``{"net": s, "queue": s, "apply": s}`` from
+        :meth:`~bluefog_tpu.runtime.window_server.DepositStream.
+        phase_ewma`; None when tracing is off — the evidence then
+        carries no breakdown and :func:`decide_plan` falls back to the
+        phase-blind table)."""
         j = int(peer)
         if lag_s is not None and math.isfinite(lag_s):
             self._lag[j] = float(lag_s)
         if state is not None:
             self._states[j] = int(state)
+        if phase_s:
+            self._phase[j] = {str(p): float(v)
+                              for p, v in phase_s.items()
+                              if math.isfinite(float(v))}
         if reconnects_total is not None:
             seen = self._recon_seen.get(j, 0)
             if reconnects_total > seen:
@@ -250,6 +319,7 @@ class CommController:
         j = int(peer)
         self._lag.pop(j, None)
         self._states.pop(j, None)
+        self._phase.pop(j, None)
         self._recon_delta.pop(j, None)
         self._recon_seen.pop(j, None)
 
@@ -257,7 +327,7 @@ class CommController:
         """Keep observations only for ``peers`` (the current
         observation surface); forget everyone else."""
         keep = {int(j) for j in peers}
-        for j in (set(self._lag) | set(self._states)
+        for j in (set(self._lag) | set(self._states) | set(self._phase)
                   | set(self._recon_seen)) - keep:
             self.forget_peer(j)
 
@@ -296,7 +366,9 @@ class CommController:
                       lag_s=dict(self._lag), states=dict(self._states),
                       reconnects=dict(self._recon_delta),
                       mixing_excess=self._mixing_excess,
-                      consensus_growth=growth)
+                      consensus_growth=growth,
+                      phase_s={j: dict(m)
+                               for j, m in self._phase.items()})
         self._dis_prev_window = self._dis_now
         self._recon_delta = {}
         return ev

@@ -44,6 +44,21 @@ def _canon_map(m: Optional[Mapping[int, float]], cast) -> Dict[int, float]:
     return {int(k): cast(v) for k, v in (m or {}).items()}
 
 
+def _canon_phases(m) -> Dict[int, Dict[str, float]]:
+    """Canonical per-peer phase map: int peer keys, str phase keys,
+    float seconds; non-finite values dropped (canonical JSON has no
+    NaN spelling for nested maps)."""
+    import math
+
+    out: Dict[int, Dict[str, float]] = {}
+    for k, phases in (m or {}).items():
+        inner = {str(p): float(v) for p, v in (phases or {}).items()
+                 if math.isfinite(float(v))}
+        if inner:
+            out[int(k)] = inner
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Evidence:
     """One rank's round-stamped local observations.
@@ -56,7 +71,17 @@ class Evidence:
     clears when the link heals and hysteresis can release the peer).
     ``mixing_excess`` is measured-minus-predicted contraction (NaN when
     unknown); ``consensus_growth`` is local disagreement now over one
-    evidence window ago (NaN until two windows exist)."""
+    evidence window ago (NaN until two windows exist).
+
+    ``phase_s`` (optional — empty when tracing is off or the peer's
+    connection never negotiated the trace feature) maps peer -> a phase
+    decomposition of the observed lag, seconds per phase: ``"net"``
+    (wire + server frontend residue), ``"queue"`` (owner apply-queue
+    wait), ``"apply"`` (owner apply).  It is what lets
+    :func:`~bluefog_tpu.control.controller.decide_plan` tell a slow
+    LINK (net-dominated — codec/cadence territory) from a slow HOST
+    (queue/apply-dominated — ring-spine penalty territory).  Records
+    without it parse and decide exactly as before."""
 
     rank: int
     round: int
@@ -65,12 +90,15 @@ class Evidence:
     reconnects: Mapping[int, int] = dataclasses.field(default_factory=dict)
     mixing_excess: float = float("nan")
     consensus_growth: float = float("nan")
+    phase_s: Mapping[int, Mapping[str, float]] = dataclasses.field(
+        default_factory=dict)
 
     def __post_init__(self):
         object.__setattr__(self, "lag_s", _canon_map(self.lag_s, float))
         object.__setattr__(self, "states", _canon_map(self.states, int))
         object.__setattr__(self, "reconnects",
                            _canon_map(self.reconnects, int))
+        object.__setattr__(self, "phase_s", _canon_phases(self.phase_s))
 
     def to_json(self) -> str:
         """Canonical encoding (sorted keys; NaN spelled explicitly) —
@@ -87,7 +115,12 @@ class Evidence:
              "reconnects": {str(k): int(v)
                             for k, v in sorted(self.reconnects.items())},
              "mixing_excess": num(self.mixing_excess),
-             "consensus_growth": num(self.consensus_growth)},
+             "consensus_growth": num(self.consensus_growth),
+             # phase maps hold only finite floats (canonicalized), so
+             # sorted-key dumping keeps the encoding byte-deterministic
+             "phase_s": {str(k): {p: float(v)
+                                  for p, v in sorted(m.items())}
+                         for k, m in sorted(self.phase_s.items())}},
             sort_keys=True, separators=(",", ":"))
 
     @staticmethod
@@ -104,7 +137,11 @@ class Evidence:
             reconnects={int(k): int(v)
                         for k, v in d["reconnects"].items()},
             mixing_excess=num(d.get("mixing_excess")),
-            consensus_growth=num(d.get("consensus_growth")))
+            consensus_growth=num(d.get("consensus_growth")),
+            # absent in pre-tracing records: they parse (and decide)
+            # exactly as before
+            phase_s={int(k): dict(m)
+                     for k, m in d.get("phase_s", {}).items()})
 
 
 def canonicalize(evidences) -> Tuple[Evidence, ...]:

@@ -150,6 +150,14 @@ class ControlConfig:
     # (0 keeps compression off — the right ceiling whenever the exact
     # mass audit matters; see docs/control.md)
     max_codec_level: int = 0
+    # link-vs-host split on traced phase evidence: a peer ENTERING the
+    # slow set on lag whose (net, queue, apply) decomposition is
+    # net-dominated (net fraction >= this) is a slow LINK — when the
+    # codec ladder has headroom the plan compresses harder instead of
+    # ring-spining the peer (a thin wire wants fewer bytes; a slow HOST
+    # wants fewer edges).  Ignored when no reporter carried phase
+    # evidence (tracing off), so pre-tracing fleets decide identically.
+    link_net_frac: float = 0.6
     # plan-change rate limit (rounds)
     cooldown_rounds: int = 16
     # never penalize more than this fraction of the member set (the
@@ -173,3 +181,5 @@ class ControlConfig:
                 f"max_codec_level must be in [0, {len(CODEC_LADDER) - 1}]")
         if self.cooldown_rounds < 1:
             raise ValueError("cooldown_rounds must be >= 1")
+        if not (0.0 < self.link_net_frac <= 1.0):
+            raise ValueError("link_net_frac must be in (0, 1]")

@@ -11,8 +11,18 @@ appends (plus the atexit summary line) and prints one row per series:
 - counters (``*_total``): the cumulative total, per-step delta mean /
   p50 / p99, and — for byte counters — bytes/step;
 - gauges: last value plus per-step mean / p50 / p99;
-- histogram expansions (``*_count`` / ``_sum`` / ``_p50`` / ...): shown
-  as gauges of their per-step values.
+- histograms: the ``_count``/``_sum``/``_p50``/... expansion series are
+  folded back into ONE ``hist`` row **per label set** (count, mean
+  observation, reservoir p50/p99) — per-peer
+  ``bf_tcp_ack_latency_seconds`` reads as one row per peer instead of
+  six suffix rows scattered through the table.
+
+``--since <step>`` restricts the window: counter deltas re-baseline
+against the last snapshot BEFORE the window (so the first in-window
+delta is honest, not the whole cumulative history), histogram counts
+and sums are differenced the same way, and gauge statistics cover only
+in-window points.  Reservoir quantiles remain whole-run values (the
+registry keeps no per-window reservoir) — the rows mark them so.
 
 Percentiles are over the per-step series, which is what an operator
 asking "what does a bad step cost" wants — the registry's own
@@ -28,7 +38,7 @@ import math
 import sys
 from typing import Dict, List, Optional
 
-from bluefog_tpu.metrics.registry import quantile
+from bluefog_tpu.metrics.registry import HIST_SUFFIXES, quantile
 
 __all__ = ["main", "load_series", "summarize"]
 
@@ -65,15 +75,32 @@ def _is_counter(name: str) -> bool:
     return base.endswith("_total")
 
 
-def _deltas(values: List[float]) -> List[float]:
+def _deltas(values: List[float], prev: float = 0.0) -> List[float]:
     out = []
-    prev = 0.0
     for v in values:
         if math.isnan(v):
             continue
         out.append(max(0.0, v - prev))
         prev = v
     return out
+
+
+def _hist_parts(name: str):
+    """``(base, labels, suffix)`` when ``name`` is one series of a
+    histogram's snapshot expansion (``<base><suffix>{labels}``), else
+    None.  The base+labels pair is the per-label-value grouping key."""
+    bare, brace, labels = name.partition("{")
+    for suf in HIST_SUFFIXES:
+        if bare.endswith(suf) and len(bare) > len(suf):
+            return bare[:-len(suf)], brace + labels, suf
+    return None
+
+
+def _last(values: List[float]) -> float:
+    for v in reversed(values):
+        if not math.isnan(v):
+            return v
+    return math.nan
 
 
 def _fmt(v: Optional[float]) -> str:
@@ -86,24 +113,60 @@ def _fmt(v: Optional[float]) -> str:
     return f"{v:.4g}"
 
 
-def summarize(steps, series, summary=None, *, match: str = "") -> List[dict]:
-    """One summary record per series (the dash table's rows)."""
-    out = []
+def summarize(steps, series, summary=None, *, match: str = "",
+              since: Optional[int] = None) -> List[dict]:
+    """One summary record per series (the dash table's rows).
+
+    ``since`` keeps only snapshots at step >= it; cumulative values
+    (counter totals, histogram counts/sums) are re-baselined against
+    the last snapshot BEFORE the window so in-window deltas are honest.
+    Histogram expansion series are folded into one ``hist`` row per
+    (metric, label set): count, total seconds, mean observation, and
+    the reservoir p50/p99 — the per-label-value breakdown that makes
+    per-peer latency histograms readable.
+    """
     final = summary or {}
     # a run that never called step() still writes the atexit summary —
     # its series must appear (with zero per-step points), not vanish
     series = dict(series)
     for name in final:
         series.setdefault(name, [])
-    for name, values in series.items():
-        if match and match not in name:
+    baseline: Dict[str, float] = {}
+    if since is not None:
+        i0 = next((i for i, s in enumerate(steps) if s >= since),
+                  len(steps))
+        for name, values in series.items():
+            pre = [v for v in values[:i0] if not math.isnan(v)]
+            if pre:
+                baseline[name] = pre[-1]
+        series = {n: v[i0:] for n, v in series.items()}
+
+    # fold histogram expansions back into per-label-set groups; only a
+    # COMPLETE suffix family is a histogram (a freestanding gauge that
+    # happens to end in _count must not be swallowed)
+    groups: Dict[tuple, Dict[str, str]] = {}
+    for name in series:
+        parts = _hist_parts(name)
+        if parts is not None:
+            base, labels, suf = parts
+            groups.setdefault((base, labels), {})[suf] = name
+    hist_names = set()
+    for key, sufs in list(groups.items()):
+        if set(sufs) == set(HIST_SUFFIXES):
+            hist_names.update(sufs.values())
+        else:
+            del groups[key]
+
+    out = []
+    for name, values in sorted(series.items()):
+        if name in hist_names or (match and match not in name):
             continue
         clean = [v for v in values if not math.isnan(v)]
         if not clean and name not in final:
             continue
         if _is_counter(name):
             total = final.get(name, clean[-1] if clean else 0.0)
-            per_step = _deltas(values)
+            per_step = _deltas(values, baseline.get(name, 0.0))
             s = sorted(per_step)
             row = {
                 "series": name, "type": "counter", "points": len(clean),
@@ -122,6 +185,30 @@ def summarize(steps, series, summary=None, *, match: str = "") -> List[dict]:
                 "p50": quantile(s, 0.50), "p99": quantile(s, 0.99),
             }
         out.append(row)
+
+    for (base, labels), sufs in sorted(groups.items()):
+        name = base + labels
+        if match and match not in name:
+            continue
+
+        def last_of(suf: str) -> float:
+            n = sufs[suf]
+            v = _last(series[n])
+            if math.isnan(v):
+                v = final.get(n, math.nan)
+            return v
+
+        count = last_of("_count") - baseline.get(sufs["_count"], 0.0)
+        total = last_of("_sum") - baseline.get(sufs["_sum"], 0.0)
+        out.append({
+            # observations + mean are windowed; the reservoir p50/p99
+            # are whole-run (the registry keeps no per-window reservoir)
+            "series": name, "type": "hist", "points": int(count)
+            if not math.isnan(count) else 0,
+            "total": total,
+            "per_step_mean": total / count if count > 0 else math.nan,
+            "p50": last_of("_p50"), "p99": last_of("_p99"),
+        })
     return out
 
 
@@ -151,6 +238,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "BLUEFOG_TPU_METRICS=<path> / metrics.export.step()")
     ap.add_argument("--match", default="",
                     help="only show series containing this substring")
+    ap.add_argument("--since", type=int, default=None, metavar="STEP",
+                    help="only count snapshots from this step on "
+                    "(counter/histogram deltas re-baseline against the "
+                    "last earlier snapshot)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary rows as JSON instead of a table")
     args = ap.parse_args(argv)
@@ -165,7 +256,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               "(did the run call bluefog_tpu.metrics.step()?)",
               file=sys.stderr)
         return 1
-    rows = summarize(steps, series, summary, match=args.match)
+    rows = summarize(steps, series, summary, match=args.match,
+                     since=args.since)
     if args.json:
         # strict JSON for machine consumers (jq chokes on bare NaN)
         clean = [{k: (None if isinstance(v, float) and math.isnan(v) else v)

@@ -71,6 +71,7 @@ from bluefog_tpu.runtime import (membership as _mship, native,
 from bluefog_tpu.serving import snapshots as _snapshots
 from bluefog_tpu.topology.graphs import (Topology, heal as _heal,
                                          replan as _replan)
+from bluefog_tpu.tracing import recorder as _tr
 from bluefog_tpu.utils import log as _log, timeline as _timeline
 
 
@@ -2057,6 +2058,10 @@ def run_async_dsgd_rank(
     finally:
         if snapshot_every:
             _snapshots.table().drop(f"{name}:{rank}")
+        # land this rank's spans before the process exits the run (the
+        # atexit hook also flushes, but a long-lived process may run
+        # several jobs into one trace dir) — no-op when tracing is off
+        _tr.flush()
         for w in opened:
             try:
                 w.free()
@@ -2118,6 +2123,10 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         # shared (e.g. NFS) incident dir gets blackbox-rank<r>.jsonl per
         # rank instead of every process fighting over rank 0's file
         rec.rank = rank
+    # causal tracing rides the same one-process-per-rank shape: pin the
+    # trace file identity before the first flush names it (no-op when
+    # BLUEFOG_TPU_TRACE is unset)
+    _tr.set_rank(rank)
     _chaos.arm(rank)
 
     x = packer.pack(params0)
@@ -2224,10 +2233,16 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             if j in dead or j in left:
                 continue
             hp = getattr(h, "health", None)
+            pe = getattr(h, "phase_ewma", None)
             ctl.note_peer(
                 j, lag_s=h.ack_ewma(),
                 state=hp.state if hp is not None else None,
-                reconnects_total=h.reconnects)
+                reconnects_total=h.reconnects,
+                # wire-phase decomposition (net/queue/apply EWMA) from
+                # the traced extended acks: the slow-link-vs-slow-host
+                # evidence; None when tracing is off or the peer's
+                # connection never negotiated FEATURE_TRACE
+                phase_s=pe() if pe is not None else None)
         d_now = ctl.disagreement
         if tracker is not None and d_now is not None:
             measured = tracker.update(d_now)
@@ -2679,23 +2694,31 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                 break  # a member finished: converge at the stop barrier
         if ctl is not None and steps > 0 \
                 and steps % control.evidence_every == 0:
-            _ctl_round_boundary()
+            with _tr.span("control", "dsgd", round_=steps):
+                _ctl_round_boundary()
+        trec = _tr.get()
+        if trec is not None:
+            t_rnd_w = time.time()
+            t_rnd_p = time.perf_counter()
         if rec is not None:
             rec.begin("collective", key=("async_dsgd_mp", rank, steps),
                       op="async_dsgd_round", cid="async_dsgd_round",
                       step=steps, rank=rank, peers=my_out)
         z_pre = (x / p) if ctl is not None else None
         dis = None
-        for k in my_slots:
-            if cap_slots and k == rank:
-                continue
-            buf, fresh = win.read(k, consume=True)
-            if fresh > 0:
-                if z_pre is not None and buf[-1] > 0:
-                    dj = float(np.linalg.norm(buf[:-1] / buf[-1] - z_pre))
-                    dis = dj if dis is None else max(dis, dj)
-                x += buf[:-1]
-                p += buf[-1]
+        with _tr.span("gossip", "dsgd", round_=steps):
+            # gossip-IN: consume landed neighbor mass
+            for k in my_slots:
+                if cap_slots and k == rank:
+                    continue
+                buf, fresh = win.read(k, consume=True)
+                if fresh > 0:
+                    if z_pre is not None and buf[-1] > 0:
+                        dj = float(np.linalg.norm(
+                            buf[:-1] / buf[-1] - z_pre))
+                        dis = dj if dis is None else max(dis, dj)
+                    x += buf[:-1]
+                    p += buf[-1]
         if ctl is not None and dis is not None:
             ctl.note_disagreement(dis)
         if elastic:
@@ -2705,7 +2728,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             self_buf[-1] = p
             win.set_self(self_buf)
         z = x / p
-        loss, grads = loss_and_grad(rank, steps, packer.unpack(z))
+        with _tr.span("compute", "dsgd", round_=steps):
+            loss, grads = loss_and_grad(rank, steps, packer.unpack(z))
         losses.append(float(loss))
         packer.pack(grads, out=gvec)
         gvec *= lr * p
@@ -2720,6 +2744,10 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         step=steps, rank=rank)
                 rec.record("optimizer_step", step=steps, rank=rank,
                            loss=float(loss))
+            if trec is not None:
+                trec.emit("round", "dsgd", t0=t_rnd_w,
+                          dur=time.perf_counter() - t_rnd_p,
+                          round_=steps, step=steps)
             steps += 1
             if skew_s > 0 or poll_interval_s > 0:
                 time.sleep(skew_s + poll_interval_s)
@@ -2729,48 +2757,53 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         payload *= frac
         failed: List[int] = []
         withheld = 0
-        for j in my_out:
-            if cfg is not None:
-                try:
-                    # a replan can add an edge never opened before, and
-                    # the peer may have died since: an open failure here
-                    # is peer evidence, not a crash
-                    h = _ensure_peer(j).health
-                except (RuntimeError, TimeoutError, OSError):
-                    failed.append(j)
-                    continue
-                if h is not None:
-                    state = h.poll()
-                    if state == _res.REJOINED:
-                        # the stream reconnected to a peer we had given
-                        # up on mid-round: re-admit at THIS round
-                        # boundary and resume sending
-                        h.admit()
-                        state = _res.HEALTHY
-                    if state == _res.DEAD:
+        # gossip-OUT under the round's active span: deposit_async
+        # captures the thread-local (trace_id, span_id, round) here, so
+        # every wire frame this round emits is causally stamped
+        with _tr.span("gossip", "dsgd", round_=steps):
+            for j in my_out:
+                if cfg is not None:
+                    try:
+                        # a replan can add an edge never opened before,
+                        # and the peer may have died since: an open
+                        # failure here is peer evidence, not a crash
+                        h = _ensure_peer(j).health
+                    except (RuntimeError, TimeoutError, OSError):
                         failed.append(j)
                         continue
-                    if state != _res.HEALTHY:
-                        # SUSPECT: withhold this peer's share instead of
-                        # bleeding mass into a possible corpse — any
-                        # row-stochastic split is unbiased under the
-                        # push-sum weight channel, so keeping the share
-                        # is free; sending resumes on recovery.  Without
-                        # this, every round of the detection window
-                        # leaks 1/(deg+1) of our mass into the void.
-                        withheld += 1
-                        continue
-            # fire-and-forget on the pipelined DCN transport: the
-            # background sender overlaps the wire with the next gradient
-            # step; the payload buffer is snapshotted at enqueue, so its
-            # reuse on the next iteration is safe
-            try:
-                _ensure_peer(j).deposit_async(_slot_in(j), payload,
-                                              accumulate=True)
-            except (RuntimeError, TimeoutError, OSError):
-                if cfg is None:
-                    raise
-                failed.append(j)
+                    if h is not None:
+                        state = h.poll()
+                        if state == _res.REJOINED:
+                            # the stream reconnected to a peer we had
+                            # given up on mid-round: re-admit at THIS
+                            # round boundary and resume sending
+                            h.admit()
+                            state = _res.HEALTHY
+                        if state == _res.DEAD:
+                            failed.append(j)
+                            continue
+                        if state != _res.HEALTHY:
+                            # SUSPECT: withhold this peer's share instead
+                            # of bleeding mass into a possible corpse —
+                            # any row-stochastic split is unbiased under
+                            # the push-sum weight channel, so keeping the
+                            # share is free; sending resumes on recovery.
+                            # Without this, every round of the detection
+                            # window leaks 1/(deg+1) of our mass into
+                            # the void.
+                            withheld += 1
+                            continue
+                # fire-and-forget on the pipelined DCN transport: the
+                # background sender overlaps the wire with the next
+                # gradient step; the payload buffer is snapshotted at
+                # enqueue, so its reuse on the next iteration is safe
+                try:
+                    _ensure_peer(j).deposit_async(_slot_in(j), payload,
+                                                  accumulate=True)
+                except (RuntimeError, TimeoutError, OSError):
+                    if cfg is None:
+                        raise
+                    failed.append(j)
         x *= frac
         p *= frac
         if failed or withheld:
@@ -2786,16 +2819,21 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             # (x, p) — z = x/p is invariant to the frac split — swapped
             # in atomically under its round stamp; this rank's
             # WindowServer serves it to SNAPSHOT/SUBSCRIBE readers
-            _snapshots.table().publish(
-                f"{name}:{rank}", steps,
-                {"x": x, "p": np.array([p]),
-                 "round": np.array([float(steps)])})
+            with _tr.span("publish", "dsgd", round_=steps):
+                _snapshots.table().publish(
+                    f"{name}:{rank}", steps,
+                    {"x": x, "p": np.array([p]),
+                     "round": np.array([float(steps)])})
         if rec is not None:
             rec.end("collective", key=("async_dsgd_mp", rank, steps),
                     op="async_dsgd_round", cid="async_dsgd_round",
                     step=steps, rank=rank)
             rec.record("optimizer_step", step=steps, rank=rank,
                        loss=float(loss))
+        if trec is not None:
+            trec.emit("round", "dsgd", t0=t_rnd_w,
+                      dur=time.perf_counter() - t_rnd_p, round_=steps,
+                      step=steps)
         steps += 1
         if skew_s > 0 or poll_interval_s > 0:
             time.sleep(skew_s + poll_interval_s)

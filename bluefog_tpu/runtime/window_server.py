@@ -149,6 +149,7 @@ from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import native, resilience, wire_codec, wire_status
 from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS, _fallback
 from bluefog_tpu.serving import snapshots as _snap
+from bluefog_tpu.tracing import recorder as _tr
 from bluefog_tpu.utils import lockcheck as _lc
 
 __all__ = ["WindowServer", "RemoteWindow", "PipelinedRemoteWindow",
@@ -177,6 +178,13 @@ _SNAP_LEAF = struct.Struct("<HBq")    # name_len, dtype, n_elems
 _SUB_REQ = struct.Struct("<QIIq")     # sub_id, epoch, every, cursor
 _PUSH = struct.Struct("<qIH")         # round (-1 = keepalive), skipped,
                                       # leaf count
+_TRACE_HDR = struct.Struct("<QQI")    # trace_id, span_id, round — the
+                                      # wire-propagated causal context
+                                      # (FEATURE_TRACE connections only)
+_ACK_TIMES = struct.Struct("<II")     # queue_us, apply_us appended to
+                                      # batch acks on FEATURE_TRACE
+                                      # connections (never to heartbeat
+                                      # acks, which keep the bit31 mark)
 
 _OP_DEPOSIT = 0
 _OP_GET_SELF = 1
@@ -188,6 +196,12 @@ _OP_STREAM_ATTACH = 6
 _OP_HEARTBEAT = 7
 _OP_SNAPSHOT = 8
 _OP_SUBSCRIBE = 9
+
+#: client->server ops whose frames carry the trace header on
+#: FEATURE_TRACE connections (SUBSCRIBE propagates the other way: the
+#: server's push frames carry it instead)
+_TRACED_OPS = frozenset((_OP_DEPOSIT_BATCH, _OP_FLUSH, _OP_HEARTBEAT,
+                         _OP_SNAPSHOT))
 
 # subscription push cadence when nothing is being published: an idle
 # server must look different from a wedged one to the reader's idle
@@ -216,9 +230,18 @@ FEATURE_HEARTBEAT = 8
 FEATURE_RESUME = 16   # STREAM_ATTACH + idempotent reconnect replay
 FEATURE_SNAPSHOT = 32   # round-stamped consistent snapshot reads (op 8)
 FEATURE_SUBSCRIBE = 64  # resumable push subscriptions (op 9)
+#: wire-propagated trace context: client->server frames of the ops in
+#: ``_TRACED_OPS`` carry a ``(trace_id u64, span_id u64, round u32)``
+#: header right after the frame header, batch acks grow a
+#: ``(queue_us, apply_us)`` tail, and SUBSCRIBE push frames carry the
+#: header after ``_PUSH`` — all ONLY on connections whose HELLO
+#: negotiated this bit, so presence is deterministic per connection and
+#: a v-old peer (or a tracing-disabled client) degrades silently.
+FEATURE_TRACE = 128
 _SERVER_FEATURES = (FEATURE_BATCH | FEATURE_CODEC_F32 | FEATURE_CODEC_TOPK
                     | FEATURE_HEARTBEAT | FEATURE_RESUME
-                    | FEATURE_SNAPSHOT | FEATURE_SUBSCRIBE)
+                    | FEATURE_SNAPSHOT | FEATURE_SUBSCRIBE
+                    | FEATURE_TRACE)
 
 _CODEC_FEATURE = {wire_codec.CODEC_NONE: 0,
                   wire_codec.CODEC_F32: FEATURE_CODEC_F32,
@@ -444,10 +467,14 @@ class _ApplyWorker:
             if len(free) < self._MAX_FREE:
                 free.append(buf)
 
-    def submit_batch(self, seq: int, jobs: List) -> None:
+    def submit_batch(self, seq: int, jobs: List, tctx=None) -> None:
         """One wire batch's jobs (('item', …) / ('err', code) entries, in
-        arrival order); blocks when the applier is two frames behind."""
-        self._jobs.put((seq, jobs))
+        arrival order); blocks when the applier is two frames behind.
+        ``tctx`` is the frame's wire-propagated trace context
+        ``(trace_id, span_id, round)`` or None — the owner-side
+        queue-wait/apply/ack spans parent to it."""
+        self._jobs.put((seq, jobs, tctx, time.time(),
+                        time.perf_counter()))
 
     def close(self) -> bool:
         """Stop the worker after it drains every queued batch; returns
@@ -477,7 +504,9 @@ class _ApplyWorker:
                 continue
             if batch is None:
                 return
-            seq, jobs = batch
+            seq, jobs, tctx, t_sub_w, t_sub_p = batch
+            t_deq_p = time.perf_counter()
+            queue_s = t_deq_p - t_sub_p
             applied = 0
             first_err = 0
             for job in jobs:
@@ -502,6 +531,17 @@ class _ApplyWorker:
                         first_err = rc
                 else:
                     applied += 1
+            apply_s = time.perf_counter() - t_deq_p
+            trec = _tr.get() if tctx is not None else None
+            if trec is not None:
+                tid_, psid, rnd_ = tctx
+                trec.emit("queue_wait", "tcp_srv", t0=t_sub_w,
+                          dur=queue_s, parent=psid, round_=rnd_,
+                          trace_id=tid_, peer=self._peer, seq=seq)
+                trec.emit("apply", "tcp_srv", t0=t_sub_w + queue_s,
+                          dur=apply_s, parent=psid, round_=rnd_,
+                          trace_id=tid_, peer=self._peer, seq=seq,
+                          items=applied)
             _mt.inc("bf_tcp_batches_total", 1.0, peer=self._peer)
             _bb.record("tcp_batch_deposit", seq=seq, applied=applied,
                        err=first_err, peer=self._peer)
@@ -527,14 +567,28 @@ class _ApplyWorker:
                 return
             if act is not None and act[0] in ("delay", "stall"):
                 time.sleep(act[1])
+            ack = _ACK.pack(seq, first_err or applied)
+            if getattr(self._handler, "_trace_granted", False):
+                # the extended batch ack: owner-side phase timings ride
+                # back so the SENDER can attribute its ack latency to
+                # queue-wait vs apply without the owner's trace file
+                ack += _ACK_TIMES.pack(
+                    min(0xFFFF_FFFF, int(queue_s * 1e6)),
+                    min(0xFFFF_FFFF, int(apply_s * 1e6)))
+            t_ack_w = time.time()
             try:
                 # the ack-after-apply ordering under the per-connection
                 # write mutex IS the client's flush fence; a peer that
                 # stops draining wedges only its own connection
                 with self._wlock:  # bfverify: holds-ok per-connection write mutex; ack ordering is the flush fence (reviewed PR 4/9)
-                    self._sock.sendall(_ACK.pack(seq, first_err or applied))
+                    self._sock.sendall(ack)
             except OSError:
                 return  # peer gone; the recv loop will notice too
+            if trec is not None:
+                trec.emit("ack", "tcp_srv", t0=t_ack_w,
+                          dur=time.time() - t_ack_w, parent=tctx[1],
+                          round_=tctx[2], trace_id=tctx[0],
+                          peer=self._peer, seq=seq)
 
 
 def _leaf_views(leaves: List[Tuple[str, np.ndarray]]) -> List:
@@ -639,6 +693,18 @@ class _SubSender:
     def _keepalive_due(self) -> bool:
         return time.monotonic() - self._last_send >= _SUB_KEEPALIVE_S
 
+    def _traced(self) -> bool:
+        return bool(getattr(self._handler, "_trace_granted", False))
+
+    def _ka_views(self) -> List:
+        """A keepalive frame (round = -1); carries an empty trace header
+        on FEATURE_TRACE connections so every push frame parses the
+        same way."""
+        views: List = [_PUSH.pack(-1, 0, 0)]
+        if self._traced():
+            views.append(_TRACE_HDR.pack(0, 0, 0))
+        return views
+
     def _loop(self) -> None:
         tbl = _snap.table()
         self._last_send = time.monotonic()
@@ -648,7 +714,7 @@ class _SubSender:
             if self._closed.is_set():
                 return
             if gen is None:
-                if not self._send([_PUSH.pack(-1, 0, 0)]):
+                if not self._send(self._ka_views()):
                     return
                 self._last_send = time.monotonic()
                 continue
@@ -664,31 +730,52 @@ class _SubSender:
                 # reader's idle timeout (large strides make pushes
                 # arbitrarily rarer than publishes)
                 if self._keepalive_due():
-                    if not self._send([_PUSH.pack(-1, 0, 0)]):
+                    if not self._send(self._ka_views()):
                         return
                     self._last_send = time.monotonic()
                 continue
             skipped = (max(0, (rnd - self._last_round) - self._every)
                        if self._last_round >= 0 else 0)
-            act = _chaos.fire("sub", peer=self._peer, group=self._group)
-            if act is not None:
-                if act[0] in ("delay", "stall"):
-                    time.sleep(act[1])
-                elif act[0] in ("drop", "truncate"):
-                    # an injected reader-side outage: cut the push
-                    # channel (after half a frame for 'truncate' — the
-                    # torn-mid-frame case the resuming reader must
-                    # survive without consuming the fragment)
-                    if act[0] == "truncate":
-                        views = ([_PUSH.pack(rnd, skipped, len(leaves))]
-                                 + _leaf_views(leaves))
-                        self._send(views[:max(1, len(views) // 2)])
-                    self.close()
+            # push-frame trace context: the reader's consume span
+            # parents to this push span, so a delivered snapshot links
+            # causally back to the serving host
+            thdr: List = []
+            psp = None
+            if self._traced():
+                trec = _tr.get()
+                if trec is not None:
+                    psp = trec.begin_span(
+                        "push", "tcp_srv", round_=max(0, rnd), parent=0,
+                        group=self._group, peer=self._peer,
+                        skipped=skipped)
+                thdr = [_TRACE_HDR.pack(
+                    psp.tid if psp is not None else 0,
+                    psp.sid if psp is not None else 0, max(0, rnd))]
+            try:
+                act = _chaos.fire("sub", peer=self._peer,
+                                  group=self._group)
+                if act is not None:
+                    if act[0] in ("delay", "stall"):
+                        time.sleep(act[1])
+                    elif act[0] in ("drop", "truncate"):
+                        # an injected reader-side outage: cut the push
+                        # channel (after half a frame for 'truncate' —
+                        # the torn-mid-frame case the resuming reader
+                        # must survive without consuming the fragment)
+                        if act[0] == "truncate":
+                            views = ([_PUSH.pack(rnd, skipped,
+                                                 len(leaves))] + thdr
+                                     + _leaf_views(leaves))
+                            self._send(views[:max(1, len(views) // 2)])
+                        self.close()
+                        return
+                views = ([_PUSH.pack(rnd, skipped, len(leaves))] + thdr
+                         + _leaf_views(leaves))
+                if not self._send(views):
                     return
-            views = ([_PUSH.pack(rnd, skipped, len(leaves))]
-                     + _leaf_views(leaves))
-            if not self._send(views):
-                return
+            finally:
+                if psp is not None:
+                    psp.finish()
             self._last_send = time.monotonic()
             self._last_round = rnd
             if skipped:
@@ -726,6 +813,11 @@ class _Handler(socketserver.BaseRequestHandler):
         # DepositStream lineage binding (STREAM_ATTACH); None = unbound
         self._stream_sid: Optional[int] = None
         self._stream_epoch = 0
+        # FEATURE_TRACE negotiated on THIS connection: frames of the
+        # _TRACED_OPS carry the trace header, batch acks grow the
+        # timing tail, push frames carry the header (set at HELLO —
+        # presence is deterministic per connection)
+        self._trace_granted = False
         # subscription push sender (SUBSCRIBE); None = plain connection
         self._sub: Optional[_SubSender] = None
 
@@ -877,17 +969,31 @@ class _Handler(socketserver.BaseRequestHandler):
                            peer=self.client_address[0])
         return rc
 
-    def _handle_batch(self, ops, sock) -> bool:
+    def _batch_ack(self, seq: int, status: int) -> None:
+        """Handler-thread batch ack (dedup / unparseable-stream paths):
+        carries the timing tail on trace connections so the ack stream
+        stays parseable regardless of which thread acked."""
+        ack = _ACK.pack(seq, status)
+        if self._trace_granted:
+            ack += _ACK_TIMES.pack(0, 0)
+        self._send(ack)
+
+    def _handle_batch(self, ops, sock, tctx=None) -> bool:
         """One DEPOSIT_BATCH frame; returns False to drop the connection
         (only when the stream itself is unrecoverable).  The handler
         thread only validates headers and ``recv_into``s payloads; the
         per-connection :class:`_ApplyWorker` decodes and lands them, so
         receiving item N+1 overlaps applying item N.  The ack is emitted
-        by the worker after the batch's last item applied."""
+        by the worker after the batch's last item applied.  ``tctx`` is
+        the frame's trace context: the owner-side recv span is emitted
+        here, the queue-wait/apply/ack spans by the worker."""
         if self._worker is None:
             self._worker = _ApplyWorker(
                 self, sock, ops, self._wmu, self.client_address[0])
         worker = self._worker
+        trec = _tr.get() if tctx is not None else None
+        t_recv_w = time.time()
+        t_recv_p = time.perf_counter()
         seq, count = _BATCH_HDR.unpack(_recv_exact(sock, _BATCH_HDR.size))
         if self._stream_sid is not None and seq <= self.server.stream_applied(  # type: ignore[attr-defined]
                 self._stream_sid):
@@ -904,7 +1010,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     # same bound discipline as the fresh path: a lying
                     # duplicate cannot make the server consume unbounded
                     # claimed bytes
-                    self._send(_ACK.pack(seq, _ERR_BAD_OP))
+                    self._batch_ack(seq, _ERR_BAD_OP)
                     return False
                 self._recv_name(sock, name_len)
                 self._eat(sock, wire_bytes)
@@ -912,7 +1018,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     peer=self.client_address[0])
             _bb.record("tcp_dedup_batch", seq=seq, items=count,
                        peer=self.client_address[0])
-            self._send(_ACK.pack(seq, count))
+            self._batch_ack(seq, count)
             return True
         jobs: List = []
         for _ in range(count):
@@ -921,7 +1027,7 @@ class _Handler(socketserver.BaseRequestHandler):
             if (dtype_id not in _DTYPES or n_elems < 0 or wire_bytes < 0
                     or codec not in wire_codec.CODEC_NAMES):
                 # lengths are unparseable -> the stream cannot be resynced
-                self._send(_ACK.pack(seq, _ERR_BAD_OP))
+                self._batch_ack(seq, _ERR_BAD_OP)
                 return False
             name_b = self._recv_name(sock, name_len)
             err = 0
@@ -954,13 +1060,22 @@ class _Handler(socketserver.BaseRequestHandler):
             _recv_into(sock, memoryview(buf)[:wire_bytes])
             jobs.append(("item", name_b, slot, flags, dtype_id, codec,
                          n_elems, buf, wire_bytes))
-        worker.submit_batch(seq, jobs)
+        if trec is not None:
+            trec.emit("recv", "tcp_srv", t0=t_recv_w,
+                      dur=time.perf_counter() - t_recv_p,
+                      parent=tctx[1], round_=tctx[2], trace_id=tctx[0],
+                      peer=self.client_address[0], seq=seq, items=count)
+        worker.submit_batch(seq, jobs, tctx)
         return True
 
-    def _handle_snapshot(self, sock, name_len: int) -> bool:
+    def _handle_snapshot(self, sock, name_len: int, tctx=None) -> bool:
         """One SNAPSHOT request: all requested leaves from ONE round or
         a retriable negative status; returns False to drop the
-        connection (unparseable request, or an injected read fault)."""
+        connection (unparseable request, or an injected read fault).
+        ``tctx``: the reader's trace context — the serve span parents to
+        it so the read links causally into the reader's trace."""
+        t_serve_w = time.time()
+        t_serve_p = time.perf_counter()
         group = self._recv_name(sock, name_len).decode("utf-8", "replace")
         want_round, count = _SNAP_REQ.unpack(
             _recv_exact(sock, _SNAP_REQ.size))
@@ -1006,6 +1121,13 @@ class _Handler(socketserver.BaseRequestHandler):
         _mt.inc("bf_reads_total", 1.0, op="snapshot", status="ok")
         _bb.record("tcp_snapshot", group=group, round=rnd,
                    leaves=len(leaves), peer=self.client_address[0])
+        trec = _tr.get() if tctx is not None else None
+        if trec is not None:
+            trec.emit("snapshot_serve", "tcp_srv", t0=t_serve_w,
+                      dur=time.perf_counter() - t_serve_p,
+                      parent=tctx[1], round_=tctx[2], trace_id=tctx[0],
+                      peer=self.client_address[0], group=group,
+                      served_round=rnd)
         return True
 
     def _handle_subscribe(self, sock, name_len: int) -> bool:
@@ -1065,6 +1187,18 @@ class _Handler(socketserver.BaseRequestHandler):
                         return
                     if kind in ("delay", "stall"):
                         time.sleep(act[1])
+                # wire-propagated trace context: present iff this
+                # connection's HELLO negotiated FEATURE_TRACE and the op
+                # is one of the traced client->server frames (read AFTER
+                # the chaos shim so an injected 'truncate' still models
+                # "header consumed, body not").  span_id 0 = the sender
+                # had no active span: parse, then ignore.
+                tctx = None
+                if self._trace_granted and op in _TRACED_OPS:
+                    t_id, s_id, t_round = _TRACE_HDR.unpack(
+                        _recv_exact(sock, _TRACE_HDR.size))
+                    if s_id:
+                        tctx = (t_id, s_id, t_round)
                 if op == _OP_HEARTBEAT:
                     (hb_seq,) = _HB.unpack(_recv_exact(sock, _HB.size))
                     self._send(_ACK.pack((hb_seq & ~_HB_MARK) | _HB_MARK, 0))
@@ -1088,14 +1222,15 @@ class _Handler(socketserver.BaseRequestHandler):
                         return
                     granted = features & _SERVER_FEATURES
                     self.server.set_features(self.request, granted)  # type: ignore
+                    self._trace_granted = bool(granted & FEATURE_TRACE)
                     self._send(_STATUS.pack(granted))
                     continue
                 if op == _OP_DEPOSIT_BATCH:
-                    if not self._handle_batch(ops, sock):
+                    if not self._handle_batch(ops, sock, tctx):
                         return
                     continue
                 if op == _OP_SNAPSHOT:
-                    if not self._handle_snapshot(sock, name_len):
+                    if not self._handle_snapshot(sock, name_len, tctx):
                         return
                     continue
                 if op == _OP_SUBSCRIBE:
@@ -1582,10 +1717,12 @@ class RemoteWindow:
 
 class _Item:
     __slots__ = ("name_b", "slot", "flags", "dtype_id", "codec", "n_elems",
-                 "views", "wire_bytes", "dense_bytes", "pooled")
+                 "views", "wire_bytes", "dense_bytes", "pooled", "tctx",
+                 "t_enq")
 
     def __init__(self, name_b, slot, flags, dtype_id, codec, n_elems,
-                 views, wire_bytes, dense_bytes, pooled):
+                 views, wire_bytes, dense_bytes, pooled, tctx=None,
+                 t_enq=0.0):
         self.name_b = name_b
         self.slot = slot
         self.flags = flags
@@ -1596,6 +1733,8 @@ class _Item:
         self.wire_bytes = wire_bytes
         self.dense_bytes = dense_bytes
         self.pooled = pooled  # buffer to return to the pool after send
+        self.tctx = tctx      # (trace_id, span_id, round) of the caller
+        self.t_enq = t_enq    # perf_counter at enqueue (enqueue span)
 
 
 class DepositStream:
@@ -1667,6 +1806,15 @@ class DepositStream:
         # flight is what keeps client send, server recv, and server apply
         # continuously overlapped
         self._max_batch_bytes = max(1 << 16, int(max_batch_bytes))
+        # ------------------------------------------------------ tracing
+        # the arming decision is taken ONCE, at construction (the same
+        # moment the codec ceiling is fixed): a stream built while
+        # tracing is armed asks for FEATURE_TRACE at every HELLO it
+        # ever sends, so reconnect replay frames parse identically to
+        # first-sends.  Non-grant (a v-old server) degrades silently —
+        # per-connection, never a handshake failure.
+        self._trace_want = _tr.get() is not None
+        self._trace_on = False
         # --------------------------------------------------- resilience
         self._resume = bool(reconnect)
         self._reconnect_cfg = (dict(reconnect)
@@ -1710,6 +1858,13 @@ class DepositStream:
         # atomic under the GIL.
         self._ack_ewma: Optional[float] = None
         self._ack_ewma_alpha = 0.2
+        # per-peer PHASE EWMAs (net / owner-queue / owner-apply seconds)
+        # from the extended batch acks of FEATURE_TRACE connections: the
+        # evidence that lets the control plane tell a slow LINK from a
+        # slow HOST.  None until the first timed ack (or forever, when
+        # tracing is off).  Written by the ack thread only; readers take
+        # a GIL-atomic tuple-ref snapshot.
+        self._phase_ewma: Optional[Tuple[float, float, float]] = None
         self._reconnects = 0
         self._sock = self._connect_once(self._timeout_s)
         self._sender = threading.Thread(
@@ -1743,6 +1898,8 @@ class DepositStream:
                 want |= FEATURE_RESUME
             if self._hb_interval > 0:
                 want |= FEATURE_HEARTBEAT
+            if self._trace_want:
+                want |= FEATURE_TRACE
             _sendmsg_all(sock, [
                 _HDR.pack(_MAGIC, _OP_HELLO, 0),
                 _HELLO.pack(PROTOCOL_VERSION, want)])
@@ -1752,11 +1909,16 @@ class DepositStream:
                     f"window server at {self._peer} rejected the v"
                     f"{PROTOCOL_VERSION} handshake ({granted}): "
                     + _err_text(int(granted)))
-            if want & ~granted:
+            if want & ~granted & ~FEATURE_TRACE:
+                # FEATURE_TRACE is the one OPTIONAL want: a v-old server
+                # that cannot carry trace headers still serves deposits
+                # — tracing degrades silently on this connection
                 raise RuntimeError(
                     f"window server at {self._peer} does not support the "
                     f"requested transport features (want {want:#x}, "
                     f"granted {int(granted):#x})")
+            self._trace_on = bool(self._trace_want
+                                  and granted & FEATURE_TRACE)
             if self._resume:
                 self._epoch += 1
                 _sendmsg_all(sock, [
@@ -1802,11 +1964,24 @@ class DepositStream:
                 for it in entry[1] or ():
                     if it.pooled is not None:
                         self._give(it.pooled)
+                if entry[5] is not None:
+                    # applied-but-unacked, resolved by the attach mark:
+                    # the wire span ends here, marked so the analyzer
+                    # knows its duration includes the outage
+                    entry[5].finish(retired=True)
             self._cv.notify_all()
 
-    def _frame_views(self, seq: int, items: List["_Item"]) -> List:
+    def _frame_views(self, seq: int, items: List["_Item"],
+                     tctx=None) -> List:
         views: List = [_HDR.pack(_MAGIC, _OP_DEPOSIT_BATCH, 0),
                        _BATCH_HDR.pack(seq, len(items))]
+        if self._trace_on:
+            # the wire-propagated causal context: present on EVERY batch
+            # frame of a FEATURE_TRACE connection (span_id 0 = no active
+            # span — the server parses, then ignores), inserted right
+            # after the frame header, before the batch header
+            tid, sid, rnd = tctx or (0, 0, 0)
+            views.insert(1, _TRACE_HDR.pack(tid, sid, rnd))
         for it in items:
             views.append(_ITEM.pack(
                 len(it.name_b), it.slot, it.flags, it.dtype_id,
@@ -1860,7 +2035,10 @@ class DepositStream:
             replayed = 0
             try:
                 for seq, entry in pending:
-                    _sendmsg_all(sock, self._frame_views(seq, entry[1]))
+                    wsp = entry[5]
+                    _sendmsg_all(sock, self._frame_views(
+                        seq, entry[1],
+                        wsp.ctx if wsp is not None else None))
                     replayed += 1
             except (OSError, ConnectionError):
                 try:
@@ -1913,9 +2091,14 @@ class DepositStream:
         while len(self._hb_sent) > 64:
             self._hb_sent.pop(next(iter(self._hb_sent)))
         self._hb_last = time.monotonic()
+        views: List = [_HDR.pack(_MAGIC, _OP_HEARTBEAT, 0), _HB.pack(seq)]
+        if self._trace_on:
+            # HEARTBEAT is a traced op: the header rides along (empty —
+            # an idle probe has no active span) so the server's frame
+            # parse stays deterministic per connection
+            views.insert(1, _TRACE_HDR.pack(0, 0, 0))
         try:
-            _sendmsg_all(self._sock, [
-                _HDR.pack(_MAGIC, _OP_HEARTBEAT, 0), _HB.pack(seq)])
+            _sendmsg_all(self._sock, views)
         except (OSError, ConnectionError) as e:
             if self._resume:
                 return self._recover(f"heartbeat send failed: {e}")
@@ -1956,6 +2139,51 @@ class DepositStream:
         twin is ``bf_peer_ack_ewma_seconds{peer=}``).  None until the
         first ack/heartbeat reply arrives."""
         return self._ack_ewma
+
+    def _note_phases(self, wsp, times, lat: float, seq: int) -> None:
+        """Ack-thread bookkeeping for one traced batch: finish the wire
+        span (folding in the owner-side ``queue_s``/``apply_s`` the
+        extended ack carried), emit the ``ack_wait`` child span, and
+        fold the (net, queue, apply) split into the per-peer phase EWMA
+        the control plane reads through :meth:`phase_ewma`."""
+        extra = {}
+        if times is not None:
+            queue_s, apply_s = times[0] / 1e6, times[1] / 1e6
+            extra = {"queue_s": queue_s, "apply_s": apply_s}
+            net = max(0.0, lat - queue_s - apply_s)
+            prev = self._phase_ewma
+            a = self._ack_ewma_alpha
+            if prev is None:
+                self._phase_ewma = (net, queue_s, apply_s)  # bfverify: shared-ok single tuple-ref store, atomic under the GIL; only the ack thread writes
+            else:
+                self._phase_ewma = (
+                    a * net + (1.0 - a) * prev[0],
+                    a * queue_s + (1.0 - a) * prev[1],
+                    a * apply_s + (1.0 - a) * prev[2])
+        trec = _tr.get()
+        if trec is not None:
+            # send_s may not be written yet when the ack beat the
+            # sender's post-sendall bookkeeping (see the benign-race
+            # note at the write site); clamp into [0, lat]
+            send_s = min(lat, float(wsp.fields.get("send_s", 0.0)
+                                    or 0.0))
+            trec.emit("ack_wait", "tcp", t0=wsp.t0 + send_s,
+                      dur=max(0.0, lat - send_s), parent=wsp.sid,
+                      round_=wsp.round, trace_id=wsp.tid,
+                      peer=self._peer, seq=seq)
+        wsp.finish(**extra)
+
+    def phase_ewma(self) -> Optional[Dict[str, float]]:
+        """Per-peer wire-phase decomposition EWMA: ``{"net": s,
+        "queue": s, "apply": s}`` splitting this peer's ack latency into
+        network+frontend residue vs owner-side queue-wait vs apply —
+        the slow-link-vs-slow-host evidence
+        (:class:`bluefog_tpu.control.evidence.Evidence` ``phase_s``).
+        None until a FEATURE_TRACE connection delivered a timed ack."""
+        p = self._phase_ewma
+        if p is None:
+            return None
+        return {"net": p[0], "queue": p[1], "apply": p[2]}
 
     @property
     def reconnects(self) -> int:
@@ -2003,6 +2231,13 @@ class DepositStream:
                 f"pipelined deposits support f32/f64, got {a.dtype}")
         a = a.reshape(-1)
         self._raise_if_err()
+        # tracing: capture the CALLER's active span context here, on the
+        # producer thread — round/parentage then ride the item into the
+        # sender thread and onto the wire with zero API churn
+        trec = _tr.get()
+        tctx = _tr.current_ctx() if trec is not None else None
+        t_snap_w = time.time() if trec is not None else 0.0
+        t_snap_p = time.perf_counter() if trec is not None else 0.0
         dense_bytes = a.nbytes
         pooled = None
         if self._codec == wire_codec.CODEC_NONE:
@@ -2020,7 +2255,15 @@ class DepositStream:
             _FLAG_DRAIN if drain else 0)
         item = _Item(name, slot, flags,
                      _DTYPE_IDS[a.dtype], self._codec, a.size, views,
-                     wire, dense_bytes, pooled)
+                     wire, dense_bytes, pooled, tctx=tctx)
+        if trec is not None:
+            item.t_enq = time.perf_counter()
+            trec.emit("snapshot", "tcp", t0=t_snap_w,
+                      dur=item.t_enq - t_snap_p,
+                      parent=tctx[1] if tctx else None,
+                      round_=tctx[2] if tctx else None,
+                      trace_id=tctx[0] if tctx else None,
+                      peer=self._peer, bytes=wire)
         t0 = time.perf_counter()
         with self._cv:
             while (len(self._queue) >= self._max_queue
@@ -2033,6 +2276,16 @@ class DepositStream:
             self._queue.append(item)
             self._cv.notify_all()
         stalled = time.perf_counter() - t0
+        if trec is not None:
+            # the enqueue phase: zero when the queue had room, the
+            # backpressure wait when it did not — the FIRST place a slow
+            # peer steals training-thread time, so it gets its own span
+            trec.emit("enqueue", "tcp", t0=time.time() - stalled,
+                      dur=stalled,
+                      parent=tctx[1] if tctx else None,
+                      round_=tctx[2] if tctx else None,
+                      trace_id=tctx[0] if tctx else None,
+                      peer=self._peer)
         if stalled > 0.005:
             # backpressure made the TRAINING thread wait: that is exactly
             # the signal a wedged/slow peer gives first — record it where
@@ -2131,19 +2384,47 @@ class DepositStream:
                     seq = self._seq
                     wire_total = sum(i.wire_bytes for i in items)
                     dense_total = sum(i.dense_bytes for i in items)
+                    wsp = None
+                    trec = _tr.get() if self._trace_on else None
+                    if trec is not None:
+                        # the wire span: begun HERE on the sender thread,
+                        # finished by the ack reader when the owner's ack
+                        # lands — its sid is what the trace header
+                        # carries, so the owner-side recv/queue/apply/ack
+                        # spans parent to it across the rank boundary
+                        ictx = next((i.tctx for i in items
+                                     if i.tctx is not None), None)
+                        t_oldest = min(i.t_enq for i in items)
+                        wsp = trec.begin_span(  # bftrace: cross-thread ack reader finishes it; an unacked batch must show an OPEN wire span
+                            "wire", "tcp",
+                            parent=ictx[1] if ictx else None,
+                            round_=ictx[2] if ictx else None,
+                            trace_id=ictx[0] if ictx else None,
+                            peer=self._peer, seq=seq, items=len(items),
+                            bytes=wire_total,
+                            dst=items[0].name_b.decode("utf-8", "replace"))
+                        trec.emit("coalesce", "tcp",
+                                  t0=time.time() -
+                                  (time.perf_counter() - t_oldest),
+                                  dur=time.perf_counter() - t_oldest,
+                                  parent=wsp.sid, round_=wsp.round,
+                                  trace_id=wsp.tid, peer=self._peer,
+                                  seq=seq, items=len(items))
                     # items are retained until the ack when reconnect is
                     # on: they ARE the replay window
                     self._inflight[seq] = (
                         time.perf_counter(),
                         items if self._resume else None,
-                        len(items), wire_total, dense_total)
+                        len(items), wire_total, dense_total, wsp)
                     self._cv.notify_all()
                 if stalled > 0.005:
                     _mt.inc("bf_tcp_window_stalls_total", 1.0,
                             peer=self._peer)
                     _bb.record("tcp_window_stall", peer=self._peer,
                                waited_s=round(stalled, 6))
-                views = self._frame_views(seq, items)
+                views = self._frame_views(
+                    seq, items, wsp.ctx if wsp is not None else None)
+                t_send0 = time.perf_counter()
                 try:
                     act = _chaos.fire("client", peer=self._peer, seq=seq)
                     if act is not None:
@@ -2167,6 +2448,17 @@ class DepositStream:
                             continue
                         return  # _recover latched the terminal error
                     raise
+                if wsp is not None:
+                    # socket-buffer occupancy of this frame: lets the
+                    # ack reader split the wire span into send vs
+                    # ack_wait.  BENIGN RACE: the server can ack while
+                    # sendall's final syscall is still returning, so
+                    # the ack reader may observe this field as absent
+                    # (it then folds the whole latency into ack_wait —
+                    # a sub-microsecond mis-split on loopback, never a
+                    # crash; _note_phases clamps)
+                    wsp.fields["send_s"] = round(
+                        time.perf_counter() - t_send0, 9)
                 if not self._resume:
                     # without a replay window the snapshots are recycled
                     # as soon as the kernel took them (pre-resilience
@@ -2202,12 +2494,26 @@ class DepositStream:
     def _ack_loop(self) -> None:
         buf = bytearray(_ACK.size)
         mv = memoryview(buf)
+        tbuf = bytearray(_ACK_TIMES.size)
+        tmv = memoryview(tbuf)
         while True:
             with self._cv:
                 sock = self._sock
                 gen = self._sock_gen
+                # per-connection negotiation decides the ack frame size;
+                # snapshot it WITH the socket so a reconnect cannot
+                # desync this reader's framing mid-generation
+                t_on = self._trace_on
             try:
                 _recv_into(sock, mv)
+                seq, status = _ACK.unpack(buf)
+                times = None
+                if t_on and not seq & _HB_MARK:
+                    # batch acks on FEATURE_TRACE connections carry the
+                    # owner-side (queue_us, apply_us) tail — heartbeat
+                    # acks never do (they keep the bit31 mark alone)
+                    _recv_into(sock, tmv)
+                    times = _ACK_TIMES.unpack(tbuf)
             except (OSError, ConnectionError, ValueError):
                 if self._closed:
                     return
@@ -2229,7 +2535,6 @@ class DepositStream:
                 self._fail("connection lost before all deposits "
                            "were acknowledged")
                 return
-            seq, status = _ACK.unpack(buf)
             if seq & _HB_MARK:
                 t0 = self._hb_sent.pop(seq & ~_HB_MARK, None)
                 if t0 is not None:
@@ -2249,6 +2554,9 @@ class DepositStream:
                 self._cv.notify_all()
             if entry is not None:
                 lat = time.perf_counter() - entry[0]
+                wsp = entry[5]
+                if wsp is not None:
+                    self._note_phases(wsp, times, lat, seq)
                 self.ack_latencies.append(lat)
                 self._note_latency(lat)
                 _mt.observe("bf_tcp_ack_latency_seconds", lat,
@@ -2378,6 +2686,11 @@ class PipelinedRemoteWindow:
         """The stream's per-peer ack-latency EWMA (seconds; None before
         the first ack) — see :meth:`DepositStream.ack_ewma`."""
         return self.stream.ack_ewma()
+
+    def phase_ewma(self) -> Optional[Dict[str, float]]:
+        """The stream's per-peer wire-phase EWMA (net/queue/apply; None
+        until a timed ack) — see :meth:`DepositStream.phase_ewma`."""
+        return self.stream.phase_ewma()
 
     @property
     def reconnects(self) -> int:

@@ -46,6 +46,7 @@ from typing import Callable, Optional, Tuple
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import resilience, wire_status
+from bluefog_tpu.tracing import recorder as _tr
 from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.serving.client import Snapshot
 
@@ -98,6 +99,11 @@ class Subscriber:
         self._timeout_s = float(timeout_s)
         self.sub_id = int.from_bytes(os.urandom(8), "little") or 1
         self._epoch = 0
+        # FEATURE_TRACE on the CURRENT connection: every push frame then
+        # carries a trace header after _PUSH (empty on keepalives) and
+        # this reader emits a consume span parented to the server's push
+        # span.  Optional want — non-grant degrades tracing silently.
+        self._trace_on = False
         self.delivered = 0
         self.skipped_rounds = 0
         self.resumes = 0
@@ -167,16 +173,21 @@ class Subscriber:
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             want = ws.FEATURE_SUBSCRIBE
+            trace_want = _tr.get() is not None
+            if trace_want:
+                want |= ws.FEATURE_TRACE
             ws._sendmsg_all(sock, [
                 ws._HDR.pack(ws._MAGIC, ws._OP_HELLO, 0),
                 ws._HELLO.pack(ws.PROTOCOL_VERSION, want)])
             (granted,) = ws._STATUS.unpack(
                 ws._recv_exact(sock, ws._STATUS.size))
-            if granted < 0 or not granted & want:
+            if granted < 0 or not granted & ws.FEATURE_SUBSCRIBE:
                 raise RuntimeError(
                     f"window server at {self._addr[0]}:{self._addr[1]} "
                     f"does not serve subscriptions (HELLO reply "
                     f"{int(granted)})")
+            self._trace_on = bool(trace_want
+                                  and granted & ws.FEATURE_TRACE)
             self._epoch += 1
             ws._sendmsg_all(sock, [
                 ws._HDR.pack(ws._MAGIC, ws._OP_SUBSCRIBE,
@@ -226,7 +237,28 @@ class Subscriber:
         while not self._closed.is_set():
             hdr = ws._recv_exact(sock, ws._PUSH.size)
             rnd, skipped, count = ws._PUSH.unpack(hdr)
+            tctx = None
+            if self._trace_on:
+                # FEATURE_TRACE connections carry the server's push-span
+                # context after EVERY _PUSH header (zeros on keepalives
+                # and untraced pushes), so the frame parse stays
+                # deterministic per connection
+                t_id, s_id, _t_rnd = ws._TRACE_HDR.unpack(
+                    ws._recv_exact(sock, ws._TRACE_HDR.size))
+                if s_id:
+                    tctx = (t_id, s_id)
+            t_con_w = time.time()
+            t_con_p = time.perf_counter()
             leaves = ws._recv_leaves(sock, count)
+            if tctx is not None:
+                trec = _tr.get()
+                if trec is not None:
+                    # the delivered snapshot links causally back to the
+                    # serving host's push span
+                    trec.emit("consume", "tcp", t0=t_con_w,
+                              dur=time.perf_counter() - t_con_p,
+                              parent=tctx[1], round_=max(0, rnd),
+                              trace_id=tctx[0], group=self.group)
             if rnd < 0:
                 continue  # keepalive
             if rnd <= self.cursor:

@@ -1,0 +1,57 @@
+"""Fleet-wide causal tracing: wire-propagated trace context +
+per-round critical-path attribution — the fifth observability leg.
+
+- :mod:`bluefog_tpu.tracing.recorder` — per-rank span recorder
+  (``BLUEFOG_TPU_TRACE=<dir>``), thread-local context propagation, the
+  wire-encodable ``(trace_id, span_id, round)`` context the transports
+  carry behind the ``FEATURE_TRACE`` HELLO bit;
+- :mod:`bluefog_tpu.tracing.analyze` — the ``bftrace-tpu`` analyzer:
+  cross-rank causal graph, per-edge phase decomposition, per-round
+  critical path, overlap fraction, chrome-trace export.
+
+See ``docs/tracing.md`` for the phase taxonomy, propagation rules, the
+critical-path algorithm, and the overhead budget.
+"""
+
+from bluefog_tpu.tracing.recorder import (  # noqa: F401
+    Span,
+    SpanRecorder,
+    configure,
+    current_ctx,
+    enabled,
+    flush,
+    get,
+    reset,
+    set_rank,
+    span,
+    trace_id_for,
+    wire_ctx,
+)
+from bluefog_tpu.tracing.analyze import (  # noqa: F401
+    chrome_trace,
+    critical_path,
+    load_traces,
+)
+# NOTE: the analyze() FUNCTION is deliberately not re-exported — the
+# name belongs to the submodule (bluefog_tpu.tracing.analyze), and a
+# package attribute shadowing its own submodule breaks
+# `import bluefog_tpu.tracing.analyze as ...` resolution.  Call
+# bluefog_tpu.tracing.analyze.analyze(trace_dir) instead.
+
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "chrome_trace",
+    "configure",
+    "critical_path",
+    "current_ctx",
+    "enabled",
+    "flush",
+    "get",
+    "load_traces",
+    "reset",
+    "set_rank",
+    "span",
+    "trace_id_for",
+    "wire_ctx",
+]

@@ -1,0 +1,5 @@
+"""``python -m bluefog_tpu.tracing`` — the bftrace-tpu analyzer CLI."""
+
+from bluefog_tpu.tracing.analyze import main
+
+raise SystemExit(main())

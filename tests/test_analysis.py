@@ -1551,6 +1551,123 @@ class TestShardingLint:
         assert any(d.code == "BF-SHD100" for d in report.diagnostics)
 
 
+class TestTracingLint:
+    """BF-TRC001: an explicit begin_span without a finally-guaranteed
+    finish (or a reasoned cross-thread waiver) leaks a forever-open
+    span — a completed phase then reads as wedged."""
+
+    def test_seeded_violation_unguarded_begin(self):
+        from bluefog_tpu.analysis.tracing_lint import check_span_discharge
+
+        src = (
+            "def send(rec, sock, data):\n"
+            "    sp = rec.begin_span('wire', 'tcp')\n"
+            "    sock.sendall(data)\n"
+            "    sp.finish()\n"  # skipped when sendall raises
+        )
+        diags = check_span_discharge(src, filename="seeded.py")
+        assert any(d.code == "BF-TRC001" and d.severity == "error"
+                   for d in diags), [d.format() for d in diags]
+
+    def test_finally_guarded_begin_is_clean(self):
+        from bluefog_tpu.analysis.tracing_lint import check_span_discharge
+
+        src = (
+            "def send(rec, sock, data):\n"
+            "    sp = rec.begin_span('wire', 'tcp')\n"
+            "    try:\n"
+            "        sock.sendall(data)\n"
+            "    finally:\n"
+            "        sp.finish()\n"
+        )
+        assert not check_span_discharge(src, filename="clean.py")
+
+    def test_cross_thread_waiver_needs_a_reason(self):
+        from bluefog_tpu.analysis.tracing_lint import check_span_discharge
+
+        waived = (
+            "def send(rec):\n"
+            "    sp = rec.begin_span(  # bftrace: cross-thread ack "
+            "reader finishes it\n"
+            "        'wire', 'tcp')\n"
+        )
+        assert not check_span_discharge(waived, filename="waived.py")
+        bare = (
+            "def send(rec):\n"
+            "    sp = rec.begin_span('wire')  # bftrace: cross-thread\n"
+        )
+        diags = check_span_discharge(bare, filename="bare.py")
+        assert any(d.code == "BF-TRC001" for d in diags), \
+            "a waiver without a reason must still be an error"
+
+    def test_nested_function_judged_against_its_own_body(self):
+        from bluefog_tpu.analysis.tracing_lint import check_span_discharge
+
+        # the OUTER function's try/finally must not excuse a begin
+        # inside a nested def that has no guard of its own
+        src = (
+            "def outer(rec):\n"
+            "    def worker():\n"
+            "        sp = rec.begin_span('apply')\n"
+            "        sp.finish()\n"
+            "    try:\n"
+            "        worker()\n"
+            "    finally:\n"
+            "        rec.flush().finish()\n"
+        )
+        diags = check_span_discharge(src, filename="nested.py")
+        assert any(d.code == "BF-TRC001" for d in diags), \
+            [d.format() for d in diags]
+
+    def test_nested_guard_cannot_vouch_for_outer_begin(self):
+        from bluefog_tpu.analysis.tracing_lint import check_span_discharge
+
+        # the reverse false negative: a finally-finish inside a nested
+        # helper must not excuse the OUTER function's leaked begin
+        src = (
+            "def outer(rec, other):\n"
+            "    sp = rec.begin_span('wire')\n"
+            "    def helper():\n"
+            "        try:\n"
+            "            pass\n"
+            "        finally:\n"
+            "            other.finish()\n"
+            "    helper()\n"
+        )
+        diags = check_span_discharge(src, filename="vouch.py")
+        assert any(d.code == "BF-TRC001" for d in diags), \
+            [d.format() for d in diags]
+
+    def test_module_level_begin_is_error(self):
+        from bluefog_tpu.analysis.tracing_lint import check_span_discharge
+
+        diags = check_span_discharge("sp = rec.begin_span('x')\n",
+                                     filename="mod.py")
+        assert any(d.code == "BF-TRC001" for d in diags)
+
+    def test_span_context_manager_is_never_flagged(self):
+        from bluefog_tpu.analysis.tracing_lint import check_span_discharge
+
+        src = (
+            "def round_(rec):\n"
+            "    with rec.span('gossip', 'dsgd'):\n"
+            "        pass\n"
+        )
+        assert not check_span_discharge(src, filename="cm.py")
+
+    def test_repo_tracing_pass_clean(self):
+        """The standard sweep's tracing pass over the repo itself:
+        every real begin_span is guarded or carries a reasoned
+        cross-thread waiver."""
+        from bluefog_tpu.analysis import lint as L
+
+        report = LintReport()
+        L.tracing_pass(report, 8)
+        errs = [d for d in report.diagnostics if d.severity == "error"]
+        assert not errs, [d.format() for d in errs]
+        assert any(d.code == "BF-TRC100" for d in report.diagnostics)
+
+
 class TestDocLint:
     def test_repo_doc_matches_registry(self):
         from bluefog_tpu.analysis.doc_lint import check_transport_doc
@@ -1594,3 +1711,69 @@ class TestDocLint:
         doc.write_text("status codes: " +
                        ", ".join(str(c) for c in codes) + "\n")
         assert not _errors(check_transport_doc(str(doc)))
+
+    # -------------------------------------------------- BF-DOC002 (metrics)
+    def test_repo_metrics_doc_matches_live_names(self):
+        """Both directions clean on the repo itself — every emitted
+        bf_* metric has a doc row and no doc row is stale."""
+        from bluefog_tpu.analysis.doc_lint import check_metrics_doc
+
+        diags = check_metrics_doc()
+        assert not _errors(diags), [d.format() for d in diags]
+        assert any(d.code == "BF-DOC101" for d in diags)
+
+    @staticmethod
+    def _metric_src_tree(tmp_path, body: str):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(body)
+        return str(pkg)
+
+    def test_undocumented_metric_is_error(self, tmp_path):
+        from bluefog_tpu.analysis.doc_lint import check_metrics_doc
+
+        src = self._metric_src_tree(
+            tmp_path,
+            "def f(reg):\n"
+            "    reg.counter('bf_documented_total').inc()\n"
+            "    reg.gauge('bf_renamed_new_name').set(1.0)\n")
+        doc = tmp_path / "metrics.md"
+        doc.write_text("| `bf_documented_total` | counter |\n")
+        errs = [d for d in _errors(check_metrics_doc(str(doc), src))
+                if d.code == "BF-DOC002"]
+        assert len(errs) == 1
+        assert "bf_renamed_new_name" in errs[0].message
+
+    def test_stale_doc_row_is_error(self, tmp_path):
+        """The renamed-metric drift the sweep previously missed: the
+        old name's doc row survives the rename."""
+        from bluefog_tpu.analysis.doc_lint import check_metrics_doc
+
+        src = self._metric_src_tree(
+            tmp_path,
+            "def f(reg):\n"
+            "    reg.counter('bf_new_name_total').inc()\n")
+        doc = tmp_path / "metrics.md"
+        doc.write_text("| `bf_new_name_total` | counter |\n"
+                       "| `bf_old_name_total` | counter |\n")
+        errs = [d for d in _errors(check_metrics_doc(str(doc), src))
+                if d.code == "BF-DOC002"]
+        assert len(errs) == 1
+        assert "bf_old_name_total" in errs[0].message
+
+    def test_hist_expansion_spelling_normalizes(self, tmp_path):
+        """A doc that spells `bf_x_seconds_p99` documents the
+        histogram `bf_x_seconds`, and an FFI-style bf_* literal
+        outside a metric call is not a metric."""
+        from bluefog_tpu.analysis.doc_lint import check_metrics_doc
+
+        src = self._metric_src_tree(
+            tmp_path,
+            "def f(reg, lib):\n"
+            "    reg.histogram('bf_x_seconds').observe(0.1)\n"
+            "    lib.symbol('bf_win_create')\n"
+            "    count(None, [('bf_tuple_total', 1)])\n")
+        doc = tmp_path / "metrics.md"
+        doc.write_text("rows: `bf_x_seconds_p99`, `bf_tuple_total`\n")
+        diags = check_metrics_doc(str(doc), src)
+        assert not _errors(diags), [d.format() for d in diags]

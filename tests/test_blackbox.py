@@ -490,6 +490,44 @@ class TestMerge:
         (caveat,) = report["caveats"]
         assert "evicted 6 event(s)" in caveat
 
+    def test_mixed_eviction_and_truncation_carries_both_reasons(
+            self, tmp_path):
+        """One file showing BOTH orphan causes — ring eviction and a
+        torn (truncated) line — must carry both reasons through the
+        report; naming eviction alone sends the operator chasing ring
+        capacity when the file was also cut mid-write (regression)."""
+        rec = recorder.configure(capacity=4, rank=0)
+        for i in range(10):  # overflow the 4-slot ring: dropped=6
+            rec.record("e", i=i)
+        blackbox.dump("mixed", directory=str(tmp_path), rank=0)
+        (path,) = tmp_path.glob("blackbox-rank0.jsonl")
+        with open(path, "a") as f:
+            f.write('{"event": {"kind": "collec')  # torn line, same file
+        report = merge.diagnose(merge.load_incident(str(tmp_path)))
+        (caveat,) = report["caveats"]
+        assert "evicted 6 event(s)" in caveat
+        assert "truncated" in caveat and "1 torn line(s)" in caveat
+        # and the CLI text renderer surfaces it verbatim
+        text = merge._format_report(report, str(tmp_path))
+        assert f"caveat: {caveat}" in text
+
+    def test_truncation_without_end_marker_is_its_own_caveat(
+            self, tmp_path):
+        """A dump cut before its end marker is truncation evidence even
+        with zero torn lines — the eviction count died with the
+        marker, so the caveat must say the file is incomplete."""
+        rec = recorder.configure(capacity=64, rank=0)
+        rec.record("e", i=0)
+        blackbox.dump("cut", directory=str(tmp_path), rank=0)
+        (path,) = tmp_path.glob("blackbox-rank0.jsonl")
+        lines = open(path).read().splitlines()
+        assert json.loads(lines[-1]).get("end")
+        with open(path, "w") as f:
+            f.write("\n".join(lines[:-1]) + "\n")  # drop the end marker
+        report = merge.diagnose(merge.load_incident(str(tmp_path)))
+        (caveat,) = report["caveats"]
+        assert "no end marker" in caveat and "evicted" not in caveat
+
     def test_cli_round_trip_with_trace_export(self, tmp_path):
         _simulate_incident(str(tmp_path), wedged=1, dump_wedged=False)
         trace = str(tmp_path / "merged.json")

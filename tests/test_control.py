@@ -403,6 +403,149 @@ class TestDecisionTable:
         assert decide_plan(prev, 50, [], self.CFG) is prev
 
 
+def _phase_lag_evidence(n, round_, lag_of_3, phases):
+    return [Evidence(rank=r, round=round_,
+                     lag_s={3: lag_of_3, (r + 1) % n: 0.01},
+                     phase_s={3: dict(phases)})
+            for r in range(n) if r != 3]
+
+
+class TestPhaseEvidence:
+    """Tracing-fed link-vs-host split: the same lag conviction routes
+    to the codec (slow LINK, net-dominated) or the ring spine (slow
+    HOST / no phase evidence) — pure and byte-convergent either way."""
+
+    CFG = ControlConfig(cooldown_rounds=1, min_lag_s=0.001,
+                        max_codec_level=2)
+
+    def test_evidence_phase_roundtrip_and_canonical(self):
+        ev = Evidence(rank=0, round=9, lag_s={3: 0.5},
+                      phase_s={3: {"net": 0.4, "queue": 0.05,
+                                   "apply": 0.05}})
+        back = Evidence.from_json(ev.to_json())
+        assert back.phase_s == {3: {"net": 0.4, "queue": 0.05,
+                                    "apply": 0.05}}
+        assert back.to_json() == ev.to_json()
+        # non-finite phase values are dropped at canonicalization
+        ev2 = Evidence(rank=0, round=9,
+                       phase_s={3: {"net": float("nan")}})
+        assert ev2.phase_s == {}
+
+    def test_pre_tracing_record_parses_and_decides_identically(self):
+        old = ('{"consensus_growth":null,"lag_s":{"1":0.01,"3":0.5},'
+               '"mixing_excess":null,"rank":0,"reconnects":{},'
+               '"round":10,"states":{}}')
+        ev = Evidence.from_json(old)
+        assert ev.phase_s == {}
+        plan = decide_plan(CommPlan(), 10, [ev] + _lag_evidence(
+            4, 10, 0.5)[1:], self.CFG)
+        assert plan.slow == (3,)  # the phase-blind table, unchanged
+
+    def test_net_dominated_lag_routes_to_codec_not_spine(self):
+        evs = _phase_lag_evidence(4, 10, 0.5,
+                                  {"net": 0.4, "queue": 0.05,
+                                   "apply": 0.05})
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.slow == ()        # no ring-spine penalty
+        assert plan.codec_level == 1  # one rung harder instead
+
+    def test_host_dominated_lag_stays_spine_territory(self):
+        evs = _phase_lag_evidence(4, 10, 0.5,
+                                  {"net": 0.05, "queue": 0.35,
+                                   "apply": 0.10})
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.slow == (3,)
+        assert plan.codec_level == 0
+
+    def test_growth_backoff_does_not_cancel_link_remedy(self):
+        """A convicted link-slow peer must get SOME remedy even when
+        the grow_hi band backs the codec off the same window: the +1
+        bump would be cancelled by the -1, so the diversion falls back
+        to the spine instead of silently dropping the remedy."""
+        prev = CommPlan(version=1, round=0, codec_level=1)
+        evs = [Evidence(rank=e.rank, round=e.round, lag_s=e.lag_s,
+                        phase_s=e.phase_s, consensus_growth=1.5)
+               for e in _phase_lag_evidence(
+                   4, 20, 0.5, {"net": 0.4, "queue": 0.05,
+                                "apply": 0.05})]
+        plan = decide_plan(prev, 20, evs, self.CFG)
+        assert plan.codec_level == 0   # the grow_hi back-off held
+        assert plan.slow == (3,)       # the spine is the fallback
+
+    def test_grow_lo_rearm_already_is_the_link_remedy(self):
+        """When grow_lo re-armed the codec the same window, the codec
+        already rose — no double bump, no spine."""
+        evs = [Evidence(rank=e.rank, round=e.round, lag_s=e.lag_s,
+                        phase_s=e.phase_s, consensus_growth=0.5)
+               for e in _phase_lag_evidence(
+                   4, 10, 0.5, {"net": 0.4, "queue": 0.05,
+                                "apply": 0.05})]
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.slow == ()
+        assert plan.codec_level == 1  # one rung, not two
+
+    def test_no_codec_headroom_falls_back_to_spine(self):
+        """A convicted peer always gets SOME remedy: at the codec
+        ceiling, a link-slow peer still takes the spine penalty."""
+        cfg = ControlConfig(cooldown_rounds=1, min_lag_s=0.001,
+                            max_codec_level=0)
+        evs = _phase_lag_evidence(4, 10, 0.5,
+                                  {"net": 0.4, "queue": 0.05,
+                                   "apply": 0.05})
+        plan = decide_plan(CommPlan(), 10, evs, cfg)
+        assert plan.slow == (3,)
+
+    def test_lossy_or_suspected_is_never_diverted(self):
+        """Reconnect/suspicion evidence stays spine territory even
+        when the phases look net-dominated — a flapping peer is not
+        fixed by a smaller payload."""
+        evs = [Evidence(rank=r, round=10, lag_s={3: 0.5, 1: 0.01},
+                        reconnects={3: 1},
+                        phase_s={3: {"net": 0.4, "queue": 0.01,
+                                     "apply": 0.01}})
+               for r in (0, 1, 2)]
+        plan = decide_plan(CommPlan(), 10, evs, self.CFG)
+        assert plan.slow == (3,)
+
+    def test_byte_convergence_with_phase_records(self):
+        import random
+
+        evs = _phase_lag_evidence(4, 10, 0.5,
+                                  {"net": 0.4, "queue": 0.05,
+                                   "apply": 0.05})
+        plans = []
+        for seed in range(6):
+            shuffled = list(evs)
+            random.Random(seed).shuffle(shuffled)
+            plans.append(decide_plan(CommPlan(), 10, shuffled,
+                                     self.CFG).to_bytes())
+        assert len(set(plans)) == 1
+
+    def test_controller_plumbs_phase_to_evidence(self):
+        ctl = CommController(0, 4)
+        ctl.note_peer(3, lag_s=0.5,
+                      phase_s={"net": 0.4, "queue": 0.05,
+                               "apply": 0.05})
+        ctl.note_peer(2, lag_s=0.01, phase_s=None)  # tracing off
+        ev = ctl.evidence(10)
+        assert ev.phase_s == {3: {"apply": 0.05, "net": 0.4,
+                                  "queue": 0.05}}
+        ctl.forget_peer(3)
+        assert ctl.evidence(11).phase_s == {}
+
+    def test_retain_peers_drops_stale_phase(self):
+        ctl = CommController(0, 4)
+        ctl.note_peer(3, phase_s={"net": 1.0})
+        ctl.retain_peers([1, 2])
+        assert ctl.evidence(5).phase_s == {}
+
+    def test_link_net_frac_validated(self):
+        with pytest.raises(ValueError):
+            ControlConfig(link_net_frac=0.0)
+        with pytest.raises(ValueError):
+            ControlConfig(link_net_frac=1.5)
+
+
 # ---------------------------------------------------------------------------
 # 5. penalized replan
 # ---------------------------------------------------------------------------

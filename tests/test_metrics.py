@@ -454,6 +454,95 @@ class TestExportAndDash:
         p.write_text("")
         assert main([str(p)]) == 1
 
+    def test_dash_since_rebaselines_counters(self):
+        """--since must difference against the last PRE-window value —
+        the first in-window delta is 200 (300-100), not the whole
+        cumulative 300."""
+        from bluefog_tpu.metrics.dash import summarize
+
+        series = {"x_total": [100.0, 300.0, 600.0]}
+        (row,) = summarize([0, 1, 2], series, since=1)
+        assert row["points"] == 2
+        assert row["per_step_mean"] == pytest.approx(250.0)
+        assert row["p50"] == 200 and row["p99"] == 300
+        assert row["total"] == 600  # the total column stays cumulative
+
+    @staticmethod
+    def _hist_series(label: str, counts, sums, p50, p99):
+        base = "bf_tcp_ack_latency_seconds"
+        n = len(counts)
+        return {
+            f"{base}_count{{peer=\"{label}\"}}": list(counts),
+            f"{base}_sum{{peer=\"{label}\"}}": list(sums),
+            f"{base}_min{{peer=\"{label}\"}}": [p50] * n,
+            f"{base}_max{{peer=\"{label}\"}}": [p99] * n,
+            f"{base}_p50{{peer=\"{label}\"}}": [p50] * n,
+            f"{base}_p99{{peer=\"{label}\"}}": [p99] * n,
+        }
+
+    def test_dash_histogram_per_label_breakdown(self):
+        """A labeled histogram's six expansion series fold into ONE
+        `hist` row per label value — per-peer ack latency reads as one
+        row per peer, not p50/p99 collapsed across labels."""
+        from bluefog_tpu.metrics.dash import summarize
+
+        series = {
+            **self._hist_series("a", [2.0, 4.0, 6.0], [0.2, 0.4, 0.6],
+                                0.1, 0.12),
+            **self._hist_series("b", [1.0, 2.0, 3.0], [1.0, 2.0, 3.0],
+                                1.0, 1.5),
+            # an incomplete suffix family is NOT a histogram: a
+            # freestanding gauge ending in _count must survive as-is
+            "stray_count": [5.0, 6.0, 7.0],
+        }
+        rows = summarize([0, 1, 2], series)
+        by_name = {r["series"]: r for r in rows}
+        ra = by_name['bf_tcp_ack_latency_seconds{peer="a"}']
+        rb = by_name['bf_tcp_ack_latency_seconds{peer="b"}']
+        assert ra["type"] == rb["type"] == "hist"
+        assert ra["points"] == 6 and rb["points"] == 3
+        assert ra["per_step_mean"] == pytest.approx(0.1)
+        assert ra["p99"] == pytest.approx(0.12)
+        assert rb["per_step_mean"] == pytest.approx(1.0)
+        assert by_name["stray_count"]["type"] == "gauge"
+        # no raw expansion rows leak through alongside the fold
+        assert not any("_p50{" in n or "_count{" in n for n in by_name)
+
+    def test_dash_histogram_since_windows_count_and_sum(self):
+        from bluefog_tpu.metrics.dash import summarize
+
+        series = self._hist_series("a", [2.0, 4.0, 6.0],
+                                   [0.2, 0.4, 0.6], 0.1, 0.12)
+        (row,) = summarize([0, 1, 2], series, since=1)
+        assert row["points"] == 4  # 6 - the pre-window 2
+        assert row["total"] == pytest.approx(0.4)
+        assert row["per_step_mean"] == pytest.approx(0.1)
+
+    def test_dash_cli_since_and_hist_flags(self, tmp_path):
+        """End-to-end: a run with a labeled histogram renders hist rows
+        through the CLI, and --since narrows the window."""
+        path = str(tmp_path / "m.jsonl")
+        reg = mreg.metrics_start(path)
+        for s in range(4):
+            reg.counter("bf_comm_bytes_total").inc(256, op="na")
+            reg.histogram("bf_tcp_ack_latency_seconds").observe(
+                0.01 * (s + 1), peer="p0")
+            mexp.step(s)
+        mexp.detach_writer()
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.metrics.dash", path,
+             "--since", "2", "--json"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        rows = {r["series"]: r for r in json.loads(proc.stdout)}
+        hist = rows['bf_tcp_ack_latency_seconds{peer="p0"}']
+        assert hist["type"] == "hist"
+        assert hist["points"] == 2  # steps 2 and 3 only
+        counter = rows['bf_comm_bytes_total{op="na"}']
+        assert counter["per_step_mean"] == pytest.approx(256.0)
+
     def test_prometheus_text_format(self):
         reg = mreg.metrics_start()
         reg.counter("bf_comm_bytes_total", "bytes shipped").inc(64, op="x")

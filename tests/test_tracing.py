@@ -1,0 +1,654 @@
+"""Fleet-wide causal tracing: the fifth observability leg.
+
+Covers the tentpole surfaces of ``bluefog_tpu/tracing/`` plus the wire
+propagation through the v2 transport:
+
+- recorder semantics: thread-local span context, cross-thread
+  begin/finish, the open-span flush snapshot (a wedged peer shows an
+  OPEN span, never a missing one), lazy env activation, and a disabled
+  path that is one env read + a None test;
+- the ``bftrace-tpu`` analyzer against a CONSTRUCTED ground truth:
+  per-edge phase decomposition, the per-round critical path naming the
+  gating edge + dominant phase, overlap fraction, straggler ranking,
+  chrome-trace causal flow arrows, torn-tail tolerance;
+- wire propagation end to end in one process: a deposit's trace
+  context rides the FEATURE_TRACE header, the owner-side
+  recv/queue-wait/apply/ack spans parent to the sender's wire span,
+  and the extended batch ack folds (queue_us, apply_us) back into the
+  sender's ``phase_ewma`` — the control plane's slow-link-vs-slow-host
+  evidence;
+- 60-case malformed/truncated trace-header fuzz: header claimed but
+  absent, garbage ids, truncation inside the header, an unnegotiated
+  header, and a v-old peer without the feature bit — the server
+  survives every case, frames apply exactly once, and tracing degrades
+  silently per connection;
+- tracing disabled => byte-identical jitted HLO (the PR 2/3
+  discipline, asserted on both the jaxpr and the lowered HLO text);
+- the 3-rank tcp dsgd acceptance run under ``server:delay`` chaos on
+  one rank: ``bftrace-tpu`` names that rank's edge as the per-round
+  critical path with a phase decomposition (slow-marked, like every MP
+  soak).
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests._util import REPO as _REPO, clean_env, uniq as _uniq
+
+import bluefog_tpu.tracing.analyze as tan
+from bluefog_tpu.tracing import recorder as trc
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Every test starts and ends without a live process recorder."""
+    trc.reset()
+    yield
+    trc.reset()
+
+
+def _mk(name, n_slots, n_elems, dtype=np.float64):
+    from bluefog_tpu.runtime.async_windows import AsyncWindow
+
+    return AsyncWindow(name, n_slots=n_slots, n_elems=n_elems, dtype=dtype)
+
+
+def _serve():
+    from bluefog_tpu.runtime.window_server import WindowServer
+
+    srv = WindowServer()
+    _, port = srv.start("127.0.0.1")
+    return srv, port
+
+
+# ---------------------------------------------------------------------------
+# 1. recorder semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRecorder:
+    def test_disabled_path_is_null(self):
+        assert not trc.enabled()
+        assert trc.get() is None
+        assert trc.wire_ctx() is None
+        with trc.span("x") as sp:
+            assert sp is None  # the null context manager
+
+    def test_lazy_env_activation(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BLUEFOG_TPU_TRACE", str(tmp_path))
+        # reset() is sticky against the env (tests own the state); undo
+        # the stick to exercise the lazy path the env var takes
+        trc._STOPPED = False
+        trc._RECORDER = None
+        assert trc.enabled()
+        assert trc.get().directory == str(tmp_path)
+
+    def test_span_context_nesting_and_wire_ctx(self, tmp_path):
+        rec = trc.configure(str(tmp_path), rank=3, job="jobA")
+        with rec.span("round", "dsgd", round_=17) as outer:
+            tid, sid, rnd = trc.wire_ctx()
+            assert (tid, sid, rnd) == (outer.tid, outer.sid, 17)
+            with rec.span("gossip", "dsgd") as inner:
+                assert inner.par == outer.sid
+                assert inner.round == 17  # inherited through the stack
+        assert trc.current_ctx() is None
+        rec.flush()
+        spans = tan.load_traces(str(tmp_path))
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["gossip"]["par"] == by_name["round"]["sid"]
+        assert by_name["gossip"]["rank"] == 3
+        assert not any(s.get("open") for s in spans)
+
+    def test_open_span_snapshot_survives_flush(self, tmp_path):
+        """A begun-but-unfinished span appears as open:true at every
+        flush WITHOUT being discharged — wedged-peer forensics."""
+        rec = trc.configure(str(tmp_path), rank=0)
+        sp = rec.begin_span("wire", "tcp", round_=4)  # bftrace: cross-thread the test finishes it below
+        rec.flush()
+        spans = tan.load_traces(str(tmp_path))
+        (open_sp,) = [s for s in spans if s["name"] == "wire"]
+        assert open_sp.get("open") is True and open_sp["round"] == 4
+        # finish from "another thread"; the closed record supersedes
+        t = threading.Thread(target=sp.finish)
+        t.start()
+        t.join()
+        rec.flush()
+        spans = tan.load_traces(str(tmp_path))
+        (closed,) = [s for s in spans if s["name"] == "wire"]
+        assert not closed.get("open") and closed["sid"] == sp.sid
+
+    def test_trace_id_is_coordination_free(self):
+        assert trc.trace_id_for("job") == trc.trace_id_for("job")
+        assert trc.trace_id_for("job") != trc.trace_id_for("job2")
+
+    def test_rankless_process_writes_pid_file(self, tmp_path):
+        """A rank-less recorder (a serving reader) must not alias
+        rank 0's file — colocated processes sharing the trace dir
+        would interleave appends; the analyzer reads both spellings."""
+        trc.configure(str(tmp_path))  # no rank
+        with trc.span("read", "tcp"):
+            pass
+        trc.flush()
+        (path,) = tmp_path.glob("trace-pid*.jsonl")
+        assert f"pid{os.getpid()}" in path.name
+        assert not list(tmp_path.glob("trace-rank*.jsonl"))
+        spans = tan.load_traces(str(tmp_path))
+        assert [s["name"] for s in spans] == ["read"]
+
+    def test_set_rank_pins_before_first_flush(self, tmp_path):
+        trc.configure(str(tmp_path))
+        trc.set_rank(5)
+        with trc.span("x"):
+            pass
+        trc.flush()
+        assert os.path.exists(str(tmp_path / "trace-rank5.jsonl"))
+        trc.set_rank(6)  # later calls must not rename the identity
+        assert trc.get().rank == 5
+
+
+# ---------------------------------------------------------------------------
+# 2. analyzer against a constructed ground truth
+# ---------------------------------------------------------------------------
+
+
+def _ground_truth_spans(rounds=5):
+    """Two ranks; rank 1's deposits gate rank 0's rounds, queue-wait
+    dominant.  Per round k (1 s cadence, synthetic clocks):
+
+    - rank 1 round: [k, k+0.4]; compute [k+0.1, k+0.3]
+    - rank 1 wire span to rank 0: [k+0.1, k+0.72] (dur 0.62)
+    - rank 0 server: queue_wait [k+0.2, 0.45 s], apply [k+0.65, 0.1 s]
+    - rank 0 round: [k, k+0.8] — last finisher, gated by the deposit
+    """
+    spans = []
+    sid = 1
+    for k in range(rounds):
+        r1 = dict(sid=sid, par=0, tid=9, name="round", cat="dsgd",
+                  rank=1, round=k, t0=float(k), dur=0.4)
+        sid += 1
+        comp = dict(sid=sid, par=r1["sid"], tid=9, name="compute",
+                    cat="dsgd", rank=1, round=k, t0=k + 0.1, dur=0.2)
+        sid += 1
+        wire = dict(sid=sid, par=r1["sid"], tid=9, name="wire",
+                    cat="tcp", rank=1, round=k, t0=k + 0.1, dur=0.62,
+                    dst="w:0", seq=k)
+        sid += 1
+        qw = dict(sid=sid, par=wire["sid"], tid=9, name="queue_wait",
+                  cat="tcp_srv", rank=0, round=k, t0=k + 0.2, dur=0.45)
+        sid += 1
+        ap = dict(sid=sid, par=wire["sid"], tid=9, name="apply",
+                  cat="tcp_srv", rank=0, round=k, t0=k + 0.65, dur=0.1)
+        sid += 1
+        r0 = dict(sid=sid, par=0, tid=9, name="round", cat="dsgd",
+                  rank=0, round=k, t0=float(k), dur=0.8)
+        sid += 1
+        spans += [r1, comp, wire, qw, ap, r0]
+    return spans
+
+
+class TestAnalyzer:
+    def test_edge_phase_decomposition(self):
+        graph = tan.build_graph(_ground_truth_spans())
+        er = tan.edge_report(graph)
+        assert set(er) == {"1->0"}
+        e = er["1->0"]
+        assert e["batches"] == 5
+        assert e["wire_mean_s"] == pytest.approx(0.62)
+        assert e["phase_mean_s"]["queue_wait"] == pytest.approx(0.45)
+        assert e["phase_mean_s"]["apply"] == pytest.approx(0.1)
+        assert e["phase_mean_s"]["net"] == pytest.approx(0.07)
+        dom = max(e["phase_frac"], key=lambda p: e["phase_frac"][p])
+        assert dom == "queue_wait"
+
+    def test_critical_path_names_gating_edge_and_phase(self):
+        graph = tan.build_graph(_ground_truth_spans())
+        cp = tan.critical_path(graph)
+        assert cp["gating_edge"] == [1, 0]
+        assert cp["gating_rounds"] == 5
+        assert cp["dominant_phase"] == "queue_wait"
+        assert cp["dominant_frac"] == pytest.approx(0.45 / 0.62)
+
+    def test_straggler_ranking_and_overlap(self):
+        graph = tan.build_graph(_ground_truth_spans())
+        rr = tan.round_report(graph)
+        assert rr["straggler_ranking"] == [0, 1]
+        assert rr["per_rank"][0]["round_mean_s"] == pytest.approx(0.8)
+        ov = tan.overlap_report(graph)
+        # compute [k+.1, k+.3] hides 0.2 s of the 0.62 s wire span
+        assert ov[1] == pytest.approx(0.2 / 0.62)
+
+    def test_extended_ack_fallback_without_server_spans(self):
+        """Degraded mode: only the sender's trace exists (the owner
+        never wrote a file) — the queue_s/apply_s the extended ack
+        folded into the wire span still decompose the edge, with the
+        destination recovered from the window name."""
+        spans = [dict(sid=1, par=0, tid=9, name="wire", cat="tcp",
+                      rank=1, round=0, t0=0.1, dur=0.62, dst="w:0",
+                      queue_s=0.45, apply_s=0.1)]
+        er = tan.edge_report(tan.build_graph(spans))
+        assert set(er) == {"1->0"}
+        assert er["1->0"]["phase_mean_s"]["queue_wait"] == \
+            pytest.approx(0.45)
+
+    def test_ack_backpressure_gate(self):
+        """A slow RECEIVER gates the sender through the bounded
+        in-flight window: the sender's own late-acked wire span is the
+        gate, and the edge still names the receiver."""
+        spans = []
+        for k in range(4):
+            spans.append(dict(sid=10 + k, par=0, tid=9, name="round",
+                              cat="dsgd", rank=0, round=k, t0=float(k),
+                              dur=0.9))
+            # the ack lands at k+0.85, later than any incoming deposit
+            spans.append(dict(sid=100 + k, par=0, tid=9, name="wire",
+                              cat="tcp", rank=0, round=k, t0=k + 0.05,
+                              dur=0.8, dst="w:2", seq=k))
+        cp = tan.critical_path(tan.build_graph(spans))
+        assert cp["gating_edge"] == [0, 2]
+        assert cp["gating_rounds"] >= 3
+
+    def test_torn_tail_and_open_dedup(self, tmp_path):
+        path = tmp_path / "trace-rank0.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(dict(sid=1, name="a", rank=0, t0=0.0,
+                                    dur=1.0)) + "\n")
+            f.write(json.dumps(dict(sid=2, name="b", rank=0, t0=0.0,
+                                    open=True)) + "\n")
+            f.write(json.dumps(dict(sid=2, name="b", rank=0, t0=0.0,
+                                    open=True, newest=True)) + "\n")
+            f.write('{"sid": 3, "name": "torn')  # crashed writer
+        spans = tan.load_traces(str(tmp_path))
+        assert len(spans) == 2
+        (b,) = [s for s in spans if s["name"] == "b"]
+        assert b.get("newest") is True  # newest open snapshot wins
+
+    def test_open_record_superseded_by_close(self, tmp_path):
+        path = tmp_path / "trace-rank0.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(dict(sid=7, name="w", rank=0, t0=0.0,
+                                    open=True)) + "\n")
+            f.write(json.dumps(dict(sid=7, name="w", rank=0, t0=0.0,
+                                    dur=2.0)) + "\n")
+        spans = tan.load_traces(str(tmp_path))
+        assert len(spans) == 1 and not spans[0].get("open")
+
+    def test_chrome_trace_causal_flow_arrows(self):
+        events = tan.chrome_trace(_ground_truth_spans(rounds=1))
+        flows = [e for e in events if e.get("cat") == "flow"]
+        # queue_wait and apply each link cross-rank to the wire span
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert len([e for e in flows if e["ph"] == "s"]) == 2
+        xs = [e for e in events if e.get("ph") == "X"]
+        assert {e["pid"] for e in xs} == {0, 1}  # one pid per rank
+
+    def test_cli_report_and_json(self, tmp_path):
+        with open(tmp_path / "trace-rank0.jsonl", "w") as f:
+            for s in _ground_truth_spans():
+                f.write(json.dumps(s) + "\n")
+        trace_out = str(tmp_path / "merged.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.tracing",
+             str(tmp_path), "--trace", trace_out],
+            capture_output=True, text=True, timeout=120,
+            env=clean_env(), cwd=_REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert "CRITICAL PATH: rank 1 -> rank 0" in proc.stdout
+        assert "queue_wait" in proc.stdout
+        assert "straggler ranking (slowest first): 0, 1" in proc.stdout
+        assert json.load(open(trace_out))  # valid chrome trace
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.tracing",
+             str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=120,
+            env=clean_env(), cwd=_REPO)
+        rep = json.loads(proc.stdout)
+        assert rep["critical_path"]["gating_edge"] == [1, 0]
+
+    def test_cli_empty_dir_fails_loud(self, tmp_path):
+        assert tan.main([str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# 3. wire propagation through the live transport (one process)
+# ---------------------------------------------------------------------------
+
+
+class TestWirePropagation:
+    def test_deposit_spans_link_across_the_wire(self, tmp_path):
+        """The full causal chain in one process: the round span's
+        context rides the trace header, the owner-side spans parent to
+        the sender's wire span, the extended ack folds queue/apply back
+        into the wire span and the per-peer phase EWMA."""
+        from bluefog_tpu.runtime.window_server import PipelinedRemoteWindow
+
+        trc.configure(str(tmp_path), rank=0)
+        name = _uniq("trc_wire")
+        win = _mk(name, 1, 8)
+        srv, port = _serve()
+        try:
+            rw = PipelinedRemoteWindow(("127.0.0.1", port), name)
+            assert rw.stream._trace_on  # HELLO negotiated FEATURE_TRACE
+            arr = np.arange(8.0)
+            with trc.span("round", "dsgd", round_=11):
+                rw.deposit_async(0, arr, accumulate=True)
+            rw.flush()
+            buf, fresh = win.read(0, consume=True)
+            assert fresh == 1  # exactly once
+            np.testing.assert_allclose(buf, arr)
+
+            phases = rw.phase_ewma()
+            assert phases is not None
+            assert set(phases) == {"net", "queue", "apply"}
+            assert all(v >= 0 for v in phases.values())
+            rw.close()
+        finally:
+            srv.stop()
+            win.free()
+        trc.flush()
+        spans = tan.load_traces(str(tmp_path))
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        for expected in ("round", "snapshot", "enqueue", "coalesce",
+                         "wire", "ack_wait", "recv", "queue_wait",
+                         "apply", "ack"):
+            assert expected in by_name, (expected, sorted(by_name))
+        (wire,) = by_name["wire"]
+        (rnd,) = by_name["round"]
+        assert wire["par"] == rnd["sid"]
+        assert wire["round"] == 11
+        # owner-side spans parent to the WIRE span (the propagated ctx)
+        for srv_name in ("recv", "queue_wait", "apply", "ack"):
+            (sp,) = by_name[srv_name]
+            assert sp["par"] == wire["sid"], srv_name
+            assert sp["round"] == 11
+        # the extended ack folded the owner's timings into the sender
+        assert wire["queue_s"] >= 0 and wire["apply_s"] >= 0
+        assert not any(s.get("open") for s in spans)
+
+    def test_tracing_off_degrades_silently(self, tmp_path):
+        """A tracing-disabled client against the same server: no
+        FEATURE_TRACE on the wire, plain acks, no trace file."""
+        from bluefog_tpu.runtime.window_server import PipelinedRemoteWindow
+
+        name = _uniq("trc_off")
+        win = _mk(name, 1, 4)
+        srv, port = _serve()
+        try:
+            rw = PipelinedRemoteWindow(("127.0.0.1", port), name)
+            assert not rw.stream._trace_on
+            rw.deposit_async(0, np.ones(4), accumulate=True)
+            rw.flush()
+            _, fresh = win.read(0, consume=True)
+            assert fresh == 1
+            assert rw.phase_ewma() is None
+            rw.close()
+        finally:
+            srv.stop()
+            win.free()
+        assert not list(tmp_path.glob("trace-*.jsonl"))
+
+    def test_snapshot_read_propagates_context(self, tmp_path):
+        """The serving read path: the reader's snapshot_read span is
+        answered by an owner-side snapshot_serve span parented to it."""
+        from bluefog_tpu.serving import snapshots as snap
+        from bluefog_tpu.serving.client import SnapshotClient
+
+        trc.configure(str(tmp_path), rank=0)
+        srv, port = _serve()
+        group = _uniq("trc_snap")
+        try:
+            snap.table().publish(group, 3, {"w": np.arange(4.0)})
+            cli = SnapshotClient(("127.0.0.1", port), group)
+            got = cli.snapshot()
+            assert got.round == 3
+            cli.close()
+        finally:
+            srv.stop()
+            snap.table().drop(group)
+        trc.flush()
+        spans = tan.load_traces(str(tmp_path))
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["snapshot_serve"]["par"] == \
+            by_name["snapshot_read"]["sid"]
+
+
+# ---------------------------------------------------------------------------
+# 4. trace-header fuzz (the wire must never trust the header)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceHeaderFuzz:
+    def test_60_case_trace_header_fuzz(self, tmp_path):
+        """Malformed/truncated trace headers across 60 connections:
+        the server survives every case, valid frames apply exactly
+        once, invalid ones apply NOTHING (no phantom deposits), and a
+        v-old peer without the feature bit works untraced."""
+        from bluefog_tpu.runtime import window_server as ws
+
+        trc.configure(str(tmp_path), rank=0)  # server-side spans live
+        name = _uniq("trc_fuzz")
+        win = _mk(name, 1, 8)
+        srv, port = _serve()
+        rng = np.random.default_rng(17)
+        arr = np.ones(8)
+        name_b = name.encode()
+        item = ws._ITEM.pack(len(name_b), 0, 1, 1, 0, arr.size,
+                             arr.nbytes)
+
+        def hello(s, features):
+            s.sendall(ws._HDR.pack(ws._MAGIC, ws._OP_HELLO, 0)
+                      + ws._HELLO.pack(ws.PROTOCOL_VERSION, features))
+            (granted,) = ws._STATUS.unpack(
+                _recv_exactly(s, ws._STATUS.size))
+            return granted
+
+        def batch(seq, thdr):
+            return (ws._HDR.pack(ws._MAGIC, ws._OP_DEPOSIT_BATCH, 0)
+                    + thdr + ws._BATCH_HDR.pack(seq, 1) + item
+                    + name_b + arr.tobytes())
+
+        def _recv_exactly(s, n):
+            buf = b""
+            while len(buf) < n:
+                got = s.recv(n - len(buf))
+                if not got:
+                    raise ConnectionError("closed")
+                buf += got
+            return buf
+
+        want = ws.FEATURE_BATCH | ws.FEATURE_TRACE
+        applied = 0
+        for trial in range(60):
+            mode = trial % 5
+            should_apply = mode in (1, 3)
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=10) as s:
+                    s.settimeout(5)
+                    if mode == 0:
+                        # header claimed (FEATURE_TRACE granted) but
+                        # ABSENT: the server misparses the batch as a
+                        # header; at worst THIS connection dies
+                        granted = hello(s, want)
+                        assert granted & ws.FEATURE_TRACE
+                        s.sendall(batch(1, b""))
+                        s.shutdown(socket.SHUT_WR)
+                        while s.recv(4096):
+                            pass
+                    elif mode == 1:
+                        # garbage ids (incl. sid=0 half the time): the
+                        # header PARSES, junk is ignored, the frame
+                        # applies exactly once with a timed ack
+                        hello(s, want)
+                        thdr = ws._TRACE_HDR.pack(
+                            int(rng.integers(0, 1 << 63)),
+                            int(rng.integers(0, 2))
+                            * int(rng.integers(1, 1 << 63)),
+                            int(rng.integers(0, 1 << 32)))
+                        s.sendall(batch(1, thdr))
+                        ack = _recv_exactly(
+                            s, ws._ACK.size + ws._ACK_TIMES.size)
+                        seq, status = ws._ACK.unpack(
+                            ack[:ws._ACK.size])
+                        assert (seq, status) == (1, 1)
+                    elif mode == 2:
+                        # truncated INSIDE the trace header
+                        hello(s, want)
+                        cut = int(rng.integers(1, ws._TRACE_HDR.size))
+                        full = batch(1, ws._TRACE_HDR.pack(7, 7, 7))
+                        s.sendall(full[:ws._HDR.size + cut])
+                        s.shutdown(socket.SHUT_WR)
+                        while s.recv(4096):
+                            pass
+                    elif mode == 3:
+                        # v-old peer: no FEATURE_TRACE wanted; frames
+                        # carry no header; plain (8+4 byte) ack
+                        granted = hello(s, ws.FEATURE_BATCH)
+                        assert granted & ws.FEATURE_BATCH
+                        s.sendall(batch(1, b""))
+                        ack = _recv_exactly(s, ws._ACK.size)
+                        seq, status = ws._ACK.unpack(ack)
+                        assert (seq, status) == (1, 1)
+                    else:
+                        # header sent WITHOUT negotiating the bit: the
+                        # 20 bytes are junk ops — connection drops,
+                        # server survives, nothing applies
+                        hello(s, ws.FEATURE_BATCH)
+                        s.sendall(batch(1, ws._TRACE_HDR.pack(9, 9, 9)))
+                        s.shutdown(socket.SHUT_WR)
+                        while s.recv(4096):
+                            pass
+            except OSError:
+                pass  # a torn connection is an allowed outcome
+            # exactly-once, checked after EVERY trial: valid frames
+            # landed once, malformed ones landed NOTHING
+            buf, fresh = win.read(0, consume=True)
+            if should_apply:
+                applied += 1
+                assert fresh == 1, (trial, mode, fresh)
+                np.testing.assert_allclose(buf, arr)
+            else:
+                assert fresh == 0, (trial, mode, fresh)
+        assert applied == 24  # 60 trials, modes 1 and 3
+
+        # the server is fully healthy for a fresh traced client
+        from bluefog_tpu.runtime.window_server import PipelinedRemoteWindow
+
+        rw = PipelinedRemoteWindow(("127.0.0.1", port), name)
+        try:
+            rw.deposit_async(0, arr, accumulate=True)
+            rw.flush()
+            _, fresh = win.read(0, consume=True)
+            assert fresh == 1
+        finally:
+            rw.close()
+            srv.stop()
+            win.free()
+
+
+# ---------------------------------------------------------------------------
+# 5. disabled => byte-identical jitted HLO
+# ---------------------------------------------------------------------------
+
+
+class TestHLOIdentity:
+    def _gossip_program(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from bluefog_tpu.ops.collectives import neighbor_allreduce
+        from bluefog_tpu.parallel.api import shard_map
+        from bluefog_tpu.topology import RingGraph, build_schedule
+
+        n = 8
+        mesh = Mesh(np.array(jax.devices()[:n]), ("bf",))
+        sched = build_schedule(RingGraph(n))
+        fn = shard_map(lambda v: neighbor_allreduce(v, sched, "bf"),
+                       mesh=mesh, in_specs=(P("bf"),),
+                       out_specs=P("bf"), check_vma=False)
+        x = jnp.ones((n, 4), jnp.float32)
+        jaxpr = str(jax.make_jaxpr(fn)(x))
+        hlo = jax.jit(fn).lower(x).as_text()
+        return jaxpr, hlo
+
+    def test_identical_hlo_tracing_off_and_on(self, tmp_path,
+                                              monkeypatch):
+        """The acceptance gate: arming tracing cannot change compiled
+        programs — byte-identical jaxpr AND lowered HLO, no callbacks
+        anywhere near the traced path."""
+        monkeypatch.delenv("BLUEFOG_TPU_TRACE", raising=False)
+        trc.reset()
+        off_jaxpr, off_hlo = self._gossip_program()
+        trc.configure(str(tmp_path), rank=0)
+        with trc.span("round", "dsgd", round_=0):
+            on_jaxpr, on_hlo = self._gossip_program()
+        assert off_jaxpr == on_jaxpr
+        assert off_hlo == on_hlo
+
+
+# ---------------------------------------------------------------------------
+# 6. acceptance: 3-rank tcp dsgd under server:delay chaos
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestChaosCriticalPathE2E:
+    def test_bftrace_names_the_delayed_ranks_edge(self, tmp_path):
+        """Rank 2's window server delays inbound frames; bftrace-tpu
+        must name an edge INTO rank 2 as the per-round critical path,
+        with a phase decomposition attached."""
+        barrier = tmp_path / "barrier"
+        trace_dir = tmp_path / "trace"
+        barrier.mkdir()
+        trace_dir.mkdir()
+        procs = [
+            subprocess.Popen(
+                [sys.executable,
+                 os.path.join(_REPO, "tests", "_mp_tracing_worker.py"),
+                 str(r), "3", str(barrier), str(trace_dir), "60"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=clean_env(), cwd=_REPO)
+            for r in range(3)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=180)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r}:\n{out}"
+            assert f"TRC_MP_OK {r}" in out, f"rank {r}:\n{out}"
+
+        rep = tan.analyze(str(trace_dir))
+        assert rep["ranks"] == [0, 1, 2]
+        cp = rep["critical_path"]
+        assert cp.get("gating_edge"), cp
+        assert cp["gating_edge"][1] == 2, cp
+        assert cp["phase_frac"], cp  # the decomposition is attached
+        assert 0 < cp["dominant_frac"] <= 1
+
+        # and the operator-facing CLI line says it in words
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.tracing",
+             str(trace_dir)],
+            capture_output=True, text=True, timeout=120,
+            env=clean_env(), cwd=_REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert "CRITICAL PATH" in proc.stdout, proc.stdout
+        assert "-> rank 2 —" in proc.stdout, proc.stdout
