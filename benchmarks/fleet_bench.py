@@ -1,0 +1,265 @@
+"""Fleet health plane bench: publisher overhead + detection latency.
+
+Two numbers with acceptance gates (ISSUE 12), committed as
+``BENCH_fleet.json`` — which ``bffleet-tpu --check BENCH_fleet.json``
+itself gates (every ``*_ok`` key must be true), making the committed
+trajectory the regression baseline:
+
+1. **Publisher overhead** — the per-publish cost of
+   :class:`bluefog_tpu.fleet.TelemetryPublisher` (record assembly:
+   metrics-family deltas over a realistically sized registry, blackbox
+   event counts over a populated ring, ``/proc`` host sample, round
+   stats, canonical JSON, one buffered append) measured in-process over
+   many publishes, expressed as a fraction of the MEASURED median
+   transport round of a live 3-rank tcp dsgd fleet (from the same
+   run's own telemetry).  Gate: <= 1% of a round.
+
+2. **Detection latency** — a 3-rank tcp dsgd fleet where rank 2's
+   window server runs behind seeded chaos
+   (``server:delay:ms=150:rate=1.0`` — a deterministic straggler, live
+   from round 0).  The run's telemetry replays through the DEFAULT SLO
+   set; the gates: the straggler WARN names rank 2, lands within <= 5
+   rounds of injection, the ``--check`` exit is nonzero — and the
+   chaos-free twin's exit is 0.  The EXACT push-sum mass audit must
+   hold in every run (the publisher reads, never moves, mass).
+
+Run: ``python benchmarks/fleet_bench.py [--steps N] [--out FILE]``
+(rc=0 off-TPU; workers are pure numpy — no jax in the hot loop).
+Committed results: ``BENCH_fleet.json``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+N_RANKS = 3
+SLOW_RANK = 2
+CHAOS_SPEC = "server:delay:ms=150:rate=1.0:seed=1"
+# ~50 ms rounds: decisively separated from healthy localhost ack
+# latency, and the 150 ms chaos delay lands inside the first few
+# rounds' EWMAs (detection measured in rounds, not EWMA warm-up)
+SKEW_S = 0.05
+
+
+def _worker(rank: int, barrier_dir: str, variant: str, steps: int) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if variant == "chaos" and rank == SLOW_RANK:
+        os.environ["BLUEFOG_TPU_CHAOS"] = CHAOS_SPEC
+
+    import numpy as np
+
+    from bluefog_tpu.fleet import FleetConfig
+    from bluefog_tpu.runtime.async_windows import (FileBarrier,
+                                                   run_async_dsgd_rank)
+    from bluefog_tpu.topology import FullyConnectedGraph
+
+    def loss_and_grad(r, step, params):
+        return 0.0, {"w": np.zeros_like(np.asarray(params["w"]))}
+
+    rep = run_async_dsgd_rank(
+        FullyConnectedGraph(N_RANKS), rank,
+        {"w": np.arange(64.0, dtype=np.float64)}, loss_and_grad,
+        barrier=FileBarrier(barrier_dir, N_RANKS, rank),
+        duration_s=90.0, skew_s=SKEW_S,
+        name=f"fleet_bench_{os.path.basename(barrier_dir)}",
+        transport="tcp", tcp_bind="127.0.0.1",
+        stop_after_steps=steps,
+        fleet=FleetConfig(every=1))
+    if rank == 0:
+        out = {"wall_s": rep.wall_time_s, "total_mass": rep.total_mass,
+               "steps_per_rank": rep.steps_per_rank}
+        print("BENCH_RESULT " + json.dumps(out), flush=True)
+
+
+def _run_variant(variant: str, steps: int) -> dict:
+    bdir = tempfile.mkdtemp(prefix=f"bf-fleetbench-{variant}-")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         str(r), bdir, variant, str(steps)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=repo) for r in range(N_RANKS)]
+    outs = []
+    deadline = time.time() + 150
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(5.0,
+                                               deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise SystemExit(f"{variant} trial timed out")
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise SystemExit(
+                f"{variant} worker {r} failed (rc={p.returncode}):\n{out}")
+    for line in outs[0].splitlines():
+        if line.startswith("BENCH_RESULT "):
+            res = json.loads(line[len("BENCH_RESULT "):])
+            res["dir"] = bdir
+            return res
+    raise SystemExit(f"{variant} rank 0 produced no result:\n{outs[0]}")
+
+
+def _measure_publish_cost(n_publishes: int = 400) -> dict:
+    """In-process micro-benchmark of one publish under realistic load:
+    a registry with dozens of live series, a blackbox ring carrying
+    fresh events between publishes, a 2-peer phase map, and real
+    ``/proc`` sampling + file append."""
+    from bluefog_tpu.blackbox import recorder as bb
+    from bluefog_tpu.fleet import TelemetryPublisher
+    from bluefog_tpu.metrics import registry as mreg
+
+    reg = mreg.metrics_start()
+    rec = bb.configure(rank=0)
+    for i in range(24):  # a realistically populated registry
+        reg.counter(f"bf_bench_fam{i}_total").inc(1.0, peer="1")
+        reg.counter(f"bf_bench_fam{i}_total").inc(2.0, peer="2")
+        reg.gauge(f"bf_bench_g{i}").set(float(i))
+    with tempfile.TemporaryDirectory() as d:
+        pub = TelemetryPublisher(0, d, every=1)
+        peers = {1: {"lag": 0.004, "net": 0.003, "queue": 0.0005,
+                     "apply": 0.0005},
+                 2: {"lag": 0.005}}
+        times = []
+        for i in range(n_publishes):
+            # fresh per-window activity, as a live round produces
+            reg.counter("bf_bench_fam0_total").inc(1.0, peer="1")
+            rec.record("tcp_batch_deposit", peer=1, batch=i)
+            rec.record("window_read", slot=0)
+            pub.note_round(0.05)
+            t0 = time.perf_counter()
+            pub.publish(i, mass=0.5, z_mean=31.5, dis=0.01,
+                        peers=peers)
+            times.append(time.perf_counter() - t0)
+        pub.close()
+        size = os.path.getsize(os.path.join(d, "fleet.0"))
+    mreg.metrics_stop()
+    bb.reset()
+    times.sort()
+    return {
+        "publishes": n_publishes,
+        "publish_mean_s": sum(times) / len(times),
+        "publish_p50_s": times[len(times) // 2],
+        "publish_p99_s": times[int(len(times) * 0.99) - 1],
+        "record_bytes_mean": size / n_publishes,
+    }
+
+
+def _round_time_from_telemetry(dirpath: str) -> float:
+    """Median per-round wall time over every rank's records — the
+    denominator of the overhead fraction, measured from the SAME fleet
+    the publisher ran in."""
+    from bluefog_tpu.fleet import FleetView
+
+    view = FleetView.load_dir(dirpath)
+    means = []
+    for r in view.ranks():
+        for rec in view._recs[r].values():
+            if rec.round_s.get("count", 0) > 0:
+                means.append(rec.round_s["mean"])
+    if not means:
+        raise SystemExit(f"no round stats in {dirpath}")
+    return statistics.median(means)
+
+
+def main(argv=None) -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker(int(sys.argv[2]), sys.argv[3], sys.argv[4],
+                int(sys.argv[5]))
+        return 0
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=50,
+                    help="step target per rank (default 50)")
+    ap.add_argument("--out", default=None,
+                    help="write JSON here (default: print only)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # run as a script: sys.path has benchmarks/, not the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bluefog_tpu.fleet import SLOEngine, FleetView, default_specs
+    from bluefog_tpu.fleet.dash import main as fleet_cli
+
+    # ---- clean fleet: overhead denominator + the clean gate ----
+    clean = _run_variant("clean", args.steps)
+    round_s = _round_time_from_telemetry(clean["dir"])
+    cost = _measure_publish_cost()
+    overhead = cost["publish_mean_s"] / round_s
+    clean_exit = fleet_cli(["--check", clean["dir"]])
+    print(f"clean: wall={clean['wall_s']:.2f}s "
+          f"mass={clean['total_mass']:.12f} round_p50={round_s*1e3:.1f}ms "
+          f"publish_mean={cost['publish_mean_s']*1e6:.0f}us "
+          f"overhead={overhead*100:.3f}% check_exit={clean_exit}")
+
+    # ---- chaos fleet: detection latency + the breach gate ----
+    chaos = _run_variant("chaos", args.steps)
+    view = FleetView.load_dir(chaos["dir"])
+    engine = SLOEngine(default_specs())
+    engine.advance(view)
+    warns = [t for t in engine.transitions
+             if t.slo == "straggler" and t.to >= 1]
+    detection_rounds = warns[0].round if warns else None
+    named_rank = warns[0].rank if warns else None
+    breach_exit = fleet_cli(["--check", chaos["dir"]])
+    print(f"chaos: wall={chaos['wall_s']:.2f}s "
+          f"mass={chaos['total_mass']:.12f} "
+          f"first_warn_round={detection_rounds} named={named_rank} "
+          f"check_exit={breach_exit}")
+
+    mass_ok = all(abs(v["total_mass"] - N_RANKS) <= 1e-9 * N_RANKS
+                  for v in (clean, chaos))
+    result = {
+        "scenario": {
+            "ranks": N_RANKS, "slow_rank": SLOW_RANK,
+            "chaos": CHAOS_SPEC, "skew_s": SKEW_S,
+            "steps": args.steps,
+            "workload": ("zero-grad push-sum averaging, d=64 f64, tcp "
+                         "localhost, fleet publisher every round"),
+        },
+        "publisher": cost,
+        "round_median_s": round_s,
+        "publisher_overhead_frac": overhead,
+        "overhead_target_frac": 0.01,
+        "overhead_ok": overhead <= 0.01,
+        "detection_first_warn_round": detection_rounds,
+        "detection_target_rounds": 5,
+        "detection_ok": (detection_rounds is not None
+                         and detection_rounds <= 5),
+        "named_rank": named_rank,
+        "named_ok": named_rank == SLOW_RANK,
+        "breach_check_exit": breach_exit,
+        "breach_gate_ok": breach_exit != 0,
+        "clean_check_exit": clean_exit,
+        "clean_gate_ok": clean_exit == 0,
+        "clean_run": {k: clean[k] for k in
+                      ("wall_s", "total_mass", "steps_per_rank")},
+        "chaos_run": {k: chaos[k] for k in
+                      ("wall_s", "total_mass", "steps_per_rank")},
+        "mass_exact_ok": mass_ok,
+    }
+    for v in (clean, chaos):
+        shutil.rmtree(v.pop("dir"), ignore_errors=True)
+    gates = [k for k, v in result.items()
+             if k.endswith("_ok") and not v]
+    print(f"\ngates: {'ALL OK' if not gates else 'FAIL ' + str(gates)}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {args.out}")
+    return 0 if not gates else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""BF-FLT lint: every alert/SLO threshold carries hysteresis + a window.
+
+The fleet health plane's no-flap argument (:mod:`bluefog_tpu.fleet.slo`,
+docs/fleet.md) is the :class:`~bluefog_tpu.control.ControlConfig`
+discipline restated for alerts: the condition that RAISES an alert must
+be strictly stronger than the one that CLEARS it (an enter/exit pair),
+and every evaluation must be windowed (a single bad rollup must never
+page anybody).  A spec site that spells a bare threshold — one
+``*_enter`` with no ``*_exit`` twin, no declared ``window``, or a
+single ``threshold=`` knob — is an alert that WILL flap the moment
+telemetry oscillates around it, which is how alert fatigue is built.
+
+The rule (AST source lint, the BF-CTL001/BF-RES002 family):
+
+- a **spec site** is a call whose callee name mentions ``slo`` or
+  ``alert`` (``SLOSpec``, ``AlertRule``, ``make_slo``, ...) — the
+  constructors through which thresholds enter the system;
+- at a spec site, every keyword ``X_enter`` (or bare ``enter``)
+  requires its ``X_exit`` (``exit``) twin among the keywords, and at
+  least one enter-style keyword requires a ``window`` keyword;
+- a keyword named ``threshold`` at a spec site is a bare threshold by
+  construction — there is no spelling of it with hysteresis;
+- spec sites that pass their config positionally or via ``**kwargs``
+  are left to the runtime validators (:class:`~bluefog_tpu.fleet.slo.
+  SLOSpec.__post_init__` enforces the same pairs loudly).
+
+**BF-FLT001** (error): an alert/SLO threshold without its hysteresis
+twin or declared window.  **BF-FLT100** (info): scan summary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_slo_specs", "check_file"]
+
+_SPEC_CALL_RE = re.compile(r"(?i)(slo|alert)")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def check_slo_specs(source: str, *, filename: str = "<source>"
+                    ) -> List[Diagnostic]:
+    """BF-FLT001: every alert/SLO spec site must pair its thresholds
+    and declare a window."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-FLT003",
+            f"could not parse {filename}: {e}",
+            pass_name="fleet-lint", subject=filename)]
+    short = os.path.basename(filename)
+    diags: List[Diagnostic] = []
+
+    def err(line: int, msg: str) -> None:
+        diags.append(Diagnostic(
+            "error", "BF-FLT001", msg, pass_name="fleet-lint",
+            subject=f"{short}:{line}"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if not name or not _SPEC_CALL_RE.search(name):
+            continue
+        kwargs = {kw.arg for kw in node.keywords if kw.arg is not None}
+        if not kwargs:
+            continue  # positional/**kwargs form: runtime validation owns it
+        enters = sorted(k for k in kwargs
+                        if k == "enter" or k.endswith("_enter"))
+        if "threshold" in kwargs:
+            err(node.lineno,
+                f"alert/SLO spec {name!r} at {short}:{node.lineno} "
+                "declares a bare `threshold=` — a single threshold "
+                "flaps the moment telemetry oscillates around it; "
+                "declare an enter/exit hysteresis pair (exit strictly "
+                "below enter) and a window instead")
+            continue
+        for k in enters:
+            twin = "exit" if k == "enter" else k[:-len("enter")] + "exit"
+            if twin not in kwargs:
+                err(node.lineno,
+                    f"alert/SLO spec {name!r} at {short}:{node.lineno} "
+                    f"declares `{k}=` without its `{twin}=` hysteresis "
+                    "twin — the condition that raises an alert must be "
+                    "strictly stronger than the one that clears it "
+                    "(the ControlConfig discipline)")
+        if enters and "window" not in kwargs:
+            err(node.lineno,
+                f"alert/SLO spec {name!r} at {short}:{node.lineno} "
+                "declares thresholds with no `window=` — every alert "
+                "evaluation must be windowed (burn rate over a window, "
+                "never a single rollup)")
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return [Diagnostic(
+            "warning", "BF-FLT003", f"could not read {path}: {e}",
+            pass_name="fleet-lint", subject=os.path.basename(path))]
+    return check_slo_specs(src, filename=path)

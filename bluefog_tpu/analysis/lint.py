@@ -435,6 +435,37 @@ def control_pass(report: LintReport, size: int) -> None:
         pass_name="control-lint", subject="control"))
 
 
+def fleet_pass(report: LintReport, size: int) -> None:
+    """BF-FLT source lint over the surfaces that declare alert/SLO
+    thresholds: the fleet plane itself, the runtime loops it wires
+    into, and every example/benchmark that could copy the shape.  A
+    threshold without its hysteresis twin or a declared window is an
+    error — see :mod:`bluefog_tpu.analysis.fleet_lint`."""
+    import glob
+
+    from bluefog_tpu.analysis.fleet_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "fleet", "*.py")))
+    targets += sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "runtime", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-FLT100",
+        f"fleet-lint scanned {n} file(s) for unpaired alert/SLO "
+        "thresholds",
+        pass_name="fleet-lint", subject="fleet"))
+
+
 def concurrency_pass(report: LintReport, size: int) -> None:
     """Pass 8 — BF-CONC: the whole-package concurrency model.  Builds
     the lock-order graph over every lock in ``bluefog_tpu/`` (cycle
@@ -671,6 +702,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     serving_pass(report, size)
     control_pass(report, size)
     tracing_pass(report, size)
+    fleet_pass(report, size)
     concurrency_pass(report, size)
     doc_pass(report, size)
     examples_pass(report, size)

@@ -208,6 +208,36 @@ class FlightRecorder:
     def events(self) -> List[dict]:
         return self._snapshot(lambda: [dict(e) for e in self._events])
 
+    def counts_since(self, seq: int) -> Tuple[int, Dict[str, int]]:
+        """``(newest seq, {kind: count})`` over ring events with
+        ``seq`` strictly above the given watermark — the fleet
+        publisher's cheap periodic sample: one lock-held counting pass
+        over the deque, never a per-event dict copy (a 4096-event ring
+        copy per round would be the publisher's whole overhead budget).
+        Evicted events are simply absent, exactly as a dump would show
+        them."""
+        def pull():
+            counts: Dict[str, int] = {}
+            last = int(seq)
+            # newest-first with early stop: the publisher calls this
+            # every round, and scanning the full 4096-slot ring per
+            # call would dominate its overhead budget — seqs are
+            # monotone, so the first already-seen event ends the walk
+            for ev in reversed(self._events):
+                s = ev["seq"]
+                if s <= seq:
+                    break
+                counts[ev["kind"]] = counts.get(ev["kind"], 0) + 1
+                if s > last:
+                    last = s
+            return last, counts
+
+        got = self._snapshot(pull)
+        # _snapshot's unlocked-retry fallback returns [] when every
+        # retry raced a mutation; report "nothing consumed" so the next
+        # window recounts instead of skipping events
+        return got if got else (int(seq), {})
+
     def open_spans(self) -> List[dict]:
         return self._snapshot(
             lambda: [dict(e) for e in self._open.values()])

@@ -35,6 +35,7 @@ from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.control.evidence import Evidence, canonicalize
 from bluefog_tpu.control.plan import CODEC_LADDER, CommPlan, ControlConfig
 from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.metrics.registry import median as _reg_median
 from bluefog_tpu.topology.graphs import Topology, replan_penalized
 
 # the resilience health-state values SUSPECT/DEAD, spelled locally so
@@ -48,13 +49,12 @@ __all__ = ["CommController", "decide_plan", "plan_topology"]
 
 
 def _median(vals: Sequence[float]) -> float:
-    s = sorted(vals)
-    n = len(s)
-    if n == 0:
+    # the shared interpolating median, with this module's empty-input
+    # convention preserved (0.0, not NaN: an empty lag table must read
+    # as "no lag evidence", never poison the threshold arithmetic)
+    if not vals:
         return 0.0
-    if n % 2:
-        return s[n // 2]
-    return 0.5 * (s[n // 2 - 1] + s[n // 2])
+    return _reg_median(vals)
 
 
 def _peer_lag(evidences: Sequence[Evidence]) -> Dict[int, float]:
@@ -270,6 +270,7 @@ class CommController:
         self.plan_changes = 0
         self._lag: Dict[int, float] = {}
         self._states: Dict[int, int] = {}
+        self._alerts: Dict[int, int] = {}  # externally-asserted states
         self._phase: Dict[int, Dict[str, float]] = {}
         self._recon_seen: Dict[int, int] = {}   # lifetime counts per peer
         self._recon_delta: Dict[int, int] = {}  # since last evidence()
@@ -308,6 +309,25 @@ class CommController:
                                         + int(reconnects_total - seen))
                 self._recon_seen[j] = int(reconnects_total)
 
+    def note_alert(self, peer: int, *, suspect: bool = True) -> None:
+        """Fold an EXTERNAL alert about ``peer`` into the states
+        evidence channel — the fleet SLO engine's straggler/silent
+        WARN naming a rank (:meth:`bluefog_tpu.fleet.SLOEngine.
+        suspect_ranks`) is consumable by the controller exactly like a
+        transport health state: while the alert stands, this rank's
+        evidence records hold the peer SUSPECT (merged as max with the
+        transport state, never downgrading it), and a majority of
+        alerting reporters is slow-set entry evidence in its own right.
+        ``suspect=False`` RETRACTS the assertion (the alert cleared);
+        retraction is explicit because alerts carry their own
+        hysteresis — the evidence channel must not decay what the SLO
+        engine still asserts."""
+        j = int(peer)
+        if suspect:
+            self._alerts[j] = _ST_SUSPECT
+        else:
+            self._alerts.pop(j, None)
+
     def forget_peer(self, peer: int) -> None:
         """Drop every sticky observation about ``peer`` — owed whenever
         the peer leaves this rank's observation surface (it died, it
@@ -319,6 +339,7 @@ class CommController:
         j = int(peer)
         self._lag.pop(j, None)
         self._states.pop(j, None)
+        self._alerts.pop(j, None)
         self._phase.pop(j, None)
         self._recon_delta.pop(j, None)
         self._recon_seen.pop(j, None)
@@ -329,7 +350,16 @@ class CommController:
         keep = {int(j) for j in peers}
         for j in (set(self._lag) | set(self._states) | set(self._phase)
                   | set(self._recon_seen)) - keep:
+            alert = self._alerts.get(j)
             self.forget_peer(j)
+            if alert is not None:
+                # an externally-asserted alert (note_alert) outlives the
+                # observation surface: a fleet SLO can name a rank this
+                # rank no longer touches, and only the asserter's
+                # explicit retraction — or the peer's death/leave via a
+                # DIRECT forget_peer — releases it (alerts carry their
+                # own hysteresis; the surface sweep must not decay them)
+                self._alerts[j] = alert
 
     def note_disagreement(self, value: float) -> None:
         """This round's local disagreement (||z_in - z_self|| over the
@@ -362,8 +392,13 @@ class CommController:
                 and self._dis_prev_window is not None
                 and self._dis_prev_window > 0):
             growth = self._dis_now / self._dis_prev_window
+        states = dict(self._states)
+        for j, st in self._alerts.items():
+            # merged as MAX: an alert can raise a peer to SUSPECT but
+            # never downgrade what the transport itself observed
+            states[j] = max(states.get(j, 0), st)
         ev = Evidence(rank=self.rank, round=int(round_),
-                      lag_s=dict(self._lag), states=dict(self._states),
+                      lag_s=dict(self._lag), states=states,
                       reconnects=dict(self._recon_delta),
                       mixing_excess=self._mixing_excess,
                       consensus_growth=growth,

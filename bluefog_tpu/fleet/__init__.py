@@ -1,0 +1,46 @@
+"""Fleet health plane: streaming telemetry, SLOs, and the regression gate.
+
+The sixth observability leg — the one that unifies the other five into
+a single LIVE, queryable fleet view with teeth:
+
+- :mod:`bluefog_tpu.fleet.record` — the per-rank telemetry publisher:
+  a cheap round-stamped record (metrics deltas, blackbox event counts,
+  per-peer lag/phase EWMAs, ``/proc`` host gauges, round-time stats)
+  appended coordinator-free to ``fleet.<rank>`` in the shared barrier
+  directory, with an optional live push over the serving machinery;
+- :mod:`bluefog_tpu.fleet.view` — :class:`FleetView`, the round-aligned
+  aggregator tolerant of torn/late/missing/duplicate records, and its
+  :class:`FleetRollup` fleet statistics;
+- :mod:`bluefog_tpu.fleet.slo` — the declarative SLO engine:
+  ``(signal, enter/exit hysteresis pair, window, burn rate)`` specs
+  driving an OK→WARN→PAGE alert state machine that emits blackbox
+  events and ``bf_slo_*`` metrics, with rank attribution;
+- :mod:`bluefog_tpu.fleet.wiring` — :class:`FleetConfig` /
+  :class:`FleetRuntime`, the ``fleet=`` knob on the async dsgd runners
+  (publisher wiring + alert-as-evidence feedback into the control
+  plane);
+- :mod:`bluefog_tpu.fleet.dash` — the ``bffleet-tpu`` CLI: live
+  refreshing dashboard and the ``--check`` replay/regression gate.
+
+See ``docs/fleet.md`` for the record schema, rollup definitions, SLO
+grammar, and exit codes.
+"""
+
+from bluefog_tpu.fleet.record import (FleetRecord, TelemetryPublisher,
+                                      decode_record_leaves,
+                                      encode_record_leaves, record_path,
+                                      sample_host)
+from bluefog_tpu.fleet.slo import (OK, PAGE, STATE_NAMES, WARN, SLOEngine,
+                                   SLOSpec, Transition, default_specs,
+                                   load_specs, specs_to_json)
+from bluefog_tpu.fleet.view import FleetRollup, FleetView
+from bluefog_tpu.fleet.wiring import FleetConfig, FleetRuntime
+
+__all__ = [
+    "OK", "WARN", "PAGE", "STATE_NAMES",
+    "FleetConfig", "FleetRecord", "FleetRollup", "FleetRuntime",
+    "FleetView", "SLOEngine", "SLOSpec", "TelemetryPublisher",
+    "Transition", "decode_record_leaves", "default_specs",
+    "encode_record_leaves", "load_specs", "record_path", "sample_host",
+    "specs_to_json",
+]

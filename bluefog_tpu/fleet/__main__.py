@@ -1,0 +1,8 @@
+"""``python -m bluefog_tpu.fleet`` — the ``bffleet-tpu`` CLI."""
+
+import sys
+
+from bluefog_tpu.fleet.dash import main
+
+if __name__ == "__main__":
+    sys.exit(main())

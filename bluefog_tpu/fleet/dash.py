@@ -1,0 +1,223 @@
+"""``bffleet-tpu``: the live fleet dashboard and the regression gate.
+
+Two modes over one record directory:
+
+**Live dash** (default) — refreshes a per-rank table (newest round,
+round-time p50/p99, push-sum mass, consensus shadow, host RSS/threads,
+round lag) plus the SLO alert lines, by incrementally tailing the
+``fleet.<rank>`` files::
+
+    bffleet-tpu /path/to/barrier-dir            # refresh until Ctrl-C
+    bffleet-tpu /path/to/barrier-dir --once     # one frame (scripts)
+
+**Check / replay** (``--check``) — the automated regression gate: replay
+a finished (or still-running) run's telemetry through the SLO engine in
+round order and exit nonzero when any alert was EVER raised (a breach
+that later cleared still fails the gate — the run was out of SLO)::
+
+    bffleet-tpu --check /path/to/barrier-dir [--spec slos.json]
+    bffleet-tpu --check BENCH_fleet.json
+
+A ``.json`` FILE as the path flips the gate to **bench mode**: every
+boolean key named ``ok`` or ending in ``_ok`` anywhere in the committed
+bench file must be true — the convention ``benchmarks/fleet_bench.py``
+writes, making the committed BENCH trajectory itself checkable.
+
+Exit codes (the CI contract, see ``docs/fleet.md``):
+
+====  =======================================================
+0     within SLO (or bench gates all true)
+2     could not load records / spec / bench file, or no records
+3     WARN was reached (or a bench gate is false)
+4     PAGE was reached
+====  =======================================================
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import List, Optional
+
+from bluefog_tpu.fleet.slo import (STATE_NAMES, SLOEngine, default_specs,
+                                   load_specs)
+from bluefog_tpu.fleet.view import FleetView
+
+__all__ = ["main", "bench_gate_failures", "run_check"]
+
+
+def _fmt(v: float, scale: float = 1.0, unit: str = "") -> str:
+    if v is None or (isinstance(v, float) and not math.isfinite(v)):
+        return "-"
+    v = v * scale
+    if abs(v) >= 1e5 or (v and abs(v) < 1e-2):
+        return f"{v:.2e}{unit}"
+    return f"{v:.3g}{unit}"
+
+
+def render(view: FleetView, engine: Optional[SLOEngine]) -> str:
+    """One dashboard frame: per-rank rows + alert lines."""
+    head = view.head_round()
+    if head is None:
+        return "(no fleet records yet)"
+    ru = view.rollup(head)
+    rows = [("rank", "round", "lag", "round p50", "round p99", "mass",
+             "z_mean", "rss", "thr")]
+    for r in ru.reporters:
+        info = ru.per_rank[r]
+        rows.append((
+            str(r), str(int(info["round"])), str(int(info["lag"])),
+            _fmt(info["round_p50"], 1e3, "ms"),
+            _fmt(info["round_p99"], 1e3, "ms"),
+            _fmt(info["mass"]), _fmt(info["z_mean"]),
+            _fmt(info["rss"], 1.0 / (1 << 20), "M"),
+            _fmt(info["threads"])))
+    widths = [max(len(row[i]) for row in rows)
+              for i in range(len(rows[0]))]
+    lines = [f"fleet @ round {head}: {len(ru.reporters)} rank(s), "
+             f"spread={_fmt(ru.consensus_spread)} "
+             f"mass={_fmt(ru.mass_total)}"
+             + (f" torn={view.torn}" if view.torn else "")]
+    for i, row in enumerate(rows):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    if ru.peer_lag:
+        lines.append("peer lag (median over reporters): " + "  ".join(
+            f"{j}:{_fmt(v, 1e3, 'ms')}"
+            for j, v in sorted(ru.peer_lag.items())))
+    if engine is not None:
+        for name, (state, rank) in sorted(engine.states().items()):
+            flag = STATE_NAMES[state]
+            who = f" rank {rank}" if rank is not None else ""
+            lines.append(f"slo {name}: {flag}{who}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- check
+def bench_gate_failures(doc, path: str = "") -> List[str]:
+    """Every false gate in a committed bench file: boolean keys named
+    ``ok`` or ending ``_ok``, recursively.  Returns their JSON paths."""
+    bad: List[str] = []
+    if isinstance(doc, dict):
+        for k, v in sorted(doc.items()):
+            sub = f"{path}.{k}" if path else str(k)
+            if isinstance(v, bool) and (k == "ok" or k.endswith("_ok")):
+                if not v:
+                    bad.append(sub)
+            else:
+                bad.extend(bench_gate_failures(v, sub))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            bad.extend(bench_gate_failures(v, f"{path}[{i}]"))
+    return bad
+
+
+def run_check(path: str, specs, *, out=sys.stdout) -> int:
+    """Replay a record directory against the SLO specs; returns the
+    exit code (the worst state ever reached maps 0/3/4)."""
+    view = FleetView.load_dir(path)
+    if not view.ranks():
+        print(f"bffleet-tpu: no fleet records under {path}",
+              file=sys.stderr)
+        return 2
+    engine = SLOEngine(specs)
+    engine.advance(view)
+    head = view.head_round()
+    print(f"{path}: ranks={view.ranks()} rounds={len(view.rounds())} "
+          f"head={head}"
+          + (f" torn={view.torn}" if view.torn else ""), file=out)
+    for tr in engine.transitions:
+        print("  " + tr.describe(), file=out)
+    for name, (state, rank) in sorted(engine.states().items()):
+        who = f" (rank {rank})" if rank is not None else ""
+        print(f"  final {name}: {STATE_NAMES[state]}{who}", file=out)
+    verdict = {0: "within SLO", 1: "WARN reached", 2: "PAGE reached"}
+    print(f"verdict: {verdict[engine.worst]}", file=out)
+    return {0: 0, 1: 3, 2: 4}[engine.worst]
+
+
+# -------------------------------------------------------------------- main
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bffleet-tpu",
+        description="Live fleet health dashboard over fleet.<rank> "
+                    "telemetry records, and the --check SLO regression "
+                    "gate (exit 0 within SLO, 3 on WARN, 4 on PAGE, 2 "
+                    "on load errors).")
+    ap.add_argument("path", help="record directory (the run's barrier "
+                    "dir), or with --check a committed BENCH_*.json "
+                    "whose *_ok gates must all be true")
+    ap.add_argument("--check", action="store_true",
+                    help="replay mode: evaluate the SLOs over the whole "
+                    "record history and exit by the worst state reached")
+    ap.add_argument("--spec", default=None, metavar="FILE",
+                    help='SLO spec JSON ({"slos": [...]}; default: the '
+                    "built-in workload-independent set)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live-mode refresh seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one live frame and exit (scripts/tests)")
+    args = ap.parse_args(argv)
+
+    try:
+        specs = (load_specs(args.spec) if args.spec else default_specs())
+    except (OSError, ValueError, TypeError, KeyError) as e:
+        print(f"bffleet-tpu: bad SLO spec: {e}", file=sys.stderr)
+        return 2
+
+    if args.check and os.path.isfile(args.path):
+        # bench-gate mode: the committed-trajectory regression check
+        try:
+            with open(args.path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bffleet-tpu: cannot load bench file: {e}",
+                  file=sys.stderr)
+            return 2
+        bad = bench_gate_failures(doc)
+        if bad:
+            for key in bad:
+                print(f"GATE FAIL {args.path}: {key} is false")
+            return 3
+        print(f"{args.path}: all bench gates true")
+        return 0
+
+    if not os.path.isdir(args.path):
+        print(f"bffleet-tpu: {args.path} is not a directory "
+              "(or, with --check, a .json bench file)", file=sys.stderr)
+        return 2
+
+    if args.check:
+        return run_check(args.path, specs)
+
+    # ------------------------------------------------------------- live
+    view = FleetView()
+    engine = SLOEngine(specs)
+    keep = 4 * max(s.window for s in specs) + 64
+    try:
+        while True:
+            view.tail_dir(args.path)
+            engine.advance(view)
+            head = view.head_round()
+            if head is not None:
+                # bounded retention: a dash watching a week-long run
+                # must not hold (or rescan) the whole history
+                view.prune_before(head - keep)
+            frame = render(view, engine)
+            if sys.stdout.isatty() and not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(frame, flush=True)
+            if args.once:
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

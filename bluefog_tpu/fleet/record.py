@@ -1,0 +1,413 @@
+"""Per-rank fleet telemetry: the record one rank publishes per round.
+
+The five earlier observability legs are per-rank artifacts merged
+OFFLINE — a metrics JSONL, a blackbox dump, a trace file — read after
+something already went wrong.  The fleet health plane's first half is a
+cheap, periodic, ROUND-STAMPED record of everything those legs know
+locally, published coordinator-free while the run is alive:
+
+- **metrics-registry deltas** — counter families since the last publish
+  (the live twin of the JSONL dash);
+- **blackbox event counts** — ring-event kinds since the last publish
+  (:meth:`~bluefog_tpu.blackbox.recorder.FlightRecorder.counts_since`,
+  a lock-held count pass, never a ring copy);
+- **per-peer lag + wire-phase EWMAs** — the transport's ack EWMA and,
+  when tracing negotiated, its ``{net, queue, apply}`` decomposition
+  (the control plane's slow-link-vs-slow-host evidence, now visible
+  fleet-wide);
+- **host gauges** — RSS / CPU seconds / thread count sampled straight
+  from ``/proc`` (no psutil), also exported as ``bf_host_*`` metrics;
+- **round-time stats** — p50/p99/mean/max of this rank's round wall
+  times since the last publish (fed by the loops' ``bf_round_seconds``
+  histogram wiring).
+
+Dissemination is the ``ctlev.<rank>`` barrier-dir discipline (PR 8)
+extended to a HISTORY: each rank appends one canonical-JSON line per
+publish to its own ``fleet.<rank>`` file in the shared directory.  One
+writer per file, so a record can tear only at a crash — and the reader
+(:class:`bluefog_tpu.fleet.view.FleetView`) tolerates torn tails
+exactly like the blackbox/tracing merges.  Records SELF-IDENTIFY
+(``rank`` and ``round`` live in the record, the filename is only a
+discovery hint), which is what makes misattribution structurally
+impossible — the damage fuzzer in ``tests/test_fleet.py`` asserts it.
+
+An optional live push rides the serving machinery: ``serve=True``
+additionally publishes each record into the process-global
+:class:`~bluefog_tpu.serving.snapshots.SnapshotTable` under group
+``bf_fleet:<rank>`` (the JSON bytes bit-packed into an f64 leaf — see
+:func:`encode_record_leaves`), so any SUBSCRIBE reader can stream the
+telemetry off-host with no new wire op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.metrics import registry as _reg
+
+__all__ = [
+    "FleetRecord",
+    "TelemetryPublisher",
+    "decode_record_leaves",
+    "encode_record_leaves",
+    "record_path",
+    "sample_host",
+]
+
+_PREFIX = "fleet"
+#: record-format version (readers skip records from the future loudly)
+RECORD_VERSION = 1
+#: cap on metric families a record carries (the record must stay a cheap
+#: line, not a full registry dump; families are kept sorted by name so
+#: the cut is deterministic)
+MAX_METRIC_FAMILIES = 24
+#: minimum seconds between /proc samples: procfs opens cost hundreds of
+#: microseconds on virtualized kernels — at a per-round publish cadence
+#: they would be most of the publisher's overhead budget, and RSS/CPU/
+#: thread gauges do not change meaningfully inside a round anyway
+HOST_SAMPLE_MIN_S = 1.0
+
+
+def record_path(dirpath: str, rank: int) -> str:
+    """``<dirpath>/fleet.<rank>`` — one JSONL history per rank."""
+    return os.path.join(dirpath, f"{_PREFIX}.{int(rank)}")
+
+
+def _num(x: float):
+    """JSON-safe number: NaN/inf -> null (the Evidence discipline)."""
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+def _opt(x):
+    if x is None:
+        return float("nan")
+    return float(x)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetRecord:
+    """One rank's round-stamped telemetry line (canonical JSON).
+
+    ``round_s`` carries window stats (count/mean/p50/p99/max of round
+    wall seconds since the previous publish); ``peers`` maps peer rank
+    -> ``{"lag": s[, "net": s, "queue": s, "apply": s]}`` (transport
+    ack EWMA, thread-mode staleness age, plus the traced phase split
+    when available); ``events`` maps blackbox event kind -> count since
+    the previous publish; ``host`` carries ``rss_bytes`` / ``cpu_s`` /
+    ``threads`` from ``/proc``; ``metrics`` maps counter-family name ->
+    delta since the previous publish (labels aggregated away).
+    ``mass`` is the local push-sum weight ``p`` at the publish point
+    (post-split — the fleet SUM is a drift detector over many rounds,
+    not an instantaneous audit: in-flight window mass is not in it);
+    ``z_mean`` is the mean of the de-biased iterate (a 1-D shadow of
+    consensus, comparable across ranks at the same round); ``dis`` is
+    the round's local disagreement (NaN when not measured);
+    ``staleness`` is rounds since the last serving snapshot publish
+    (None when serving is off)."""
+
+    rank: int
+    round: int
+    t: float
+    round_s: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    mass: float = float("nan")
+    z_mean: float = float("nan")
+    dis: float = float("nan")
+    staleness: Optional[int] = None
+    peers: Mapping[int, Mapping[str, float]] = dataclasses.field(
+        default_factory=dict)
+    events: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    host: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    metrics: Mapping[str, float] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "round_s",
+                           {str(k): float(v)
+                            for k, v in (self.round_s or {}).items()})
+        object.__setattr__(
+            self, "peers",
+            {int(j): {str(k): float(v) for k, v in (m or {}).items()
+                      if math.isfinite(float(v))}
+             for j, m in (self.peers or {}).items()})
+        object.__setattr__(self, "events",
+                           {str(k): int(v)
+                            for k, v in (self.events or {}).items()})
+        object.__setattr__(self, "host",
+                           {str(k): float(v)
+                            for k, v in (self.host or {}).items()})
+        object.__setattr__(self, "metrics",
+                           {str(k): float(v)
+                            for k, v in (self.metrics or {}).items()})
+
+    def to_json(self) -> str:
+        """Canonical encoding: sorted keys, NaN spelled ``null`` — two
+        publishers holding the same observations produce identical
+        bytes (the Evidence discipline), and every consumer parses one
+        spelling."""
+        return json.dumps(
+            {"v": RECORD_VERSION, "rank": int(self.rank),
+             "round": int(self.round), "t": float(self.t),
+             "round_s": {k: _num(v)
+                         for k, v in sorted(self.round_s.items())},
+             "mass": _num(self.mass), "z_mean": _num(self.z_mean),
+             "dis": _num(self.dis),
+             "staleness": (None if self.staleness is None
+                           else int(self.staleness)),
+             "peers": {str(j): {k: _num(v) for k, v in sorted(m.items())}
+                       for j, m in sorted(self.peers.items())},
+             "events": {k: int(v)
+                        for k, v in sorted(self.events.items())},
+             "host": {k: _num(v) for k, v in sorted(self.host.items())},
+             "metrics": {k: _num(v)
+                         for k, v in sorted(self.metrics.items())}},
+            sort_keys=True, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(text: str) -> "FleetRecord":
+        d = json.loads(text)
+        if not isinstance(d, dict):
+            raise ValueError("fleet record is not an object")
+        if int(d.get("v", 0)) > RECORD_VERSION:
+            raise ValueError(f"fleet record version {d['v']} is from "
+                             f"the future (reader speaks {RECORD_VERSION})")
+
+        def num(x):
+            return float("nan") if x is None else float(x)
+
+        return FleetRecord(
+            rank=int(d["rank"]), round=int(d["round"]),
+            t=float(d.get("t", 0.0)),
+            round_s={str(k): num(v)
+                     for k, v in (d.get("round_s") or {}).items()},
+            mass=num(d.get("mass")), z_mean=num(d.get("z_mean")),
+            dis=num(d.get("dis")),
+            staleness=(None if d.get("staleness") is None
+                       else int(d["staleness"])),
+            peers={int(j): {str(k): num(v) for k, v in (m or {}).items()}
+                   for j, m in (d.get("peers") or {}).items()},
+            events={str(k): int(v)
+                    for k, v in (d.get("events") or {}).items()},
+            host={str(k): num(v)
+                  for k, v in (d.get("host") or {}).items()},
+            metrics={str(k): num(v)
+                     for k, v in (d.get("metrics") or {}).items()})
+
+
+# ------------------------------------------------------------- host gauges
+def sample_host() -> Dict[str, float]:
+    """RSS bytes, cumulative CPU seconds, and live thread count of THIS
+    process, read straight from ``/proc`` (no psutil anywhere).  Returns
+    ``{}`` on hosts without procfs — the record's ``host`` map is then
+    empty and every consumer treats the gauges as unknown."""
+    out: Dict[str, float] = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss_bytes"] = float(line.split()[1]) * 1024.0
+                elif line.startswith("Threads:"):
+                    out["threads"] = float(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        with open("/proc/self/stat") as f:
+            # field 2 is "(comm)" and may contain spaces: split AFTER
+            # the closing paren, so utime/stime (fields 14/15, 1-based)
+            # land at fixed offsets
+            rest = f.read().rsplit(")", 1)[1].split()
+        tck = float(os.sysconf("SC_CLK_TCK")) or 100.0
+        out["cpu_s"] = (float(rest[11]) + float(rest[12])) / tck
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
+# ----------------------------------------------------------- serving ride
+def encode_record_leaves(rec: FleetRecord) -> Dict[str, np.ndarray]:
+    """Bit-pack a record's canonical JSON into f64 leaves the serving
+    :class:`~bluefog_tpu.serving.snapshots.SnapshotTable` accepts (it
+    validates f32/f64): the UTF-8 bytes, space-padded to a multiple of
+    8, viewed as float64.  The bits are copied verbatim by every layer
+    (a NaN payload is still just bits), and :func:`decode_record_leaves`
+    strips the padding back off."""
+    blob = rec.to_json().encode()
+    pad = (-len(blob)) % 8
+    arr = np.frombuffer(blob + b" " * pad, dtype=np.float64).copy()
+    return {"rec": arr, "round": np.array([float(rec.round)])}
+
+
+def decode_record_leaves(leaves: Mapping[str, np.ndarray]) -> FleetRecord:
+    blob = np.ascontiguousarray(leaves["rec"]).tobytes().rstrip(b" ")
+    return FleetRecord.from_json(blob.decode())
+
+
+# --------------------------------------------------------------- publisher
+class TelemetryPublisher:
+    """Appends one :class:`FleetRecord` line per publish to this rank's
+    ``fleet.<rank>`` file.
+
+    The loop contract: call :meth:`note_round` once per round with the
+    round's wall seconds, and :meth:`publish` at round boundaries that
+    :meth:`due` approves (every ``every``-th round).  The publisher is
+    the delta bookkeeper — it remembers the previous metrics snapshot
+    and blackbox sequence so each record carries clean per-window
+    deltas — and it is deliberately boring: pure host-side dict work +
+    one buffered file append, measured at well under 1% of a transport
+    round (``BENCH_fleet.json``)."""
+
+    def __init__(self, rank: int, dirpath: str, *, every: int = 1,
+                 serve: bool = False, process_stats: bool = True,
+                 max_metric_families: int = MAX_METRIC_FAMILIES):
+        if every < 1:
+            raise ValueError("publish cadence `every` must be >= 1")
+        self.rank = int(rank)
+        self.dirpath = dirpath
+        self.every = int(every)
+        self.serve = bool(serve)
+        # the blackbox ring, metrics registry, and /proc gauges are
+        # PROCESS-global: in the one-process-per-rank (MP) shape every
+        # rank rightly carries them, but rank-THREADS sharing a process
+        # must elect ONE carrier (rank 0) or a fleet-wide sum over
+        # records over-counts the same events n-fold
+        self.process_stats = bool(process_stats)
+        self.max_metric_families = int(max_metric_families)
+        # create the record directory up front (the FileBarrier
+        # discipline): a missing dir must not abort the training run at
+        # the first round-boundary publish
+        os.makedirs(dirpath, exist_ok=True)
+        self._path = record_path(dirpath, rank)
+        self._fh = None
+        self._round_samples: List[float] = []
+        self._bb_seq = -1
+        self._prev_counters: Dict[str, float] = {}
+        self._prev_cpu: Optional[float] = None
+        self._host_cache: Dict[str, float] = {}
+        self._host_t = float("-inf")
+        self.published = 0
+
+    # ------------------------------------------------------------- feeds
+    def note_round(self, seconds: float) -> None:
+        """One round's wall time (the loop feeds every round; stats are
+        computed over the window at publish time)."""
+        self._round_samples.append(float(seconds))
+
+    def due(self, round_: int) -> bool:
+        return int(round_) % self.every == 0
+
+    # ----------------------------------------------------------- helpers
+    def _round_stats(self) -> Dict[str, float]:
+        samples = self._round_samples
+        self._round_samples = []
+        if not samples:
+            return {"count": 0.0}
+        s = sorted(samples)
+        return {"count": float(len(s)),
+                "mean": sum(s) / len(s),
+                "p50": _reg.quantile(s, 0.50),
+                "p99": _reg.quantile(s, 0.99),
+                "max": s[-1]}
+
+    def _event_counts(self) -> Dict[str, int]:
+        rec = _bb.get()
+        if rec is None:
+            return {}
+        self._bb_seq, counts = rec.counts_since(self._bb_seq)
+        return counts
+
+    def _metric_deltas(self) -> Dict[str, float]:
+        """Counter-family deltas since the last publish: labels are
+        aggregated away (the record is a fleet rollup feed, not a
+        per-series export — the JSONL writer already is that), and the
+        family list is cut deterministically at
+        ``max_metric_families``.  Uses the registry's cheap
+        :meth:`~bluefog_tpu.metrics.registry.MetricsRegistry.
+        counter_totals` aggregate — a full formatted snapshot per round
+        would be most of the publisher's overhead budget."""
+        reg = _reg.current()
+        if reg is None:
+            return {}
+        fams = reg.counter_totals()
+        out: Dict[str, float] = {}
+        for name in sorted(fams)[:self.max_metric_families]:
+            delta = fams[name] - self._prev_counters.get(name, 0.0)
+            if delta > 0 and math.isfinite(delta):
+                out[name] = delta
+        self._prev_counters = fams
+        return out
+
+    def _host(self) -> Dict[str, float]:
+        now = time.monotonic()
+        if now - self._host_t < HOST_SAMPLE_MIN_S:
+            return self._host_cache  # fresh enough; records re-carry it
+        self._host_t = now
+        host = sample_host()
+        self._host_cache = host
+        if "rss_bytes" in host:
+            _mt.set("bf_host_rss_bytes", host["rss_bytes"])
+        if "threads" in host:
+            _mt.set("bf_host_threads", host["threads"])
+        cpu = host.get("cpu_s")
+        if cpu is not None:
+            if self._prev_cpu is not None and cpu > self._prev_cpu:
+                _mt.inc("bf_host_cpu_seconds_total",
+                        cpu - self._prev_cpu)
+            self._prev_cpu = cpu
+        return host
+
+    # ----------------------------------------------------------- publish
+    def publish(self, round_: int, *, mass: float = float("nan"),
+                z_mean: float = float("nan"),
+                dis: Optional[float] = None,
+                staleness: Optional[int] = None,
+                peers: Optional[Mapping[int, Mapping[str, float]]] = None,
+                ) -> FleetRecord:
+        """Assemble and append this round's record (and, with
+        ``serve=True``, push it into the serving table)."""
+        t0 = time.perf_counter()
+        rec = FleetRecord(
+            rank=self.rank, round=int(round_), t=time.time(),
+            round_s=self._round_stats(), mass=_opt(mass),
+            z_mean=_opt(z_mean), dis=_opt(dis), staleness=staleness,
+            peers=dict(peers or {}),
+            events=self._event_counts() if self.process_stats else {},
+            host=self._host() if self.process_stats else {},
+            metrics=(self._metric_deltas() if self.process_stats
+                     else {}))
+        if self._fh is None:
+            self._fh = open(self._path, "ab")
+        # one writer per file; a single buffered write + flush per line
+        # keeps a torn record possible only at a crash (readers tolerate
+        # torn tails — the blackbox/tracing discipline)
+        self._fh.write(rec.to_json().encode() + b"\n")
+        self._fh.flush()
+        if self.serve:
+            from bluefog_tpu.serving import snapshots as _snapshots
+
+            _snapshots.table().publish(f"bf_fleet:{self.rank}",
+                                       rec.round,
+                                       encode_record_leaves(rec))
+        self.published += 1
+        _mt.inc("bf_fleet_publishes_total")
+        _mt.observe("bf_fleet_publish_seconds",
+                    time.perf_counter() - t0)
+        return rec
+
+    def close(self) -> None:
+        if self.serve:
+            from bluefog_tpu.serving import snapshots as _snapshots
+
+            _snapshots.table().drop(f"bf_fleet:{self.rank}")
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None

@@ -1,0 +1,432 @@
+"""The declarative SLO engine: specs in, OK→WARN→PAGE alerts out.
+
+"Healthy" becomes a checkable statement: an :class:`SLOSpec` names a
+fleet rollup **signal**, a **hysteresis pair** of thresholds (the
+:class:`~bluefog_tpu.control.ControlConfig` discipline — the condition
+that raises an alert is strictly stronger than the one that clears it,
+so telemetry oscillating around one threshold cannot flap the state),
+an evaluation **window** in rounds, and a **burn rate** — the fraction
+of the window's evaluations that must breach before the state machine
+moves.  The BF-FLT001 lint (:mod:`bluefog_tpu.analysis.fleet_lint`)
+refuses a spec site that spells a threshold without its exit twin or a
+window, exactly as BF-CTL001 refuses mid-round actuation.
+
+State machine, per spec::
+
+    OK ──(burn vs warn_enter ≥ burn_rate)──▶ WARN
+    WARN ──(burn vs page_enter ≥ burn_rate)──▶ PAGE     [optional pair]
+    WARN ──(no window entry ≥ warn_exit)──▶ OK
+    PAGE ──(no window entry ≥ page_exit)──▶ WARN
+
+Every transition emits a blackbox event (``slo_warn`` / ``slo_page`` /
+``slo_clear``) carrying the attributed rank, and the engine exports
+``bf_slo_state`` / ``bf_slo_burn`` gauges plus a
+``bf_slo_transitions_total`` counter — the alert surface IS
+observability, so it rides the same legs it guards.
+
+Attribution: signals that localize (peer lag, straggler z, RSS) carry
+the offending rank through the evaluation; an alert's ``rank`` is the
+most frequent attribution among the window's breaching entries, which
+is what lets a straggler WARN *name the slow rank* and lets a
+control-wired loop feed it back as SUSPECT evidence
+(:meth:`bluefog_tpu.control.CommController.note_alert`).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import math
+from typing import Callable, Deque, Dict, List, Mapping, Optional, Tuple
+
+from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.metrics import comm as _mt
+from bluefog_tpu.metrics.registry import median as _median
+
+__all__ = [
+    "OK", "WARN", "PAGE", "STATE_NAMES",
+    "SLOSpec", "SLOEngine", "Transition",
+    "default_specs", "load_specs", "specs_to_json",
+]
+
+OK, WARN, PAGE = 0, 1, 2
+STATE_NAMES = {OK: "OK", WARN: "WARN", PAGE: "PAGE"}
+
+# ------------------------------------------------------------------ signals
+# signal extractor: (rollup, spec) -> (value, attributed rank | None,
+# absolute magnitude).  `value` is compared against the thresholds;
+# `absmag` is the underlying physical quantity SLOSpec.min_abs floors —
+# a ratio of two microscopic lags must not page anybody.
+
+
+def _sig_peer_lag_ratio(ru, spec):
+    """Worst peer's median observed lag over the median of the OTHER
+    peers' lags (the slow-host detector: what a straggling rank's
+    SENDERS see).  Excluding the worst from its own baseline keeps the
+    ratio honest in small fleets — with two peers an inclusive median
+    IS the worst value and every ratio collapses toward 1.  A
+    single-peer view has no relative baseline at all and never
+    convicts (use an absolute ``peer_lag_s`` spec there)."""
+    if not ru.peer_lag:
+        return 0.0, None, 0.0
+    worst = max(ru.peer_lag, key=lambda j: (ru.peer_lag[j], j))
+    lag = ru.peer_lag[worst]
+    others = [v for j, v in ru.peer_lag.items() if j != worst]
+    if not others:
+        return 0.0, None, 0.0
+    med = _median(others)
+    ratio = lag / med if med > 0 else (float("inf") if lag > 0 else 0.0)
+    return ratio, worst, lag
+
+
+def _sig_peer_lag_s(ru, spec):
+    if not ru.peer_lag:
+        return 0.0, None, 0.0
+    worst = max(ru.peer_lag, key=lambda j: (ru.peer_lag[j], j))
+    return ru.peer_lag[worst], worst, ru.peer_lag[worst]
+
+
+def _sig_straggler_z(ru, spec):
+    if not ru.straggler_z:
+        return 0.0, None, 0.0
+    worst = max(ru.straggler_z, key=lambda r: (ru.straggler_z[r], r))
+    absmag = ru.per_rank[worst].get("round_mean", 0.0)
+    if not math.isfinite(absmag):
+        absmag = 0.0
+    return ru.straggler_z[worst], worst, absmag
+
+
+def _sig_round_p99_s(ru, spec):
+    worst, val = None, float("nan")
+    for r, info in ru.per_rank.items():
+        v = info.get("round_p99", float("nan"))
+        if math.isfinite(v) and (worst is None or v > val):
+            worst, val = r, v
+    if worst is None:
+        return 0.0, None, 0.0
+    return val, worst, val
+
+
+def _sig_consensus_spread(ru, spec):
+    v = ru.consensus_spread
+    if not math.isfinite(v):
+        return 0.0, None, 0.0
+    return v, ru.spread_worst, v
+
+
+def _sig_mass_drift_frac(ru, spec):
+    """|mean reporter mass − 1|: a DRIFT detector, not an instantaneous
+    audit — in-flight window mass is invisible to records, so only a
+    sustained breach over a long window means anything (the default
+    spec's window/burn say so)."""
+    if not ru.reporters or not math.isfinite(ru.mass_total):
+        return 0.0, None, 0.0
+    v = abs(ru.mass_total / len(ru.reporters) - 1.0)
+    return v, None, v
+
+
+def _sig_round_lag_max(ru, spec):
+    """Rounds the laggiest rank's newest record trails the fleet head —
+    the silent-rank age signal (a wedged or partitioned rank stops
+    publishing; its lag grows without bound)."""
+    if not ru.per_rank:
+        return 0.0, None, 0.0
+    worst = max(ru.per_rank, key=lambda r: (ru.per_rank[r]["lag"], r))
+    v = ru.per_rank[worst]["lag"]
+    return v, worst, v
+
+
+def _sig_silent_ranks(ru, spec):
+    silent = ru.silent_ranks(spec.window)
+    return float(len(silent)), (silent[0] if silent else None), \
+        float(len(silent))
+
+
+def _sig_staleness_rounds(ru, spec):
+    if ru.staleness_rounds is None:
+        return 0.0, None, 0.0
+    return float(ru.staleness_rounds), None, float(ru.staleness_rounds)
+
+
+def _sig_rss_bytes(ru, spec):
+    worst, val = None, float("nan")
+    for r, info in ru.per_rank.items():
+        v = info.get("rss", float("nan"))
+        if math.isfinite(v) and (worst is None or v > val):
+            worst, val = r, v
+    if worst is None:
+        return 0.0, None, 0.0
+    return val, worst, val
+
+
+SIGNALS: Dict[str, Callable] = {
+    "peer_lag_ratio": _sig_peer_lag_ratio,
+    "peer_lag_s": _sig_peer_lag_s,
+    "straggler_z": _sig_straggler_z,
+    "round_p99_s": _sig_round_p99_s,
+    "consensus_spread": _sig_consensus_spread,
+    "mass_drift_frac": _sig_mass_drift_frac,
+    "round_lag_max": _sig_round_lag_max,
+    "silent_ranks": _sig_silent_ranks,
+    "staleness_rounds": _sig_staleness_rounds,
+    "rss_bytes": _sig_rss_bytes,
+}
+
+
+# -------------------------------------------------------------------- spec
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One service-level objective over a fleet rollup signal.
+
+    Mandatory: the ``(warn_enter, warn_exit)`` hysteresis pair (exit
+    strictly below enter) and the ``window`` (rounds of rollups each
+    evaluation looks back over).  ``burn_rate`` is the fraction of the
+    window that must breach ``*_enter`` to move the state up; moving
+    DOWN requires the whole window clear of ``*_exit`` — enter-strong,
+    exit-weak, the no-flap shape.  ``page_enter``/``page_exit`` opt
+    into the PAGE tier (both or neither).  ``min_abs`` floors the
+    underlying magnitude: an evaluation whose physical quantity is
+    below it never counts as a breach (ratios over microscopic lags
+    are noise, the ``ControlConfig.min_lag_s`` lesson)."""
+
+    name: str
+    signal: str
+    warn_enter: float
+    warn_exit: float
+    window: int
+    burn_rate: float = 0.5
+    page_enter: Optional[float] = None
+    page_exit: Optional[float] = None
+    min_abs: float = 0.0
+
+    def __post_init__(self):
+        if self.signal not in SIGNALS:
+            raise ValueError(
+                f"unknown SLO signal {self.signal!r}; known: "
+                f"{sorted(SIGNALS)}")
+        if not (self.warn_exit < self.warn_enter):
+            raise ValueError(
+                f"SLO {self.name!r}: hysteresis requires warn_exit < "
+                f"warn_enter (got exit={self.warn_exit}, "
+                f"enter={self.warn_enter})")
+        if int(self.window) < 1:
+            raise ValueError(f"SLO {self.name!r}: window must be >= 1")
+        object.__setattr__(self, "window", int(self.window))
+        if not (0.0 < self.burn_rate <= 1.0):
+            raise ValueError(
+                f"SLO {self.name!r}: burn_rate must be in (0, 1]")
+        if (self.page_enter is None) != (self.page_exit is None):
+            raise ValueError(
+                f"SLO {self.name!r}: page thresholds are a PAIR — "
+                "declare both page_enter and page_exit or neither")
+        if self.page_enter is not None:
+            if not (self.page_exit < self.page_enter):
+                raise ValueError(
+                    f"SLO {self.name!r}: hysteresis requires "
+                    "page_exit < page_enter")
+            if self.page_enter < self.warn_enter:
+                raise ValueError(
+                    f"SLO {self.name!r}: page_enter must be at or "
+                    "above warn_enter (PAGE is the stronger claim)")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+def default_specs() -> Tuple[SLOSpec, ...]:
+    """Workload-independent defaults: relative signals only (a default
+    cannot know what a round costs), each with wide hysteresis."""
+    return (
+        SLOSpec(name="straggler", signal="peer_lag_ratio",
+                warn_enter=4.0, warn_exit=2.0,
+                page_enter=16.0, page_exit=4.0,
+                window=4, burn_rate=0.5, min_abs=0.02),
+        SLOSpec(name="silent", signal="round_lag_max",
+                warn_enter=8.0, warn_exit=4.0,
+                window=4, burn_rate=0.75),
+        SLOSpec(name="mass", signal="mass_drift_frac",
+                warn_enter=0.9, warn_exit=0.5,
+                window=16, burn_rate=0.9),
+    )
+
+
+def load_specs(path: str) -> Tuple[SLOSpec, ...]:
+    """Parse an SLO spec file: ``{"slos": [{...SLOSpec fields}]}`` —
+    validation (hysteresis pairs, windows) happens in the constructor,
+    so a spec file that would flap is refused at load time."""
+    with open(path) as f:
+        d = json.load(f)
+    specs = tuple(SLOSpec(**s) for s in d.get("slos", []))
+    if not specs:
+        raise ValueError(f"{path}: no SLOs declared (want "
+                         '{"slos": [{...}]})')
+    return specs
+
+
+def specs_to_json(specs) -> str:
+    return json.dumps({"slos": [s.to_dict() for s in specs]}, indent=2)
+
+
+# ------------------------------------------------------------------ engine
+@dataclasses.dataclass(frozen=True)
+class Transition:
+    """One alert state change (the ``--check`` gate's unit of output)."""
+
+    round: int
+    slo: str
+    frm: int
+    to: int
+    rank: Optional[int]
+    value: float
+    burn: float
+
+    def describe(self) -> str:
+        who = f" rank {self.rank}" if self.rank is not None else ""
+        return (f"{STATE_NAMES[self.to]:4s} {self.slo} at round "
+                f"{self.round}:{who} value={self.value:.4g} "
+                f"burn={self.burn:.2f} "
+                f"(was {STATE_NAMES[self.frm]})")
+
+
+class _AlertState:
+    __slots__ = ("state", "since", "rank", "history")
+
+    def __init__(self, window: int):
+        self.state = OK
+        self.since = 0
+        self.rank: Optional[int] = None
+        # (value, rank, absmag) per evaluated rollup
+        self.history: Deque[Tuple[float, Optional[int], float]] = \
+            collections.deque(maxlen=window)
+
+
+class SLOEngine:
+    """Folds rollups into per-spec alert states; deterministic in the
+    observed rollup sequence, so every rank that tails the same records
+    converges on the same alert states (the decide_plan property,
+    restated for alerts)."""
+
+    def __init__(self, specs, *, rank: Optional[int] = None):
+        self.specs: Tuple[SLOSpec, ...] = tuple(specs)
+        names = [s.name for s in self.specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names: {names}")
+        self.rank = rank
+        self._states = {s.name: _AlertState(s.window) for s in self.specs}
+        self._last_round: Optional[int] = None
+        self.transitions: List[Transition] = []
+        self.worst = OK  # highest state ever reached (the gate's verdict)
+
+    # ------------------------------------------------------------ helpers
+    def _labels(self, spec: SLOSpec) -> Dict[str, str]:
+        labels = {"slo": spec.name}
+        if self.rank is not None:
+            labels["rank"] = str(self.rank)
+        return labels
+
+    def _burn(self, st: _AlertState, spec: SLOSpec,
+              threshold: float) -> Tuple[float, Optional[int]]:
+        """Fraction of the window breaching ``threshold`` (min_abs
+        floored), plus the modal attributed rank among breaches."""
+        hits = 0
+        ranks: Dict[int, int] = {}
+        for value, rank, absmag in st.history:
+            if value >= threshold and absmag >= spec.min_abs:
+                hits += 1
+                if rank is not None:
+                    ranks[rank] = ranks.get(rank, 0) + 1
+        burn = hits / spec.window
+        who = (min(sorted(ranks, key=lambda r: (-ranks[r], r))[:1],
+                   default=None) if ranks else None)
+        return burn, who
+
+    def _transition(self, spec: SLOSpec, st: _AlertState, round_: int,
+                    to: int, value: float, burn: float,
+                    rank: Optional[int]) -> None:
+        frm = st.state
+        st.state = to
+        st.since = round_
+        st.rank = rank if to != OK else None
+        self.worst = max(self.worst, to)
+        tr = Transition(round=round_, slo=spec.name, frm=frm, to=to,
+                        rank=st.rank, value=value, burn=burn)
+        self.transitions.append(tr)
+        kind = {OK: "slo_clear", WARN: "slo_warn", PAGE: "slo_page"}[to]
+        _bb.record(kind, slo=spec.name, round=round_, value=value,
+                   burn=round(burn, 4),
+                   **({"peer": st.rank} if st.rank is not None else {}))
+        _mt.inc("bf_slo_transitions_total", 1.0,
+                to=STATE_NAMES[to], **self._labels(spec))
+
+    # ----------------------------------------------------------- evaluate
+    def observe(self, rollup) -> List[Transition]:
+        """Evaluate every spec against one round's rollup.  Rollups
+        must arrive in round order (the view's sorted rounds); each
+        call appends one window entry per spec and applies at most one
+        state move per spec."""
+        before = len(self.transitions)
+        round_ = int(rollup.round)
+        self._last_round = round_
+        for spec in self.specs:
+            st = self._states[spec.name]
+            value, rank, absmag = SIGNALS[spec.signal](rollup, spec)
+            st.history.append((float(value), rank, float(absmag)))
+            burn_enter, who_enter = self._burn(st, spec, spec.warn_enter)
+            if st.state == OK:
+                if burn_enter >= spec.burn_rate:
+                    self._transition(spec, st, round_, WARN, value,
+                                     burn_enter, who_enter)
+            elif st.state == WARN:
+                paged = False
+                if spec.page_enter is not None:
+                    burn_page, who_page = self._burn(st, spec,
+                                                     spec.page_enter)
+                    if burn_page >= spec.burn_rate:
+                        self._transition(spec, st, round_, PAGE, value,
+                                         burn_page, who_page)
+                        paged = True
+                if not paged:
+                    burn_exit, _ = self._burn(st, spec, spec.warn_exit)
+                    if (burn_exit == 0.0
+                            and len(st.history) >= spec.window):
+                        self._transition(spec, st, round_, OK, value,
+                                         burn_exit, None)
+            else:  # PAGE
+                burn_pexit, _ = self._burn(st, spec, spec.page_exit)
+                if burn_pexit == 0.0 and len(st.history) >= spec.window:
+                    # rank 0 is a valid attribution: only a None modal
+                    # rank falls back to the escalation's attribution
+                    self._transition(
+                        spec, st, round_, WARN, value, burn_enter,
+                        st.rank if who_enter is None else who_enter)
+            # burn_enter is this round's gauge value too (same window,
+            # same threshold — no second O(window) pass)
+            _mt.set("bf_slo_state", float(st.state), **self._labels(spec))
+            _mt.set("bf_slo_burn", burn_enter, **self._labels(spec))
+        return self.transitions[before:]
+
+    def advance(self, view) -> List[Transition]:
+        """Evaluate every view round newer than the last one seen (the
+        incremental live-mode driver; replay calls it once over a fully
+        loaded view)."""
+        before = len(self.transitions)
+        for rd in view.rounds():
+            if self._last_round is not None and rd <= self._last_round:
+                continue
+            self.observe(view.rollup(rd))
+        return self.transitions[before:]
+
+    # ------------------------------------------------------------- status
+    def states(self) -> Dict[str, Tuple[int, Optional[int]]]:
+        """``{slo name: (state, attributed rank)}`` right now."""
+        return {name: (st.state, st.rank)
+                for name, st in self._states.items()}
+
+    def suspect_ranks(self):
+        """Ranks currently named by a WARN-or-worse alert — what a
+        control-wired loop feeds back as SUSPECT evidence
+        (:meth:`~bluefog_tpu.control.CommController.note_alert`)."""
+        return frozenset(st.rank for st in self._states.values()
+                         if st.state > OK and st.rank is not None)

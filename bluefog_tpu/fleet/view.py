@@ -1,0 +1,291 @@
+"""FleetView: the round-aligned fleet time-series and its rollups.
+
+The aggregation half of the fleet health plane: reads every rank's
+``fleet.<rank>`` record history (incrementally — the live dash and the
+in-loop SLO engines tail, they never re-parse), keys records strictly
+by their SELF-IDENTIFIED ``(rank, round)`` stamp, and computes the
+fleet rollups the SLO engine alarms on.
+
+Damage tolerance, stated plainly (and fuzzed in ``tests/test_fleet.py``):
+
+- **torn** — a line cut mid-write (crash) or still being written
+  (reader raced the writer) parses as garbage and is skipped; a
+  trailing line with no newline yet is left in place and re-read on the
+  next tail (never half-consumed);
+- **late** — records are aligned by their ``round`` stamp, not arrival
+  order; a record that shows up after later rounds were read slots into
+  its own round;
+- **missing** — a rank with no record at a round simply does not report
+  into that round's rollup (``reporters`` names who did); its latest
+  earlier record stands in, with ``round_lag`` saying how stale it is;
+- **duplicate** — two records for one ``(rank, round)`` resolve by
+  newest wall-clock ``t`` (a re-published record supersedes);
+- **misfiled** — a record living in the wrong rank's file is attributed
+  by its CONTENT, never its filename.
+
+Rollups (:class:`FleetRollup`, definitions in ``docs/fleet.md``): fleet
+round-time p50/p99, per-rank straggler z-scores over round-time means,
+per-PEER lag medians over all reporters (the control plane's
+``_peer_lag`` shape — what names a slow HOST its senders observe),
+consensus spread over the ``z_mean`` shadow, push-sum mass total (a
+drift detector — in-flight mass is not in it), snapshot staleness, and
+silent-rank detection by record age in rounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import math
+import os
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from bluefog_tpu.fleet.record import FleetRecord
+from bluefog_tpu.metrics.registry import median as _median
+
+__all__ = ["FleetRollup", "FleetView"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetRollup:
+    """One round's fleet-wide view, computed over each reporter's
+    latest record at or before ``round``.
+
+    ``per_rank`` maps rank -> that record's headline numbers
+    (``round``, ``lag`` in rounds behind this rollup, ``round_mean`` /
+    ``round_p50`` / ``round_p99`` seconds, ``mass``, ``z_mean``,
+    ``rss``, ``threads``).
+    ``peer_lag`` maps peer -> the MEDIAN observed lag over every
+    reporter that carries an observation of that peer (median, not max
+    — one confused reporter must not convict a healthy peer; the
+    controller's discipline).  ``straggler_z`` maps rank -> the z-score
+    of its round-time mean against the reporting fleet."""
+
+    round: int
+    reporters: Tuple[int, ...]
+    per_rank: Mapping[int, Mapping[str, float]]
+    peer_lag: Mapping[int, float]
+    straggler_z: Mapping[int, float]
+    round_p50_s: float
+    round_p99_s: float
+    consensus_spread: float
+    spread_worst: Optional[int]
+    mass_total: float
+    staleness_rounds: Optional[int]
+
+    def round_lag(self, rank: int) -> Optional[int]:
+        info = self.per_rank.get(rank)
+        if info is None:
+            return None
+        return int(info["lag"])
+
+    def silent_ranks(self, max_lag: int) -> Tuple[int, ...]:
+        """Ranks whose latest record is more than ``max_lag`` rounds
+        behind this rollup's round — the silent-rank detector (a rank
+        that stopped publishing is wedged, dead, or partitioned)."""
+        return tuple(r for r in self.reporters
+                     if self.per_rank[r]["lag"] > max_lag)
+
+
+class FleetView:
+    """Round-aligned record store with incremental directory tailing.
+
+    Not thread-safe by design: each consumer (a rank loop's SLO engine,
+    the dash CLI, the replay gate) owns its own view — the files are
+    the shared medium, exactly like the barrier-dir records."""
+
+    def __init__(self):
+        # rank -> {round -> FleetRecord}; duplicate (rank, round)
+        # records resolve by newest t
+        self._recs: Dict[int, Dict[int, FleetRecord]] = {}
+        # path -> byte offset already consumed (tail state)
+        self._offsets: Dict[str, int] = {}
+        self.torn = 0   # unparseable complete lines skipped
+        self.late = 0   # records that arrived behind an already-read round
+
+    # ------------------------------------------------------------ loading
+    def add(self, rec: FleetRecord) -> None:
+        table = self._recs.setdefault(int(rec.rank), {})
+        cur = table.get(int(rec.round))
+        if cur is None or rec.t >= cur.t:
+            table[int(rec.round)] = rec
+        head = max(table) if table else 0
+        if rec.round < head:
+            self.late += 1
+
+    def tail_file(self, path: str) -> int:
+        """Consume new complete lines from one record file; returns the
+        number of records added.  A trailing partial line (no newline)
+        stays unconsumed — the offset never moves past bytes that could
+        still grow into a record."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return 0
+        off = self._offsets.get(path, 0)
+        if size <= off:
+            return 0
+        try:
+            with open(path, "rb") as f:
+                f.seek(off)
+                blob = f.read(size - off)
+        except OSError:
+            return 0
+        end = blob.rfind(b"\n")
+        if end < 0:
+            return 0  # nothing complete yet
+        self._offsets[path] = off + end + 1
+        n = 0
+        for line in blob[:end + 1].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                self.add(FleetRecord.from_json(line.decode()))
+                n += 1
+            except (ValueError, KeyError, UnicodeDecodeError):
+                self.torn += 1
+        return n
+
+    def tail_dir(self, dirpath: str) -> int:
+        """Consume new records from every ``fleet.*`` file in
+        ``dirpath`` (discovery by glob; attribution by content)."""
+        n = 0
+        for path in sorted(glob.glob(os.path.join(dirpath, "fleet.*"))):
+            if path.endswith(".tmp"):
+                continue
+            n += self.tail_file(path)
+        return n
+
+    @classmethod
+    def load_dir(cls, dirpath: str) -> "FleetView":
+        view = cls()
+        view.tail_dir(dirpath)
+        return view
+
+    def prune_before(self, round_: int) -> int:
+        """Drop records stamped before ``round_``, KEEPING each rank's
+        newest record regardless of age — a silent rank's last word is
+        what keeps it visible to the round-lag detector (pruning it
+        would make the rank vanish from rollups instead of alarming).
+        Long-lived tailers (the in-loop SLO engines, the live dash)
+        call this so per-round cost and memory stay bounded by the
+        retention window, not the run length.  Returns the number of
+        records dropped."""
+        n = 0
+        for table in self._recs.values():
+            if not table:
+                continue
+            newest = max(table)
+            for rd in [rd for rd in table
+                       if rd < round_ and rd != newest]:
+                del table[rd]
+                n += 1
+        return n
+
+    # ------------------------------------------------------------ queries
+    def ranks(self) -> List[int]:
+        return sorted(r for r, t in self._recs.items() if t)
+
+    def rounds(self) -> List[int]:
+        out = set()
+        for table in self._recs.values():
+            out.update(table)
+        return sorted(out)
+
+    def head_round(self) -> Optional[int]:
+        rounds = self.rounds()
+        return rounds[-1] if rounds else None
+
+    def latest(self, rank: int,
+               at_round: Optional[int] = None) -> Optional[FleetRecord]:
+        """The newest record of ``rank`` at or before ``at_round``
+        (late/missing tolerance: a non-reporting round falls back to
+        the rank's last word)."""
+        table = self._recs.get(int(rank))
+        if not table:
+            return None
+        if at_round is None:
+            return table[max(table)]
+        best = None
+        for rd, rec in table.items():
+            if rd <= at_round and (best is None or rd > best.round):
+                best = rec
+        return best
+
+    def record(self, rank: int, round_: int) -> Optional[FleetRecord]:
+        return self._recs.get(int(rank), {}).get(int(round_))
+
+    # ------------------------------------------------------------ rollups
+    def rollup(self, round_: int) -> FleetRollup:
+        """The fleet at ``round_``: every rank's latest word at or
+        before it, never a value attributed across ranks or rounds."""
+        round_ = int(round_)
+        per_rank: Dict[int, Dict[str, float]] = {}
+        peer_obs: Dict[int, List[float]] = {}
+        staleness: Optional[int] = None
+        mass_total = 0.0
+        mass_seen = False
+        for rank in self.ranks():
+            rec = self.latest(rank, at_round=round_)
+            if rec is None:
+                continue
+            rs = rec.round_s
+            per_rank[rank] = {
+                "round": float(rec.round),
+                "lag": float(round_ - rec.round),
+                "round_mean": float(rs.get("mean", float("nan"))),
+                "round_p50": float(rs.get("p50", float("nan"))),
+                "round_p99": float(rs.get("p99", float("nan"))),
+                "mass": float(rec.mass),
+                "z_mean": float(rec.z_mean),
+                "rss": float(rec.host.get("rss_bytes", float("nan"))),
+                "threads": float(rec.host.get("threads", float("nan"))),
+            }
+            if math.isfinite(rec.mass):
+                mass_total += rec.mass
+                mass_seen = True
+            if rec.staleness is not None:
+                staleness = (rec.staleness if staleness is None
+                             else max(staleness, rec.staleness))
+            for j, m in rec.peers.items():
+                lag = m.get("lag")
+                if lag is not None and math.isfinite(lag):
+                    peer_obs.setdefault(int(j), []).append(float(lag))
+        reporters = tuple(sorted(per_rank))
+        peer_lag = {j: _median(vs) for j, vs in peer_obs.items()}
+
+        means = [per_rank[r]["round_mean"] for r in reporters
+                 if math.isfinite(per_rank[r]["round_mean"])]
+        mu = (sum(means) / len(means)) if means else float("nan")
+        var = (sum((m - mu) ** 2 for m in means) / len(means)
+               if means else float("nan"))
+        sd = math.sqrt(var) if var == var else float("nan")
+        straggler_z = {}
+        for r in reporters:
+            m = per_rank[r]["round_mean"]
+            if math.isfinite(m) and sd and math.isfinite(sd):
+                straggler_z[r] = (m - mu) / sd
+            else:
+                straggler_z[r] = 0.0
+
+        p50s = [per_rank[r]["round_p50"] for r in reporters
+                if math.isfinite(per_rank[r]["round_p50"])]
+        p99s = [per_rank[r]["round_p99"] for r in reporters
+                if math.isfinite(per_rank[r]["round_p99"])]
+        zs = {r: per_rank[r]["z_mean"] for r in reporters
+              if math.isfinite(per_rank[r]["z_mean"])}
+        spread = float("nan")
+        spread_worst = None
+        if zs:
+            zbar = sum(zs.values()) / len(zs)
+            spread_worst = max(zs, key=lambda r: abs(zs[r] - zbar))
+            spread = abs(zs[spread_worst] - zbar)
+        return FleetRollup(
+            round=round_, reporters=reporters, per_rank=per_rank,
+            peer_lag=peer_lag, straggler_z=straggler_z,
+            round_p50_s=_median(p50s),
+            round_p99_s=max(p99s) if p99s else float("nan"),
+            consensus_spread=spread, spread_worst=spread_worst,
+            mass_total=mass_total if mass_seen else float("nan"),
+            staleness_rounds=staleness)

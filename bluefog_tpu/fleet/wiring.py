@@ -1,0 +1,116 @@
+"""Loop-facing glue: one object the dsgd runners drive per rank.
+
+:class:`FleetConfig` is the ``fleet=`` knob bag on
+:func:`~bluefog_tpu.runtime.async_windows.run_async_dsgd` /
+``run_async_dsgd_rank``; :class:`FleetRuntime` bundles the publisher
+with an optional in-loop SLO engine so the runtime wiring stays a few
+lines per loop:
+
+- every round: :meth:`FleetRuntime.note_round` with the round's wall
+  seconds (alongside the ``bf_round_seconds`` histogram);
+- at round boundaries :meth:`due` approves: :meth:`boundary` publishes
+  the record and — when SLOs are declared — tails the shared directory,
+  advances the engine, and (when a controller is given) feeds
+  alert-named ranks back as SUSPECT evidence via
+  :meth:`~bluefog_tpu.control.CommController.note_alert` — the alert
+  plane closing into the control plane.
+
+Everything here is a round-BOUNDARY actuation surface: the publisher
+reads loop-local values the caller hands it at the boundary, and alert
+evidence changes only what the NEXT evidence window disseminates —
+nothing mid-round, the BF-CTL001 quiesce posture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Tuple
+
+from bluefog_tpu.fleet.record import TelemetryPublisher
+from bluefog_tpu.fleet.slo import SLOEngine, SLOSpec
+from bluefog_tpu.fleet.view import FleetView
+
+__all__ = ["FleetConfig", "FleetRuntime"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet telemetry knobs for the async dsgd runners.
+
+    ``every`` is the publish cadence in rounds; ``dir`` is the shared
+    record directory (defaults to the barrier directory in MP mode;
+    REQUIRED for the thread runner, which has no barrier dir);
+    ``slos`` arms the in-loop engine — empty means publish-only, the
+    normal production posture (the dash / ``--check`` gate evaluate the
+    same specs offline); ``serve`` additionally pushes each record
+    into the serving snapshot table (group ``bf_fleet:<rank>``)."""
+
+    every: int = 1
+    dir: Optional[str] = None
+    slos: Tuple[SLOSpec, ...] = ()
+    serve: bool = False
+
+    def __post_init__(self):
+        if int(self.every) < 1:
+            raise ValueError("FleetConfig.every must be >= 1")
+        object.__setattr__(self, "every", int(self.every))
+        object.__setattr__(self, "slos", tuple(self.slos))
+
+
+class FleetRuntime:
+    """One rank's fleet-plane driver (publisher + optional engine)."""
+
+    def __init__(self, rank: int, dirpath: str, cfg: FleetConfig, *,
+                 process_stats: bool = True):
+        self.rank = int(rank)
+        self.dir = dirpath
+        self.cfg = cfg
+        self.publisher = TelemetryPublisher(
+            rank, dirpath, every=cfg.every, serve=cfg.serve,
+            process_stats=process_stats)
+        self.engine = (SLOEngine(cfg.slos, rank=rank)
+                       if cfg.slos else None)
+        self.view = FleetView() if self.engine is not None else None
+        self._named: frozenset = frozenset()
+
+    def note_round(self, seconds: float) -> None:
+        self.publisher.note_round(seconds)
+
+    def due(self, round_: int) -> bool:
+        return self.publisher.due(round_)
+
+    def boundary(self, round_: int, *, mass: float = float("nan"),
+                 z_mean: float = float("nan"),
+                 dis: Optional[float] = None,
+                 staleness: Optional[int] = None,
+                 peers: Optional[Mapping[int, Mapping[str, float]]] = None,
+                 controller=None) -> None:
+        """Publish this round's record; with SLOs armed, re-evaluate
+        the fleet and reconcile alert evidence into ``controller``
+        (added for newly named ranks, RETRACTED for ranks whose alert
+        cleared — an alert that stands keeps the peer suspect, the
+        hysteresis release happens here, not by decay)."""
+        self.publisher.publish(round_, mass=mass, z_mean=z_mean,
+                               dis=dis, staleness=staleness, peers=peers)
+        if self.engine is None:
+            return
+        self.view.tail_dir(self.dir)
+        self.engine.advance(self.view)
+        # bounded retention: the engine reads each round once, so only
+        # the spec windows (plus tail-reordering slack) need history —
+        # without this a long run's per-boundary cost is O(rounds²)
+        head = self.view.head_round()
+        if head is not None:
+            keep = max((s.window for s in self.cfg.slos), default=1)
+            self.view.prune_before(head - 4 * keep - 64)
+        if controller is None:
+            return
+        named = self.engine.suspect_ranks() - {self.rank}
+        for j in self._named - named:
+            controller.note_alert(j, suspect=False)
+        for j in named - self._named:
+            controller.note_alert(j, suspect=True)
+        self._named = named
+
+    def close(self) -> None:
+        self.publisher.close()

@@ -24,6 +24,12 @@ and sums are differenced the same way, and gauge statistics cover only
 in-window points.  Reservoir quantiles remain whole-run values (the
 registry keeps no per-window reservoir) — the rows mark them so.
 
+``--follow`` is the LIVE half (the missing twin of ``--since``): the
+dash re-reads the growing JSONL every ``--interval`` seconds and
+re-renders, exiting cleanly when the run's atexit summary line appears
+(the file is finished) or on Ctrl-C.  On a TTY each refresh repaints in
+place; redirected output gets one frame per refresh (tail-able logs).
+
 Percentiles are over the per-step series, which is what an operator
 asking "what does a bad step cost" wants — the registry's own
 reservoir quantiles (the ``_p50``/``_p99`` series) answer the
@@ -36,6 +42,7 @@ import argparse
 import json
 import math
 import sys
+import time
 from typing import Dict, List, Optional
 
 from bluefog_tpu.metrics.registry import HIST_SUFFIXES, quantile
@@ -244,31 +251,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "last earlier snapshot)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary rows as JSON instead of a table")
+    ap.add_argument("--follow", action="store_true",
+                    help="live tail mode: re-read and re-render every "
+                    "--interval seconds until the run's summary line "
+                    "lands (or Ctrl-C)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow refresh period in seconds (default 2)")
     args = ap.parse_args(argv)
 
+    def render_once() -> int:
+        try:
+            steps, series, summary = load_series(args.path)
+        except OSError as e:
+            if args.follow:
+                # the writer may simply not have created the file yet
+                print(f"bfmetrics-tpu: waiting for {args.path} ({e})",
+                      flush=True)
+                return -1
+            print(f"bfmetrics-tpu: {e}", file=sys.stderr)
+            return 2
+        if not steps and summary is None:
+            if args.follow:
+                return -1  # nothing yet; keep waiting
+            print(f"bfmetrics-tpu: {args.path} has no metric records "
+                  "(did the run call bluefog_tpu.metrics.step()?)",
+                  file=sys.stderr)
+            return 1
+        rows = summarize(steps, series, summary, match=args.match,
+                         since=args.since)
+        if args.json:
+            # strict JSON for machine consumers (jq chokes on bare NaN)
+            clean = [{k: (None if isinstance(v, float) and math.isnan(v)
+                          else v) for k, v in r.items()} for r in rows]
+            print(json.dumps(clean, indent=2, allow_nan=False))
+            return 0 if summary is not None or not args.follow else -1
+        if args.follow and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")  # repaint in place
+        n_steps = len(steps)
+        print(f"{args.path}: {n_steps} step record(s), {len(rows)} series"
+              + (" (summary line present)" if summary is not None
+                 else ""), flush=True)
+        print(format_table(rows), flush=True)
+        # in follow mode the summary line is the writer's "finished"
+        # marker (metrics_stop / atexit): render it one last time, stop
+        return 0 if summary is not None or not args.follow else -1
+
+    if not args.follow:
+        return render_once()
     try:
-        steps, series, summary = load_series(args.path)
-    except OSError as e:
-        print(f"bfmetrics-tpu: {e}", file=sys.stderr)
-        return 2
-    if not steps and summary is None:
-        print(f"bfmetrics-tpu: {args.path} has no metric records "
-              "(did the run call bluefog_tpu.metrics.step()?)",
-              file=sys.stderr)
-        return 1
-    rows = summarize(steps, series, summary, match=args.match,
-                     since=args.since)
-    if args.json:
-        # strict JSON for machine consumers (jq chokes on bare NaN)
-        clean = [{k: (None if isinstance(v, float) and math.isnan(v) else v)
-                  for k, v in r.items()} for r in rows]
-        print(json.dumps(clean, indent=2, allow_nan=False))
+        while True:
+            rc = render_once()
+            if rc >= 0:
+                return rc
+            time.sleep(max(0.05, args.interval))
+    except KeyboardInterrupt:
         return 0
-    n_steps = len(steps)
-    print(f"{args.path}: {n_steps} step record(s), {len(rows)} series"
-          + (" (summary line present)" if summary is not None else ""))
-    print(format_table(rows))
-    return 0
 
 
 if __name__ == "__main__":

@@ -160,6 +160,18 @@ def quantile(sorted_samples: List[float], q: float) -> float:
     return sorted_samples[idx]
 
 
+def median(vals) -> float:
+    """Plain interpolating median (NaN on empty) — the ONE shared
+    implementation the control and fleet planes aggregate with."""
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return math.nan
+    if n % 2:
+        return s[n // 2]
+    return 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
 class MetricsRegistry:
     """Holds every metric family and its series; snapshot-able.
 
@@ -253,6 +265,21 @@ class MetricsRegistry:
                                 expand[suffix]
                     else:
                         out[format_series(name, key)] = float(val)
+        return out
+
+    def counter_totals(self) -> Dict[str, float]:
+        """Per-FAMILY totals of every counter (label sets summed away):
+        the cheap aggregate the fleet telemetry publisher carries each
+        round.  Unlike :meth:`snapshot` there is no series-name
+        formatting, no histogram expansion, and no callback-gauge
+        evaluation — one lock hold, one float sum per family."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for name, series in self._values.items():
+                m = self._metrics.get(name)
+                if m is None or m.kind != "counter":
+                    continue
+                out[name] = float(sum(series.values()))
         return out
 
     def kinds(self) -> Dict[str, str]:

@@ -64,6 +64,8 @@ from bluefog_tpu.control import (CommController as _CommController,
                                  ControlConfig as _ControlConfig,
                                  EvidenceBoard as _EvidenceBoard,
                                  evidence as _ctlev)
+from bluefog_tpu.fleet.wiring import (FleetConfig as _FleetConfig,
+                                      FleetRuntime as _FleetRuntime)
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.metrics.health import MixingTracker as _MixingTracker
 from bluefog_tpu.runtime import (membership as _mship, native,
@@ -927,6 +929,7 @@ def run_async_dsgd(
     snapshot_every: int = 0,
     control: Optional[_ControlConfig] = None,
     stop_after_steps: Optional[int] = None,
+    fleet: Optional[_FleetConfig] = None,
 ) -> DSGDReport:
     """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
     over the passive-target windows: the execution model of the reference's
@@ -1028,8 +1031,25 @@ def run_async_dsgd(
         soon as ANY rank completes this many steps — the
         time-to-target mode the control A/B bench measures; otherwise
         ``duration_s`` alone gates the run.
+      fleet: opt into the fleet health plane
+        (:class:`~bluefog_tpu.fleet.FleetConfig`): each rank-thread
+        publishes a round-stamped telemetry record (round-time stats,
+        push-sum mass, per-in-neighbor deposit-staleness ages,
+        blackbox event counts, host gauges) to ``fleet.<rank>`` under
+        ``fleet.dir`` — REQUIRED here, the thread runner has no
+        barrier directory — every ``fleet.every``-th round, at round
+        boundaries.  ``fleet.slos`` additionally arms the in-loop SLO
+        engine; with ``control=`` active, alert-named ranks feed the
+        controller's evidence as SUSPECT
+        (:meth:`~bluefog_tpu.control.CommController.note_alert`).
+        The publisher only reads — the exact mass audit is unchanged.
     """
     n = topology.size
+    if fleet is not None and fleet.dir is None:
+        raise ValueError(
+            "the thread runner has no barrier directory to default to: "
+            "pass fleet=FleetConfig(dir=...) naming the shared record "
+            "directory the bffleet-tpu dash / --check gate will read")
     packer = TreePacker(params0, np.float64)
     d = packer.size
 
@@ -1156,13 +1176,23 @@ def run_async_dsgd(
         gossip_every = 1
         # per-peer deposit-staleness clocks: the thread-mode lag signal
         # (seconds since the peer's last fresh deposit — the in-process
-        # analog of the wire path's ack EWMA)
+        # analog of the wire path's ack EWMA); fed for the controller
+        # AND the fleet publisher, whichever is armed
         last_fresh: Dict[int, float] = {}
+        # fleet health plane (opt-in): publisher + optional SLO engine.
+        # Rank threads SHARE one process's blackbox ring / metrics
+        # registry / procfs — rank 0 is elected their one carrier, or a
+        # fleet-wide sum over records would count them n-fold
+        flt = (_FleetRuntime(r, fleet.dir, fleet, process_stats=(r == 0))
+               if fleet is not None else None)
+        fleet_dis: Optional[float] = None
 
         def consume(x, p, observe: bool = False):
+            nonlocal fleet_dis
             dis = None
             z0 = None
-            if observe and ctl is not None:
+            fleet_due = flt is not None and flt.due(steps[r])
+            if observe and (ctl is not None or fleet_due):
                 z0 = x / p
             now = time.perf_counter()
             for k in my_slots:
@@ -1170,16 +1200,24 @@ def run_async_dsgd(
                     continue
                 buf, fresh = wins[r].read(k, consume=True)
                 if fresh > 0:
-                    if z0 is not None:
-                        if buf[-1] > 0:
-                            dj = float(np.linalg.norm(
-                                buf[:-1] / buf[-1] - z0))
-                            dis = dj if dis is None else max(dis, dj)
-                        last_fresh[k] = now
+                    if z0 is not None and buf[-1] > 0:
+                        dj = float(np.linalg.norm(
+                            buf[:-1] / buf[-1] - z0))
+                        dis = dj if dis is None else max(dis, dj)
+                    if observe and (ctl is not None or flt is not None):
+                        # staleness clocks are keyed by SOURCE RANK:
+                        # capacity slots are rank-indexed already, but a
+                        # fixed fleet's dense slots must translate
+                        # through the in-neighbor list (keying by slot
+                        # would attribute rank j's freshness to rank k)
+                        last_fresh[k if cap_slots
+                                   else in_nbrs[r][k]] = now
                     x += buf[:-1]
                     p += buf[-1]
             if observe and ctl is not None and dis is not None:
                 ctl.note_disagreement(dis)
+            if fleet_due:
+                fleet_dis = dis
             return p
 
         def harvest_evidence_at_round_boundary():
@@ -1290,6 +1328,7 @@ def run_async_dsgd(
                 frac = 1.0
                 known_active: Optional[frozenset] = None
                 want_leave = False
+                t_rnd0 = time.perf_counter()  # boundary-to-boundary clock
                 try:
                     while not stop.is_set():
                         _chaos.check_step(r, steps[r])
@@ -1415,6 +1454,33 @@ def run_async_dsgd(
                                     step=steps[r], rank=r)
                             rec.record("optimizer_step", step=steps[r],
                                        rank=r, loss=float(loss))
+                        # boundary-to-boundary wall clock: the
+                        # inter-round skew sleep is part of the cadence
+                        now_p = time.perf_counter()
+                        rdt = now_p - t_rnd0
+                        t_rnd0 = now_p
+                        _mt.observe("bf_round_seconds", rdt, rank=str(r))
+                        if flt is not None:
+                            flt.note_round(rdt)
+                            if flt.due(steps[r]):
+                                # fleet telemetry at this round
+                                # boundary: staleness ages of the
+                                # CURRENT in-neighbors (the thread-mode
+                                # lag signal) + the round's loop-local
+                                # values; the publisher only reads
+                                now_t = time.perf_counter()
+                                peer_tel = {
+                                    k: {"lag": now_t
+                                        - last_fresh.setdefault(k, now_t)}
+                                    for k in my_in if k != r}
+                                flt.boundary(
+                                    steps[r], mass=p,
+                                    z_mean=float(z.mean()),
+                                    dis=fleet_dis,
+                                    staleness=(steps[r] % snapshot_every
+                                               if snapshot_every
+                                               else None),
+                                    peers=peer_tel, controller=ctl)
                         steps[r] += 1
                         if (stop_after_steps is not None
                                 and steps[r] >= stop_after_steps):
@@ -1490,6 +1556,9 @@ def run_async_dsgd(
         except BaseException as e:
             errors.append(e)
             stop.set()
+        finally:
+            if flt is not None:
+                flt.close()  # records are on disk line by line already
 
     threads = [threading.Thread(target=rank_loop, args=(r,), daemon=True)
                for r in range(n)]
@@ -1851,6 +1920,7 @@ def run_async_dsgd_rank(
     control: Optional[_ControlConfig] = None,
     stop_after_steps: Optional[int] = None,
     stream_options: Optional[Dict] = None,
+    fleet: Optional[_FleetConfig] = None,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -1964,6 +2034,20 @@ def run_async_dsgd_rank(
     a BOUNDED queue is how a deployment opts into honest backpressure
     instead of buffering unboundedly toward a slow peer.
 
+    ``fleet`` (:class:`~bluefog_tpu.fleet.FleetConfig`) arms the fleet
+    health plane's telemetry publisher: every ``fleet.every``-th round
+    boundary this rank appends a round-stamped record (round-time
+    stats, push-sum mass, per-peer lag/phase EWMAs, blackbox event
+    counts, metrics deltas, ``/proc`` host gauges) to
+    ``fleet.<rank>`` in ``fleet.dir`` (default: the barrier
+    directory) — what ``bffleet-tpu`` dashboards live and replays as
+    the ``--check`` SLO regression gate.  Declaring ``fleet.slos``
+    additionally runs the per-rank SLO engine in-loop; with
+    ``control=`` active, alert-named ranks feed back into the
+    controller's evidence as SUSPECT (see ``docs/fleet.md``).  The
+    publisher reads, never moves, mass — the exact audit is unchanged
+    with it active (asserted by the bench and the MP acceptance test).
+
     Returns a :class:`DSGDReport` on rank 0 (``losses`` filled only at index
     ``rank`` — other ranks' loss curves stay in their processes), ``None``
     elsewhere (including joiners and leavers).
@@ -2054,7 +2138,7 @@ def run_async_dsgd_rank(
             join=join, leave_after_s=leave_after_s,
             initial_members=initial_members,
             snapshot_every=snapshot_every, control=control,
-            stop_after_steps=stop_after_steps)
+            stop_after_steps=stop_after_steps, fleet=fleet)
     finally:
         if snapshot_every:
             _snapshots.table().drop(f"{name}:{rank}")
@@ -2075,7 +2159,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         transport, create_window, open_window,
                         resilience=None, join=False, leave_after_s=None,
                         initial_members=None, snapshot_every=0,
-                        control=None, stop_after_steps=None):
+                        control=None, stop_after_steps=None, fleet=None):
     n = topology.size
     packer = TreePacker(params0, np.float64)
     d = packer.size
@@ -2143,6 +2227,13 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     gossip_every = 1
     if ctl is not None:
         _ctlev.clear_evidence(barrier.path, rank)  # previous life's record
+
+    # fleet health plane (opt-in): per-rank telemetry publisher +
+    # optional in-loop SLO engine, appending to fleet.<rank> in the
+    # shared directory (default: the barrier dir — the one medium every
+    # rank and the bffleet-tpu dash already watch)
+    flt = (_FleetRuntime(rank, fleet.dir or barrier.path, fleet)
+           if fleet is not None else None)
 
     # slot scheme (must agree across every rank of the job, which the
     # shared arguments guarantee): elastic AND control-plane runs use
@@ -2277,6 +2368,48 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                     h.set_codec(new_plan.codec)
                 except (RuntimeError, OSError, ValueError):
                     pass  # a dying handle's codec no longer matters
+
+    def _round_end_telemetry(z, dis) -> None:
+        """Per-round observability at THIS round boundary: the
+        ``bf_round_seconds`` histogram plus — when the fleet plane is
+        armed and the cadence is due — the telemetry record publish
+        (and SLO/alert evaluation over the shared records).  Reads
+        loop-local values and the streams' telemetry accessors; moves
+        no mass (the exact audit is indifferent to it).  Round time is
+        boundary-to-boundary wall clock, so the inter-round skew sleep
+        and the boundary work itself are IN it — the cadence an
+        operator's p99 question is about."""
+        nonlocal t_rnd0
+        now_p = time.perf_counter()
+        rdt = now_p - t_rnd0
+        t_rnd0 = now_p
+        _mt.observe("bf_round_seconds", rdt, rank=str(rank))
+        if flt is None:
+            return
+        flt.note_round(rdt)
+        if not flt.due(steps):
+            return
+        peer_tel: Dict[int, Dict[str, float]] = {}
+        for j, h in sorted(peers.items()):
+            if j in dead or j in left:
+                continue
+            ae = getattr(h, "ack_ewma", None)
+            lag = ae() if ae is not None else None
+            if lag is None:
+                continue
+            entry = {"lag": float(lag)}
+            pe = getattr(h, "phase_ewma", None)
+            ph = pe() if pe is not None else None
+            if ph:
+                entry.update({str(k): float(v) for k, v in ph.items()})
+            peer_tel[j] = entry
+        stale = (steps % snapshot_every) if snapshot_every else None
+        with _tr.span("fleet", "dsgd", round_=steps):
+            flt.boundary(steps, mass=p,
+                         z_mean=(float(z.mean()) if z is not None
+                                 else float("nan")),
+                         dis=dis, staleness=stale, peers=peer_tel,
+                         controller=ctl)
 
     def _mass_rendezvous(stage: str) -> float:
         """Second half of a quiesce-rendezvous: publish local mass, meet
@@ -2468,6 +2601,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         members know the handoff landed.  The audit stays exact: the
         mass is conserved among the remaining members."""
         nonlocal x, p
+        if flt is not None:
+            flt.close()  # the leaver's history ends at its last round
         token = _mship.new_token()
         stage = f"leave-{rank}-{token}"
         _bb.record("leave_begin", rank=rank, step=steps)
@@ -2670,6 +2805,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     leave_deadline = leave_after_s
 
     t0 = time.perf_counter()
+    t_rnd0 = t0  # first round's boundary-to-boundary clock starts here
     while (time.perf_counter() - t0 < duration_s
            and (stop_after_steps is None or steps < stop_after_steps)):
         try:
@@ -2704,7 +2840,11 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             rec.begin("collective", key=("async_dsgd_mp", rank, steps),
                       op="async_dsgd_round", cid="async_dsgd_round",
                       step=steps, rank=rank, peers=my_out)
-        z_pre = (x / p) if ctl is not None else None
+        # the disagreement observation feeds control evidence every
+        # round and the fleet record at its (cheaper) publish cadence
+        z_pre = (x / p if (ctl is not None
+                           or (flt is not None and flt.due(steps)))
+                 else None)
         dis = None
         with _tr.span("gossip", "dsgd", round_=steps):
             # gossip-IN: consume landed neighbor mass
@@ -2748,6 +2888,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                 trec.emit("round", "dsgd", t0=t_rnd_w,
                           dur=time.perf_counter() - t_rnd_p,
                           round_=steps, step=steps)
+            _round_end_telemetry(z, dis)
             steps += 1
             if skew_s > 0 or poll_interval_s > 0:
                 time.sleep(skew_s + poll_interval_s)
@@ -2834,9 +2975,15 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             trec.emit("round", "dsgd", t0=t_rnd_w,
                       dur=time.perf_counter() - t_rnd_p, round_=steps,
                       step=steps)
+        _round_end_telemetry(z, dis)
         steps += 1
         if skew_s > 0 or poll_interval_s > 0:
             time.sleep(skew_s + poll_interval_s)
+    if flt is not None:
+        # the run is over: land the file handle (records already on
+        # disk line by line — a crash loses at most the torn tail the
+        # readers tolerate)
+        flt.close()
     # FENCE before the audit barrier: every pipelined deposit must be
     # acknowledged as APPLIED by its owner before this rank declares "I
     # deposit no more" — otherwise in-flight mass would land after the

@@ -1,0 +1,821 @@
+"""Fleet health plane tests (bluefog_tpu.fleet).
+
+1. Record semantics: canonical JSON round-trip, NaN spelling, publisher
+   delta bookkeeping (metrics families, blackbox event counts, round
+   stats), /proc host gauges, the serving-table live-push ride.
+2. FleetView aggregation: incremental tailing, latest-at-or-before
+   round alignment, rollup math against hand-computed oracles.
+3. Aggregation under damage: a seeded fuzzer tears/drops/duplicates/
+   reorders/misfiles records and asserts the view NEVER attributes a
+   value to the wrong rank or round (records self-identify).
+4. SLO engine: spec validation (hysteresis pairs, windows, burn rates)
+   and a table-driven state-machine suite — no-flap inside the band,
+   burn-rate gating, PAGE escalation, full-window clears, min_abs
+   floors.
+5. Alert-as-evidence: SLOEngine -> CommController.note_alert -> the
+   Evidence states channel (merged as max, explicit retraction,
+   surviving the retain_peers surface sweep).
+6. Integration: thread-mode run_async_dsgd(fleet=...) with a skewed
+   straggler — records land, the exact mass audit holds, and the
+   ``bffleet-tpu --check`` subprocess pair exits nonzero on the seeded
+   breach and 0 on the clean twin (the tier-1 regression gate).
+7. Slow/chaos MP acceptance: 3 tcp rank processes under a seeded
+   ``server:delay`` straggler on rank 2 — the replay names rank 2,
+   WARN lands within <= 5 rounds of injection, exits nonzero; the
+   chaos-free twin exits 0; both audits exact.
+"""
+
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests._util import clean_env, uniq
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "_mp_fleet_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# 1. records + publisher
+# ---------------------------------------------------------------------------
+class TestRecord:
+    def test_canonical_roundtrip(self):
+        from bluefog_tpu.fleet import FleetRecord
+
+        rec = FleetRecord(
+            rank=3, round=17, t=123.5,
+            round_s={"count": 4, "mean": 0.01, "p50": 0.01,
+                     "p99": 0.02, "max": 0.02},
+            mass=0.75, z_mean=1.5, dis=float("nan"), staleness=2,
+            peers={1: {"lag": 0.004, "net": 0.003}},
+            events={"tcp_batch_deposit": 9},
+            host={"rss_bytes": 1e8, "cpu_s": 1.5, "threads": 12},
+            metrics={"bf_comm_bytes_total": 4096.0})
+        text = rec.to_json()
+        back = FleetRecord.from_json(text)
+        assert back.to_json() == text
+        assert back.rank == 3 and back.round == 17
+        assert math.isnan(back.dis)
+        # canonical: NaN is spelled null, keys sorted
+        assert "NaN" not in text
+        assert json.loads(text)["dis"] is None
+
+    def test_future_version_refused(self):
+        from bluefog_tpu.fleet import FleetRecord
+
+        with pytest.raises(ValueError, match="future"):
+            FleetRecord.from_json('{"v": 99, "rank": 0, "round": 0}')
+
+    def test_host_sample_procfs(self):
+        from bluefog_tpu.fleet import sample_host
+
+        host = sample_host()
+        if not os.path.exists("/proc/self/status"):
+            pytest.skip("no procfs on this host")
+        assert host["rss_bytes"] > 1e6
+        assert host["threads"] >= 1
+        assert host["cpu_s"] > 0
+
+    def test_publisher_deltas_and_stats(self, tmp_path):
+        from bluefog_tpu.blackbox import recorder as bb
+        from bluefog_tpu.fleet import FleetView, TelemetryPublisher
+        from bluefog_tpu.metrics import registry as reg
+
+        r = reg.metrics_start()
+        try:
+            rec = bb.configure(rank=0)
+            pub = TelemetryPublisher(0, str(tmp_path), every=2)
+            assert pub.due(0) and not pub.due(1) and pub.due(2)
+            r.counter("bf_x_total").inc(5.0, peer="1")
+            r.counter("bf_x_total").inc(2.0, peer="2")
+            rec.record("window_deposit", slot=1)
+            rec.record("window_deposit", slot=2)
+            rec.record("tcp_connect")
+            for s in (0.01, 0.02, 0.03, 0.04):
+                pub.note_round(s)
+            out1 = pub.publish(0, mass=0.5, z_mean=2.0)
+            # label sets aggregate into one family; blackbox kinds count
+            assert out1.metrics["bf_x_total"] == 7.0
+            assert out1.events == {"window_deposit": 2, "tcp_connect": 1}
+            assert out1.round_s["count"] == 4
+            assert abs(out1.round_s["mean"] - 0.025) < 1e-12
+            assert out1.round_s["max"] == 0.04
+            # second publish: deltas only, round window reset
+            r.counter("bf_x_total").inc(1.0, peer="1")
+            rec.record("window_deposit", slot=1)
+            out2 = pub.publish(2, mass=0.25, z_mean=2.0)
+            assert out2.metrics.get("bf_x_total") == 1.0
+            assert out2.events == {"window_deposit": 1}
+            assert out2.round_s["count"] == 0
+            pub.close()
+            view = FleetView.load_dir(str(tmp_path))
+            assert view.ranks() == [0]
+            assert [rc.round for rc in
+                    (view.record(0, 0), view.record(0, 2))] == [0, 2]
+        finally:
+            reg.metrics_stop()
+            bb.reset()
+
+    def test_host_metrics_exported(self, tmp_path):
+        from bluefog_tpu.fleet import TelemetryPublisher
+        from bluefog_tpu.metrics import registry as reg
+
+        if not os.path.exists("/proc/self/status"):
+            pytest.skip("no procfs on this host")
+        r = reg.metrics_start()
+        try:
+            pub = TelemetryPublisher(0, str(tmp_path))
+            pub.publish(0)
+            pub.publish(1)
+            snap = r.snapshot()
+            assert snap["bf_host_rss_bytes"] > 1e6
+            assert snap["bf_host_threads"] >= 1
+            assert snap["bf_fleet_publishes_total"] == 2.0
+            pub.close()
+        finally:
+            reg.metrics_stop()
+
+    def test_process_stats_carrier_election(self, tmp_path):
+        # rank threads share one process's ring/registry/procfs: only
+        # the elected carrier's records carry them (n-fold sum guard)
+        from bluefog_tpu.blackbox import recorder as bb
+        from bluefog_tpu.fleet import TelemetryPublisher
+        from bluefog_tpu.metrics import registry as reg
+
+        r = reg.metrics_start()
+        try:
+            rec = bb.configure(rank=0)
+            r.counter("bf_x_total").inc(5.0)
+            rec.record("window_deposit")
+            carrier = TelemetryPublisher(0, str(tmp_path))
+            quiet = TelemetryPublisher(1, str(tmp_path),
+                                       process_stats=False)
+            out0 = carrier.publish(0)
+            out1 = quiet.publish(0)
+            assert out0.events and out0.metrics
+            assert not out1.events and not out1.metrics \
+                and not out1.host
+            carrier.close()
+            quiet.close()
+        finally:
+            reg.metrics_stop()
+            bb.reset()
+
+    def test_serving_ride_roundtrip(self, tmp_path):
+        from bluefog_tpu.fleet import (TelemetryPublisher,
+                                       decode_record_leaves)
+        from bluefog_tpu.serving import snapshots
+
+        pub = TelemetryPublisher(5, str(tmp_path), serve=True)
+        rec = pub.publish(7, mass=0.5, z_mean=-1.25,
+                          peers={1: {"lag": 0.25}})
+        rd, leaves = snapshots.table().read("bf_fleet:5")
+        assert rd == 7
+        back = decode_record_leaves(dict(leaves))
+        assert back.to_json() == rec.to_json()
+        pub.close()  # drops the group
+        with pytest.raises(Exception):
+            snapshots.table().read("bf_fleet:5")
+
+
+# ---------------------------------------------------------------------------
+# 2. view + rollups
+# ---------------------------------------------------------------------------
+def _mk(rank, round_, *, t=None, mean=0.01, p99=None, mass=0.5,
+        z_mean=1.0, peers=None, host=None):
+    from bluefog_tpu.fleet import FleetRecord
+
+    return FleetRecord(
+        rank=rank, round=round_, t=(t if t is not None else float(round_)),
+        round_s={"count": 1, "mean": mean, "p50": mean,
+                 "p99": p99 if p99 is not None else mean, "max": mean},
+        mass=mass, z_mean=z_mean, peers=peers or {}, host=host or {})
+
+
+def _write(dirpath, recs, rank):
+    from bluefog_tpu.fleet import record_path
+
+    with open(record_path(dirpath, rank), "a") as f:
+        for r in recs:
+            f.write(r.to_json() + "\n")
+
+
+class TestView:
+    def test_round_alignment_and_rollup_math(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView
+
+        d = str(tmp_path)
+        _write(d, [_mk(0, r, mean=0.010, z_mean=1.0,
+                       peers={1: {"lag": 0.002}, 2: {"lag": 0.1}})
+                   for r in range(6)], 0)
+        _write(d, [_mk(1, r, mean=0.011, z_mean=1.1,
+                       peers={2: {"lag": 0.2}})
+                   for r in range(6)], 1)
+        _write(d, [_mk(2, r, mean=0.050, z_mean=4.0)
+                   for r in range(3)], 2)  # lags behind after round 2
+        view = FleetView.load_dir(d)
+        assert view.ranks() == [0, 1, 2]
+        assert view.head_round() == 5
+        ru = view.rollup(5)
+        assert ru.reporters == (0, 1, 2)
+        # rank 2's latest word at round 5 is its round-2 record
+        assert ru.per_rank[2]["round"] == 2 and ru.per_rank[2]["lag"] == 3
+        # peer 2's lag = median over the two observers = (0.1+0.2)/2
+        assert abs(ru.peer_lag[2] - 0.15) < 1e-12
+        assert abs(ru.peer_lag[1] - 0.002) < 1e-12
+        # straggler z: rank 2's 50ms mean vs fleet {10, 11, 50}
+        assert ru.straggler_z[2] == max(ru.straggler_z.values())
+        assert ru.straggler_z[2] > 1.0
+        # consensus spread: z_means {1.0, 1.1, 4.0}, worst = rank 2
+        assert ru.spread_worst == 2
+        zbar = (1.0 + 1.1 + 4.0) / 3
+        assert abs(ru.consensus_spread - abs(4.0 - zbar)) < 1e-12
+        assert abs(ru.mass_total - 1.5) < 1e-12
+        assert ru.silent_ranks(2) == (2,)
+        assert ru.silent_ranks(4) == ()
+
+    def test_incremental_tail_partial_lines(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView, record_path
+
+        d = str(tmp_path)
+        view = FleetView()
+        path = record_path(d, 0)
+        line1 = _mk(0, 0).to_json()
+        line2 = _mk(0, 1).to_json()
+        with open(path, "w") as f:
+            f.write(line1 + "\n" + line2[:10])  # torn tail, no newline
+        assert view.tail_dir(d) == 1
+        assert view.record(0, 0) is not None
+        # the torn tail completes: the next tail picks EXACTLY it up
+        with open(path, "a") as f:
+            f.write(line2[10:] + "\n")
+        assert view.tail_dir(d) == 1
+        assert view.record(0, 1) is not None
+        assert view.torn == 0
+
+    def test_prune_keeps_each_ranks_newest_record(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView
+
+        d = str(tmp_path)
+        _write(d, [_mk(0, r) for r in range(100)], 0)
+        _write(d, [_mk(1, r) for r in range(6)], 1)  # went silent
+        view = FleetView.load_dir(d)
+        dropped = view.prune_before(90)
+        assert dropped == 90 + 5  # rank 0: rounds 0-89; rank 1: 0-4
+        # rank 1's newest word (round 5) survives the prune: the
+        # silent-rank detector still sees it
+        ru = view.rollup(99)
+        assert 1 in ru.reporters
+        assert ru.per_rank[1]["round"] == 5
+        assert ru.round_lag(1) == 94
+
+    def test_duplicate_round_newest_t_wins(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView
+
+        d = str(tmp_path)
+        _write(d, [_mk(0, 3, t=10.0, z_mean=1.0),
+                   _mk(0, 3, t=20.0, z_mean=2.0),
+                   _mk(0, 3, t=15.0, z_mean=3.0)], 0)
+        view = FleetView.load_dir(d)
+        assert view.record(0, 3).z_mean == 2.0
+
+
+# ---------------------------------------------------------------------------
+# 3. aggregation under damage (the torn-read-fuzzer pattern)
+# ---------------------------------------------------------------------------
+class TestDamageFuzz:
+    N_RANKS = 4
+    N_ROUNDS = 12
+
+    def _truth(self):
+        """Ground-truth records with sentinel values derived from
+        (rank, round): any cross-attribution becomes a value mismatch."""
+        recs = {}
+        for r in range(self.N_RANKS):
+            for k in range(self.N_ROUNDS):
+                recs[(r, k)] = _mk(
+                    r, k, t=100.0 + k, mean=0.001 * (r * 100 + k + 1),
+                    mass=r + k / 1000.0, z_mean=r * 1000.0 + k,
+                    peers={(r + 1) % self.N_RANKS:
+                           {"lag": r + k / 100.0}})
+        return recs
+
+    def test_fuzzed_damage_never_misattributes(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView, record_path
+
+        truth = self._truth()
+        for trial in range(25):
+            rng = random.Random(1000 + trial)
+            d = str(tmp_path / f"t{trial}")
+            os.makedirs(d)
+            # per-rank line lists, then seeded damage
+            by_rank = {r: [truth[(r, k)].to_json()
+                           for k in range(self.N_ROUNDS)]
+                       for r in range(self.N_RANKS)}
+            for r, lines in by_rank.items():
+                # late records: shuffle arrival order
+                if rng.random() < 0.5:
+                    rng.shuffle(lines)
+                # duplicates: re-append some records later
+                for _ in range(rng.randrange(3)):
+                    lines.append(rng.choice(lines))
+                # missing: drop some lines entirely
+                for _ in range(rng.randrange(3)):
+                    lines.pop(rng.randrange(len(lines)))
+                # misfiled: a record landing in ANOTHER rank's file
+                if rng.random() < 0.4:
+                    other = rng.randrange(self.N_RANKS)
+                    lines.append(truth[(other,
+                                        rng.randrange(self.N_ROUNDS))]
+                                 .to_json())
+                # garbage + torn lines
+                if rng.random() < 0.5:
+                    lines.insert(rng.randrange(len(lines) + 1),
+                                 "{not json" + "x" * rng.randrange(40))
+                blob = "\n".join(lines) + "\n"
+                if rng.random() < 0.5:
+                    blob += truth[(r, rng.randrange(self.N_ROUNDS))] \
+                        .to_json()[:rng.randrange(1, 40)]  # torn tail
+                with open(record_path(d, r), "w") as f:
+                    f.write(blob)
+            view = FleetView.load_dir(d)
+            # every surviving record matches ground truth for its OWN
+            # (rank, round) — damage may lose records, never mix them
+            for r in view.ranks():
+                table = view._recs[r]
+                for k, rec in table.items():
+                    want = truth[(r, k)]
+                    assert rec.z_mean == want.z_mean, (trial, r, k)
+                    assert rec.mass == want.mass, (trial, r, k)
+                    assert rec.peers == want.peers, (trial, r, k)
+            # rollups only ever read those records: spot-check one
+            head = view.head_round()
+            if head is not None:
+                ru = view.rollup(head)
+                for r in ru.reporters:
+                    rec = view.latest(r, at_round=head)
+                    assert ru.per_rank[r]["z_mean"] == rec.z_mean
+                    assert ru.per_rank[r]["round"] == rec.round
+
+    def test_empty_and_garbage_only_dirs(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView, record_path
+
+        d = str(tmp_path)
+        view = FleetView.load_dir(d)
+        assert view.ranks() == [] and view.head_round() is None
+        with open(record_path(d, 0), "w") as f:
+            f.write("garbage\n{}\n")
+        view = FleetView.load_dir(d)
+        assert view.ranks() == []
+        assert view.torn == 2
+
+
+# ---------------------------------------------------------------------------
+# 4. SLO engine
+# ---------------------------------------------------------------------------
+def _rollup_seq(values, *, rank=2):
+    """Synthetic single-signal rollups: peer_lag carries `values[i]`
+    for peer `rank` and 0.001 for peer 0 at round i."""
+    from bluefog_tpu.fleet import FleetRollup
+
+    out = []
+    for i, v in enumerate(values):
+        out.append(FleetRollup(
+            round=i, reporters=(0, 1), per_rank={},
+            peer_lag={0: 0.001, rank: v}, straggler_z={},
+            round_p50_s=0.01, round_p99_s=0.01,
+            consensus_spread=0.0, spread_worst=None,
+            mass_total=2.0, staleness_rounds=None))
+    return out
+
+
+class TestSLOSpec:
+    def test_hysteresis_pair_required(self):
+        from bluefog_tpu.fleet import SLOSpec
+
+        with pytest.raises(ValueError, match="hysteresis"):
+            SLOSpec(name="x", signal="peer_lag_s", warn_enter=1.0,
+                    warn_exit=1.0, window=4)
+        with pytest.raises(ValueError, match="hysteresis"):
+            SLOSpec(name="x", signal="peer_lag_s", warn_enter=1.0,
+                    warn_exit=2.0, window=4)
+
+    def test_page_pair_both_or_neither(self):
+        from bluefog_tpu.fleet import SLOSpec
+
+        with pytest.raises(ValueError, match="PAIR"):
+            SLOSpec(name="x", signal="peer_lag_s", warn_enter=1.0,
+                    warn_exit=0.5, window=4, page_enter=4.0)
+        with pytest.raises(ValueError, match="hysteresis"):
+            SLOSpec(name="x", signal="peer_lag_s", warn_enter=1.0,
+                    warn_exit=0.5, window=4, page_enter=4.0,
+                    page_exit=4.0)
+
+    def test_window_burn_signal_validated(self):
+        from bluefog_tpu.fleet import SLOSpec
+
+        with pytest.raises(ValueError, match="window"):
+            SLOSpec(name="x", signal="peer_lag_s", warn_enter=1.0,
+                    warn_exit=0.5, window=0)
+        with pytest.raises(ValueError, match="burn_rate"):
+            SLOSpec(name="x", signal="peer_lag_s", warn_enter=1.0,
+                    warn_exit=0.5, window=4, burn_rate=0.0)
+        with pytest.raises(ValueError, match="unknown SLO signal"):
+            SLOSpec(name="x", signal="nope", warn_enter=1.0,
+                    warn_exit=0.5, window=4)
+
+    def test_spec_file_roundtrip(self, tmp_path):
+        from bluefog_tpu.fleet import (default_specs, load_specs,
+                                       specs_to_json)
+
+        path = str(tmp_path / "slos.json")
+        with open(path, "w") as f:
+            f.write(specs_to_json(default_specs()))
+        assert load_specs(path) == default_specs()
+        with open(path, "w") as f:
+            f.write('{"slos": []}')
+        with pytest.raises(ValueError, match="no SLOs"):
+            load_specs(path)
+
+
+class TestSLOEngine:
+    def _engine(self, **over):
+        from bluefog_tpu.fleet import SLOEngine, SLOSpec
+
+        kw = dict(name="lag", signal="peer_lag_s", warn_enter=1.0,
+                  warn_exit=0.5, window=4, burn_rate=0.5,
+                  page_enter=4.0, page_exit=2.0)
+        kw.update(over)
+        return SLOEngine((SLOSpec(**kw),))
+
+    def test_burn_rate_gates_entry(self):
+        # one breaching rollup out of four is below burn 0.5: no WARN
+        eng = self._engine()
+        for ru in _rollup_seq([0.1, 2.0, 0.1, 0.1, 0.1, 0.1]):
+            eng.observe(ru)
+        assert eng.worst == 0 and not eng.transitions
+
+    def test_warn_page_clear_trajectory(self):
+        from bluefog_tpu.fleet import OK, PAGE, WARN
+
+        eng = self._engine()
+        seq = ([0.1, 2.0, 2.0] +        # 2/4 breach warn_enter -> WARN
+               [8.0, 8.0] +             # 2/4 breach page_enter -> PAGE
+               [0.1, 0.1, 0.1, 0.1] +   # window clears page_exit -> WARN
+               [0.1, 0.1, 0.1])         # window clears warn_exit -> OK
+        for ru in _rollup_seq(seq):
+            eng.observe(ru)
+        states = [(t.frm, t.to) for t in eng.transitions]
+        assert states == [(OK, WARN), (WARN, PAGE), (PAGE, WARN),
+                          (WARN, OK)], eng.transitions
+        assert eng.worst == PAGE
+        # attribution: the breaching peer is named on the raise
+        assert eng.transitions[0].rank == 2
+
+    def test_no_flap_inside_hysteresis_band(self):
+        # oscillation BETWEEN exit (0.5) and enter (1.0) after a WARN
+        # holds the state: never clears (>= exit entries exist), never
+        # re-raises (already WARN)
+        eng = self._engine()
+        seq = [2.0, 2.0] + [0.7, 0.9, 0.6, 0.8, 0.7, 0.9]
+        for ru in _rollup_seq(seq):
+            eng.observe(ru)
+        assert len(eng.transitions) == 1  # the single OK->WARN
+        assert eng.states()["lag"][0] == 1
+
+    def test_clear_requires_full_window(self):
+        from bluefog_tpu.fleet import OK, WARN
+
+        eng = self._engine()
+        seq = [2.0, 2.0, 0.1, 0.1, 0.1, 0.1, 0.1]
+        trs = []
+        for ru in _rollup_seq(seq):
+            trs += eng.observe(ru)
+        clear = [t for t in trs if t.to == OK]
+        assert len(clear) == 1
+        # the 2.0s leave the window only at round 5 (deque of 4)
+        assert clear[0].round == 5
+        assert [t.to for t in trs] == [WARN, OK]
+
+    def test_min_abs_floors_noise(self):
+        # enormous RATIOS over microscopic lags never alert
+        from bluefog_tpu.fleet import SLOEngine, SLOSpec
+
+        spec = SLOSpec(name="strag", signal="peer_lag_ratio",
+                       warn_enter=3.0, warn_exit=1.5, window=4,
+                       burn_rate=0.5, min_abs=0.02)
+        eng = SLOEngine((spec,))
+        for ru in _rollup_seq([0.019] * 8):  # ratio 19x, lag 19 ms
+            eng.observe(ru)
+        assert eng.worst == 0
+        eng2 = SLOEngine((spec,))
+        for ru in _rollup_seq([0.2] * 4):    # ratio 200x, lag 200 ms
+            eng2.observe(ru)
+        assert eng2.worst == 1
+        assert eng2.transitions[0].rank == 2
+
+    def test_rank_zero_attribution_survives_deescalation(self):
+        # rank 0 is a valid attribution: the PAGE->WARN move must name
+        # it, not fall back to the escalation's old rank (falsy-zero)
+        from bluefog_tpu.fleet import PAGE, WARN, FleetRollup, SLOEngine
+
+        def ru(i, lags):
+            return FleetRollup(
+                round=i, reporters=(0, 1), per_rank={},
+                peer_lag=lags, straggler_z={}, round_p50_s=0.01,
+                round_p99_s=0.01, consensus_spread=0.0,
+                spread_worst=None, mass_total=2.0,
+                staleness_rounds=None)
+
+        eng = self._engine()
+        seq = ([{1: 0.001, 3: 8.0}] * 2          # rank 3 pages
+               + [{1: 0.001, 0: 1.5}] * 6)       # rank 0 keeps WARN-level
+        for i, lags in enumerate(seq):
+            eng.observe(ru(i, lags))
+        down = [t for t in eng.transitions
+                if t.frm == PAGE and t.to == WARN]
+        assert down, eng.transitions
+        assert down[0].rank == 0, eng.transitions
+
+    def test_transitions_emit_blackbox_and_metrics(self):
+        from bluefog_tpu.blackbox import recorder as bb
+        from bluefog_tpu.metrics import registry as reg
+
+        r = reg.metrics_start()
+        rec = bb.configure(rank=0)
+        try:
+            eng = self._engine()
+            for ru in _rollup_seq([2.0, 2.0, 8.0, 8.0]):
+                eng.observe(ru)
+            kinds = [e["kind"] for e in rec.events()]
+            assert "slo_warn" in kinds and "slo_page" in kinds
+            snap = r.snapshot()
+            assert snap['bf_slo_state{slo="lag"}'] == 2.0
+            assert snap['bf_slo_transitions_total{slo="lag",to="WARN"}'] \
+                == 1.0
+        finally:
+            reg.metrics_stop()
+            bb.reset()
+
+    def test_silent_rank_signal(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView, SLOEngine, SLOSpec
+
+        d = str(tmp_path)
+        _write(d, [_mk(0, r) for r in range(20)], 0)
+        _write(d, [_mk(1, r) for r in range(4)], 1)  # goes silent
+        view = FleetView.load_dir(d)
+        eng = SLOEngine((SLOSpec(name="silent", signal="round_lag_max",
+                                 warn_enter=8.0, warn_exit=4.0,
+                                 window=4, burn_rate=0.75),))
+        eng.advance(view)
+        assert eng.worst == 1
+        assert eng.states()["silent"] == (1, 1)  # WARN, names rank 1
+
+
+# ---------------------------------------------------------------------------
+# 5. alerts as control evidence
+# ---------------------------------------------------------------------------
+class TestAlertEvidence:
+    def test_note_alert_merges_and_retracts(self):
+        from bluefog_tpu.control import CommController
+        from bluefog_tpu.runtime import resilience as res
+
+        ctl = CommController(0, 4)
+        ctl.note_peer(2, lag_s=0.01, state=res.HEALTHY)
+        ctl.note_alert(2, suspect=True)
+        ev = ctl.evidence(8)
+        assert ev.states[2] == res.SUSPECT  # max(HEALTHY, SUSPECT)
+        # transport says DEAD: the alert must not downgrade it
+        ctl.note_peer(2, state=res.DEAD)
+        assert ctl.evidence(16).states[2] == res.DEAD
+        ctl.note_peer(2, state=res.HEALTHY)
+        ctl.note_alert(2, suspect=False)
+        assert ctl.evidence(24).states[2] == res.HEALTHY
+
+    def test_alert_survives_retain_peers_sweep(self):
+        from bluefog_tpu.control import CommController
+        from bluefog_tpu.runtime import resilience as res
+
+        ctl = CommController(0, 4)
+        ctl.note_alert(3, suspect=True)  # fleet names a non-neighbor
+        ctl.retain_peers([1, 2])         # the per-window surface sweep
+        assert ctl.evidence(8).states[3] == res.SUSPECT
+        ctl.forget_peer(3)               # death/leave drops it
+        assert 3 not in ctl.evidence(16).states
+
+    def test_engine_feeds_controller_via_runtime(self, tmp_path):
+        from bluefog_tpu.control import CommController
+        from bluefog_tpu.fleet import FleetConfig, SLOSpec
+        from bluefog_tpu.fleet.wiring import FleetRuntime
+        from bluefog_tpu.runtime import resilience as res
+
+        d = str(tmp_path)
+        # rank 1's records already in the dir show peer 2 slow
+        _write(d, [_mk(1, r, peers={2: {"lag": 0.5}, 0: {"lag": 0.001}})
+                   for r in range(6)], 1)
+        cfg = FleetConfig(dir=d, every=1, slos=(
+            SLOSpec(name="strag", signal="peer_lag_ratio",
+                    warn_enter=3.0, warn_exit=1.5, window=2,
+                    burn_rate=0.5, min_abs=0.02),))
+        ctl = CommController(0, 4)
+        rt = FleetRuntime(0, d, cfg)
+        rt.note_round(0.01)
+        rt.boundary(6, mass=0.5, z_mean=1.0,
+                    peers={2: {"lag": 0.5}}, controller=ctl)
+        assert ctl.evidence(6).states.get(2) == res.SUSPECT
+        # alert clears -> the runtime retracts (hysteresis: the clear
+        # needs a FULL window of clean rollups, hence two boundaries)
+        _write(d, [_mk(1, r, peers={2: {"lag": 0.001},
+                                    0: {"lag": 0.001}})
+                   for r in range(7, 15)], 1)
+        for rd in (13, 14):
+            rt.boundary(rd, mass=0.5, z_mean=1.0,
+                        peers={2: {"lag": 0.001}}, controller=ctl)
+        assert ctl.evidence(14).states.get(2) != res.SUSPECT
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# 6. integration: thread-mode runs + the tier-1 --check subprocess pair
+# ---------------------------------------------------------------------------
+def _strict_specs_file(path):
+    from bluefog_tpu.fleet import SLOSpec, specs_to_json
+
+    # ABSOLUTE staleness thresholds, sized for a 2-core CI host: the
+    # thread runner's GIL stalls reach tens of ms, the seeded
+    # straggler's staleness runs ~400 ms — 150 ms separates them
+    # decisively (the relative peer_lag_ratio default is exercised by
+    # the MP tcp acceptance, where ack EWMAs are smooth)
+    specs = (SLOSpec(name="straggler", signal="peer_lag_s",
+                     warn_enter=0.15, warn_exit=0.05, window=4,
+                     burn_rate=0.5),
+             SLOSpec(name="silent", signal="round_lag_max",
+                     warn_enter=30.0, warn_exit=15.0, window=4,
+                     burn_rate=0.75),)
+    with open(path, "w") as f:
+        f.write(specs_to_json(specs))
+    return path
+
+
+def _thread_run(d, skew, duration=2.5):
+    from bluefog_tpu.fleet import FleetConfig
+    from bluefog_tpu.runtime.async_windows import run_async_dsgd
+    from bluefog_tpu.topology import FullyConnectedGraph
+
+    def lg(r, step, params):
+        return 0.0, {"w": np.zeros_like(np.asarray(params["w"]))}
+
+    return run_async_dsgd(
+        FullyConnectedGraph(3), {"w": np.arange(16.0)}, lg, lr=0.05,
+        duration_s=duration, skew=skew, name=uniq("fleet_thr"),
+        fleet=FleetConfig(dir=d, every=1))
+
+
+@pytest.mark.duration_budget(90)
+class TestCheckGate:
+    """The tier-1 regression gate: ``bffleet-tpu --check`` as a
+    subprocess over a seeded-breach run (exit nonzero, names the
+    straggler) and its clean twin (exit 0)."""
+
+    def test_check_pair_breach_and_clean(self, tmp_path):
+        from bluefog_tpu.fleet import FleetView
+
+        spec = _strict_specs_file(str(tmp_path / "slos.json"))
+        bdir = str(tmp_path / "breach")
+        cdir = str(tmp_path / "clean")
+        os.makedirs(bdir)
+        os.makedirs(cdir)
+        # seeded breach: rank 2's thread runs ~40x slower — its
+        # deposits go stale, every other rank's records convict it
+        rep_b = _thread_run(bdir, [0.01, 0.01, 0.4])
+        assert abs(rep_b.total_mass - 3) <= 1e-9 * 3
+        # clean twin: uniform cadence
+        rep_c = _thread_run(cdir, [0.01, 0.01, 0.01], duration=1.5)
+        assert abs(rep_c.total_mass - 3) <= 1e-9 * 3
+        assert FleetView.load_dir(bdir).ranks() == [0, 1, 2]
+
+        breach = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.fleet", "--check", bdir,
+             "--spec", spec],
+            capture_output=True, text=True, env=clean_env(), cwd=_REPO,
+            timeout=120)
+        assert breach.returncode != 0, breach.stdout + breach.stderr
+        assert "rank 2" in breach.stdout, breach.stdout
+        assert "WARN" in breach.stdout
+        # detection latency: the straggler WARN lands early
+        warn_rounds = [t for t in breach.stdout.splitlines()
+                       if "WARN straggler" in t]
+        assert warn_rounds, breach.stdout
+
+        clean = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.fleet", "--check", cdir,
+             "--spec", spec],
+            capture_output=True, text=True, env=clean_env(), cwd=_REPO,
+            timeout=120)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        assert "within SLO" in clean.stdout
+
+
+class TestCheckBenchGate:
+    def test_bench_gate_mode(self, tmp_path):
+        from bluefog_tpu.fleet import dash
+
+        good = str(tmp_path / "good.json")
+        with open(good, "w") as f:
+            json.dump({"a_ok": True, "nested": {"ok": True},
+                       "ratio": 0.3}, f)
+        assert dash.main(["--check", good]) == 0
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump({"a_ok": True,
+                       "trials": [{"detection_ok": False}]}, f)
+        assert dash.main(["--check", bad]) == 3
+
+    def test_committed_bench_file_gates_green(self):
+        from bluefog_tpu.fleet import dash
+
+        path = os.path.join(_REPO, "BENCH_fleet.json")
+        assert dash.main(["--check", path]) == 0
+
+    def test_missing_dir_and_bad_spec_exit_2(self, tmp_path):
+        from bluefog_tpu.fleet import dash
+
+        assert dash.main(["--check", str(tmp_path / "nope")]) == 2
+        bad = str(tmp_path / "bad_spec.json")
+        with open(bad, "w") as f:
+            f.write('{"slos": [{"name": "x"}]}')
+        assert dash.main(["--check", str(tmp_path), "--spec", bad]) == 2
+
+    def test_empty_dir_exits_2(self, tmp_path):
+        from bluefog_tpu.fleet import dash
+
+        assert dash.main(["--check", str(tmp_path)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# 7. MP acceptance (slow): 3 tcp rank processes, chaos straggler
+# ---------------------------------------------------------------------------
+def _run_mp(bdir, variant, steps=50):
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(r), "3", bdir, variant,
+         str(steps)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=clean_env(), cwd=_REPO) for r in range(3)]
+    outs = []
+    deadline = time.time() + 150
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(5.0,
+                                               deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} rc={p.returncode}:\n{out}"
+        assert f"FLEET_MP_OK {r}" in out
+    return outs
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestMPAcceptance:
+    """ISSUE 12 acceptance: a 3-rank tcp dsgd run under an injected
+    ``server:delay`` straggler on rank 2 — ``bffleet-tpu --check``
+    names the slow rank, the WARN lands within <= 5 rounds of
+    injection (chaos is live from round 0), exits nonzero; the
+    chaos-free twin exits 0; the exact mass audit holds in both (the
+    workers assert it)."""
+
+    def test_chaos_breach_then_clean_twin(self, tmp_path):
+        bdir = str(tmp_path / "chaos")
+        cdir = str(tmp_path / "clean")
+        os.makedirs(bdir)
+        os.makedirs(cdir)
+        _run_mp(bdir, "chaos")
+        chk = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.fleet", "--check", bdir],
+            capture_output=True, text=True, env=clean_env(), cwd=_REPO,
+            timeout=120)
+        assert chk.returncode != 0, chk.stdout + chk.stderr
+        assert "rank 2" in chk.stdout, chk.stdout
+        warn_lines = [ln for ln in chk.stdout.splitlines()
+                      if "WARN straggler" in ln and "rank 2" in ln]
+        assert warn_lines, chk.stdout
+        warn_round = int(warn_lines[0].split("round")[1].split(":")[0])
+        assert warn_round <= 5, chk.stdout  # detection latency gate
+
+        _run_mp(cdir, "clean")
+        chk2 = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.fleet", "--check", cdir],
+            capture_output=True, text=True, env=clean_env(), cwd=_REPO,
+            timeout=120)
+        assert chk2.returncode == 0, chk2.stdout + chk2.stderr

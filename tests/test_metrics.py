@@ -543,6 +543,73 @@ class TestExportAndDash:
         counter = rows['bf_comm_bytes_total{op="na"}']
         assert counter["per_step_mean"] == pytest.approx(256.0)
 
+    def test_dash_follow_tails_live_file(self, tmp_path):
+        """--follow: the dash re-reads a GROWING JSONL, renders new
+        data, and exits 0 when the run's summary line lands — the live
+        half of the one-shot dash (fleet-plane satellite, PR 12)."""
+        import time as _time
+
+        path = str(tmp_path / "m.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(
+                {"step": 0, "metrics": {"bf_x_total": 1.0}}) + "\n")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "bluefog_tpu.metrics.dash", path,
+             "--follow", "--interval", "0.2"],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            # wait for the first rendered frame...
+            first = []
+            deadline = _time.time() + 90
+            while _time.time() < deadline:
+                line = proc.stdout.readline()
+                first.append(line)
+                if "step record(s)" in line:
+                    break
+            assert any("step record(s)" in ln for ln in first), first
+            # ...then the run appends more data and finishes
+            with open(path, "a") as f:
+                f.write(json.dumps({"step": 1, "metrics": {
+                    "bf_x_total": 2.0, "bf_late_total": 7.0}}) + "\n")
+                f.write(json.dumps({"summary": True, "metrics": {
+                    "bf_x_total": 2.0, "bf_late_total": 7.0}}) + "\n")
+            rest, _ = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        out = "".join(first) + rest
+        assert proc.returncode == 0, out
+        # a later frame rendered the late-appended series and the
+        # summary marker ended the loop
+        assert out.count("step record(s)") >= 2, out
+        assert "bf_late_total" in rest
+        assert "summary line present" in out
+
+    def test_dash_follow_waits_for_missing_file(self, tmp_path):
+        """--follow on a not-yet-created path waits instead of exiting
+        (the run may not have opened its writer yet)."""
+        import time as _time
+
+        path = str(tmp_path / "later.jsonl")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "bluefog_tpu.metrics.dash", path,
+             "--follow", "--interval", "0.2"],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            line = proc.stdout.readline()  # the waiting notice
+            assert "waiting" in line, line
+            with open(path, "w") as f:
+                f.write(json.dumps({"summary": True, "metrics":
+                                    {"bf_x_total": 1.0}}) + "\n")
+            out, _ = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == 0, out
+        assert "summary line present" in out
+
     def test_prometheus_text_format(self):
         reg = mreg.metrics_start()
         reg.counter("bf_comm_bytes_total", "bytes shipped").inc(64, op="x")
