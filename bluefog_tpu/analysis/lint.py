@@ -421,6 +421,10 @@ def control_pass(report: LintReport, size: int) -> None:
         root, "bluefog_tpu", "control", "*.py")))
     targets += sorted(glob.glob(os.path.join(
         root, "bluefog_tpu", "runtime", "*.py")))
+    # the fleet simulator actuates real CommPlans at its epoch barrier
+    # — same round-boundary discipline, same lint
+    targets += sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "sim", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
     n = 0
@@ -451,6 +455,9 @@ def fleet_pass(report: LintReport, size: int) -> None:
         root, "bluefog_tpu", "fleet", "*.py")))
     targets += sorted(glob.glob(os.path.join(
         root, "bluefog_tpu", "runtime", "*.py")))
+    # the simulator's scenario layer constructs SLO specs too
+    targets += sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "sim", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
     n = 0
@@ -464,6 +471,40 @@ def fleet_pass(report: LintReport, size: int) -> None:
         f"fleet-lint scanned {n} file(s) for unpaired alert/SLO "
         "thresholds",
         pass_name="fleet-lint", subject="fleet"))
+
+
+def sim_pass(report: LintReport, size: int) -> None:
+    """Pass 12 — BF-SIM: the fleet simulator's determinism contract
+    (no wall clock / no ambient RNG inside ``bluefog_tpu/sim/``) and
+    the scenario-table discipline (every ``Scenario(...)`` call site
+    declares ``accept=`` predicates and a bounded ``horizon_s=``) —
+    see :mod:`bluefog_tpu.analysis.sim_lint` and docs/sim.md."""
+    import glob
+
+    from bluefog_tpu.analysis.sim_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "sim", "*.py")))
+    # scenario tables can also live in examples/benchmarks — the
+    # accept/horizon rule follows the constructor there too (tests are
+    # deliberately NOT swept: they construct invalid scenarios inside
+    # pytest.raises on purpose; Scenario.__post_init__ still guards
+    # any table a test builds for real)
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-SIM100",
+        f"sim-lint scanned {n} file(s) for wall-clock/ambient-RNG "
+        "calls and unchecked scenario entries",
+        pass_name="sim-lint", subject="sim"))
 
 
 def concurrency_pass(report: LintReport, size: int) -> None:
@@ -703,6 +744,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     control_pass(report, size)
     tracing_pass(report, size)
     fleet_pass(report, size)
+    sim_pass(report, size)
     concurrency_pass(report, size)
     doc_pass(report, size)
     examples_pass(report, size)

@@ -1,0 +1,171 @@
+"""BF-SIM lint: the simulator's determinism contract, statically held.
+
+The fleet digital twin (:mod:`bluefog_tpu.sim`, docs/sim.md) promises
+two things a regression gate lives or dies by:
+
+1. **Same seed, same bytes.**  Nothing in ``bluefog_tpu/sim/`` may
+   read the wall clock or the ambient process RNG — virtual time comes
+   from the event loop, randomness from ``random.Random`` instances
+   seeded through :func:`bluefog_tpu.sim.core.derive_seed`.  One
+   ``time.time()`` in a handler and the scenario report depends on host
+   load; one ``random.random()`` and it depends on import order.
+2. **Every scenario is a CHECK.**  A table entry without an acceptance
+   predicate is a demo, and one without a bounded virtual-time horizon
+   is a hang waiting for a scheduler; :class:`~bluefog_tpu.sim.
+   scenarios.Scenario` enforces both at construction, and this lint
+   enforces them at every CALL SITE — a keyword omitted in source is
+   caught before anything runs.
+
+The rules (AST source lint, the BF-CTL001/BF-FLT001 family):
+
+- **BF-SIM001** (error), inside ``bluefog_tpu/sim/``: a call on the
+  ``time`` module that reads a clock or sleeps (``time.time``,
+  ``time.monotonic``, ``time.perf_counter``, ``time.sleep``, ...), or
+  a call on the ``random`` / ``np.random`` module's AMBIENT generator
+  (``random.random``, ``random.randint``, ``np.random.rand``, ...).
+  Constructing a SEEDED generator (``random.Random(seed)``,
+  ``np.random.default_rng(seed)``) is the sanctioned spelling and does
+  not fire.
+- **BF-SIM001** (error), anywhere: a ``Scenario(...)`` call missing the
+  ``accept=`` or ``horizon_s=`` keyword (positional/`**kwargs`
+  spellings are left to the runtime validator, the BF-FLT001 posture).
+
+**BF-SIM100** (info): scan summary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_determinism", "check_scenario_table", "check_file"]
+
+#: time-module attributes that read a host clock or block on one
+_CLOCK_ATTRS = frozenset((
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "process_time", "process_time_ns", "sleep",
+    "clock_gettime", "localtime", "gmtime",
+))
+
+#: ambient-RNG entry points on the random / numpy.random modules; the
+#: seeded constructors (Random, SystemRandom is NOT ok, default_rng,
+#: Generator) are deliberately absent
+_AMBIENT_RNG_ATTRS = frozenset((
+    "random", "randint", "randrange", "uniform", "gauss", "normalvariate",
+    "choice", "choices", "shuffle", "sample", "seed", "betavariate",
+    "expovariate", "triangular", "vonmisesvariate", "getrandbits",
+    "rand", "randn", "permutation", "standard_normal",
+))
+
+_RNG_MODULE_NAMES = frozenset(("random", "np.random", "numpy.random"))
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def check_determinism(source: str, *, filename: str = "<source>"
+                      ) -> List[Diagnostic]:
+    """BF-SIM001 rule 1: no wall clock, no ambient RNG (for files under
+    ``bluefog_tpu/sim/``)."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-SIM003",
+            f"could not parse {filename}: {e}",
+            pass_name="sim-lint", subject=filename)]
+    short = os.path.basename(filename)
+    diags: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        base = _dotted(node.func.value)
+        attr = node.func.attr
+        if base == "time" and attr in _CLOCK_ATTRS:
+            diags.append(Diagnostic(
+                "error", "BF-SIM001",
+                f"time.{attr}() at {short}:{node.lineno}: the simulator "
+                "runs on the VIRTUAL clock only (EventLoop.now) — a "
+                "wall-clock read makes the scenario report depend on "
+                "host load and breaks same-seed byte-identity",
+                pass_name="sim-lint",
+                subject=f"{short}:{node.lineno}"))
+        elif base in _RNG_MODULE_NAMES and attr in _AMBIENT_RNG_ATTRS:
+            diags.append(Diagnostic(
+                "error", "BF-SIM001",
+                f"{base}.{attr}() at {short}:{node.lineno}: the "
+                "simulator draws only from seeded random.Random "
+                "instances (bluefog_tpu.sim.core.rng_for) — the ambient "
+                "module generator depends on import order and every "
+                "other consumer in the process",
+                pass_name="sim-lint",
+                subject=f"{short}:{node.lineno}"))
+    return diags
+
+
+def check_scenario_table(source: str, *, filename: str = "<source>"
+                         ) -> List[Diagnostic]:
+    """BF-SIM001 rule 2: every ``Scenario(...)`` call site spells
+    ``accept=`` and ``horizon_s=`` as keywords."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Diagnostic(
+            "warning", "BF-SIM003",
+            f"could not parse {filename}: {e}",
+            pass_name="sim-lint", subject=filename)]
+    short = os.path.basename(filename)
+    diags: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if name != "Scenario":
+            continue
+        kwargs = {kw.arg for kw in node.keywords if kw.arg is not None}
+        has_splat = any(kw.arg is None for kw in node.keywords)
+        if has_splat or node.args:
+            continue  # runtime validation's job (BF-FLT001 posture)
+        for want, why in (
+                ("accept", "a scenario without an acceptance predicate "
+                           "is a demo, not a regression check"),
+                ("horizon_s", "a scenario without a bounded virtual-"
+                              "time horizon is an unbounded run, not "
+                              "a gate")):
+            if want not in kwargs:
+                diags.append(Diagnostic(
+                    "error", "BF-SIM001",
+                    f"Scenario(...) at {short}:{node.lineno} omits "
+                    f"{want}= — {why}",
+                    pass_name="sim-lint",
+                    subject=f"{short}:{node.lineno}"))
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    """Both rules over one file; the determinism rule applies only to
+    files living under ``bluefog_tpu/sim/``."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return [Diagnostic(
+            "warning", "BF-SIM003", f"could not read {path}: {e}",
+            pass_name="sim-lint", subject=path)]
+    diags = check_scenario_table(source, filename=path)
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    if "/bluefog_tpu/sim/" in norm:
+        diags.extend(check_determinism(source, filename=path))
+    return diags

@@ -32,24 +32,13 @@ like the metrics/blackbox env vars), programmatically via
 CLI.  Everything is deterministic given the same traffic: triggers count
 frames/steps, and probabilistic rules draw from a per-rule seeded RNG.
 
-Spec grammar (``;``-separated rules)::
-
-    spec  := rule (';' rule)*
-    rule  := site ':' fault (':' key '=' value)*
-    site  := 'server' | 'ack' | 'client' | 'read' | 'sub' | 'any' | 'rank<N>'
-    fault := 'drop' | 'truncate' | 'delay' | 'stall'          (socket)
-           | 'sigkill' | 'sigstop' | 'die'                    (process/thread)
-           | 'leave' | 'join'                                 (membership churn)
-
-Socket-rule keys: ``after_frames=N`` (fire once when the site's frame
-counter reaches N), ``every=K`` (every K-th frame), ``prob=P`` (seeded
-coin per frame), ``rate=P`` (the LOSSY-LINK spelling of the same seeded
-coin: a link that loses ~P of its frames, deterministic per seed —
-``server:drop:rate=0.05`` is a 5%-loss link), ``times=T`` (max firings;
-0 = unlimited), ``seed=S``, ``ms=M`` (delay milliseconds), ``s=S``
-(stall seconds).  Rank-rule keys: ``at_step=N`` (fired from the rank
-loop's :func:`check_step`), ``after_s=T`` (armed as a timer by
-:func:`arm`), ``for_s=T`` (sigstop duration / stall length via ``s=``).
+The spec grammar — sites, faults, trigger keys, and their validation —
+is defined and documented exactly ONCE, in
+:mod:`bluefog_tpu.chaos.spec` (``parse_spec`` / ``Rule``); this package
+re-exports the parser, and the fleet simulator
+(:mod:`bluefog_tpu.sim`) consumes the same parsed rules for its
+declarative fault schedules, so a fault reproduced live at 3 ranks
+replays unchanged at 1000 simulated ranks.
 
 Examples::
 

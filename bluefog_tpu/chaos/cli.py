@@ -22,44 +22,11 @@ import subprocess
 import sys
 from typing import List, Optional
 
-from bluefog_tpu.chaos.injector import ChaosSpecError, parse_spec
+from bluefog_tpu.chaos.spec import (GRAMMAR, ChaosSpecError,
+                                    parse_spec)
 
 __all__ = ["main"]
 
-_GRAMMAR = """\
-spec  := rule (';' rule)*
-rule  := site ':' fault (':' key '=' value)*
-site  := 'server' | 'ack' | 'client' | 'read' | 'sub' | 'any' | 'rank<N>'
-fault := drop | truncate | delay | stall            (socket sites)
-       | sigkill | sigstop | die | stall            (rank sites)
-       | leave | join                               (membership churn)
-
-socket keys: after_frames=N  every=K  prob=P  rate=P  times=T  seed=S
-             ms=M (delay)    s=S (stall)
-             (rate= is the lossy-link spelling of prob=: a link that
-             loses ~P of its frames, deterministic per seed)
-rank keys:   at_step=N  after_s=T  for_s=T (sigstop thaw / stall length)
-             (leave needs at_step=; join needs after_s=)
-
-sites 'server'/'ack'/'client' are the deposit (write) path; 'read' cuts
-or stalls sync-read/SNAPSHOT replies on the serving host, 'sub' the
-subscription push sender — the read-path fault surface.
-
-examples:
-  server:drop:after_frames=40      cut a server connection at frame 40
-  ack:drop:after_frames=3          apply batch 3, drop before the ack
-  client:truncate:after_frames=5   send half a frame, then cut
-  server:delay:ms=20:prob=0.1      delay 10%% of frames by 20 ms
-  server:drop:rate=0.05:seed=3     a 5%%-loss lossy link (seeded)
-  read:truncate:every=7            tear every 7th read reply mid-frame
-  read:stall:s=2:prob=0.05         wedge 5%% of read replies for 2 s
-  sub:drop:after_frames=10         cut a push subscription at frame 10
-  sub:stall:s=1:every=13           stall every 13th snapshot push 1 s
-  rank2:sigkill:at_step=8          rank 2 SIGKILLs itself at step 8
-  rank1:sigstop:after_s=0.8:for_s=1  freeze rank 1 for 1 s, then thaw
-  rank1:leave:at_step=20           graceful drain (mass handed off)
-  rank3:join:after_s=0.5           rank 3 attaches to the running job
-"""
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -78,7 +45,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.grammar:
-        print(_GRAMMAR)
+        print(GRAMMAR)
         return 0
     if args.spec is None:
         ap.error("--spec is required (or use --grammar)")

@@ -1,5 +1,9 @@
-"""The chaos injector: spec parsing, trigger evaluation, fault execution.
+"""The chaos injector: trigger evaluation and fault execution.
 
+Spec parsing lives in :mod:`bluefog_tpu.chaos.spec` — the ONE grammar
+definition, shared with the fleet simulator's fault schedules
+(:mod:`bluefog_tpu.sim`); this module re-exports ``Rule`` /
+``parse_spec`` / ``ChaosSpecError`` so existing imports keep working.
 See the package docstring for the grammar.  Design notes:
 
 - **Cheap when off.**  ``fire()`` is one module-level call with a None
@@ -21,7 +25,6 @@ See the package docstring for the grammar.  Design notes:
 
 from __future__ import annotations
 
-import dataclasses
 import os
 import random
 import signal
@@ -32,6 +35,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.blackbox import recorder as _bb
+from bluefog_tpu.chaos.spec import (ChaosSpecError, Rule, parse_spec)
 from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.metrics import comm as _mt
 
@@ -53,19 +57,6 @@ __all__ = [
 ]
 
 _ENV = "BLUEFOG_TPU_CHAOS"
-
-_SOCKET_FAULTS = ("drop", "truncate", "delay", "stall")
-_RANK_FAULTS = ("sigkill", "sigstop", "die", "stall", "leave", "join")
-# 'read' fires where the server is about to send a sync-read / SNAPSHOT
-# reply (drop = vanish, truncate = reply torn mid-frame, stall = wedged
-# owner); 'sub' fires in the per-subscription push sender (stall = slow
-# push channel, drop/truncate = the reader's connection cut, torn for
-# truncate).  Together they are the READ-path fault surface, the twin of
-# the PR-5 deposit-path sites.
-_SOCKET_SITES = ("server", "ack", "client", "read", "sub", "any")
-
-_INT_KEYS = ("after_frames", "every", "times", "seed", "at_step")
-_FLOAT_KEYS = ("prob", "rate", "ms", "s", "after_s", "for_s")
 
 
 class ChaosKill(Exception):
@@ -92,132 +83,6 @@ class ChaosLeave(Exception):
         super().__init__(f"chaos drained rank {rank} at step {step}")
         self.rank = rank
         self.step = step
-
-
-class ChaosSpecError(ValueError):
-    """Malformed ``BLUEFOG_TPU_CHAOS`` spec."""
-
-
-@dataclasses.dataclass
-class Rule:
-    site: str                 # 'server' | 'ack' | 'client' | 'any' | 'rank'
-    fault: str
-    rank: Optional[int] = None
-    after_frames: Optional[int] = None
-    every: Optional[int] = None
-    prob: Optional[float] = None
-    # the LOSSY-LINK trigger: an independent seeded coin per frame, like
-    # ``prob`` but named for what it models — a link that loses ~rate of
-    # its frames, deterministically per seed.  One of prob/rate per rule.
-    rate: Optional[float] = None
-    times: Optional[int] = None      # None -> default per trigger kind
-    seed: int = 0
-    ms: float = 0.0                  # delay milliseconds
-    s: float = 0.0                   # stall seconds
-    at_step: Optional[int] = None
-    after_s: Optional[float] = None
-    for_s: Optional[float] = None
-
-    def max_fires(self) -> int:
-        """0 = unlimited."""
-        if self.times is not None:
-            return self.times
-        # a one-shot by nature: counter threshold or a scheduled fault
-        if (self.after_frames is not None or self.at_step is not None
-                or self.after_s is not None):
-            return 1
-        return 0
-
-
-def _parse_rule(text: str, index: int) -> Rule:
-    parts = [p.strip() for p in text.split(":") if p.strip()]
-    if len(parts) < 2:
-        raise ChaosSpecError(
-            f"rule {text!r}: need at least '<site>:<fault>'")
-    site_raw, fault = parts[0].lower(), parts[1].lower()
-    rank: Optional[int] = None
-    if site_raw.startswith("rank"):
-        try:
-            rank = int(site_raw[4:])
-        except ValueError:
-            raise ChaosSpecError(
-                f"rule {text!r}: bad rank site {site_raw!r} "
-                "(want e.g. 'rank2')") from None
-        site = "rank"
-        if fault not in _RANK_FAULTS:
-            raise ChaosSpecError(
-                f"rule {text!r}: fault {fault!r} is not a rank fault "
-                f"{_RANK_FAULTS}")
-    elif site_raw in _SOCKET_SITES:
-        site = site_raw
-        if fault not in _SOCKET_FAULTS:
-            raise ChaosSpecError(
-                f"rule {text!r}: fault {fault!r} is not a socket fault "
-                f"{_SOCKET_FAULTS}")
-    else:
-        raise ChaosSpecError(
-            f"rule {text!r}: unknown site {site_raw!r} (want one of "
-            f"{_SOCKET_SITES} or 'rank<N>')")
-    kw: Dict[str, object] = {}
-    for p in parts[2:]:
-        if "=" not in p:
-            raise ChaosSpecError(f"rule {text!r}: bad key=value {p!r}")
-        k, v = p.split("=", 1)
-        k = k.strip().lower()
-        try:
-            if k in _INT_KEYS:
-                kw[k] = int(v)
-            elif k in _FLOAT_KEYS:
-                kw[k] = float(v)
-            else:
-                raise ChaosSpecError(
-                    f"rule {text!r}: unknown key {k!r}")
-        except ValueError:
-            raise ChaosSpecError(
-                f"rule {text!r}: bad value for {k!r}: {v!r}") from None
-    rule = Rule(site=site, fault=fault, rank=rank,
-                seed=int(kw.pop("seed", index)), **kw)  # type: ignore
-    if rule.site == "rank" and rule.at_step is None and rule.after_s is None:
-        raise ChaosSpecError(
-            f"rule {text!r}: rank faults need at_step= or after_s=")
-    if rule.fault == "die" and rule.at_step is None:
-        raise ChaosSpecError(
-            f"rule {text!r}: 'die' is a thread-loop fault and needs "
-            "at_step= (a timer thread cannot kill another thread)")
-    if rule.fault == "leave" and rule.at_step is None:
-        raise ChaosSpecError(
-            f"rule {text!r}: 'leave' is a graceful drain executed by the "
-            "rank loop itself and needs at_step= (the leave protocol — "
-            "fence, mass handoff, record — must run on the leaving "
-            "rank's own thread at a round boundary)")
-    if rule.fault == "join" and rule.after_s is None:
-        raise ChaosSpecError(
-            f"rule {text!r}: 'join' schedules when a rank ATTACHES to "
-            "the running job and needs after_s= (queried by the elastic "
-            "runner via join_times(), not executed as a fault)")
-    if rule.prob is not None and rule.rate is not None:
-        raise ChaosSpecError(
-            f"rule {text!r}: prob= and rate= are the same trigger "
-            "(a seeded per-frame coin); give one, not both")
-    for k in ("prob", "rate"):
-        v = getattr(rule, k)
-        if v is not None and not (0.0 <= v <= 1.0):
-            raise ChaosSpecError(f"rule {text!r}: {k} must be in [0, 1]")
-    if rule.rate is not None and rule.site == "rank":
-        raise ChaosSpecError(
-            f"rule {text!r}: rate= is a socket-site trigger (a lossy "
-            "link); rank faults are scheduled with at_step=/after_s=")
-    return rule
-
-
-def parse_spec(spec: str) -> List[Rule]:
-    rules = [
-        _parse_rule(part, i)
-        for i, part in enumerate(p for p in spec.split(";") if p.strip())
-    ]
-    if not rules:
-        raise ChaosSpecError(f"empty chaos spec {spec!r}")
-    return rules
 
 
 class Injector:

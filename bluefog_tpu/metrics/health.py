@@ -184,6 +184,17 @@ class MixingTracker:
             ).set(self.predicted, **self.labels)
         return self.predicted
 
+    def reset_measurement(self) -> None:
+        """Drop the previous consensus-distance sample so the NEXT
+        :meth:`update` yields no ratio — owed at every MEMBERSHIP
+        boundary (join/leave/heal), where the previous distance was
+        measured over a DIFFERENT member set: the cross-boundary ratio
+        compares apples to oranges and reads as a mixing failure (a
+        join widens disagreement) or a miracle (a corpse's outlier
+        leaves).  :meth:`rebase` re-anchors the *prediction*; this
+        re-anchors the *measurement* stream."""
+        self._prev = None
+
     @staticmethod
     def _predict(schedule) -> Optional[float]:
         try:

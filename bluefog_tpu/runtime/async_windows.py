@@ -1172,6 +1172,7 @@ def run_async_dsgd(
         ctl = (_CommController(r, n, config=control)
                if control is not None else None)
         tracker: Optional[_MixingTracker] = None
+        tracker_members: Optional[frozenset] = None
         my_in: List[int] = list(in_nbrs[r])
         gossip_every = 1
         # per-peer deposit-staleness clocks: the thread-mode lag signal
@@ -1250,7 +1251,7 @@ def run_async_dsgd(
             mixing topology; rebases the mixing tracker so the
             bf_mixing_excess baseline tracks the topology actually in
             effect."""
-            nonlocal tracker, gossip_every
+            nonlocal tracker, gossip_every, tracker_members
             plan_topo = ctl.apply_plan(topology=topology, members=active)
             gossip_every = ctl.plan.gossip_every
             # the feed-window exponent tracks the CADENCE in effect: a
@@ -1258,11 +1259,19 @@ def run_async_dsgd(
             # evidence window, and a prediction still assuming
             # gossip-every-step would read the stretch as broken mixing
             rpu = max(1, round(control.evidence_every / gossip_every))
+            live = frozenset(active)
             if tracker is None:
                 tracker = _MixingTracker(
                     plan_topo, rounds_per_update=rpu, rank=str(r))
             else:
+                if tracker_members is not None and live != tracker_members:
+                    # a MEMBERSHIP boundary: the previous distance was
+                    # measured over a different member set, and the
+                    # cross-boundary ratio would feed a bogus
+                    # bf_mixing_excess into the densify ladder
+                    tracker.reset_measurement()
                 tracker.rebase(plan_topo, rounds_per_update=rpu)
+            tracker_members = live
             ctl_changes[r] = ctl.plan.version
             return plan_topo
 
@@ -2224,6 +2233,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     ctl = (_CommController(rank, n, config=control)
            if control is not None else None)
     tracker: Optional[_MixingTracker] = None
+    tracker_members: Optional[frozenset] = None
     gossip_every = 1
     if ctl is not None:
         _ctlev.clear_evidence(barrier.path, rank)  # previous life's record
@@ -2270,7 +2280,7 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         the disseminated evidence — so every rank that has seen the
         same records converges on the same matrix with no extra
         coordination."""
-        nonlocal tracker, gossip_every
+        nonlocal tracker, gossip_every, tracker_members
         t0p = time.perf_counter()
         if ctl is not None:
             plan = ctl.apply_plan(topology=topology, members=members - dead)
@@ -2279,11 +2289,19 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             # stretched gossip_every halves the gossip rounds per
             # evidence window — see MixingTracker.rebase)
             rpu = max(1, round(control.evidence_every / gossip_every))
+            live = frozenset(members - dead)
             if tracker is None:
                 tracker = _MixingTracker(
                     plan, rounds_per_update=rpu, rank=str(rank))
             else:
+                if tracker_members is not None and live != tracker_members:
+                    # membership boundary: drop the cross-member-set
+                    # sample (see MixingTracker.reset_measurement) —
+                    # a departing outlier's miracle ratio must not
+                    # walk the densify ladder down
+                    tracker.reset_measurement()
                 tracker.rebase(plan, rounds_per_update=rpu)
+            tracker_members = live
         elif elastic:
             plan = _replan(topology, members - dead)
         else:

@@ -1,0 +1,58 @@
+"""Fleet digital twin: a deterministic discrete-event simulator that
+runs the package's REAL decision code at 1000+ simulated ranks.
+
+Every live check in this repo tops out at 3-4 ranks in one container;
+the paper's claims (arXiv:2111.04287) are about what topology and
+asynchrony do at fleet scale.  The pieces needed to go bigger without
+sockets already existed — ``decide_plan`` is pure and byte-convergent,
+``replan``/``replan_penalized``/``heal`` are deterministic and
+memoryless, Evidence and FleetRecord are canonical JSON, and the chaos
+grammar describes faults declaratively — this package composes them
+under a virtual clock:
+
+- :mod:`~bluefog_tpu.sim.core` — event loop, virtual clock, seeded RNG
+  derivation (no wall clock, no ambient RNG: the BF-SIM001 contract);
+- :mod:`~bluefog_tpu.sim.network` — links with latency/loss/straggler
+  profiles expressed in the ONE chaos spec grammar
+  (:mod:`bluefog_tpu.chaos.spec`);
+- :mod:`~bluefog_tpu.sim.mixing` — synchronous spectral-gap fidelity:
+  simulated contraction vs the real MixingTracker's |lambda_2|;
+- :mod:`~bluefog_tpu.sim.fleet` — the event-driven push-sum fleet over
+  the real ``CommController``/``decide_plan``, ``replan``/``heal``, and
+  ``SLOEngine`` code paths, with exact mass audits through churn;
+- :mod:`~bluefog_tpu.sim.scenarios` — the table-driven scenario lab
+  (diurnal autoscale, partition, flash crowd, cascading slow peers)
+  with bounded horizons and explicit acceptance predicates;
+- the ``bfsim-tpu`` CLI (:mod:`~bluefog_tpu.sim.cli`) — ``--check``
+  runs the suite and exits nonzero on any failed predicate.
+
+See docs/sim.md for the event model, the determinism contract, and the
+scenario grammar.
+"""
+
+from bluefog_tpu.sim.core import EventLoop, derive_seed, rng_for
+from bluefog_tpu.sim.fleet import FleetSim, SimConfig
+from bluefog_tpu.sim.mixing import MixingRun, run_sync_mixing
+from bluefog_tpu.sim.network import FaultBox, LinkModel, SendOutcome
+from bluefog_tpu.sim.scenarios import (PREDICATES, SCENARIO_NAMES,
+                                       Scenario, build_suite,
+                                       run_scenario, run_suite)
+
+__all__ = [
+    "EventLoop",
+    "FaultBox",
+    "FleetSim",
+    "LinkModel",
+    "MixingRun",
+    "PREDICATES",
+    "SCENARIO_NAMES",
+    "Scenario",
+    "SendOutcome",
+    "SimConfig",
+    "build_suite",
+    "derive_seed",
+    "rng_for",
+    "run_scenario",
+    "run_suite",
+    "run_sync_mixing",
+]

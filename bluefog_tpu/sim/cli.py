@@ -1,0 +1,118 @@
+"""``bfsim-tpu``: run the fleet digital twin's scenario lab.
+
+::
+
+    bfsim-tpu --list                         # scenario table
+    bfsim-tpu network_partition [--ranks N]  # one scenario, full report
+    bfsim-tpu --check [--ranks N] [--seed S] [--report PATH]
+
+``--check`` runs the WHOLE suite and exits nonzero on any failed
+acceptance predicate — the controller-change regression gate the
+4-rank live bench cannot be.  ``--report`` writes the deterministic
+JSON report (same seed, byte-identical bytes — no wall clock in it);
+``BENCH_sim.json`` is exactly that file at the 1024-rank acceptance
+scale, and it carries the ``*_ok`` booleans the ``bffleet-tpu --check``
+bench gate verifies.
+
+Exit codes (the CI contract, see docs/sim.md):
+
+====  ====================================================
+0     every acceptance predicate passed
+2     usage error / unknown scenario
+3     at least one acceptance predicate failed
+====  ====================================================
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from bluefog_tpu.sim.scenarios import (SCENARIO_NAMES, build_suite,
+                                       run_suite)
+
+__all__ = ["main"]
+
+
+def _print_report(doc: dict, *, verbose: bool, out) -> None:
+    for rep in doc["scenarios"]:
+        flag = "ok " if rep["ok"] else "FAIL"
+        print(f"[{flag}] {rep['name']:22s} kind={rep['kind']:6s} "
+              f"n={rep['n_ranks']}", file=out)
+        for pname, info in rep["predicates"].items():
+            pf = "ok " if info["ok"] else "FAIL"
+            detail = {k: v for k, v in info.items() if k != "ok"}
+            print(f"    [{pf}] {pname}: "
+                  f"{json.dumps(detail, sort_keys=True, default=str)}",
+                  file=out)
+        if verbose and "stats" in rep:
+            print("    stats: " + json.dumps(rep["stats"],
+                                             sort_keys=True), file=out)
+        if verbose:
+            for line in rep.get("slo_transitions", [])[:8]:
+                print("    slo: " + line, file=out)
+    print(("suite: OK" if doc["ok"] else "suite: FAILED"), file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bfsim-tpu",
+        description="Discrete-event fleet simulator: run a scenario, or "
+                    "the whole regression suite with --check (exit 0 "
+                    "all predicates pass, 3 on any failure, 2 usage).")
+    ap.add_argument("scenario", nargs="?", default=None,
+                    help="scenario name (see --list); omit with --check")
+    ap.add_argument("--check", action="store_true",
+                    help="run the full scenario suite as a regression "
+                    "gate")
+    ap.add_argument("--ranks", type=int, default=1024,
+                    help="simulated rank count (default 1024, the "
+                    "acceptance scale; use a small value for a smoke "
+                    "trim)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="scenario seed (same seed -> byte-identical "
+                    "report)")
+    ap.add_argument("--report", metavar="PATH", default=None,
+                    help="write the deterministic JSON report here")
+    ap.add_argument("--list", action="store_true",
+                    help="list the scenario table and exit")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print per-scenario stats and SLO lines")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for sc in build_suite(n=args.ranks, seed=args.seed):
+            print(f"{sc.name:22s} kind={sc.kind:6s} n={sc.n_ranks:5d} "
+                  f"horizon={sc.horizon_s:g}s "
+                  f"predicates={[p[0] for p in sc.accept]}")
+        return 0
+
+    if args.ranks < 8:
+        print("bfsim-tpu: --ranks must be >= 8", file=sys.stderr)
+        return 2
+    if not args.check and not args.scenario:
+        print("bfsim-tpu: name a scenario or pass --check "
+              f"(known: {list(SCENARIO_NAMES)})", file=sys.stderr)
+        return 2
+    names = None
+    if args.scenario:
+        if args.scenario not in SCENARIO_NAMES:
+            print(f"bfsim-tpu: unknown scenario {args.scenario!r} "
+                  f"(known: {list(SCENARIO_NAMES)})", file=sys.stderr)
+            return 2
+        names = [args.scenario]
+
+    doc = run_suite(n=args.ranks, seed=args.seed, names=names)
+    _print_report(doc, verbose=args.verbose, out=sys.stdout)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"report written to {args.report}")
+    return 0 if doc["ok"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

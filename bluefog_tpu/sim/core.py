@@ -1,0 +1,111 @@
+"""The discrete-event core: virtual clock, event queue, seeded RNG.
+
+The determinism contract (docs/sim.md), stated plainly and enforced by
+the BF-SIM001 lint over this package:
+
+- **No wall clock.**  Time is the :class:`EventLoop`'s ``now`` — a
+  float of virtual seconds that advances ONLY when the loop pops the
+  next event.  Nothing in ``bluefog_tpu/sim/`` may call ``time.time``/
+  ``time.monotonic``/``time.sleep``; a simulated second costs whatever
+  the handlers cost, and the same scenario produces the same virtual
+  trajectory on a loaded laptop and an idle server.
+- **No ambient RNG.**  Every random draw comes from a
+  ``random.Random`` seeded through :func:`derive_seed` — a stable FNV-1a
+  fold of the scenario seed and a structural name (``"link:3:7"``,
+  ``"compute:42"``), so adding a new consumer never perturbs existing
+  streams (the seeded-chaos discipline: per-rule RNGs, not one shared
+  stream whose consumption order is load-bearing).
+- **Deterministic ordering.**  Events at equal virtual times pop in
+  schedule order (a monotone sequence number breaks ties), so two runs
+  with the same seed execute handlers in the same order and the
+  scenario report is byte-identical.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["EventLoop", "derive_seed", "rng_for"]
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK = (1 << 64) - 1
+
+
+def derive_seed(*parts) -> int:
+    """A stable 64-bit seed from structural parts (ints/strings): FNV-1a
+    over the parts' canonical byte spellings.  Pure and
+    platform-independent — the same parts give the same seed on any
+    Python, which is what makes scenario reports reproducible across
+    machines."""
+    h = _FNV_OFFSET
+    for part in parts:
+        data = str(part).encode() + b"\x1f"
+        for b in data:
+            h = ((h ^ b) * _FNV_PRIME) & _MASK
+    return h
+
+
+def rng_for(*parts) -> random.Random:
+    """A fresh seeded ``random.Random`` for one named consumer (the only
+    sanctioned RNG constructor inside the simulator)."""
+    return random.Random(derive_seed(*parts))
+
+
+class EventLoop:
+    """A minimal deterministic discrete-event loop.
+
+    Events are ``(time, seq, fn)`` on a heap; ``seq`` is a monotone
+    schedule counter so same-time events pop in the order they were
+    scheduled (no comparison ever reaches the callables).  ``now``
+    advances monotonically — scheduling into the past is a bug and
+    raises rather than silently reordering history."""
+
+    def __init__(self):
+        self.now = 0.0
+        self._q: List[Tuple[float, int, Callable[[], None]]] = []
+        self._seq = 0
+        self.processed = 0
+
+    def at(self, t: float, fn: Callable[[], None]) -> None:
+        t = float(t)
+        if t < self.now:
+            raise ValueError(
+                f"cannot schedule at t={t:.6f} before now={self.now:.6f}")
+        heapq.heappush(self._q, (t, self._seq, fn))
+        self._seq += 1
+
+    def after(self, dt: float, fn: Callable[[], None]) -> None:
+        if dt < 0:
+            raise ValueError(f"negative delay {dt}")
+        self.at(self.now + float(dt), fn)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def run(self, until: Optional[float] = None,
+            max_events: Optional[int] = None) -> int:
+        """Pop and execute events in ``(time, seq)`` order until the
+        queue is empty, the next event lies beyond ``until``, or
+        ``max_events`` handlers ran (the runaway backstop every bounded
+        scenario horizon relies on).  Returns the number of events
+        executed by THIS call."""
+        n = 0
+        while self._q:
+            if max_events is not None and n >= max_events:
+                break
+            t, _, fn = self._q[0]
+            if until is not None and t > until:
+                break
+            heapq.heappop(self._q)
+            self.now = t
+            fn()
+            n += 1
+        if until is not None and self.now < until and (
+                not self._q or self._q[0][0] > until):
+            # the horizon itself is an observable point in virtual time
+            self.now = until
+        self.processed += n
+        return n

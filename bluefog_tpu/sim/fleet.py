@@ -1,0 +1,887 @@
+"""The fleet digital twin: an event-driven push-sum fleet at 1000 ranks.
+
+Everything DECISION-SHAPED is the real package code; only the physics
+(clock, sockets, signals) is simulated:
+
+- the mixing graph comes from the real :func:`bluefog_tpu.topology.
+  replan` / :func:`~bluefog_tpu.topology.replan_penalized` /
+  :func:`~bluefog_tpu.topology.heal` at every membership and plan
+  boundary — provenance-name collapse, inactive-row discipline and all;
+- every rank owns a real :class:`bluefog_tpu.control.CommController`;
+  per-peer lag/state/reconnect observations feed it exactly as the live
+  loops do, its :class:`~bluefog_tpu.control.Evidence` records are the
+  canonical-JSON objects, and plan decisions go through the real
+  :func:`~bluefog_tpu.control.decide_plan` — byte-convergence across
+  ranks is ASSERTED at every decision epoch;
+- mixing health is a real :class:`bluefog_tpu.metrics.health.
+  MixingTracker` (measured contraction on the simulated 1-D consensus
+  state vs the |lambda_2| prediction), rebased at every boundary;
+- fleet telemetry is real :class:`bluefog_tpu.fleet.FleetRecord`
+  objects fed to a real :class:`~bluefog_tpu.fleet.FleetView`, and the
+  real :class:`~bluefog_tpu.fleet.SLOEngine` replays over the simulated
+  rollups (the ``bffleet-tpu --check`` shape).
+
+The physics model (docs/sim.md has the full contract):
+
+- **push-sum gossip** on a scalar state per rank: at a round boundary a
+  rank consumes its mailbox, splits ``(x, p)`` uniformly over itself and
+  its current out-neighbors, and ships the shares over the
+  :class:`~bluefog_tpu.sim.network.LinkModel`; mass never leaves the
+  arrays, so the exact audit (``sum(x) == injected``, ``sum(p) ==
+  admissions``) holds to float addition error through every fault;
+- **fences**: the round boundary waits for the slowest of the round's
+  acks (the live loop's flush-per-peer), which is how a slow host
+  throttles its senders — and what a control plan's ring-spine penalty
+  relieves;
+- **failure detection** is sender-side: a send whose retries exhaust the
+  link budget is ABANDONED (mass kept, peer held DEAD in evidence); a
+  killed rank is healed out at the next evidence-epoch boundary, the
+  detection deadline the live HealthBoard's silence threshold plays;
+- **membership** changes only at boundaries: joins are queued and
+  admitted at the next epoch barrier (warm-started from a live donor's
+  de-biased state, the PR-6 snapshot warm-start), graceful leaves hand
+  their entire ``(x, p)`` to their out-neighbors at their own round
+  boundary (mass conserved, the drain-flag discipline);
+- **evidence dissemination** is epoch-consistent: every live rank's
+  epoch-``w`` decision reads the same canonicalized record set (the
+  shared barrier directory made ideal — no torn records, no propagation
+  delay; PR 8's torn-record fuzzers already cover that axis), which
+  isolates the byte-convergence property the simulator asserts.  The
+  one compute elision, stated plainly: with identical inputs and
+  identical prior plans, ``decide_plan`` is pure — so the simulator
+  runs the REAL decide on a deterministic sample of controllers
+  (``decide_sample``, all of them in small fleets), asserts literal
+  byte-equality across the sample, and installs the identical plan
+  everywhere instead of recomputing it ``n`` more times.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from bluefog_tpu.control.controller import CommController
+from bluefog_tpu.control.evidence import Evidence, canonicalize
+from bluefog_tpu.control.plan import CommPlan, ControlConfig
+from bluefog_tpu.fleet.record import FleetRecord
+from bluefog_tpu.fleet.slo import SLOEngine, SLOSpec, default_specs
+from bluefog_tpu.fleet.view import FleetView
+from bluefog_tpu.metrics.health import MixingTracker
+from bluefog_tpu.metrics.registry import quantile as _quantile
+from bluefog_tpu.sim.core import EventLoop, rng_for
+from bluefog_tpu.sim.network import LinkModel
+from bluefog_tpu.topology.graphs import Topology, heal, replan
+
+__all__ = ["SimConfig", "FleetSim", "ST_HEALTHY", "ST_SUSPECT", "ST_DEAD"]
+
+# the resilience health-state values, spelled locally exactly as
+# bluefog_tpu.control.controller spells them (this package must not
+# import the runtime back; the pairing is asserted by a test)
+ST_HEALTHY, ST_SUSPECT, ST_DEAD = 0, 1, 2
+
+_EWMA_ALPHA = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """One simulated fleet's knobs (all times are VIRTUAL seconds).
+
+    ``faults`` maps host rank -> a chaos spec (the
+    :mod:`bluefog_tpu.chaos.spec` grammar, verbatim): socket rules hit
+    that host's simulated transport, rank rules schedule kills/leaves/
+    stalls/joins.  ``compute_scale`` maps rank -> a persistent
+    round-compute multiplier (the straggler profile the chaos grammar
+    has no spelling for).  ``decide_sample`` bounds how many real
+    ``decide_plan`` calls run per epoch (byte-equality is asserted
+    across the sample; small fleets decide on every rank)."""
+
+    n_ranks: int
+    seed: int = 0
+    capacity: Optional[int] = None
+    initial_members: Optional[Sequence[int]] = None
+    base_round_s: float = 0.01
+    compute_jitter: float = 0.05
+    latency_s: float = 0.002
+    rto_s: float = 0.02
+    link_budget_s: float = 0.25
+    control: bool = False
+    control_cfg: Optional[ControlConfig] = None
+    evidence_every: int = 8
+    fleet_every: int = 4
+    decide_sample: int = 8
+    faults: Mapping[int, str] = dataclasses.field(default_factory=dict)
+    compute_scale: Mapping[int, float] = dataclasses.field(
+        default_factory=dict)
+    max_events: int = 8_000_000
+
+    def __post_init__(self):
+        if self.n_ranks < 2:
+            raise ValueError("n_ranks must be >= 2")
+        if self.evidence_every < 1 or self.fleet_every < 1:
+            raise ValueError("cadences must be >= 1")
+        if self.base_round_s <= 0:
+            raise ValueError("base_round_s must be > 0")
+
+
+class FleetSim:
+    """See the module docstring.  Construct, optionally schedule
+    scenario actions (:meth:`join` / :meth:`request_leave` /
+    :meth:`kill` / :meth:`set_partition` / :meth:`set_compute_scale` /
+    :meth:`set_host_faults` via ``loop.at``), then :meth:`run`."""
+
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        cap = int(cfg.capacity or cfg.n_ranks)
+        members = sorted(int(r) for r in (
+            cfg.initial_members if cfg.initial_members is not None
+            else range(cfg.n_ranks)))
+        if not members or members[-1] >= cap:
+            raise ValueError("initial members must fit the capacity")
+        self.capacity = cap
+        self.loop = EventLoop()
+        self.links = LinkModel(latency_s=cfg.latency_s, rto_s=cfg.rto_s,
+                               budget_s=cfg.link_budget_s, seed=cfg.seed)
+        for r, spec in sorted(dict(cfg.faults).items()):
+            self.links.set_host_faults(r, spec)
+        self.compute_scale: Dict[int, float] = dict(cfg.compute_scale)
+
+        # ---- per-slot state (plain lists: scalar hot path) ----
+        self.x = [0.0] * cap
+        self.p = [0.0] * cap
+        self.mx = [0.0] * cap          # in-flight mailbox (x shares)
+        self.mp = [0.0] * cap
+        self.alive = [False] * cap
+        self.round_no = [0] * cap
+        self._dis_last = [float("nan")] * cap
+        self._round_samples: List[List[float]] = [[] for _ in range(cap)]
+        self._last_recv: List[Dict[int, float]] = [{} for _ in range(cap)]
+        self._ack_ewma: List[Dict[int, float]] = [{} for _ in range(cap)]
+        self._retx_total: List[Dict[int, int]] = [{} for _ in range(cap)]
+        self._peer_state: List[Dict[int, int]] = [{} for _ in range(cap)]
+        self._dead_view: List[Set[int]] = [set() for _ in range(cap)]
+        self._pending_stall = [0.0] * cap
+        self._leave_requested: Set[int] = set()
+        # graceful leavers whose trailing in-flight deposits forward to
+        # a live member (the drain fence's conservation, kept exact)
+        self._forward_to: Dict[int, int] = {}
+        self._compute_rng = [rng_for("compute", cfg.seed, r)
+                             for r in range(cap)]
+
+        # ---- audit ledgers ----
+        self.injected_x = 0.0
+        self.admitted_p = 0.0
+        self.admissions = 0
+        self.leaves = 0
+        self.deaths = 0
+        # mass inside DELIVER events still queued at audit time (sends
+        # already deducted from the sender, not yet in a mailbox)
+        self._inflight_x = 0.0
+        self._inflight_p = 0.0
+
+        # ---- the real decision stack ----
+        self.ccfg = cfg.control_cfg or ControlConfig()
+        self.ctl: Dict[int, CommController] = {}
+        self.plan = CommPlan(codec_level=self.ccfg.max_codec_level)
+        self.plan_changes = 0
+        self.plan_divergences = 0
+        self._ev_store: Dict[int, Evidence] = {}
+        self._ev_round: Dict[int, int] = {}
+        self._epoch_decided = 0
+        self._corpses: Set[int] = set()
+        self._healed: Set[int] = set()
+        self._left_done: Set[int] = set()
+        self._pending_joins: List[int] = []
+
+        seed_topo = Topology(weights=np.eye(cap), name="sim")
+        self.topo = replan(seed_topo, members)
+        self._rebuild_adjacency()
+        # the tracker is fed once per epoch = evidence_every rank
+        # steps, each of which gossips (gossip_every=1 at launch), so
+        # the prediction exponent starts at evidence_every
+        self.tracker = MixingTracker(
+            self.topo, rounds_per_update=cfg.evidence_every)
+        self._mixing_excess: Optional[float] = None
+        self._d0: Optional[float] = None  # initial consensus distance
+        self.max_name_len = len(self.topo.name)
+        self.connectivity_ok = True
+
+        self.view = FleetView()
+        self.spread_history: List[Tuple[float, float, float]] = []
+
+        for r in members:
+            self._activate(r, x0=self._draw_x0(r))
+        # ranks whose next-epoch evidence the barrier still awaits (the
+        # O(1)-per-publish arrival counter; rebuilt after every barrier)
+        self._await_left: Set[int] = set(members)
+        # stagger first rounds inside one nominal round so the fleet is
+        # honestly asynchronous from t=0
+        for r in members:
+            start = self._compute_rng[r].random() * cfg.base_round_s
+            self.loop.at(start, self._round_fn(r))
+        self._arm_timed_faults()
+        # spread sampling on a fixed virtual-time grid (2 nominal
+        # rounds), so time-to-target resolution is independent of the
+        # epoch-barrier cadence a straggler stretches
+        self._sample_dt = 2.0 * cfg.base_round_s
+        self.loop.at(self._sample_dt, self._sample_tick)
+
+    def _sample_tick(self) -> None:
+        self._sample_spread()
+        self.loop.at(self.loop.now + self._sample_dt, self._sample_tick)
+
+    # ------------------------------------------------------------ plumbing
+    def _draw_x0(self, r: int) -> float:
+        return rng_for("x0", self.cfg.seed, r).uniform(-1.0, 1.0)
+
+    def _round_fn(self, r: int):
+        return lambda: self._round(r)
+
+    def _activate(self, r: int, *, x0: float) -> None:
+        # ACCUMULATE, never overwrite: a rejoining leaver whose drain
+        # handoff was partly abandoned (partition at leave time) still
+        # holds residual ledgered (x, p) in its slot — the admission
+        # adds the warm-start value and one unit of weight on top, so
+        # arrays and ledgers move by exactly the same amount and the
+        # exact audit survives a failed-drain rejoin
+        self.x[r] += float(x0)
+        self.p[r] += 1.0
+        self.alive[r] = True
+        self.injected_x += float(x0)
+        self.admitted_p += 1.0
+        self.admissions += 1
+        self.ctl[r] = CommController(r, self.capacity, config=self.ccfg)
+        self.ctl[r].plan = self.plan
+
+    def _arm_timed_faults(self) -> None:
+        for host in sorted(self.cfg.faults):
+            self._check_rank_rule_placement(host)
+            self._arm_box(host, base_t=0.0)
+
+    def _check_rank_rule_placement(self, host: int) -> None:
+        """A ``rank<N>`` rule filed under a DIFFERENT host's entry
+        would never be consulted by rank N's round handler — refuse it
+        loudly (the inert-rule posture ``read``/``sub`` already get)."""
+        box = self.links.host_box(host)
+        if box is None:
+            return
+        stray = sorted({r.rank for r in box.rules
+                        if r.site == "rank" and r.rank != host})
+        if stray:
+            raise ValueError(
+                f"faults entry for host {host} carries rank rules for "
+                f"rank(s) {stray}: rank faults must live under their "
+                "own rank's entry (a misplaced rule would sit silently "
+                "inert and make scenario predicates vacuous)")
+
+    def _arm_box(self, host: int, *, base_t: float) -> None:
+        """Schedule a box's ``after_s`` rank rules on the virtual clock
+        (offsets relative to ``base_t`` — construction time for config
+        faults, installation time for mid-run installs, the live
+        injector's ``arm()`` semantics).  Each armed closure re-checks
+        that ITS box is still the host's installed one before firing,
+        so replacing a spec genuinely cancels the superseded schedule
+        (heap entries cannot be deleted; stale ones become no-ops)."""
+        box = self.links.host_box(host)
+        if box is None:
+            return
+
+        def guarded(action):
+            def fire():
+                if self.links.host_box(host) is box:
+                    action()
+            return fire
+
+        for rule in box.timed_faults(host):
+            rk = int(rule.rank)
+            at = base_t + rule.after_s
+            if rule.fault == "join":
+                self.loop.at(at, guarded(
+                    (lambda j: lambda: self.join(j))(rk)))
+            elif rule.fault in ("die", "sigkill"):
+                self.loop.at(at, guarded(
+                    (lambda j: lambda: self.kill(j))(rk)))
+            elif rule.fault == "leave":
+                self.loop.at(at, guarded(
+                    (lambda j: lambda: self.request_leave(j))(rk)))
+            else:  # stall / sigstop: consumed at the next boundary
+                dur = rule.s if rule.s > 0 else (rule.for_s or 0.0)
+                self.loop.at(at, guarded(
+                    (lambda j, d: lambda: self._add_stall(j, d))(
+                        rk, dur)))
+
+    def _add_stall(self, r: int, dur: float) -> None:
+        self._pending_stall[r] += float(dur)
+
+    def _rebuild_adjacency(self) -> None:
+        w = self.topo.weights
+        pos = w > 0.0
+        np.fill_diagonal(pos, False)
+        # plain-int adjacency: numpy int64 keys make every hot-path dict
+        # op hash a numpy scalar — at 10^6 sends that is most of the run
+        self._adj_out = [[int(v) for v in np.nonzero(pos[:, r])[0]]
+                         for r in range(self.capacity)]
+        self._adj_in = [[int(v) for v in np.nonzero(pos[r, :])[0]]
+                        for r in range(self.capacity)]
+
+    # --------------------------------------------------- scenario actions
+    def members(self) -> List[int]:
+        return [r for r in range(self.capacity) if self.alive[r]]
+
+    def join(self, r: int) -> None:
+        """Queue slot ``r`` to join; admitted at the next epoch barrier
+        (round-boundary admission, the BF-RES002 discipline)."""
+        r = int(r)
+        if not self.alive[r] and r not in self._pending_joins:
+            self._pending_joins.append(r)
+
+    def request_leave(self, r: int) -> None:
+        """Ask rank ``r`` for a graceful drain at its next round
+        boundary (the ChaosLeave contract)."""
+        self._leave_requested.add(int(r))
+
+    def kill(self, r: int) -> None:
+        """SIGKILL twin: the rank stops mid-flight — no drain, no final
+        publish; its frozen ``(x, p)`` is the written-off mass and its
+        peers discover by silence."""
+        r = int(r)
+        if not self.alive[r]:
+            return
+        self.alive[r] = False
+        self._corpses.add(r)
+        self.deaths += 1
+        self._await_left.discard(r)
+        self._check_barrier()
+
+    def set_partition(self, cut_pairs) -> None:
+        self.links.set_partition(cut_pairs)
+        if not cut_pairs:
+            # reachability restored: let senders re-probe immediately
+            for dv in self._dead_view:
+                dv.clear()
+
+    def set_compute_scale(self, r: int, mult: float) -> None:
+        self.compute_scale[int(r)] = float(mult)
+
+    def set_host_faults(self, r: int, spec) -> None:
+        """Install (or replace) one host's chaos rules mid-run; timed
+        (``after_s``) rank rules are armed RELATIVE TO NOW — the live
+        injector's ``arm()`` semantics — so a schedule-installed fault
+        can never be silently inert."""
+        self.links.set_host_faults(int(r), spec)
+        self._check_rank_rule_placement(int(r))
+        self._arm_box(int(r), base_t=self.loop.now)
+
+    # ------------------------------------------------------ the rank round
+    def _round(self, r: int) -> None:
+        if not self.alive[r]:
+            return
+        t = self.loop.now
+        step = self.round_no[r]
+        extra = self._pending_stall[r]
+        self._pending_stall[r] = 0.0
+
+        box = self.links.host_box(r)
+        if box is not None:
+            for rule in box.rank_faults_due(r, step):
+                if rule.fault in ("die", "sigkill"):
+                    self.kill(r)
+                    return
+                if rule.fault == "leave":
+                    self._leave_now(r)
+                    return
+                # stall / sigstop freeze the loop for the stated time
+                extra += rule.s if rule.s > 0 else (rule.for_s or 0.0)
+        if r in self._leave_requested:
+            self._leave_requested.discard(r)
+            self._leave_now(r)
+            return
+
+        # ---- consume the mailbox (the observing consume) ----
+        if self.mp[r] != 0.0 or self.mx[r] != 0.0:
+            if self.mp[r] > 0 and self.p[r] > 0:
+                dis = abs(self.mx[r] / self.mp[r]
+                          - self.x[r] / self.p[r])
+                self._dis_last[r] = dis
+                self.ctl[r].note_disagreement(dis)
+            self.x[r] += self.mx[r]
+            self.p[r] += self.mp[r]
+            self.mx[r] = 0.0
+            self.mp[r] = 0.0
+
+        # ---- gossip (plan cadence) ----
+        fence = 0.0
+        if step % self.plan.gossip_every == 0:
+            fence = self._gossip(r, t)
+
+        # ---- telemetry at boundaries ----
+        nxt = step + 1
+        if nxt % self.cfg.fleet_every == 0:
+            self._publish_fleet(r, nxt, t)
+        if nxt % self.cfg.evidence_every == 0:
+            self._publish_evidence(r, nxt)
+
+        comp = (self.cfg.base_round_s * self.compute_scale.get(r, 1.0)
+                * (1.0 + self.cfg.compute_jitter
+                   * (2.0 * self._compute_rng[r].random() - 1.0)))
+        dur = comp + extra + fence
+        self._round_samples[r].append(dur)
+        self.round_no[r] = nxt
+        self.loop.at(t + dur, self._round_fn(r))
+
+    def _gossip(self, r: int, t: float) -> float:
+        """Split (x, p) over self + out-neighbors and ship the shares;
+        returns the fence cost (slowest ack of the round)."""
+        outs = self._adj_out[r]
+        if not outs:
+            return 0.0
+        share = 1.0 / (len(outs) + 1)
+        dead_view = self._dead_view[r]
+        ewma = self._ack_ewma[r]
+        retx = self._retx_total[r]
+        states = self._peer_state[r]
+        fence = 0.0
+        deliveries: Dict[float, List[Tuple[int, float, float]]] = {}
+        sent = 0
+        links_send = self.links.send
+        alive = self.alive
+        xr = self.x[r]
+        pr = self.p[r]
+        dx = xr * share
+        dp = pr * share
+        inflight_x = 0.0
+        inflight_p = 0.0
+        for j in outs:
+            if j in dead_view:
+                continue
+            out = links_send(r, j) if alive[j] else None
+            if out is None or out.abandoned:
+                # budget exhausted (or silent corpse): latch, keep the
+                # mass, hold the peer DEAD in this rank's evidence
+                fence = max(fence, self.links.budget_s)
+                dead_view.add(j)
+                ewma[j] = self.links.budget_s
+                states[j] = ST_DEAD
+                continue
+            deliveries.setdefault(out.deliver_dt, []).append(
+                (j, dx, dp))
+            inflight_x += dx
+            inflight_p += dp
+            sent += 1
+            prev = ewma.get(j)
+            ewma[j] = (out.ack_dt if prev is None
+                       else _EWMA_ALPHA * out.ack_dt
+                       + (1.0 - _EWMA_ALPHA) * prev)
+            if out.retries:
+                retx[j] = retx.get(j, 0) + out.retries
+            states[j] = ST_HEALTHY
+            if out.ack_dt > fence:
+                fence = out.ack_dt
+        if sent:
+            frac = share * sent
+            self.x[r] = xr - xr * frac
+            self.p[r] = pr - pr * frac
+            self._inflight_x += inflight_x
+            self._inflight_p += inflight_p
+            for delay in sorted(deliveries):
+                items = deliveries[delay]
+                self.loop.at(
+                    t + delay,
+                    (lambda it: lambda: self._deliver(r, it))(items))
+        return fence
+
+    def _deliver(self, src: int,
+                 items: List[Tuple[int, float, float]]) -> None:
+        t = self.loop.now
+        fw = self._forward_to
+        for j, dx, dp in items:
+            # the heir may itself have drained since: walk the chain
+            # (always toward a later-live rank, so it terminates)
+            while fw and j in fw:
+                j = fw[j]
+            self.mx[j] += dx
+            self.mp[j] += dp
+            self._inflight_x -= dx
+            self._inflight_p -= dp
+            # receiver-side freshness clock (the thread-mode lag twin)
+            self._last_recv[j][src] = t
+
+    # ----------------------------------------------------- graceful leave
+    def _leave_now(self, r: int) -> None:
+        """The drain protocol at this rank's own round boundary:
+        consume the pending mailbox (the live protocol's fence makes it
+        empty; the sim folds it in explicitly), hand the ENTIRE (x, p)
+        to the out-neighbors, then deactivate — mass conserved,
+        baseline unchanged (vs a corpse's write-off).  Deposits still
+        in flight toward the leaver are forwarded to a live member at
+        the next barrier (:attr:`_forward_to`)."""
+        self.x[r] += self.mx[r]
+        self.p[r] += self.mp[r]
+        self.mx[r] = 0.0
+        self.mp[r] = 0.0
+        outs = [j for j in self._adj_out[r]
+                if self.alive[j] and j not in self._dead_view[r]]
+        if outs:
+            share = 1.0 / len(outs)
+            handed = 0
+            for j in outs:
+                out = self.links.send(r, j)
+                if out.abandoned:
+                    continue
+                dx = self.x[r] * share
+                dp = self.p[r] * share
+                self._inflight_x += dx
+                self._inflight_p += dp
+                self.loop.at(
+                    self.loop.now + out.deliver_dt,
+                    (lambda it: lambda: self._deliver(r, it))(
+                        [(j, dx, dp)]))
+                handed += 1
+            self.x[r] -= self.x[r] * share * handed
+            self.p[r] -= self.p[r] * share * handed
+        self.alive[r] = False
+        self._left_done.add(r)
+        self.leaves += 1
+        self._await_left.discard(r)
+        self._check_barrier()
+
+    # -------------------------------------------------- telemetry publish
+    def _publish_fleet(self, r: int, round_: int, t: float) -> None:
+        samples = self._round_samples[r]
+        self._round_samples[r] = []
+        if samples:
+            s = sorted(samples)
+            stats = {"count": float(len(s)),
+                     "mean": sum(s) / len(s),
+                     "p50": _quantile(s, 0.50),
+                     "p99": _quantile(s, 0.99),
+                     "max": s[-1]}
+        else:
+            stats = {"count": 0.0}
+        peers: Dict[int, Dict[str, float]] = {}
+        for j, v in self._ack_ewma[r].items():
+            peers[j] = {"lag": float(v)}
+        z = self.x[r] / self.p[r] if self.p[r] > 0 else float("nan")
+        self.view.add(FleetRecord(
+            rank=r, round=int(round_), t=float(t), round_s=stats,
+            mass=self.p[r], z_mean=z, dis=self._dis_last[r],
+            peers=peers))
+
+    def _publish_evidence(self, r: int, round_: int) -> None:
+        # per-peer lag evidence is the WIRE channel only (the sender's
+        # ack EWMA, folded here once per epoch rather than per send —
+        # the hot-path batching): it names the slow HOST its senders
+        # observe — the BENCH_control shape.  A receiver-side staleness
+        # channel would convict the slow host's fenced SENDERS (the
+        # cascade, not the cause) and dilute the slow set.
+        ctl = self.ctl[r]
+        states = self._peer_state[r]
+        retx = self._retx_total[r]
+        for j, ew in self._ack_ewma[r].items():
+            ctl.note_peer(j, lag_s=ew, state=states.get(j, ST_HEALTHY),
+                          reconnects_total=retx.get(j, 0))
+        ctl.note_mixing_excess(self._mixing_excess)
+        self._ev_store[r] = ctl.evidence(int(round_))
+        self._ev_round[r] = int(round_)
+        self._await_left.discard(r)
+        self._check_barrier()
+
+    # ------------------------------------------------- the epoch barrier
+    def _check_barrier(self) -> None:
+        e = self.cfg.evidence_every
+        while not self._await_left:
+            if not any(self.alive):
+                return
+            self._epoch_barrier(self._epoch_decided + 1)
+            nxt = (self._epoch_decided + 1) * e
+            self._await_left = {
+                m for m in self.members()
+                if self._ev_round.get(m, 0) < nxt}
+
+    def _epoch_barrier(self, epoch: int) -> None:
+        """The round-boundary rendezvous: heal corpses, admit joins,
+        replan after leaves, decide + actuate the plan, re-anchor the
+        mixing tracker, sample consensus spread.  Fires when the LAST
+        live rank published epoch ``epoch``'s evidence — virtual time
+        here is the straggler's publish time, which is honest."""
+        e = self.cfg.evidence_every
+        round_ = epoch * e
+        topo_changed = False
+        membership_changed = False
+
+        # 1. heal discovered corpses (the detection deadline: one epoch)
+        new_dead = self._corpses - self._healed
+        if new_dead:
+            membership_changed = True
+            self.topo = heal(self.topo, self._corpses)
+            self._healed |= new_dead
+            topo_changed = True
+            for r in sorted(new_dead):
+                self._ev_store.pop(r, None)
+                self._ev_round.pop(r, None)
+            for ctl in self.ctl.values():
+                for r in sorted(new_dead):
+                    ctl.forget_peer(r)
+
+        # 2. membership change: admissions + completed drains -> replan
+        if self._pending_joins or self._left_done:
+            membership_changed = True
+            heir = next(iter(self.members()), None)
+            for r in sorted(self._left_done):
+                self._ev_store.pop(r, None)
+                self._ev_round.pop(r, None)
+                for ctl in self.ctl.values():
+                    ctl.forget_peer(r)
+                if heir is not None:
+                    # stragglers that were in flight toward the leaver
+                    # when it drained land on a live member instead —
+                    # the fence's conservation, kept exact
+                    self.mx[heir] += self.mx[r]
+                    self.mp[heir] += self.mp[r]
+                    self.mx[r] = 0.0
+                    self.mp[r] = 0.0
+                    self._forward_to[r] = heir
+                    # path-compress earlier chains ending at r so the
+                    # deliver-time walk stays short
+                    for old, tgt in self._forward_to.items():
+                        if tgt == r:
+                            self._forward_to[old] = heir
+            self._left_done.clear()
+            joins = sorted(set(self._pending_joins))
+            self._pending_joins = []
+            donor_pool = self.members()
+            for r in joins:
+                if self.alive[r] or r in self._corpses:
+                    continue
+                self._forward_to.pop(r, None)  # rejoining leaver
+                donor = donor_pool[0] if donor_pool else None
+                x0 = (self.x[donor] / self.p[donor]
+                      if donor is not None and self.p[donor] > 0
+                      else self._draw_x0(r))
+                self._activate(r, x0=x0)
+                self._ev_round[r] = int(round_)  # admitted THIS epoch
+                start = self.loop.now + (
+                    self._compute_rng[r].random() * self.cfg.base_round_s)
+                self.round_no[r] = int(round_)
+                self.loop.at(start, self._round_fn(r))
+            members = self.members()
+            if members:
+                self.topo = replan(self.topo, members)
+                topo_changed = True  # the surface sweep below narrows
+                # every controller to its new out-neighbors
+
+        # 3. decide + actuate (control runs only)
+        members = self.members()
+        if self.cfg.control and members:
+            records = canonicalize(self._ev_store.values())
+            k = max(1, min(self.cfg.decide_sample, len(members)))
+            sample = members[:k - 1] + [members[-1]] if k > 1 \
+                else members[:1]
+            blobs = set()
+            plan0 = None
+            for r in sample:
+                plan_r = self.ctl[r].decide(int(round_), records)
+                blobs.add(plan_r.to_bytes())
+                plan0 = plan_r if plan0 is None else plan0
+            if len(blobs) > 1:
+                self.plan_divergences += 1
+            if plan0 is not None:
+                changed = plan0.version != self.plan.version
+                self.plan = plan0
+                for r in members:
+                    self.ctl[r].plan = plan0
+                if changed:
+                    self.plan_changes += 1
+                    # the actuation: the plan's penalized mixing graph
+                    # over the current members (real replan_penalized
+                    # via the real primitive, gauges and all)
+                    self.topo = self.ctl[sample[0]].apply_plan(
+                        topology=self.topo, members=members)
+                    topo_changed = True
+
+        if topo_changed:
+            self._rebuild_adjacency()
+            # the observation-surface sweep (the retain_peers/
+            # forget_peer discipline): a rank whose edge to a peer the
+            # plan just dropped must stop republishing its FROZEN last
+            # observation — a stale 250 ms lag would keep convicting a
+            # peer only its ring-pred still actually measures
+            for r in self.members():
+                allowed = set(self._adj_out[r])
+                for table in (self._ack_ewma[r], self._peer_state[r],
+                              self._retx_total[r]):
+                    for j in [j for j in table if j not in allowed]:
+                        del table[j]
+                self.ctl[r].retain_peers(allowed)
+            # gossip happens on steps divisible by gossip_every, so an
+            # epoch of e rank-steps contains e / gossip_every gossip
+            # rounds — the exponent DIVIDES when the controller
+            # stretches the cadence (the live loops' rpu arithmetic;
+            # multiplying would predict |λ2|^(e·g) and read a healthy
+            # stretched fleet as a huge mixing excess)
+            self.tracker.rebase(
+                self.topo,
+                rounds_per_update=max(1, e // self.plan.gossip_every))
+            self.max_name_len = max(self.max_name_len,
+                                    len(self.topo.name))
+            self.connectivity_ok = (self.connectivity_ok
+                                    and self._strongly_connected())
+        if membership_changed:
+            # the cross-boundary contraction ratio compares distances
+            # over DIFFERENT member sets — a join reads as a mixing
+            # failure and marches the densify ladder toward the
+            # fully-connected top rung (at 1000 ranks, a million-edge
+            # plan).  The rebase re-anchored the prediction; this
+            # re-anchors the measurement stream.
+            self.tracker.reset_measurement()
+            self._mixing_excess = None
+
+        # 4. mixing health on the simulated consensus state — only
+        # while the distance is far from float noise (the mixing.py
+        # floor discipline): a fully mixed fleet's ratio is numerical
+        # garbage that would read as a huge excess and false-alarm the
+        # densify ladder
+        d = self._consensus_l2()
+        if self._d0 is None and d > 0:
+            self._d0 = d
+        if d > 1e-12 * max(self._d0 or 1.0, 1.0):
+            meas = self.tracker.update(d)
+            if meas is not None and self.tracker.predicted is not None:
+                self._mixing_excess = meas - self.tracker.predicted
+        else:
+            self.tracker.reset_measurement()
+            self._mixing_excess = None
+        # bounded re-probe: one abandoned-send retry per edge per epoch
+        # (the Backoff cadence) — a healed partition is rediscovered
+        # within an epoch, a still-dead peer costs one budget per epoch
+        for dv in self._dead_view:
+            dv.clear()
+        self._epoch_decided = epoch
+
+    # ----------------------------------------------------------- queries
+    def _consensus_l2(self) -> float:
+        zs = [self.x[r] / self.p[r] for r in self.members()
+              if self.p[r] > 0]
+        if not zs:
+            return 0.0
+        mean = sum(zs) / len(zs)
+        return math.sqrt(sum((z - mean) ** 2 for z in zs))
+
+    def _zstar(self) -> float:
+        """The live set's consensus fixed point: total live (x + in
+        flight) over total live weight."""
+        live = self.members()
+        tx = sum(self.x[r] + self.mx[r] for r in live)
+        tp = sum(self.p[r] + self.mp[r] for r in live)
+        return tx / tp if tp > 0 else float("nan")
+
+    def _sample_spread(self) -> None:
+        zstar = self._zstar()
+        errs = sorted(abs(self.x[r] / self.p[r] - zstar)
+                      for r in self.members() if self.p[r] > 0)
+        if not errs:
+            return
+        med = _quantile(errs, 0.50)
+        self.spread_history.append((self.loop.now, med, errs[-1]))
+
+    def _strongly_connected(self) -> bool:
+        live = self.members()
+        if len(live) <= 1:
+            return True
+        idx = {r: i for i, r in enumerate(live)}
+        fwd = [[idx[j] for j in self._adj_out[r] if self.alive[j]]
+               for r in live]
+        rev = [[idx[j] for j in self._adj_in[r] if self.alive[j]]
+               for r in live]
+
+        def reach(adj) -> bool:
+            seen = [False] * len(live)
+            seen[0] = True
+            frontier = [0]
+            n = 1
+            while frontier:
+                nxt = []
+                for u in frontier:
+                    for v in adj[u]:
+                        if not seen[v]:
+                            seen[v] = True
+                            n += 1
+                            nxt.append(v)
+                frontier = nxt
+            return n == len(live)
+
+        return reach(fwd) and reach(rev)
+
+    def audit(self) -> Tuple[float, float]:
+        """(x error, p error) of the exact conservation ledgers over ALL
+        slots — mass never leaves the arrays, so both are float-addition
+        noise no matter what faults ran."""
+        tx = sum(self.x) + sum(self.mx) + self._inflight_x
+        tp = sum(self.p) + sum(self.mp) + self._inflight_p
+        return tx - self.injected_x, tp - self.admitted_p
+
+    def plans_converged(self) -> bool:
+        blobs = {self.ctl[r].plan.to_bytes() for r in self.members()}
+        return len(blobs) <= 1
+
+    def time_to_target(self, eps: float, *,
+                       metric: str = "median") -> Optional[float]:
+        """First virtual time the consensus error fell below ``eps``
+        (None if never).  ``metric``: ``median`` ignores a straggling
+        tail (the BENCH_control posture — time-to-target of the healthy
+        majority); ``max`` is the strict spread."""
+        col = 1 if metric == "median" else 2
+        for entry in self.spread_history:
+            if entry[col] < eps:
+                return entry[0]
+        return None
+
+    def time_to_rounds(self, k: int,
+                       quantile: float = 0.5) -> Optional[float]:
+        """Virtual time at which the ``quantile`` rank completed ``k``
+        rounds (from the round-stamped fleet records; resolution is the
+        ``fleet_every`` publish cadence).  This is the STEP-THROUGHPUT
+        time-to-target — in the DSGD model every round is a local
+        optimizer step, so "the median rank has taken K steps" is the
+        simulated twin of the live bench's loss-target clock; consensus
+        health is asserted separately.  None when fewer than
+        ``quantile`` of the ranks ever got there."""
+        times: List[float] = []
+        ranks = self.view.ranks()
+        rounds_at_or_after = [rd for rd in self.view.rounds()
+                              if rd >= k]
+        for rank in ranks:
+            best = None
+            for rd in rounds_at_or_after:
+                rec = self.view.record(rank, rd)
+                if rec is not None and (best is None or rec.t < best):
+                    best = rec.t
+            if best is not None:
+                times.append(best)
+        if not ranks:
+            return None
+        times.sort()
+        need = int(len(ranks) * quantile) + 1
+        if len(times) < need:
+            return None
+        return times[need - 1]
+
+    def replay_slos(self, specs: Optional[Sequence[SLOSpec]] = None
+                    ) -> SLOEngine:
+        """Replay the simulated fleet records through a real
+        :class:`SLOEngine` (the ``bffleet-tpu --check`` shape) and
+        return the engine (transitions, worst state, attributions)."""
+        engine = SLOEngine(tuple(specs) if specs else default_specs())
+        engine.advance(self.view)
+        return engine
+
+    # --------------------------------------------------------------- run
+    def run(self, horizon_s: float) -> None:
+        """Run the event loop to the bounded virtual-time horizon."""
+        if horizon_s <= 0:
+            raise ValueError("horizon_s must be > 0 (every scenario "
+                             "declares a bounded virtual-time horizon)")
+        self.loop.run(until=float(horizon_s),
+                      max_events=self.cfg.max_events)

@@ -1,0 +1,292 @@
+"""The simulated network: links, faults, and the ack/fence arithmetic.
+
+Faults are expressed in the ONE chaos spec grammar
+(:mod:`bluefog_tpu.chaos.spec`) and interpreted here against virtual
+traffic: a :class:`FaultBox` mirrors the live injector's trigger
+semantics exactly — per-rule frame counters (``after_frames``/
+``every``), seeded per-rule coins (``prob``/``rate``), ``times`` caps —
+so ``server:delay:ms=120:rate=0.95`` means the same thing to a 3-rank
+live run under ``BLUEFOG_TPU_CHAOS`` and to a 1000-rank simulated one.
+Each simulated host owns a box (the live injector is per-process too);
+``server``/``ack`` sites evaluate on the DESTINATION host's box (frames
+into its window server, acks out of it), ``client`` on the sender's.
+
+The deposit model is the PR-4/5 transport collapsed to arithmetic: a
+deposit is reliable (the real `DepositStream` retains payload snapshots
+and replays under a bounded Backoff), so a dropped or truncated frame
+costs a retransmit timeout, never lost mass.  :meth:`LinkModel.send`
+computes the whole exchange at send time — delivery delay, ack
+round-trip (the fence cost the sender's round boundary pays), retry
+count — and a retry budget exceeded reports the send ABANDONED: the
+sender keeps the mass snapshot (nothing was acked) and marks the peer
+DEAD, which is precisely the live stream's budget-exhaustion latch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from bluefog_tpu.chaos.spec import Rule, parse_spec
+from bluefog_tpu.sim.core import rng_for
+
+__all__ = ["FaultBox", "LinkModel", "SendOutcome"]
+
+
+class FaultBox:
+    """One simulated host's chaos rules, with the live injector's
+    trigger semantics (counters, seeded coins, fire caps) evaluated
+    against virtual frames.  Single-threaded by construction — the
+    event loop serializes everything — so no lock."""
+
+    def __init__(self, host: int, rules, *, seed: int = 0):
+        if isinstance(rules, str):
+            rules = parse_spec(rules)
+        self.host = int(host)
+        self.rules: List[Rule] = list(rules)
+        self._counters = [0] * len(self.rules)
+        self._fired = [0] * len(self.rules)
+        self._rngs = [rng_for("chaos", seed, self.host, r.seed, i)
+                      for i, r in enumerate(self.rules)]
+
+    def fire(self, site: str) -> Optional[Tuple]:
+        """The injector's ``fire`` contract on virtual traffic: count
+        this frame for every matching socket rule and return the first
+        triggered action — ``('drop',) | ('truncate',) | ('delay', s) |
+        ('stall', s)`` — or None."""
+        action: Optional[Tuple] = None
+        for i, r in enumerate(self.rules):
+            if r.site != site and r.site != "any":
+                continue
+            self._counters[i] += 1
+            if action is not None:
+                continue  # keep counting other rules
+            mx = r.max_fires()
+            if mx and self._fired[i] >= mx:
+                continue
+            hit = True
+            if r.after_frames is not None:
+                hit = self._counters[i] == r.after_frames
+            elif r.every is not None:
+                hit = self._counters[i] % max(r.every, 1) == 0
+            elif r.prob is not None:
+                hit = self._rngs[i].random() < r.prob
+            elif r.rate is not None:
+                hit = self._rngs[i].random() < r.rate
+            if not hit:
+                continue
+            self._fired[i] += 1
+            if r.fault == "drop":
+                action = ("drop",)
+            elif r.fault == "truncate":
+                action = ("truncate",)
+            elif r.fault == "delay":
+                action = ("delay", r.ms / 1000.0)
+            else:  # stall
+                action = ("stall", r.s)
+        return action
+
+    def rank_faults_due(self, rank: int, step: int) -> List[Rule]:
+        """Matured ``at_step`` rank rules for this host (``check_step``
+        semantics: fires once per rule, at the first round boundary at
+        or after ``at_step``)."""
+        due: List[Rule] = []
+        for i, r in enumerate(self.rules):
+            if r.site != "rank" or r.rank != rank or r.at_step is None:
+                continue
+            mx = r.max_fires()
+            if mx and self._fired[i] >= mx:
+                continue
+            if step >= r.at_step:
+                self._fired[i] += 1
+                due.append(r)
+        return due
+
+    def timed_faults(self, rank: int) -> List[Rule]:
+        """``after_s`` rank rules for this host (the simulator schedules
+        them on the virtual clock — the event-loop twin of the
+        injector's daemon timers)."""
+        return [r for r in self.rules
+                if r.site == "rank" and r.rank == rank
+                and r.after_s is not None]
+
+
+@dataclasses.dataclass(frozen=True)
+class SendOutcome:
+    """One deposit exchange, fully resolved at send time.
+
+    ``deliver_dt`` — virtual seconds until the payload lands in the
+    destination mailbox; ``ack_dt`` — seconds until the sender holds the
+    ack (>= deliver_dt; the round boundary's fence cost — the live
+    loop's flush-per-peer); ``retries`` — retransmissions the exchange
+    needed; ``abandoned`` — the retry budget ran out (nothing
+    delivered, mass stays with the sender, peer marked DEAD)."""
+
+    deliver_dt: float
+    ack_dt: float
+    retries: int
+    abandoned: bool = False
+
+
+_ABANDONED = SendOutcome(deliver_dt=0.0, ack_dt=0.0, retries=0,
+                         abandoned=True)
+
+
+class LinkModel:
+    """Latency, loss, and reachability between simulated hosts.
+
+    ``latency_s`` is the one-way base latency; ``rto_s`` the retransmit
+    timeout a lost frame costs; ``budget_s`` the per-send retry budget
+    (the live ``Backoff`` ctor REFUSES unbounded budgets — so does the
+    simulator: ``budget_s`` is mandatory and positive).  ``partition``
+    is a set of ordered ``(src, dst)`` pairs whose DIRECTION is
+    severed.  A severed direction kills everything that must traverse
+    it — payloads of ``src -> dst`` sends AND acks of ``dst -> src``
+    sends — so one ordered pair abandons both flows over the link,
+    exactly as a one-direction fiber cut stalls both TCP flows live;
+    :meth:`cut_between` spells a full bidirectional partition."""
+
+    def __init__(self, *, latency_s: float = 0.002, rto_s: float = 0.02,
+                 budget_s: float = 0.25, seed: int = 0):
+        if budget_s <= 0:
+            raise ValueError(
+                "budget_s must be > 0: an unbounded retry budget is the "
+                "unbounded-reconnect loop BF-RES001 forbids live, and it "
+                "would wedge a simulated sender the same way")
+        self.latency_s = float(latency_s)
+        self.rto_s = float(rto_s)
+        self.budget_s = float(budget_s)
+        self.seed = int(seed)
+        self._boxes: Dict[int, FaultBox] = {}
+        self.partition: FrozenSet[Tuple[int, int]] = frozenset()
+        # the fault-free fast path is one shared outcome object — at a
+        # thousand ranks most sends hit it, and building a dataclass per
+        # clean send is most of the event loop's cost
+        self._clean = SendOutcome(deliver_dt=self.latency_s,
+                                  ack_dt=2.0 * self.latency_s, retries=0)
+        self._trivial = True  # no boxes, no partition: sends hit _clean
+
+    # ------------------------------------------------------------- faults
+    def set_host_faults(self, host: int, rules) -> None:
+        """Install (or replace) one host's chaos rules — ``rules`` is a
+        spec string or pre-parsed ``Rule`` list; an empty/None value
+        clears the box.
+
+        Sites the simulator cannot actuate are REFUSED rather than
+        silently stored: the sim models the deposit path
+        (``server``/``ack``/``client``, and ``any`` over those three) —
+        a ``read``/``sub`` rule would parse, sit inert, and let a
+        scenario's predicates pass vacuously over a fault that never
+        fired."""
+        if not rules:
+            self._boxes.pop(int(host), None)
+        else:
+            if isinstance(rules, str):
+                rules = parse_spec(rules)
+            inert = sorted({r.site for r in rules
+                            if r.site in ("read", "sub")})
+            if inert:
+                raise ValueError(
+                    f"chaos site(s) {inert} are read-path faults the "
+                    "simulator does not model (it simulates the "
+                    "deposit path: server/ack/client/any and rank "
+                    "faults); a silently inert rule would make the "
+                    "scenario's acceptance predicates vacuous")
+            self._boxes[int(host)] = FaultBox(int(host), rules,
+                                              seed=self.seed)
+        self._trivial = not self._boxes and not self.partition
+
+    def host_box(self, host: int) -> Optional[FaultBox]:
+        return self._boxes.get(int(host))
+
+    def set_partition(self, cut_pairs) -> None:
+        """Install the current unreachable ``(src, dst)`` set (empty =
+        fully reachable)."""
+        self.partition = frozenset(
+            (int(a), int(b)) for a, b in (cut_pairs or ()))
+        self._trivial = not self._boxes and not self.partition
+
+    @staticmethod
+    def cut_between(group_a, group_b):
+        """The ordered pair set that severs two rank groups BOTH ways —
+        the partition-scenario helper."""
+        a, b = [int(r) for r in group_a], [int(r) for r in group_b]
+        return frozenset((x, y) for x in a for y in b) | frozenset(
+            (y, x) for x in a for y in b)
+
+    # --------------------------------------------------------------- send
+    def send(self, src: int, dst: int) -> SendOutcome:
+        """Resolve one deposit ``src -> dst``: returns the
+        :class:`SendOutcome` (see class docstring).  Deterministic given
+        the model seed and the frame history both hosts' boxes have
+        seen."""
+        if self._trivial:
+            return self._clean
+        if (src, dst) in self.partition or (dst, src) in self.partition:
+            # unreachable in EITHER direction: a forward cut loses the
+            # payload, a reverse-only cut loses every ack — live, both
+            # burn the sender's whole budget and latch (the sim's
+            # documented applied-but-unacked convention resolves the
+            # reverse case conservatively as not-applied)
+            return _ABANDONED
+        sbox = self._boxes.get(int(src))
+        dbox = self._boxes.get(int(dst))
+        if sbox is None and dbox is None:
+            return self._clean
+        waited = 0.0
+        retries = 0
+        while True:
+            leg = self.latency_s
+            lost = False
+            if sbox is not None:
+                act = sbox.fire("client")
+                if act is not None:
+                    if act[0] in ("drop", "truncate"):
+                        lost = True
+                    else:  # delay / stall
+                        leg += act[1]
+            if not lost and dbox is not None:
+                act = dbox.fire("server")
+                if act is not None:
+                    if act[0] in ("drop", "truncate"):
+                        lost = True
+                    else:
+                        leg += act[1]
+            if lost:
+                waited += self.rto_s
+                retries += 1
+                if waited > self.budget_s:
+                    return _ABANDONED
+                continue
+            deliver_dt = waited + leg
+            # ack leg: a lost ack re-sends the (already applied) batch
+            # after an RTO; the owner dedups by seq, so only the fence
+            # cost grows (the applied-but-unacked ambiguity, resolved
+            # exactly as op-6 STREAM_ATTACH does live)
+            ack_wait = 0.0
+            while True:
+                ack_leg = self.latency_s
+                ack_lost = False
+                if dbox is not None:
+                    act = dbox.fire("ack")
+                    if act is not None:
+                        if act[0] in ("drop", "truncate"):
+                            ack_lost = True
+                        else:
+                            ack_leg += act[1]
+                if ack_lost:
+                    ack_wait += self.rto_s
+                    retries += 1
+                    if waited + leg + ack_wait > self.budget_s:
+                        # nothing acked: the live sender retains the
+                        # snapshot and latches; the sim keeps the mass.
+                        # (The batch may have APPLIED owner-side; the
+                        # sim resolves the ambiguity conservatively as
+                        # not-applied — the replay path's dedup makes
+                        # both answers equivalent for the audit.)
+                        return _ABANDONED
+                    continue
+                break
+            return SendOutcome(deliver_dt=deliver_dt,
+                               ack_dt=deliver_dt + ack_wait + ack_leg,
+                               retries=retries)

@@ -1,0 +1,658 @@
+"""The scenario regression lab: declarative specs, checkable verdicts.
+
+A :class:`Scenario` is a TABLE ENTRY, not a script: a fleet shape, a
+bounded virtual-time ``horizon_s``, a schedule of membership/fault
+events (fault payloads in the one chaos spec grammar), and — the part
+the BF-SIM001 lint refuses to let anyone omit — an ``accept`` tuple of
+named predicates with explicit parameters.  ``bfsim-tpu --check`` runs
+the whole suite and exits nonzero when any predicate fails, which makes
+controller/topology changes regression-gateable at 1000 simulated ranks
+the way ``BENCH_control.json`` gates the 4-rank live case.
+
+Three scenario kinds:
+
+- ``fleet`` — one :class:`~bluefog_tpu.sim.fleet.FleetSim` run with the
+  event schedule applied;
+- ``ab`` — the control-vs-static pair: the SAME seed, faults, and
+  schedule run twice (``control=True`` / ``False``), compared on
+  simulated time-to-target (the BENCH_control shape);
+- ``mixing`` — the synchronous spectral-gap fidelity runs
+  (:mod:`bluefog_tpu.sim.mixing`) over a set of topology constructors.
+
+Alert semantics, stated plainly: scenario predicates are the gate here
+— the replayed :class:`~bluefog_tpu.fleet.SLOEngine` transitions are
+EVIDENCE a predicate inspects (``warn_fired`` asserts detection
+happened and names the right rank), not an automatic failure the way
+``bffleet-tpu --check`` treats them on a production run, because these
+scenarios inject the very faults the alerts exist to catch.  A
+gracefully departed rank's last record also keeps aging in the view, so
+the ``silent`` SLO fires on leavers by construction — detection working
+as built, asserted where a scenario expects it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from bluefog_tpu.control.plan import ControlConfig
+from bluefog_tpu.fleet.slo import WARN, default_specs
+from bluefog_tpu.sim.fleet import FleetSim, SimConfig
+from bluefog_tpu.sim.mixing import run_sync_mixing
+from bluefog_tpu.sim.network import LinkModel
+from bluefog_tpu.topology.graphs import (ExponentialTwoGraph,
+                                         FullyConnectedGraph, RingGraph)
+
+__all__ = ["Scenario", "build_suite", "run_scenario", "run_suite",
+           "PREDICATES", "SCENARIO_NAMES"]
+
+_KINDS = ("fleet", "ab", "mixing")
+
+#: the chaos-grammar spelling of a server-delayed slow host (the
+#: BENCH_control fault, scaled up)
+_SLOW_HOST_SPEC = "server:delay:ms=150:rate=1.0"
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One table entry.  ``horizon_s`` (a bounded virtual-time horizon)
+    and ``accept`` (non-empty ``(predicate name, params)`` tuple) are
+    MANDATORY — enforced here at construction and by the BF-SIM001
+    lint at every call site.  ``events`` is ``(t, action, params)``
+    with actions ``join`` / ``leave`` / ``kill`` (``rank`` or
+    ``ranks``), ``partition`` (``side_a`` / ``side_b`` rank lists),
+    ``merge``, ``slow_host`` (``rank``, optional ``spec``), and
+    ``compute_scale`` (``rank``, ``mult``)."""
+
+    name: str
+    kind: str
+    n_ranks: int
+    horizon_s: float
+    accept: Tuple[Tuple[str, Mapping], ...]
+    seed: int = 0
+    config: Mapping = dataclasses.field(default_factory=dict)
+    events: Tuple[Tuple[float, str, Mapping], ...] = ()
+    topologies: Tuple[str, ...] = ()   # mixing kind only
+    notes: str = ""
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"scenario {self.name!r}: unknown kind "
+                             f"{self.kind!r} (want one of {_KINDS})")
+        if not (isinstance(self.horizon_s, (int, float))
+                and self.horizon_s > 0):
+            raise ValueError(
+                f"scenario {self.name!r}: horizon_s must be a positive "
+                "virtual-time bound (an unbounded scenario is not a "
+                "regression gate)")
+        if not self.accept:
+            raise ValueError(
+                f"scenario {self.name!r}: accept must name at least one "
+                "predicate (a scenario without an acceptance predicate "
+                "is a demo, not a check)")
+        for entry in self.accept:
+            pname = entry[0]
+            if pname not in PREDICATES:
+                raise ValueError(
+                    f"scenario {self.name!r}: unknown predicate "
+                    f"{pname!r}; known: {sorted(PREDICATES)}")
+
+
+# ---------------------------------------------------------------------------
+# Predicates: (ctx, **params) -> (ok, info-dict).  ctx carries whatever
+# the scenario kind produced (see run_scenario).
+# ---------------------------------------------------------------------------
+
+
+def _pred_audit_exact(ctx, *, tol: float = 1e-9):
+    sims = ctx.get("sims") or [ctx["sim"]]
+    worst = max(max(abs(e) for e in s.audit()) for s in sims)
+    bound = tol * max(s.admissions for s in sims)
+    return worst <= bound, {"worst_err": worst, "bound": bound}
+
+
+def _pred_converged(ctx, *, eps: float, metric: str = "median"):
+    sim = ctx["sim"]
+    t = sim.time_to_target(eps, metric=metric)
+    return t is not None, {"eps": eps, "metric": metric,
+                           "time_to_target_s": t}
+
+
+def _pred_plans_converged(ctx):
+    sims = ctx.get("sims") or [ctx["sim"]]
+    ok = all(s.plan_divergences == 0 and s.plans_converged()
+             for s in sims)
+    return ok, {"divergence_epochs": sum(s.plan_divergences
+                                         for s in sims)}
+
+
+def _pred_connected(ctx):
+    sims = ctx.get("sims") or [ctx["sim"]]
+    return all(s.connectivity_ok for s in sims), {}
+
+
+def _pred_name_collapsed(ctx, *, max_len: int = 160):
+    sims = ctx.get("sims") or [ctx["sim"]]
+    worst = max(s.max_name_len for s in sims)
+    return worst <= max_len, {"max_name_len": worst}
+
+
+def _pred_members(ctx, *, count: int):
+    sim = ctx["sim"]
+    return len(sim.members()) == count, {"members": len(sim.members())}
+
+
+def _pred_warn_fired(ctx, *, slo: str, rank: Optional[int] = None):
+    """The detection check: the replayed SLO engine raised WARN-or-worse
+    for ``slo`` (attributing ``rank`` when given)."""
+    hits = []
+    for tr in ctx["engine"].transitions:
+        if tr.slo != slo or tr.to < WARN:
+            continue
+        hits.append({"round": tr.round, "rank": tr.rank,
+                     "state": tr.to})
+    ok = any(h for h in hits
+             if rank is None or h["rank"] == rank)
+    return ok, {"transitions": hits[:8], "want_rank": rank}
+
+
+def _pred_plan_penalizes(ctx, *, ranks: Sequence[int],
+                         min_count: int = 1):
+    sim = ctx.get("sim") or ctx["control_sim"]
+    hit = sorted(set(sim.plan.slow) & {int(r) for r in ranks})
+    return len(hit) >= min_count, {"slow": list(sim.plan.slow),
+                                   "matched": hit}
+
+
+def _pred_control_beats_static(ctx, *, max_ratio: float,
+                               target_rounds: Optional[int] = None,
+                               eps: Optional[float] = None,
+                               metric: str = "median",
+                               quantile: float = 0.75):
+    """Simulated time-to-target, control / static, must be at or below
+    ``max_ratio``.  ``target_rounds`` clocks STEP THROUGHPUT (the
+    median rank completing K rounds — each round is a local optimizer
+    step in the DSGD model, the live bench's loss-target proxy);
+    ``eps`` clocks consensus spread instead.  When the static run never
+    reached the target inside the horizon, its time is floored at the
+    horizon — the reported ratio is then an upper bound and the check
+    is conservative."""
+    ctl, sta = ctx["control_sim"], ctx["static_sim"]
+    if target_rounds is not None:
+        a = ctl.time_to_rounds(int(target_rounds), quantile=quantile)
+        b = sta.time_to_rounds(int(target_rounds), quantile=quantile)
+    else:
+        if eps is None:
+            return False, {"error": "need target_rounds or eps"}
+        a = ctl.time_to_target(eps, metric=metric)
+        b = sta.time_to_target(eps, metric=metric)
+    horizon = ctx["horizon_s"]
+    b_floor = horizon if b is None else b
+    if a is None or b_floor <= 0:
+        return False, {"control_ttt_s": a, "static_ttt_s": b,
+                       "max_ratio": max_ratio}
+    return a / b_floor <= max_ratio, {
+        "control_ttt_s": a, "static_ttt_s": b,
+        "static_floored_at_horizon": b is None,
+        "ratio": a / b_floor, "max_ratio": max_ratio}
+
+
+def _pred_mixing_match(ctx, *, tol: float):
+    """Every non-degenerate topology's geometric-mean contraction is
+    within ``tol`` of its |lambda_2| prediction; one-step averagers are
+    checked on the float-floor final distance instead."""
+    rows = ctx["mixing_runs"]
+    bad = []
+    for row in rows:
+        if math.isnan(row["measured"]):
+            if not row["final_distance"] <= 1e-12:
+                bad.append(row["topology"])
+        elif abs(row["measured"] - row["predicted"]) > tol:
+            bad.append(row["topology"])
+    return not bad, {"tol": tol, "failed": bad}
+
+
+PREDICATES: Dict[str, Callable] = {
+    "audit_exact": _pred_audit_exact,
+    "converged": _pred_converged,
+    "plans_converged": _pred_plans_converged,
+    "connected": _pred_connected,
+    "name_collapsed": _pred_name_collapsed,
+    "members": _pred_members,
+    "warn_fired": _pred_warn_fired,
+    "plan_penalizes": _pred_plan_penalizes,
+    "control_beats_static": _pred_control_beats_static,
+    "mixing_match": _pred_mixing_match,
+}
+
+
+# ---------------------------------------------------------------------------
+# The suite
+# ---------------------------------------------------------------------------
+
+
+def _spread(n: int, count: int, *, exclude=()) -> List[int]:
+    """``count`` ranks spread deterministically over ``range(n)``."""
+    step = max(1, n // max(1, count))
+    out: List[int] = []
+    r = step // 2
+    banned = set(int(x) for x in exclude)
+    while len(out) < count:
+        if r % n not in banned and r % n not in out:
+            out.append(r % n)
+        r += step
+        if len(out) < count and r > 4 * n * step:
+            break
+    return sorted(out[:count])
+
+
+def diurnal_autoscale(n: int = 1024, seed: int = 0) -> Scenario:
+    """Capacity ``n``; three-quarters run steady, the last quarter joins
+    at the virtual morning, drains at the virtual evening, and joins
+    again — two membership swings through the real replan, audited
+    exactly, with the provenance name required to stay collapsed."""
+    grow = list(range(3 * n // 4, n))
+    events: List[Tuple[float, str, Mapping]] = []
+    events.append((0.8, "join", {"ranks": grow}))
+    events.append((1.8, "leave", {"ranks": grow}))
+    events.append((2.8, "join", {"ranks": grow}))
+    return Scenario(
+        name="diurnal_autoscale", kind="fleet", n_ranks=n, seed=seed,
+        horizon_s=4.5,
+        config={"capacity": n,
+                "initial_members": list(range(3 * n // 4)),
+                "fleet_every": 8},
+        events=tuple(events),
+        accept=(
+            ("audit_exact", {"tol": 1e-9}),
+            ("connected", {}),
+            ("plans_converged", {}),
+            ("name_collapsed", {"max_len": 160}),
+            ("members", {"count": n}),
+            ("converged", {"eps": 1e-6, "metric": "max"}),
+        ),
+        notes="two grow/shrink swings; graceful drains conserve mass")
+
+
+def network_partition(n: int = 1024, seed: int = 0) -> Scenario:
+    """The fleet splits into halves for 1.5 virtual seconds: gossip
+    links across the cut fail (evidence still rides the shared barrier
+    dir, as live), controllers converge on a plan that spines the
+    unreachable peers, the straggler SLO fires, and after the merge the
+    fleet reconverges with the audit exact throughout."""
+    side_a = list(range(n // 2))
+    side_b = list(range(n // 2, n))
+    return Scenario(
+        name="network_partition", kind="fleet", n_ranks=n, seed=seed,
+        horizon_s=7.0,
+        # densify is disabled here (enter threshold above any reachable
+        # excess): a partition's stall is a GENUINE sustained mixing
+        # excess, and the ladder's top rung is the one-step exact
+        # averager — a million-edge plan at 1024 ranks.  Climbing it is
+        # the real decide_plan's answer and the ladder is exercised at
+        # small scale in tests/test_sim.py; at fleet scale densify-to-FC
+        # is a deliberate operator decision, not an automatic remedy.
+        config={"control": True, "fleet_every": 8,
+                "control_cfg": {"cooldown_rounds": 8,
+                                "densify_enter": 8.0,
+                                "densify_exit": 4.0}},
+        events=(
+            (1.0, "partition", {"side_a": side_a, "side_b": side_b}),
+            (2.5, "merge", {}),
+        ),
+        accept=(
+            ("audit_exact", {"tol": 1e-9}),
+            ("warn_fired", {"slo": "straggler"}),
+            ("plans_converged", {}),
+            ("connected", {}),
+            ("converged", {"eps": 1e-5, "metric": "max"}),
+        ),
+        notes="halves cut 1.5s; reconverges after merge")
+
+
+def flash_crowd(n: int = 1024, seed: int = 0) -> Scenario:
+    """Half the capacity is running; the other half joins in ONE
+    admission wave (the flash crowd): one replan boundary, warm-started
+    joiners, exact audit over the doubled fleet."""
+    joiners = list(range(n // 2, n))
+    return Scenario(
+        name="flash_crowd", kind="fleet", n_ranks=n, seed=seed,
+        horizon_s=3.0,
+        config={"capacity": n,
+                "initial_members": list(range(n // 2)),
+                "control": True, "fleet_every": 8,
+                "control_cfg": {"cooldown_rounds": 8}},
+        events=((1.0, "join", {"ranks": joiners}),),
+        accept=(
+            ("audit_exact", {"tol": 1e-9}),
+            ("members", {"count": n}),
+            ("connected", {}),
+            ("plans_converged", {}),
+            ("converged", {"eps": 1e-6, "metric": "max"}),
+        ),
+        notes="n/2 ranks admitted in one wave")
+
+
+def cascading_slow_peers(n: int = 1024, seed: int = 0) -> Scenario:
+    """Slow hosts appear in waves (server-delayed, the BENCH_control
+    fault) until ~15% of the fleet is slow — enough that MOST ranks
+    fence on some slow out-neighbor (at out-degree ~log2 n that takes
+    a double-digit slow fraction).  Run twice from the same seed: the
+    controller must penalize the slow set and beat the static config on
+    simulated time-to-target (the BENCH_control ratio, directionally).
+    The waves start within the fleet's first contraction decades —
+    a fault injected after convergence gates nothing."""
+    n_slow = max(2, n * 15 // 100)
+    slow = _spread(n, n_slow)
+    waves = 4
+    per = max(1, len(slow) // waves)
+    events: List[Tuple[float, str, Mapping]] = []
+    for w in range(waves):
+        chunk = slow[w * per:(w + 1) * per] if w < waves - 1 \
+            else slow[(waves - 1) * per:]
+        if chunk:
+            events.append(
+                (0.12 + 0.3 * w, "slow_host", {"ranks": chunk}))
+    return Scenario(
+        name="cascading_slow_peers", kind="ab", n_ranks=n, seed=seed,
+        horizon_s=14.0,
+        config={"fleet_every": 8,
+                "control_cfg": {"cooldown_rounds": 8}},
+        events=tuple(events),
+        accept=(
+            ("audit_exact", {"tol": 1e-9}),
+            ("control_beats_static",
+             {"target_rounds": 72, "max_ratio": 0.6}),
+            ("plan_penalizes", {"ranks": slow,
+                                "min_count": max(1, len(slow) // 2)}),
+            ("warn_fired", {"slo": "straggler"}),
+            ("converged", {"eps": 1e-5, "metric": "median"}),
+        ),
+        notes=f"{len(slow)} hosts turn slow in {waves} waves; "
+              "control vs static A/B")
+
+
+def mixing_fidelity(n: int = 1024, seed: int = 0) -> Scenario:
+    """The headline physics check: simulated synchronous gossip on a
+    1-D consensus state must contract at the |lambda_2| the real
+    MixingTracker predicts — ring, exponential-2, and the one-step
+    fully connected averager, at the full rank count."""
+    return Scenario(
+        name="mixing_fidelity", kind="mixing", n_ranks=n, seed=seed,
+        horizon_s=3.0,   # rounds = horizon_s / base_round_s nominal
+        topologies=("ring", "exp2", "fc"),
+        accept=(("mixing_match", {"tol": 0.02}),),
+        notes="measured geometric contraction vs spectral-gap "
+              "prediction")
+
+
+SCENARIO_NAMES: Tuple[str, ...] = (
+    "mixing_fidelity",
+    "diurnal_autoscale",
+    "network_partition",
+    "flash_crowd",
+    "cascading_slow_peers",
+)
+
+_FACTORIES = {
+    "diurnal_autoscale": diurnal_autoscale,
+    "network_partition": network_partition,
+    "flash_crowd": flash_crowd,
+    "cascading_slow_peers": cascading_slow_peers,
+    "mixing_fidelity": mixing_fidelity,
+}
+
+
+def build_suite(n: int = 1024, seed: int = 0,
+                names: Optional[Sequence[str]] = None
+                ) -> Tuple[Scenario, ...]:
+    """The suite at rank count ``n`` (>= 1024 is the acceptance scale;
+    small ``n`` is the tier-1 smoke trim — same scenarios, same
+    predicates, scaled schedules)."""
+    picked = tuple(names) if names else SCENARIO_NAMES
+    unknown = [x for x in picked if x not in _FACTORIES]
+    if unknown:
+        raise ValueError(f"unknown scenario(s) {unknown}; known: "
+                         f"{sorted(_FACTORIES)}")
+    return tuple(_FACTORIES[x](n=n, seed=seed) for x in picked)
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+_BASE_ROUND_S = 0.01
+
+
+def _make_sim(sc: Scenario, *, control: Optional[bool] = None) -> FleetSim:
+    cfgd = dict(sc.config)
+    ccfg = cfgd.pop("control_cfg", None)
+    if isinstance(ccfg, Mapping):
+        ccfg = ControlConfig(**ccfg)
+    if control is not None:
+        cfgd["control"] = control
+    cfg = SimConfig(n_ranks=sc.n_ranks, seed=sc.seed,
+                    control_cfg=ccfg, **cfgd)
+    sim = FleetSim(cfg)
+    for (t, action, params) in sc.events:
+        _schedule_event(sim, t, action, dict(params))
+    return sim
+
+
+def _ranks_of(params: Mapping) -> List[int]:
+    if "ranks" in params:
+        return [int(r) for r in params["ranks"]]
+    return [int(params["rank"])]
+
+
+def _schedule_event(sim: FleetSim, t: float, action: str,
+                    params: Dict) -> None:
+    if action == "join":
+        ranks = _ranks_of(params)
+        sim.loop.at(t, (lambda rs: lambda: [sim.join(r) for r in rs])(
+            ranks))
+    elif action == "leave":
+        ranks = _ranks_of(params)
+        sim.loop.at(
+            t, (lambda rs: lambda: [sim.request_leave(r)
+                                    for r in rs])(ranks))
+    elif action == "kill":
+        ranks = _ranks_of(params)
+        sim.loop.at(t, (lambda rs: lambda: [sim.kill(r) for r in rs])(
+            ranks))
+    elif action == "partition":
+        cut = LinkModel.cut_between(params["side_a"], params["side_b"])
+        sim.loop.at(t, lambda: sim.set_partition(cut))
+    elif action == "merge":
+        sim.loop.at(t, lambda: sim.set_partition(None))
+    elif action == "slow_host":
+        spec = params.get("spec", _SLOW_HOST_SPEC)
+        ranks = _ranks_of(params)
+        sim.loop.at(
+            t, (lambda rs, sp: lambda: [sim.set_host_faults(r, sp)
+                                        for r in rs])(ranks, spec))
+    elif action == "compute_scale":
+        sim.loop.at(
+            t, (lambda r, m: lambda: sim.set_compute_scale(r, m))(
+                int(params["rank"]), float(params["mult"])))
+    else:
+        raise ValueError(f"unknown scenario event action {action!r}")
+
+
+def _fleet_ctx(sc: Scenario) -> Dict:
+    sim = _make_sim(sc)
+    sim.run(sc.horizon_s)
+    engine = sim.replay_slos(default_specs())
+    return {"sim": sim, "sims": [sim], "engine": engine}
+
+
+def _ab_ctx(sc: Scenario) -> Dict:
+    # an ab run may stop SHORT of horizon_s, but only once EVERY
+    # time/convergence goal the scenario's predicates will evaluate is
+    # already met — the A/B target-rounds/eps plus every converged
+    # predicate's eps.  (Event predicates like warn_fired must expect
+    # their event before these targets; documented in docs/sim.md.)
+    ab = [dict(p) for name, p in sc.accept
+          if name == "control_beats_static"]
+    rounds_goal = max((int(p["target_rounds"]) for p in ab
+                       if p.get("target_rounds")), default=None)
+    # the early stop must clock the STRICTEST quantile any predicate
+    # declares, or a q=0.9 predicate could evaluate a run the q=0.75
+    # default already stopped
+    rounds_q = max((float(p.get("quantile", 0.75)) for p in ab
+                    if p.get("target_rounds")), default=0.75)
+    eps_goals = [(p["eps"], p.get("metric", "median")) for p in ab
+                 if p.get("eps")]
+    eps_goals += [(p["eps"], p.get("metric", "median"))
+                  for name, p in sc.accept
+                  if name == "converged" and p.get("eps")]
+    out: Dict[str, FleetSim] = {}
+    for label, control in (("static", False), ("control", True)):
+        sim = _make_sim(sc, control=control)
+        if rounds_goal is None and not eps_goals:
+            # no time/convergence goal to clock: the horizon is the
+            # run (an empty goal set must not read as "already done")
+            sim.run(sc.horizon_s)
+            out[label] = sim
+            continue
+        # run in slices so a run that already reached every goal stops
+        # burning host time on the converged tail
+        slice_s = max(sc.horizon_s / 28.0, 0.25)
+        t = 0.0
+        while t < sc.horizon_s:
+            t = min(sc.horizon_s, t + slice_s)
+            sim.run(t)
+            done = True
+            if rounds_goal is not None and \
+                    sim.time_to_rounds(rounds_goal,
+                                       quantile=rounds_q) is None:
+                done = False
+            for eps, metric in eps_goals:
+                if sim.time_to_target(eps, metric=metric) is None:
+                    done = False
+            if done:
+                break
+        out[label] = sim
+    engine = out["control"].replay_slos(default_specs())
+    return {"sim": out["control"], "control_sim": out["control"],
+            "static_sim": out["static"],
+            "sims": [out["static"], out["control"]], "engine": engine}
+
+
+_MIX_TOPOLOGIES = {
+    "ring": RingGraph,
+    "exp2": ExponentialTwoGraph,
+    "fc": FullyConnectedGraph,
+}
+
+
+def _mixing_ctx(sc: Scenario) -> Dict:
+    rounds = max(50, int(sc.horizon_s / _BASE_ROUND_S))
+    rows = []
+    for key in sc.topologies:
+        topo = _MIX_TOPOLOGIES[key](sc.n_ranks)
+        run = run_sync_mixing(topo, rounds=rounds, seed=sc.seed)
+        rows.append({"topology": key, "n": run.n,
+                     "predicted": run.predicted,
+                     "measured": run.measured_geomean,
+                     "rounds_used": run.rounds_used,
+                     "final_distance": run.final_distance})
+    return {"mixing_runs": rows}
+
+
+def run_scenario(sc: Scenario) -> Dict:
+    """Run one scenario and evaluate its predicates; returns the
+    deterministic report dict (no wall clock anywhere in it — same
+    seed, same bytes)."""
+    if sc.kind == "fleet":
+        ctx = _fleet_ctx(sc)
+    elif sc.kind == "ab":
+        ctx = _ab_ctx(sc)
+    else:
+        ctx = _mixing_ctx(sc)
+    ctx["horizon_s"] = sc.horizon_s
+
+    preds: Dict[str, Dict] = {}
+    ok = True
+    for entry in sc.accept:
+        pname, params = entry[0], dict(entry[1])
+        p_ok, info = PREDICATES[pname](ctx, **params)
+        key = pname if pname not in preds else \
+            f"{pname}#{sum(1 for k in preds if k.startswith(pname))}"
+        preds[key] = {"ok": bool(p_ok), **_jsonable(info)}
+        ok = ok and bool(p_ok)
+
+    report: Dict = {
+        "name": sc.name, "kind": sc.kind, "n_ranks": sc.n_ranks,
+        "seed": sc.seed, "horizon_s": sc.horizon_s,
+        "predicates": preds, "ok": ok, "notes": sc.notes,
+    }
+    if "sim" in ctx:
+        report["stats"] = _sim_stats(ctx["sim"])
+        if "static_sim" in ctx:
+            report["static_stats"] = _sim_stats(ctx["static_sim"])
+        report["slo_transitions"] = [
+            tr.describe() for tr in ctx["engine"].transitions][:24]
+    if "mixing_runs" in ctx:
+        report["mixing_runs"] = [_jsonable(r) for r in ctx["mixing_runs"]]
+    return report
+
+
+def _sim_stats(sim: FleetSim) -> Dict:
+    live = sim.members()
+    xerr, perr = sim.audit()
+    return _jsonable({
+        "virtual_end_s": sim.loop.now,
+        "events": sim.loop.processed,
+        "members": len(live),
+        "rounds_min": min((sim.round_no[r] for r in live), default=0),
+        "rounds_max": max((sim.round_no[r] for r in live), default=0),
+        "admissions": sim.admissions, "leaves": sim.leaves,
+        "deaths": sim.deaths,
+        "audit_x_err": xerr, "audit_p_err": perr,
+        "plan_version": sim.plan.version,
+        "plan_slow": list(sim.plan.slow),
+        "plan_changes": sim.plan_changes,
+        "plan_divergences": sim.plan_divergences,
+        "topology": sim.topo.name,
+        "mixing_excess": sim._mixing_excess,
+        "spread_final_median": (sim.spread_history[-1][1]
+                                if sim.spread_history else None),
+        "spread_final_max": (sim.spread_history[-1][2]
+                             if sim.spread_history else None),
+    })
+
+
+def _jsonable(obj):
+    """NaN/inf -> None, numpy scalars -> python, recursively — the
+    canonical-JSON discipline so reports dump identically everywhere."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, bool) or obj is None or isinstance(obj, (int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if hasattr(obj, "item"):
+        return _jsonable(obj.item())
+    return str(obj)
+
+
+def run_suite(n: int = 1024, seed: int = 0,
+              names: Optional[Sequence[str]] = None) -> Dict:
+    """Run the (possibly filtered) suite; returns the top-level report
+    with the BENCH-gate ``ok`` booleans the committed ``BENCH_sim.json``
+    carries."""
+    reports = [run_scenario(sc) for sc in build_suite(n=n, seed=seed,
+                                                      names=names)]
+    return {
+        "bench": "sim_scenarios",
+        "n_ranks": n,
+        "seed": seed,
+        "scenarios": reports,
+        "scenarios_ok": {r["name"] + "_ok": bool(r["ok"])
+                         for r in reports},
+        "ok": all(r["ok"] for r in reports),
+    }

@@ -1852,3 +1852,80 @@ class TestFleetLint:
                 errs += [d for d in check_file(path)
                          if d.severity == "error"]
         assert not errs, [d.format() for d in errs]
+
+
+class TestSimLint:
+    """BF-SIM001: the simulator's determinism contract (no wall clock,
+    no ambient RNG inside bluefog_tpu/sim/) and the scenario-table
+    discipline (every Scenario(...) call site declares accept= and a
+    bounded horizon_s=)."""
+
+    def test_seeded_wall_clock_violation(self):
+        from bluefog_tpu.analysis.sim_lint import check_determinism
+
+        src = "import time\ndef handler():\n    return time.time()\n"
+        diags = check_determinism(src, filename="seeded_sim.py")
+        assert any(d.code == "BF-SIM001" and d.severity == "error"
+                   and "VIRTUAL clock" in d.message for d in diags), \
+            [d.format() for d in diags]
+
+    def test_seeded_ambient_rng_violation(self):
+        from bluefog_tpu.analysis.sim_lint import check_determinism
+
+        src = ("import random\nimport numpy as np\n"
+               "a = random.random()\nb = np.random.rand(3)\n")
+        diags = check_determinism(src, filename="seeded_sim2.py")
+        assert sum(1 for d in diags if d.code == "BF-SIM001") == 2, \
+            [d.format() for d in diags]
+
+    def test_seeded_generators_are_clean(self):
+        from bluefog_tpu.analysis.sim_lint import check_determinism
+
+        src = ("import random\nimport numpy as np\n"
+               "r = random.Random(7)\nv = r.random()\n"
+               "g = np.random.default_rng(7)\n")
+        assert not check_determinism(src, filename="clean_sim.py")
+
+    def test_scenario_missing_accept_or_horizon(self):
+        from bluefog_tpu.analysis.sim_lint import check_scenario_table
+
+        src = ("s = Scenario(name='x', kind='fleet', n_ranks=8,\n"
+               "             horizon_s=1.0)\n"
+               "t = Scenario(name='y', kind='fleet', n_ranks=8,\n"
+               "             accept=(('audit_exact', {}),))\n")
+        diags = check_scenario_table(src, filename="seeded_sc.py")
+        msgs = [d.message for d in diags if d.code == "BF-SIM001"]
+        assert any("accept=" in m for m in msgs), msgs
+        assert any("horizon_s=" in m for m in msgs), msgs
+
+    def test_scenario_splat_left_to_runtime(self):
+        from bluefog_tpu.analysis.sim_lint import check_scenario_table
+
+        # **kwargs spellings are the runtime validator's job
+        # (Scenario.__post_init__ raises on a missing accept/horizon)
+        src = "s = Scenario(**cfg)\n"
+        assert not check_scenario_table(src, filename="splat.py")
+
+    def test_determinism_rule_scoped_to_sim_package(self):
+        from bluefog_tpu.analysis.sim_lint import check_file
+
+        # a wall-clock call OUTSIDE bluefog_tpu/sim/ is not this
+        # lint's business (the fleet publisher reads time.time by
+        # design); only the scenario-table rule applies there
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(root, "bluefog_tpu", "fleet", "record.py")
+        assert not [d for d in check_file(path) if d.severity == "error"]
+
+    def test_sim_package_is_repo_clean(self):
+        import glob
+
+        from bluefog_tpu.analysis.sim_lint import check_file
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        errs = []
+        for pat in ("bluefog_tpu/sim/*.py", "examples/*.py",
+                    "benchmarks/*.py"):
+            for path in glob.glob(os.path.join(root, pat)):
+                errs += [d for d in check_file(path)
+                         if d.severity == "error"]
+        assert not errs, [d.format() for d in errs]

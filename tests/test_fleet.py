@@ -819,3 +819,60 @@ class TestMPAcceptance:
             capture_output=True, text=True, env=clean_env(), cwd=_REPO,
             timeout=120)
         assert chk2.returncode == 0, chk2.stdout + chk2.stderr
+
+
+# ---------------------------------------------------------------------------
+# 8. every committed BENCH file gates itself
+# ---------------------------------------------------------------------------
+
+
+def _committed_bench_files():
+    import glob
+
+    return sorted(os.path.basename(p)
+                  for p in glob.glob(os.path.join(_REPO, "BENCH_*.json")))
+
+
+class TestCommittedBenchGates:
+    """The bffleet-tpu BENCH gate over EVERY committed ``BENCH_*.json``
+    that carries ``ok``/``*_ok`` booleans — a regression committed into
+    any bench trajectory fails the suite, not just BENCH_fleet.json."""
+
+    @pytest.mark.parametrize("fname", _committed_bench_files())
+    def test_bench_file_passes_its_gates(self, fname):
+        from bluefog_tpu.fleet import dash
+
+        path = os.path.join(_REPO, fname)
+        with open(path) as f:
+            doc = json.load(f)
+        gates = dash.bench_gate_failures(doc)
+        assert gates == [], f"{fname}: false gates {gates}"
+        # the CLI agrees: gated files exit 0, gate-free files are
+        # trivially 0 (nothing to fail) — either way rc must be 0
+        assert dash.main(["--check", path]) == 0
+
+    def test_gated_set_is_not_empty(self):
+        """The suite must actually be gating something: the control,
+        fleet, and sim trajectories all carry ok keys."""
+        from bluefog_tpu.fleet.dash import bench_gate_failures
+
+        def has_gates(doc):
+            if isinstance(doc, dict):
+                return any(
+                    (isinstance(v, bool)
+                     and (k == "ok" or k.endswith("_ok")))
+                    or has_gates(v) for k, v in doc.items())
+            if isinstance(doc, list):
+                return any(has_gates(v) for v in doc)
+            return False
+
+        gated = []
+        for fname in _committed_bench_files():
+            with open(os.path.join(_REPO, fname)) as f:
+                doc = json.load(f)
+            if has_gates(doc):
+                gated.append(fname)
+                assert bench_gate_failures(doc) == []
+        for expected in ("BENCH_control.json", "BENCH_fleet.json",
+                         "BENCH_sim.json"):
+            assert expected in gated, (expected, gated)

@@ -395,6 +395,22 @@ class TestHealth:
         with pytest.raises(ValueError, match="rounds_per_update"):
             tracker.rebase(healed, rounds_per_update=0)
 
+    def test_mixing_tracker_reset_measurement_at_membership_boundary(self):
+        """The measurement twin of rebase: a distance measured over one
+        member set must not ratio against a distance over another — a
+        join widens disagreement and the cross-boundary ratio reads as
+        a mixing failure (the fleet simulator caught this marching the
+        densify ladder to fully-connected).  reset_measurement() drops
+        the previous sample so the next update yields no ratio."""
+        tracker = mhealth.MixingTracker(RingGraph(6))
+        assert tracker.update(10.0) is None  # first sample
+        assert tracker.update(9.0) == pytest.approx(0.9)
+        tracker.reset_measurement()
+        # the membership boundary: disagreement jumped to 30 over a
+        # grown fleet — no ratio, instead of a spurious 30/9
+        assert tracker.update(30.0) is None
+        assert tracker.update(27.0) == pytest.approx(0.9)
+
     def test_heartbeat_age_gauge(self):
         from bluefog_tpu.utils.failure import Heartbeat
 

@@ -1,0 +1,614 @@
+"""Fleet digital twin: the discrete-event simulator and scenario lab.
+
+Covers, in tier-1 (fast, deterministic, no sockets):
+
+1. event-core semantics: virtual clock, deterministic same-time
+   ordering, seed derivation stability;
+2. the chaos-grammar link model: one parser with the live injector,
+   mirrored trigger semantics, retry-budget abandonment, partitions;
+3. simulated-vs-closed-form mixing (the spectral-gap property tests at
+   n in {8, 64, 512, 1024}) against the REAL MixingTracker;
+4. provenance-name collapse staying O(1) under thousands of simulated
+   membership events;
+5. FleetSim: exact mass audits through join/leave/kill, plan
+   byte-convergence over the real decide_plan, SLO replay naming the
+   planted slow host, same-seed byte-identical scenario reports;
+6. the scenario table contract and the ``bfsim-tpu --check`` smoke
+   (trimmed suite, subprocess) — the full 1024-rank acceptance run is
+   slow-marked.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from bluefog_tpu.chaos.spec import ChaosSpecError, parse_spec
+from bluefog_tpu.sim.core import EventLoop, derive_seed, rng_for
+from bluefog_tpu.sim.fleet import (FleetSim, SimConfig, ST_DEAD,
+                                   ST_HEALTHY, ST_SUSPECT)
+from bluefog_tpu.sim.mixing import run_sync_mixing
+from bluefog_tpu.sim.network import FaultBox, LinkModel
+from bluefog_tpu.sim.scenarios import (SCENARIO_NAMES, Scenario,
+                                       build_suite, run_scenario,
+                                       run_suite)
+from bluefog_tpu import topology as T
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. event core
+# ---------------------------------------------------------------------------
+
+
+class TestEventCore:
+    def test_same_time_events_pop_in_schedule_order(self):
+        loop = EventLoop()
+        seen = []
+        for k in range(16):
+            loop.at(1.0, (lambda v: lambda: seen.append(v))(k))
+        loop.at(0.5, lambda: seen.append("early"))
+        loop.run()
+        assert seen == ["early"] + list(range(16))
+        assert loop.now == 1.0
+
+    def test_scheduling_into_the_past_raises(self):
+        loop = EventLoop()
+        loop.at(1.0, lambda: loop.at(0.5, lambda: None))
+        with pytest.raises(ValueError, match="before now"):
+            loop.run()
+
+    def test_run_until_advances_clock_to_horizon(self):
+        loop = EventLoop()
+        loop.at(0.25, lambda: None)
+        loop.run(until=2.0)
+        assert loop.now == 2.0
+
+    def test_max_events_backstop(self):
+        loop = EventLoop()
+
+        def rearm():
+            loop.after(0.001, rearm)
+
+        loop.after(0.0, rearm)
+        n = loop.run(until=1e9, max_events=100)
+        assert n == 100
+
+    def test_derive_seed_stable_and_structural(self):
+        assert derive_seed("link", 3, 7) == derive_seed("link", 3, 7)
+        assert derive_seed("link", 3, 7) != derive_seed("link", 7, 3)
+        # pinned: the cross-machine reproducibility contract (FNV-1a)
+        assert derive_seed("x") == derive_seed("x")
+        a = rng_for("a", 1).random()
+        b = rng_for("a", 1).random()
+        assert a == b
+        assert rng_for("a", 1).random() != rng_for("a", 2).random()
+
+
+# ---------------------------------------------------------------------------
+# 2. link model on the one chaos grammar
+# ---------------------------------------------------------------------------
+
+
+class TestLinkModel:
+    def test_same_parser_as_the_live_injector(self):
+        from bluefog_tpu import chaos
+
+        assert chaos.parse_spec is parse_spec
+        with pytest.raises(ChaosSpecError):
+            FaultBox(0, "server:flood")
+        with pytest.raises(ChaosSpecError):
+            FaultBox(0, "rank2:die")  # needs at_step
+
+    def test_rate_coin_is_seeded_and_per_rule(self):
+        box1 = FaultBox(3, "server:drop:rate=0.5:seed=9", seed=1)
+        box2 = FaultBox(3, "server:drop:rate=0.5:seed=9", seed=1)
+        seq1 = [box1.fire("server") for _ in range(64)]
+        seq2 = [box2.fire("server") for _ in range(64)]
+        assert seq1 == seq2
+        hits = sum(1 for a in seq1 if a == ("drop",))
+        assert 16 <= hits <= 48  # a coin, not a constant
+
+    def test_after_frames_and_every_and_times(self):
+        box = FaultBox(0, "server:delay:ms=10:after_frames=3")
+        acts = [box.fire("server") for _ in range(6)]
+        assert acts == [None, None, ("delay", 0.01), None, None, None]
+        box = FaultBox(0, "ack:stall:s=0.5:every=2:times=2")
+        acts = [box.fire("ack") for _ in range(8)]
+        assert acts == [None, ("stall", 0.5), None, ("stall", 0.5),
+                        None, None, None, None]
+
+    def test_drop_costs_a_retransmit_not_mass(self):
+        links = LinkModel(latency_s=0.001, rto_s=0.05, budget_s=1.0)
+        links.set_host_faults(7, "server:drop:after_frames=1")
+        out = links.send(0, 7)
+        assert not out.abandoned
+        assert out.retries == 1
+        assert out.deliver_dt == pytest.approx(0.05 + 0.001)
+
+    def test_budget_exhaustion_abandons(self):
+        links = LinkModel(latency_s=0.001, rto_s=0.05, budget_s=0.12)
+        links.set_host_faults(7, "server:drop:rate=1.0")
+        out = links.send(0, 7)
+        assert out.abandoned
+
+    def test_unbounded_budget_refused(self):
+        with pytest.raises(ValueError, match="budget"):
+            LinkModel(budget_s=0.0)
+
+    def test_partition_cuts_both_ways_and_clears(self):
+        links = LinkModel()
+        links.set_partition(LinkModel.cut_between([0, 1], [2, 3]))
+        assert links.send(0, 2).abandoned
+        assert links.send(3, 1).abandoned
+        assert not links.send(0, 1).abandoned
+        links.set_partition(None)
+        assert not links.send(0, 2).abandoned
+
+    def test_one_directed_cut_kills_acks_of_the_reverse_flow(self):
+        # severing ONE direction stalls both flows over the link: the
+        # forward sender loses payloads, the reverse sender loses acks
+        # (live TCP behavior; regression for the ack leg ignoring the
+        # reverse pair)
+        links = LinkModel()
+        links.set_partition({(2, 5)})
+        assert links.send(2, 5).abandoned   # payload path severed
+        assert links.send(5, 2).abandoned   # ack path severed
+        assert not links.send(2, 4).abandoned
+
+    def test_replacing_a_fault_spec_cancels_armed_timers(self):
+        # regression: timed rank faults armed from a replaced spec
+        # must not still fire (heap entries become no-ops once their
+        # box is superseded)
+        sim = FleetSim(SimConfig(
+            n_ranks=8, seed=0,
+            faults={2: "rank2:sigkill:after_s=0.3"}))
+        sim.loop.at(0.1, lambda: sim.set_host_faults(
+            2, "rank2:sigkill:after_s=1.5"))
+        sim.run(1.0)
+        assert sim.alive[2]  # the t=0.3 kill was cancelled
+        sim.run(2.0)
+        assert not sim.alive[2]  # the replacement fired at ~1.6
+
+    def test_trigger_semantics_lockstep_with_live_injector(self):
+        """The fidelity contract: FaultBox mirrors Injector.fire's
+        trigger evaluation (counters, after_frames==, every%,
+        max_fires short-circuit, first-action-wins with continued
+        counting).  Drive both with the same spec over the same frame
+        sequence and assert IDENTICAL action streams for every
+        deterministic trigger (seeded coins draw from differently
+        derived streams by design, so prob/rate parity is semantic,
+        not bitwise — covered by the rate test above)."""
+        from bluefog_tpu.chaos.injector import Injector
+
+        spec = ("server:delay:ms=10:after_frames=3;"
+                "server:stall:s=0.5:every=4:times=2;"
+                "ack:drop:after_frames=2;"
+                "any:truncate:every=7:times=1")
+        inj = Injector(spec)
+        box = FaultBox(0, spec)
+        sites = ["server", "ack", "server", "client"] * 10
+        live = [inj.fire(site) for site in sites]
+        simd = [box.fire(site) for site in sites]
+        assert live == simd
+        # and the per-rule frame counters agree
+        assert [inj.stats()[i][0] for i in range(4)] == box._counters
+        from bluefog_tpu.runtime import resilience as res
+
+        assert ST_HEALTHY == res.HEALTHY
+        assert ST_SUSPECT == res.SUSPECT
+        assert ST_DEAD == res.DEAD
+
+
+# ---------------------------------------------------------------------------
+# 3. simulated vs closed-form mixing (the spectral-gap property tests)
+# ---------------------------------------------------------------------------
+
+
+class TestMixingFidelity:
+    @pytest.mark.parametrize("n", [8, 64, 512, 1024])
+    @pytest.mark.parametrize("ctor", [T.RingGraph, T.ExponentialTwoGraph])
+    def test_measured_contraction_matches_lambda2(self, n, ctor):
+        run = run_sync_mixing(ctor(n), rounds=300, seed=1)
+        assert run.rounds_used >= 20
+        assert run.measured_geomean == pytest.approx(
+            run.predicted, abs=0.01), (n, ctor.__name__, run)
+
+    @pytest.mark.parametrize("n", [8, 64, 512, 1024])
+    def test_fully_connected_averages_in_one_step(self, n):
+        run = run_sync_mixing(T.FullyConnectedGraph(n), rounds=5, seed=1)
+        assert run.final_distance <= 1e-12
+
+    def test_prediction_is_the_trackers(self):
+        from bluefog_tpu.analysis.topology_check import spectral_gap
+
+        topo = T.ExponentialTwoGraph(64)
+        run = run_sync_mixing(topo, rounds=50, seed=0)
+        assert run.predicted == pytest.approx(
+            1.0 - spectral_gap(topo.weights))
+
+
+# ---------------------------------------------------------------------------
+# 4. provenance collapse under thousands of membership events
+# ---------------------------------------------------------------------------
+
+
+class TestProvenanceCollapse:
+    def test_name_stays_o1_over_thousands_of_events(self):
+        import re
+
+        rng = rng_for("churn", 0)
+        n = 128
+        topo = T.ExponentialTwoGraph(n)
+        members = set(range(n))
+        suffix_re = re.compile(r"\+(heal|replan|ctl)\(")
+        max_first, max_last = 0, 0
+        for i in range(3000):
+            op = i % 3
+            if op == 0 and len(members) > n // 2:
+                dead = rng.choice(sorted(members))
+                members.discard(dead)
+                topo = T.heal(topo, {dead})
+            elif op == 1 and len(members) > n // 2:
+                gone = rng.choice(sorted(members))
+                members.discard(gone)
+                topo = T.replan(topo, sorted(members))
+            else:
+                missing = sorted(set(range(n)) - members)
+                if missing:
+                    members.add(missing[0])
+                topo = T.replan_penalized(
+                    topo, sorted(members),
+                    slow=sorted(members)[:2], densify=i % 3)
+            # exactly ONE collapsed provenance suffix, ever (a chain
+            # would accrete one "+heal(...)" per event)
+            assert len(suffix_re.findall(topo.name)) == 1, topo.name
+            if i < 1000:
+                max_first = max(max_first, len(topo.name))
+            elif i >= 2000:
+                max_last = max(max_last, len(topo.name))
+        # O(1) in the EVENT count: the name after 3000 events is no
+        # longer than after 1000 (its length tracks the bounded member
+        # set — a heal suffix lists the inactive ranks — never the
+        # event history)
+        assert max_last <= max_first + 32, (max_first, max_last)
+        assert max_first < 16 + 6 * n
+
+    def test_sim_churn_keeps_name_collapsed(self):
+        cfg = SimConfig(n_ranks=24, capacity=32, seed=2)
+        sim = FleetSim(cfg)
+        for k in range(8):
+            t = 0.15 + 0.1 * k
+            if k % 2 == 0:
+                sim.loop.at(t, (lambda r: lambda: sim.kill(r))(k))
+            else:
+                sim.loop.at(
+                    t, (lambda r: lambda: sim.request_leave(r))(k))
+            sim.loop.at(t + 0.4,
+                        (lambda r: lambda: sim.join(24 + r % 8))(k))
+        sim.run(2.0)
+        assert sim.max_name_len < 200
+        assert sim.connectivity_ok
+
+
+# ---------------------------------------------------------------------------
+# 5. FleetSim
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSim:
+    def test_audit_exact_through_churn(self):
+        sim = FleetSim(SimConfig(n_ranks=24, capacity=32, seed=7))
+        sim.loop.at(0.3, lambda: sim.request_leave(5))
+        sim.loop.at(0.5, lambda: sim.kill(9))
+        sim.loop.at(0.7, lambda: sim.join(24))
+        sim.loop.at(0.7, lambda: sim.join(25))
+        sim.run(2.5)
+        xerr, perr = sim.audit()
+        assert abs(xerr) < 1e-9 * sim.admissions
+        assert abs(perr) < 1e-9 * sim.admissions
+        assert not sim.alive[5] and not sim.alive[9]
+        assert sim.alive[24] and sim.alive[25]
+        assert 9 in sim.topo.inactive  # healed corpse
+        # the corpse's evidence no longer votes anywhere
+        for r in sim.members():
+            assert 9 not in sim.ctl[r].evidence(10_000).lag_s
+
+    def test_graceful_leave_conserves_mass_kill_writes_off(self):
+        sim = FleetSim(SimConfig(n_ranks=8, seed=1))
+        sim.loop.at(0.3, lambda: sim.request_leave(2))
+        sim.run(1.0)
+        live_p = sum(sim.p[r] + sim.mp[r] for r in sim.members())
+        # the leaver handed its whole (x, p) over: nothing retained,
+        # live + in-flight mass == n (the drain-conserves-mass contract)
+        assert sim.p[2] + sim.mp[2] == 0.0
+        assert live_p + sim._inflight_p == pytest.approx(8.0, abs=1e-9)
+        sim2 = FleetSim(SimConfig(n_ranks=8, seed=1))
+        sim2.loop.at(0.3, lambda: sim2.kill(2))
+        sim2.run(1.0)
+        live_p2 = sum(sim2.p[r] + sim2.mp[r] for r in sim2.members())
+        dead_p = sim2.p[2] + sim2.mp[2]
+        assert live_p2 + dead_p + sim2._inflight_p == pytest.approx(
+            8.0, abs=1e-9)
+        assert dead_p > 0  # written off with the corpse, not conserved
+
+    def test_leaver_forward_chain_survives_heir_leaving(self):
+        # regression: mass in flight toward a leaver whose HEIR has
+        # itself since drained must walk the forward chain to a live
+        # rank, not strand in a dead slot (live mass would silently
+        # shrink while the all-slots audit still balanced)
+        sim = FleetSim(SimConfig(
+            n_ranks=8, seed=3,
+            faults={4: "server:delay:ms=120:rate=1.0"}))
+        # rank 0 is the heir pick (lowest live); drain it right after
+        sim.loop.at(0.30, lambda: sim.request_leave(4))
+        sim.loop.at(0.45, lambda: sim.request_leave(0))
+        sim.run(2.5)
+        live_p = sum(sim.p[r] + sim.mp[r] for r in sim.members())
+        dead_p = sum(sim.p[r] + sim.mp[r]
+                     for r in range(8) if not sim.alive[r])
+        assert dead_p == pytest.approx(0.0, abs=1e-12)
+        assert live_p + sim._inflight_p == pytest.approx(8.0, abs=1e-9)
+
+    def test_failed_drain_rejoin_keeps_the_ledger_exact(self):
+        # regression: a partitioned leaver whose handoff sends were all
+        # ABANDONED retains its (x, p); rejoining it must ACCUMULATE
+        # the warm-start on top of the residual, not overwrite it (the
+        # overwrite destroyed ledgered mass and broke the exact audit)
+        sim = FleetSim(SimConfig(n_ranks=8, seed=6))
+        cut = LinkModel.cut_between([3], [r for r in range(8) if r != 3])
+        sim.loop.at(0.30, lambda: sim.set_partition(cut))
+        sim.loop.at(0.40, lambda: sim.request_leave(3))
+        sim.loop.at(0.80, lambda: sim.set_partition(None))
+        sim.loop.at(0.90, lambda: sim.join(3))
+        sim.run(2.5)
+        assert sim.alive[3]
+        xerr, perr = sim.audit()
+        assert abs(xerr) < 1e-9 * sim.admissions, xerr
+        assert abs(perr) < 1e-9 * sim.admissions, perr
+
+    def test_mid_run_timed_rank_fault_is_armed(self):
+        # regression: a rank fault with after_s= installed mid-run via
+        # set_host_faults was silently inert (timed rules were armed
+        # only at construction); now it arms relative to install time
+        sim = FleetSim(SimConfig(n_ranks=8, seed=0))
+        sim.loop.at(0.05, lambda: sim.set_host_faults(
+            2, "rank2:sigkill:after_s=0.1"))
+        sim.run(1.0)
+        assert not sim.alive[2]
+        assert sim.deaths == 1
+
+    def test_misplaced_rank_rule_refused(self):
+        # a rank5 rule under host 3's entry would never be consulted
+        with pytest.raises(ValueError, match="own rank's entry"):
+            FleetSim(SimConfig(n_ranks=8, seed=0,
+                               faults={3: "rank5:die:at_step=4"}))
+        sim = FleetSim(SimConfig(n_ranks=8, seed=0))
+        with pytest.raises(ValueError, match="own rank's entry"):
+            sim.set_host_faults(3, "rank5:leave:at_step=4")
+
+    def test_read_path_fault_sites_refused(self):
+        # the sim models the deposit path; a read/sub rule would sit
+        # inert and make a scenario's predicates vacuous — refused
+        sim = FleetSim(SimConfig(n_ranks=8, seed=0))
+        with pytest.raises(ValueError, match="read-path"):
+            sim.set_host_faults(3, "read:stall:s=2:prob=0.5")
+        with pytest.raises(ValueError, match="read-path"):
+            sim.set_host_faults(3, "sub:drop:every=5")
+        sim.set_host_faults(3, "any:delay:ms=5:every=3")  # fine
+
+    def test_consensus_converges_to_fixed_point(self):
+        sim = FleetSim(SimConfig(n_ranks=32, seed=3))
+        sim.run(1.0)
+        t, med, mx = sim.spread_history[-1]
+        assert mx < 1e-9
+
+    def test_plan_byte_convergence_over_all_ranks(self):
+        # decide on EVERY rank (decide_sample >= n) and assert literal
+        # byte equality of the real decide_plan outputs each epoch
+        sim = FleetSim(SimConfig(
+            n_ranks=16, seed=5, control=True, decide_sample=16,
+            faults={3: "server:delay:ms=120:rate=1.0"}))
+        sim.run(4.0)
+        assert sim.plan_divergences == 0
+        assert sim.plans_converged()
+        assert sim.plan.version >= 1
+        assert 3 in sim.plan.slow  # the real decide_plan convicted it
+        blobs = {sim.ctl[r].plan.to_bytes() for r in sim.members()}
+        assert len(blobs) == 1
+
+    def test_slo_replay_names_the_slow_host(self):
+        sim = FleetSim(SimConfig(
+            n_ranks=16, seed=5,
+            faults={3: "server:delay:ms=120:rate=1.0"}))
+        sim.run(2.0)
+        engine = sim.replay_slos()
+        warns = [tr for tr in engine.transitions
+                 if tr.slo == "straggler" and tr.to >= 1]
+        assert warns and warns[0].rank == 3
+
+    def test_lossy_link_reconnect_evidence(self):
+        sim = FleetSim(SimConfig(
+            n_ranks=8, seed=2,
+            faults={3: "server:drop:rate=0.3:seed=5"}))
+        sim.run(1.0)
+        # senders to host 3 saw retransmits; the controller's evidence
+        # carries them as reconnect deltas (the lossy-link channel)
+        total = sum(sim._retx_total[r].get(3, 0) for r in range(8))
+        assert total > 0
+
+    def test_flash_join_does_not_false_alarm_densify(self):
+        # a membership boundary's cross-set contraction ratio must not
+        # read as a mixing failure: after a big join the plan may
+        # retune cadence, but the densify ladder stays at 0 (the
+        # MixingTracker.reset_measurement contract)
+        sim = FleetSim(SimConfig(
+            n_ranks=32, capacity=32, seed=4, control=True,
+            initial_members=list(range(16))))
+        sim.loop.at(0.5, lambda: [sim.join(r) for r in range(16, 32)])
+        sim.run(2.0)
+        assert len(sim.members()) == 32
+        assert sim.plan.densify == 0, sim.plan
+
+    def test_partition_climbs_the_densify_ladder(self):
+        # a PARTITION is a genuine sustained mixing stall: the real
+        # decide_plan's densify ladder must climb (at n=16 the top
+        # rung's fully-connected rebuild is harmless)
+        sim = FleetSim(SimConfig(n_ranks=16, seed=11, control=True))
+        cut = LinkModel.cut_between(range(8), range(8, 16))
+        sim.loop.at(0.5, lambda: sim.set_partition(cut))
+        sim.run(2.5)
+        assert sim.plan.densify >= 1, sim.plan
+
+    def test_partition_detect_and_reconverge(self):
+        sim = FleetSim(SimConfig(n_ranks=16, seed=11, control=True))
+        cut = LinkModel.cut_between(range(8), range(8, 16))
+        sim.loop.at(0.5, lambda: sim.set_partition(cut))
+        sim.loop.at(1.5, lambda: sim.set_partition(None))
+        sim.run(6.0)
+        assert max(abs(v) for v in sim.audit()) < 1e-9 * 16
+        assert sim.plans_converged()
+        # reconverged after the merge
+        assert sim.spread_history[-1][2] < 1e-5
+        # the plan reacted while the halves were cut
+        assert sim.plan_changes >= 1
+
+    def test_same_seed_same_bytes(self):
+        def one():
+            sim = FleetSim(SimConfig(
+                n_ranks=12, seed=9,
+                faults={5: "server:delay:ms=60:rate=0.5"}))
+            sim.loop.at(0.4, lambda: sim.kill(2))
+            sim.run(1.5)
+            return (tuple(sim.spread_history), sim.audit(),
+                    sim.plan.to_bytes(), tuple(sim.x), tuple(sim.p))
+
+        assert one() == one()
+
+
+# ---------------------------------------------------------------------------
+# 6. scenario table + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioTable:
+    def test_every_suite_entry_is_checked_and_bounded(self):
+        for sc in build_suite(n=64):
+            assert sc.accept, sc.name
+            assert sc.horizon_s > 0, sc.name
+            for pname, params in sc.accept:
+                assert isinstance(params, dict)
+
+    def test_scenario_without_accept_refused(self):
+        with pytest.raises(ValueError, match="accept"):
+            Scenario(name="x", kind="fleet", n_ranks=8,
+                     horizon_s=1.0, accept=())
+
+    def test_scenario_without_horizon_refused(self):
+        with pytest.raises(ValueError, match="horizon"):
+            Scenario(name="x", kind="fleet", n_ranks=8,
+                     horizon_s=0.0,
+                     accept=(("audit_exact", {}),))
+
+    def test_unknown_predicate_refused(self):
+        with pytest.raises(ValueError, match="unknown predicate"):
+            Scenario(name="x", kind="fleet", n_ranks=8, horizon_s=1.0,
+                     accept=(("no_such_predicate", {}),))
+
+    def test_unknown_scenario_name_refused(self):
+        with pytest.raises(ValueError, match="unknown scenario"):
+            build_suite(n=64, names=["nope"])
+
+    def test_scenario_report_is_deterministic(self):
+        sc = build_suite(n=16, names=["diurnal_autoscale"])[0]
+        a = json.dumps(run_scenario(sc), sort_keys=True)
+        b = json.dumps(run_scenario(sc), sort_keys=True)
+        assert a == b
+
+    def test_failed_predicate_fails_the_suite(self):
+        sc = Scenario(
+            name="impossible", kind="fleet", n_ranks=8,
+            horizon_s=0.2,
+            accept=(("converged", {"eps": 1e-300, "metric": "max"}),))
+        rep = run_scenario(sc)
+        assert not rep["ok"]
+        assert not rep["predicates"]["converged"]["ok"]
+
+
+class TestSimCli:
+    def test_check_runs_trimmed_suite(self, tmp_path):
+        """The tier-1 smoke (satellite): the FULL scenario suite at a
+        48-rank trim, as a subprocess, exit 0, deterministic report."""
+        rep = tmp_path / "sim_report.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.sim", "--check",
+             "--ranks", "48", "--report", str(rep)],
+            capture_output=True, text=True, env=env, cwd=_REPO,
+            timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(rep.read_text())
+        assert doc["ok"] is True
+        names = [r["name"] for r in doc["scenarios"]]
+        assert sorted(names) == sorted(SCENARIO_NAMES)
+        for r in doc["scenarios"]:
+            assert r["ok"] is True, r["name"]
+        # the report passes the bffleet-tpu BENCH gate
+        from bluefog_tpu.fleet.dash import bench_gate_failures
+
+        assert bench_gate_failures(doc) == []
+
+    def test_report_bytes_are_seed_deterministic(self):
+        a = json.dumps(run_suite(n=16, seed=4,
+                                 names=["diurnal_autoscale"]),
+                       sort_keys=True)
+        b = json.dumps(run_suite(n=16, seed=4,
+                                 names=["diurnal_autoscale"]),
+                       sort_keys=True)
+        assert a == b
+
+    def test_usage_errors_exit_2(self):
+        from bluefog_tpu.sim import cli
+
+        assert cli.main(["--check", "--ranks", "4"]) == 2
+        assert cli.main(["no_such_scenario"]) == 2
+        assert cli.main([]) == 2
+
+    def test_list_exits_0(self, capsys):
+        from bluefog_tpu.sim import cli
+
+        assert cli.main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for name in SCENARIO_NAMES:
+            assert name in out
+
+    def test_failed_predicate_exits_3(self, monkeypatch):
+        from bluefog_tpu.sim import cli
+
+        monkeypatch.setattr(
+            cli, "run_suite",
+            lambda **kw: {"ok": False, "scenarios": [
+                {"name": "x", "kind": "fleet", "n_ranks": 8,
+                 "ok": False, "predicates": {
+                     "p": {"ok": False}}}]})
+        assert cli.main(["--check"]) == 3
+
+
+@pytest.mark.slow
+class TestFullScaleSuite:
+    def test_full_1024_rank_suite(self, tmp_path):
+        """The acceptance run: the whole suite at 1024 simulated ranks
+        (what the committed BENCH_sim.json records)."""
+        rep = tmp_path / "sim1024.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.sim", "--check",
+             "--ranks", "1024", "--report", str(rep)],
+            capture_output=True, text=True, env=env, cwd=_REPO,
+            timeout=1800)
+        assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr
+        doc = json.loads(rep.read_text())
+        assert doc["ok"] is True and doc["n_ranks"] == 1024
