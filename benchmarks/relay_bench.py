@@ -1,0 +1,325 @@
+"""Relay-tree read-path bench: fan-out through tiers, delta wire savings.
+
+Measures what `bluefog_tpu/relay/` exists to buy over the flat fan-out
+ceiling `BENCH_serving.json` recorded (8 direct subscribers -> ~7
+rounds/s each while the publisher does 15.5):
+
+1. **fan-out** — ``--readers`` (default 32) real subscriber threads,
+   split over reader worker PROCESSES (so the measurement sees the
+   tree, not one reader process's GIL), behind a relay tree at depth 1
+   and depth 2 (the acceptance shape), relays as separate
+   ``bfrelay-tpu`` processes: delivered rounds/s per reader vs the
+   publisher's unthrottled cadence, which every reader must sustain;
+2. **staleness** — worst observed leaf staleness in rounds (the
+   publisher runs on an absolute schedule from a shared ``t0``, so a
+   delivery's lag is measurable in any process) against the declared
+   additive per-tier budget;
+3. **delta wire ratio** — dense-equivalent bytes / actual wire bytes
+   on the trainer's own push channels (op-10 topk deltas with error
+   feedback vs full anchors), gated >= 2x;
+4. **consistency** — every delivered snapshot passes the exact
+   round-stamp audit (the in-band ``round`` leaf equals the frame
+   stamp); any mismatch is a torn read and fails the bench.
+
+Self-contained, no jax, rc=0 off-TPU (~30 s; sized for a 1-core CI
+container).  The committed run is ``BENCH_relay.json`` at the repo
+root; its ``*_ok`` gates ride the ``bffleet-tpu --check`` BENCH mode
+and the tier-1 ``TestCommittedBenchGates`` sweep.
+
+Run:
+  python benchmarks/relay_bench.py [--dim 50000] [--readers 32]
+      [--out BENCH_relay.json]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+#: the declared additive staleness budget, in rounds per tier (a leaf
+#: behind a depth-d tree consumes at tier d+1, so its budget is
+#: (d + 1) * this) — generous for a single-core CI container, tight
+#: enough that a wedged tier would blow it
+STALE_BUDGET_PER_TIER = 6.0
+#: every reader must deliver at least this fraction of the publisher's
+#: unthrottled cadence (skip-to-latest makes the remainder `skipped`,
+#: never lag)
+SUSTAIN_FRAC = 0.7
+
+
+def _spawn_relay(upstream, group, tier, full_every):
+    """One bfrelay-tpu subprocess; returns (proc, (host, port))."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bluefog_tpu.relay",
+         f"{upstream[0]}:{upstream[1]}", "--group", group,
+         "--host", "127.0.0.1", "--tier", str(tier),
+         "--full-every", str(full_every), "--codec", "topk"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=_REPO)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("RELAY_READY"):
+        proc.kill()
+        raise RuntimeError(f"relay failed to start: {line!r}")
+    _, host, port = line.split()
+    return proc, (host, int(port))
+
+
+# ---------------------------------------------------------------------------
+# reader worker (subprocess mode)
+# ---------------------------------------------------------------------------
+
+
+def _worker(args) -> int:
+    """``--worker``: run N subscriber threads against the given leaf
+    addresses for ``--seconds``, then print one JSON line with
+    per-reader delivered counts, worst staleness, and the torn-read
+    audit.  Staleness per delivery = the publisher's live round (from
+    the shared absolute schedule ``t0 + k * publish_dt``) minus the
+    delivered round — the cursor-stamped freshness the tree promises."""
+    from bluefog_tpu.serving.subscriber import Subscriber
+
+    addrs = [(h, int(p)) for h, p in
+             (a.split(":") for a in args.addrs.split(","))]
+    counts = [0] * args.n
+    stale = [0.0]
+    torn = [0]
+    mu = threading.Lock()
+
+    def cb(i):
+        def on_snap(snap):
+            if int(snap["round"][0]) != snap.round:
+                with mu:
+                    torn[0] += 1
+            live = (time.time() - args.t0) / args.publish_dt
+            lag = max(0.0, live - snap.round)
+            with mu:
+                counts[i] += 1
+                if lag > stale[0]:
+                    stale[0] = lag
+        return on_snap
+
+    subs = [Subscriber(addrs[i % len(addrs)], args.group, delta=True,
+                       queue_max=2, on_snapshot=cb(i))
+            for i in range(args.n)]
+    # the measurement window is the worker's OWN steady-state span —
+    # process startup and subscribe handshakes are excluded, so the
+    # reported rate is deliveries over the time the readers were live
+    t_start = time.perf_counter()
+    time.sleep(args.seconds)
+    elapsed = time.perf_counter() - t_start
+    for s in subs:
+        s.close()
+    print("WORKER " + json.dumps(
+        {"counts": counts, "elapsed_s": elapsed,
+         "worst_staleness_rounds": round(stale[0], 1),
+         "torn": torn[0]}), flush=True)
+    return 0
+
+
+def _run_phase(leaf_addrs, group, round_box, readers, seconds, t0,
+               publish_dt, n_workers=4):
+    addr_arg = ",".join(f"{h}:{p}" for h, p in leaf_addrs)
+    per = [readers // n_workers + (1 if i < readers % n_workers else 0)
+           for i in range(n_workers)]
+    r0 = round_box[0]
+    t_start = time.perf_counter()
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--addrs", addr_arg, "--group", group, "--n", str(n),
+         "--seconds", str(seconds), "--t0", repr(t0),
+         "--publish-dt", str(publish_dt)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=_REPO) for n in per if n > 0]
+    rates, stale, torn = [], 0.0, 0
+    for proc in procs:
+        out, _ = proc.communicate(timeout=seconds + 60)
+        line = next((ln for ln in out.splitlines()
+                     if ln.startswith("WORKER ")), None)
+        if proc.returncode != 0 or line is None:
+            raise RuntimeError(f"reader worker failed:\n{out}")
+        doc = json.loads(line[len("WORKER "):])
+        rates += [round(c / doc["elapsed_s"], 2)
+                  for c in doc["counts"]]
+        stale = max(stale, doc["worst_staleness_rounds"])
+        torn += doc["torn"]
+    dt = time.perf_counter() - t_start
+    published = round_box[0] - r0
+    return {
+        "readers": len(rates),
+        "publisher_rounds_per_s": round(published / dt, 2),
+        "delivered_per_reader_per_s_mean": round(
+            sum(rates) / len(rates), 2),
+        "delivered_per_reader_per_s_min": min(rates),
+        "worst_staleness_rounds": stale,
+        "torn": torn,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=50_000,
+                    help="model-vector elements (f64)")
+    ap.add_argument("--readers", type=int, default=32,
+                    help="leaf subscriber threads per phase (>= 32 is "
+                    "the acceptance scale)")
+    ap.add_argument("--seconds", type=float, default=6.0,
+                    help="measurement window per phase")
+    ap.add_argument("--publish-dt", type=float, default=0.1,
+                    help="publisher cadence (s/round)")
+    ap.add_argument("--full-every", type=int, default=8,
+                    help="delta resync-anchor cadence")
+    ap.add_argument("--out", default=None, help="write JSON here")
+    # worker mode (internal)
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--addrs", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--group", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--n", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--t0", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.worker:
+        return _worker(args)
+
+    from bluefog_tpu.metrics.registry import metrics_start, metrics_stop
+    from bluefog_tpu.runtime.delta import DeltaConfig
+    from bluefog_tpu.runtime.window_server import WindowServer
+    from bluefog_tpu.serving.snapshots import SnapshotTable
+
+    reg = metrics_start()
+    tbl = SnapshotTable()
+    srv = WindowServer(
+        snapshots=tbl,
+        delta=DeltaConfig(full_every=args.full_every, codec="topk",
+                          topk_ratio=0.05, min_delta_elems=1024))
+    addr = srv.start("127.0.0.1")
+    group = f"relay_bench_{os.getpid()}"
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(args.dim)
+    dense_frame = x.nbytes + 8 + 8  # x + p + round leaves
+
+    stop = threading.Event()
+    round_box = [0]
+    t0 = time.time()
+    tbl.publish(group, 0, {"x": x, "p": np.array([1.0]),
+                           "round": np.array([0.0])})
+
+    def publisher():
+        # absolute schedule: round k is published at t0 + k*dt, so any
+        # process can convert a delivery time into a staleness measure
+        while not stop.is_set():
+            rnd = round_box[0] + 1
+            # the model moves a little every round: the delta codec's
+            # steady state (anchors resync it exactly every Nth push)
+            np.add(x, 0.001 * rng.standard_normal(args.dim), out=x)
+            tbl.publish(group, rnd, {"x": x, "p": np.array([1.0]),
+                                     "round": np.array([float(rnd)])})
+            round_box[0] = rnd
+            next_t = t0 + (rnd + 1) * args.publish_dt
+            delay = next_t - time.time()
+            if delay > 0:
+                time.sleep(delay)
+
+    pub = threading.Thread(target=publisher, daemon=True)
+    pub.start()
+
+    relays = []
+    result = {"dim": args.dim, "leaf_bytes": int(dense_frame),
+              "publish_dt_s": args.publish_dt,
+              "full_every": args.full_every,
+              "stale_budget_per_tier": STALE_BUDGET_PER_TIER,
+              "sustain_frac": SUSTAIN_FRAC}
+    try:
+        # ---------------------------------------------- depth 1 tree
+        t1 = [_spawn_relay(addr, group, 1, args.full_every)
+              for _ in range(4)]
+        relays += t1
+        time.sleep(1.0)  # let the tier land its first rounds
+        result["depth1"] = _run_phase(
+            [a for _, a in t1], group, round_box, args.readers,
+            args.seconds, t0, args.publish_dt)
+
+        # ---------------------------------------------- depth 2 tree
+        t2 = [_spawn_relay(t1[i % len(t1)][1], group, 2,
+                           args.full_every) for i in range(4)]
+        relays += t2
+        time.sleep(1.0)
+        result["depth2"] = _run_phase(
+            [a for _, a in t2], group, round_box, args.readers,
+            args.seconds, t0, args.publish_dt)
+    finally:
+        stop.set()
+        pub.join(timeout=5)
+        for proc, _ in relays:
+            proc.terminate()
+        for proc, _ in relays:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        srv.stop()
+        tbl.drop(group)
+
+    # ------------------------------------------- delta wire accounting
+    # the trainer's own push channels (to the tier-1 relays) run in this
+    # process: bf_push_bytes_total{kind=...} counts actual wire bytes;
+    # fulls are exactly dense_frame bytes each, and in steady state
+    # every full anchor is followed by (full_every - 1) deltas, so the
+    # dense-equivalent traffic is (fulls + deltas) x dense_frame
+    snap = reg.snapshot()
+    wire_full = sum(v for k, v in snap.items()
+                    if k.startswith("bf_push_bytes_total")
+                    and 'kind="full"' in k)
+    wire_delta = sum(v for k, v in snap.items()
+                     if k.startswith("bf_push_bytes_total")
+                     and 'kind="delta"' in k)
+    metrics_stop()
+    full_frames = wire_full / dense_frame if dense_frame else 0.0
+    delta_frames = full_frames * max(0, args.full_every - 1)
+    dense_equiv = (full_frames + delta_frames) * dense_frame
+    wire_total = wire_full + wire_delta
+    ratio = dense_equiv / wire_total if wire_total else float("nan")
+    result["delta"] = {
+        "wire_full_bytes": int(wire_full),
+        "wire_delta_bytes": int(wire_delta),
+        "dense_equivalent_bytes": int(dense_equiv),
+        "wire_ratio": round(ratio, 2),
+    }
+
+    # ---------------------------------------------------------- gates
+    d1, d2 = result["depth1"], result["depth2"]
+    result["depth1_sustained_ok"] = bool(
+        d1["delivered_per_reader_per_s_min"]
+        >= SUSTAIN_FRAC * d1["publisher_rounds_per_s"])
+    result["depth2_sustained_ok"] = bool(
+        d2["delivered_per_reader_per_s_min"]
+        >= SUSTAIN_FRAC * d2["publisher_rounds_per_s"])
+    result["staleness_ok"] = bool(
+        d1["worst_staleness_rounds"] <= 2 * STALE_BUDGET_PER_TIER
+        and d2["worst_staleness_rounds"] <= 3 * STALE_BUDGET_PER_TIER)
+    result["torn_ok"] = bool(d1["torn"] == 0 and d2["torn"] == 0)
+    result["delta_ratio_ok"] = bool(ratio >= 2.0)
+    result["ok"] = bool(
+        result["depth1_sustained_ok"] and result["depth2_sustained_ok"]
+        and result["staleness_ok"] and result["torn_ok"]
+        and result["delta_ratio_ok"])
+
+    print(json.dumps(result, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

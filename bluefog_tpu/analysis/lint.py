@@ -353,9 +353,12 @@ def resilience_pass(report: LintReport, size: int) -> None:
     targets = sorted(glob.glob(os.path.join(
         root, "bluefog_tpu", "runtime", "*.py")))
     # the serving tier's readers carry their own reconnect loops — the
-    # same bounded-retry discipline applies to the read path
+    # same bounded-retry discipline applies to the read path, and to
+    # the relay tree's uplink re-parent loop
     targets += sorted(glob.glob(os.path.join(
         root, "bluefog_tpu", "serving", "*.py")))
+    targets += sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "relay", "*.py")))
     targets.append(os.path.join(root, "bluefog_tpu", "utils", "failure.py"))
     targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
@@ -422,9 +425,13 @@ def control_pass(report: LintReport, size: int) -> None:
     targets += sorted(glob.glob(os.path.join(
         root, "bluefog_tpu", "runtime", "*.py")))
     # the fleet simulator actuates real CommPlans at its epoch barrier
-    # — same round-boundary discipline, same lint
+    # — same round-boundary discipline, same lint; the relay tree
+    # actuates TreePlans through RelayNode.apply_plan under the same
+    # rule
     targets += sorted(glob.glob(os.path.join(
         root, "bluefog_tpu", "sim", "*.py")))
+    targets += sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "relay", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
     targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
     n = 0
@@ -658,6 +665,36 @@ def serving_pass(report: LintReport, size: int) -> None:
         pass_name="serving-lint", subject="serving"))
 
 
+def relay_pass(report: LintReport, size: int) -> None:
+    """BF-RLY source lint over the surfaces that re-publish received
+    snapshots: the relay tree itself plus every example/benchmark that
+    could copy its forwarding shape.  A re-publish hop without
+    resync-anchor/cursor-gap vocabulary is an error — the
+    delta-divergence twin of BF-SRV001; see
+    :mod:`bluefog_tpu.analysis.relay_lint`."""
+    import glob
+
+    from bluefog_tpu.analysis.relay_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "relay", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    targets += sorted(glob.glob(os.path.join(root, "benchmarks", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-RLY100",
+        f"relay-lint scanned {n} file(s) for guard-free snapshot "
+        "re-publish hops",
+        pass_name="relay-lint", subject="relay"))
+
+
 _EXAMPLE_CONSTRUCTORS = (
     "ExponentialTwoGraph",
     "ExponentialGraph",
@@ -741,6 +778,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     window_pass(report, size)
     resilience_pass(report, size)
     serving_pass(report, size)
+    relay_pass(report, size)
     control_pass(report, size)
     tracing_pass(report, size)
     fleet_pass(report, size)

@@ -39,7 +39,8 @@ __all__ = [
 GRAMMAR = """\
 spec  := rule (';' rule)*
 rule  := site ':' fault (':' key '=' value)*
-site  := 'server' | 'ack' | 'client' | 'read' | 'sub' | 'any' | 'rank<N>'
+site  := 'server' | 'ack' | 'client' | 'read' | 'sub' | 'relay' | 'any'
+       | 'rank<N>'
 fault := drop | truncate | delay | stall            (socket sites)
        | sigkill | sigstop | die | stall            (rank sites)
        | leave | join                               (membership churn)
@@ -53,7 +54,10 @@ rank keys:   at_step=N  after_s=T  for_s=T (sigstop thaw / stall length)
 
 sites 'server'/'ack'/'client' are the deposit (write) path; 'read' cuts
 or stalls sync-read/SNAPSHOT replies on the serving host, 'sub' the
-subscription push sender — the read-path fault surface.  The fleet
+subscription push sender, 'relay' a relay node's re-publish of an
+upstream snapshot (drop = the round is not re-published, children see
+a skip; truncate additionally tears the relay's upstream link, forcing
+a cursor-gap resync) — the read-path fault surface.  The fleet
 simulator (bluefog_tpu.sim) interprets the same rules against virtual
 traffic: socket rules hit the simulated host's transport, rank rules
 schedule kills/drains/stalls/joins on the virtual clock.
@@ -68,6 +72,8 @@ examples:
   read:stall:s=2:prob=0.05         wedge 5% of read replies for 2 s
   sub:drop:after_frames=10         cut a push subscription at frame 10
   sub:stall:s=1:every=13           stall every 13th snapshot push 1 s
+  relay:drop:every=9               a relay skips every 9th re-publish
+  relay:truncate:after_frames=20   tear a relay's uplink at land 20
   rank2:sigkill:at_step=8          rank 2 SIGKILLs itself at step 8
   rank1:sigstop:after_s=0.8:for_s=1  freeze rank 1 for 1 s, then thaw
   rank1:leave:at_step=20           graceful drain (mass handed off)
@@ -80,9 +86,11 @@ RANK_FAULTS = ("sigkill", "sigstop", "die", "stall", "leave", "join")
 # reply (drop = vanish, truncate = reply torn mid-frame, stall = wedged
 # owner); 'sub' fires in the per-subscription push sender (stall = slow
 # push channel, drop/truncate = the reader's connection cut, torn for
-# truncate).  Together they are the READ-path fault surface, the twin of
-# the PR-5 deposit-path sites.
-SOCKET_SITES = ("server", "ack", "client", "read", "sub", "any")
+# truncate); 'relay' fires in a relay node's land/re-publish path
+# (drop = the round is not re-published, truncate = that plus a torn
+# uplink — the cursor-gap resync case).  Together they are the
+# READ-path fault surface, the twin of the PR-5 deposit-path sites.
+SOCKET_SITES = ("server", "ack", "client", "read", "sub", "relay", "any")
 
 _INT_KEYS = ("after_frames", "every", "times", "seed", "at_step")
 _FLOAT_KEYS = ("prob", "rate", "ms", "s", "after_s", "for_s")

@@ -46,6 +46,8 @@ from bluefog_tpu.control.evidence import (Evidence, EvidenceBoard,
                                           canonicalize, clear_evidence,
                                           read_evidence, write_evidence)
 from bluefog_tpu.control.plan import CODEC_LADDER, CommPlan, ControlConfig
+from bluefog_tpu.control.tree import (TreeConfig, TreeEvidence, TreePlan,
+                                      decide_tree_plan, tree_capacity)
 
 __all__ = [
     "CODEC_LADDER",
@@ -54,10 +56,15 @@ __all__ = [
     "ControlConfig",
     "Evidence",
     "EvidenceBoard",
+    "TreeConfig",
+    "TreeEvidence",
+    "TreePlan",
     "canonicalize",
     "clear_evidence",
     "decide_plan",
+    "decide_tree_plan",
     "plan_topology",
     "read_evidence",
+    "tree_capacity",
     "write_evidence",
 ]

@@ -180,6 +180,15 @@ def decide_plan(prev: CommPlan, round_: int,
         elif worst < cfg.densify_exit:
             densify -= 1
     densify = max(0, densify)
+    # size-aware top rung: the reporter count is the live-member proxy
+    # the records themselves carry; above cfg.densify_full_max the
+    # one-step exact averager (a million-edge plan at 1024 ranks) is
+    # capped away and sustained excess tops out at the
+    # symmetric-exponential rung — which is what lets a fleet-scale run
+    # keep the ladder ENABLED (the partition scenario used to have to
+    # configure it off entirely)
+    if len(evs) > cfg.densify_full_max:
+        densify = min(densify, 1)
 
     # ---- cadence + codec on the consensus-growth band ----
     growths = [ev.consensus_growth for ev in evs

@@ -137,6 +137,14 @@ class ControlConfig:
     # contraction; persistently positive = gossip under-delivering)
     densify_enter: float = 0.15
     densify_exit: float = 0.02
+    # size-aware ladder cap (the digital twin's scale-blindness
+    # finding, PR 13): the ladder's top rung is the one-step exact
+    # averager — a million-edge plan at 1024 ranks.  Fully-connected
+    # stays reachable only for fleets at or below this many live
+    # reporters; larger fleets top out at the symmetric-exponential
+    # rung (level 1, out-degree ~2·log2 m), so the ladder can stay
+    # ENABLED at fleet scale instead of being configured off
+    densify_full_max: int = 64
     # gossip-cadence band on the local consensus-growth ratio
     # (disagreement now / disagreement one evidence window ago):
     # > grow_hi -> gossip MORE (halve gossip_every) and back the codec
@@ -174,6 +182,8 @@ class ControlConfig:
         if not (self.densify_exit < self.densify_enter):
             raise ValueError(
                 "hysteresis requires densify_exit < densify_enter")
+        if self.densify_full_max < 1:
+            raise ValueError("densify_full_max must be >= 1")
         if not (self.grow_lo < self.grow_hi):
             raise ValueError("hysteresis requires grow_lo < grow_hi")
         if not (0 <= self.max_codec_level < len(CODEC_LADDER)):

@@ -1,0 +1,44 @@
+"""Planet-scale read path: snapshot relay trees with delta encoding.
+
+``bluefog_tpu.relay`` turns the PR-7 serving fabric's flat fan-out into
+a DISTRIBUTION TREE — the read-path application of the paper's premise
+(BlueFog, arXiv:2111.04287) that point-to-point neighbor exchange
+scales where all-to-all cannot.  ``BENCH_serving.json`` shows the flat
+ceiling: 8 subscribers each get ~7 rounds/s while the publisher does
+15.5; a trainer serving millions of readers directly is arithmetic that
+can never work.  A :class:`~bluefog_tpu.relay.node.RelayNode`
+subscribes upstream like any reader, lands frames into its own
+:class:`~bluefog_tpu.serving.snapshots.SnapshotTable`, and re-publishes
+to its own subscribers — so capacity multiplies per tier
+(``degree^(depth+1)`` leaves) while the trainer still pays for exactly
+``degree`` readers.
+
+What the tree preserves, hop by hop:
+
+- **round-stamped consistency** — a re-published snapshot keeps the
+  trainer's round stamp; torn reads stay impossible by construction at
+  every tier (each hop is a full publish into a double-buffered table);
+- **strictly-increasing delivery** — each hop's cursor discipline plus
+  the land-path forward guard; kill a mid-tree relay and its children
+  resume or re-parent with nothing missed or duplicated;
+- **bounded, measured staleness** — staleness adds per tier (each
+  hop's skip-to-latest backlog) and is exported as
+  ``bf_snapshot_age_rounds{tier=...}``;
+- **delta wire economy** — wire op 10 pushes round-over-round diffs
+  (``wire_codec`` twins + sender-side error feedback,
+  :mod:`bluefog_tpu.runtime.delta`), with a full snapshot every Nth
+  round as the resync anchor and on every cursor gap.
+
+Degree, depth, and delta cadence are policy, not code: the control
+plane's :class:`~bluefog_tpu.control.tree.TreePlan`
+(:func:`~bluefog_tpu.control.tree.decide_tree_plan`, pure and
+deterministic) autoscales them from subscriber-count, skip-rate, and
+staleness evidence, actuated at round boundaries only (BF-CTL001).
+Run a standalone relay with ``bfrelay-tpu``; see ``docs/serving.md``
+for the tree consistency/staleness model and ``docs/transport.md`` for
+the op-10 wire row.
+"""
+
+from bluefog_tpu.relay.node import RelayNode
+
+__all__ = ["RelayNode"]

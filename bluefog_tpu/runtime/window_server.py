@@ -146,7 +146,8 @@ import numpy as np
 from bluefog_tpu import chaos as _chaos
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
-from bluefog_tpu.runtime import native, resilience, wire_codec, wire_status
+from bluefog_tpu.runtime import (delta as _delta, native, resilience,
+                                 wire_codec, wire_status)
 from bluefog_tpu.runtime.async_windows import _DTYPES as _DTYPE_IDS, _fallback
 from bluefog_tpu.serving import snapshots as _snap
 from bluefog_tpu.tracing import recorder as _tr
@@ -178,6 +179,14 @@ _SNAP_LEAF = struct.Struct("<HBq")    # name_len, dtype, n_elems
 _SUB_REQ = struct.Struct("<QIIq")     # sub_id, epoch, every, cursor
 _PUSH = struct.Struct("<qIH")         # round (-1 = keepalive), skipped,
                                       # leaf count
+_DELTA_HDR = struct.Struct("<Bq")     # frame kind (0 full / 10 delta),
+                                      # base_round — after _PUSH (and the
+                                      # trace header) on EVERY push frame
+                                      # of a FEATURE_DELTA connection,
+                                      # keepalives included, so the frame
+                                      # parse stays deterministic
+_DELTA_LEAF = struct.Struct("<HBBqq")  # name_len, dtype, codec, n_elems,
+                                       # wire_bytes — one delta leaf entry
 _TRACE_HDR = struct.Struct("<QQI")    # trace_id, span_id, round — the
                                       # wire-propagated causal context
                                       # (FEATURE_TRACE connections only)
@@ -196,6 +205,9 @@ _OP_STREAM_ATTACH = 6
 _OP_HEARTBEAT = 7
 _OP_SNAPSHOT = 8
 _OP_SUBSCRIBE = 9
+#: not a request op: the frame-KIND marker of a delta push frame on the
+#: SUBSCRIBE push channel (FEATURE_DELTA connections; kind 0 = full)
+_OP_DELTA = 10
 
 #: client->server ops whose frames carry the trace header on
 #: FEATURE_TRACE connections (SUBSCRIBE propagates the other way: the
@@ -238,10 +250,18 @@ FEATURE_SUBSCRIBE = 64  # resumable push subscriptions (op 9)
 #: negotiated this bit, so presence is deterministic per connection and
 #: a v-old peer (or a tracing-disabled client) degrades silently.
 FEATURE_TRACE = 128
+#: delta push frames on the SUBSCRIBE channel (wire op 10): every push
+#: frame of a granting connection carries a ``(kind u8, base_round i64)``
+#: header after ``_PUSH`` (and the trace header) — kind 0 = full-frame
+#: anchor (leaves dense, the resync point), kind 10 = round-over-round
+#: delta encoded per leaf with the wire_codec twins + sender-side error
+#: feedback.  Optional want, like FEATURE_TRACE: a v-old server degrades
+#: to dense pushes silently.
+FEATURE_DELTA = 256
 _SERVER_FEATURES = (FEATURE_BATCH | FEATURE_CODEC_F32 | FEATURE_CODEC_TOPK
                     | FEATURE_HEARTBEAT | FEATURE_RESUME
                     | FEATURE_SNAPSHOT | FEATURE_SUBSCRIBE
-                    | FEATURE_TRACE)
+                    | FEATURE_TRACE | FEATURE_DELTA)
 
 _CODEC_FEATURE = {wire_codec.CODEC_NONE: 0,
                   wire_codec.CODEC_F32: FEATURE_CODEC_F32,
@@ -621,6 +641,44 @@ def _recv_leaves(sock: socket.socket, count: int) -> Dict[str, np.ndarray]:
     return leaves
 
 
+def _delta_leaf_views(items) -> List:
+    """Encode a :meth:`DeltaEncoder.step` item list as op-10 delta leaf
+    entries (``_DELTA_LEAF`` + name + codec payload per leaf)."""
+    views: List = []
+    for name, dtype, codec, n_elems, payload_views, wire_b in items:
+        nb = name.encode()
+        views.append(_DELTA_LEAF.pack(len(nb), _DTYPE_IDS[dtype], codec,
+                                      n_elems, wire_b))
+        views.append(nb)
+        views.extend(payload_views)
+    return views
+
+
+def _recv_delta_leaves(sock: socket.socket, count: int) -> List:
+    """Decode ``count`` op-10 delta leaf entries (the
+    :func:`_delta_leaf_views` wire twin) into ``(name, dtype, codec,
+    n_elems, payload)`` tuples for :meth:`DeltaApplier.apply`.  Claimed
+    lengths are bounded BEFORE any allocation (the deposit path's
+    discipline); a malformed entry raises ``ValueError``, which the
+    subscriber treats as a dead connection — the cursor never moves on
+    a frame that did not fully parse."""
+    items: List = []
+    for _ in range(count):
+        name_len, dtype_id, codec, n_elems, wire_b = _DELTA_LEAF.unpack(
+            _recv_exact(sock, _DELTA_LEAF.size))
+        if (dtype_id not in _DTYPES or codec not in wire_codec.CODEC_NAMES
+                or n_elems < 0 or wire_b < 0 or name_len > _MAX_LEAF_NAME
+                or wire_b > wire_codec.wire_bytes_bound(
+                    n_elems, _DTYPES[dtype_id].itemsize)):
+            raise ValueError("delta leaf header out of bounds")
+        name = _recv_exact(sock, name_len).decode("utf-8", "replace")
+        payload = bytearray(wire_b)
+        _recv_into(sock, memoryview(payload))
+        items.append((name, _DTYPES[dtype_id], codec, n_elems,
+                      memoryview(payload)))
+    return items
+
+
 class _SubSender:
     """Per-subscription background pusher: blocks in the snapshot
     table's publish wait and pushes the LATEST due round to its reader.
@@ -653,13 +711,19 @@ class _SubSender:
         self.sid = sid
         self.epoch = epoch
         self._closed = threading.Event()
+        # delta pushes (wire op 10) ride only connections whose HELLO
+        # negotiated FEATURE_DELTA; the encoder is per-CONNECTION state
+        # (a reconnect gets a fresh one, which is what forces the
+        # full-frame resync anchor after every cursor gap)
+        self._delta_on = bool(getattr(handler, "_delta_granted", False))
+        self._enc = _delta.DeltaEncoder() if self._delta_on else None
         # start one generation BEHIND the table: a subscriber attaching
         # AFTER the latest publish (replica restart, converged trainer)
         # must still receive the current round if its cursor is below
         # it — the first wait_newer then returns immediately and the
         # due-ness rule decides, instead of waiting for a future
         # publish that may never come
-        gen = _snap.table().generation(group)
+        gen = handler.server.snap_table.generation(group)
         self._gen = gen - 1 if gen > 0 else 0
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"bf-sub:{peer}")
@@ -698,15 +762,43 @@ class _SubSender:
 
     def _ka_views(self) -> List:
         """A keepalive frame (round = -1); carries an empty trace header
-        on FEATURE_TRACE connections so every push frame parses the
-        same way."""
+        on FEATURE_TRACE connections (and an empty delta header on
+        FEATURE_DELTA connections) so every push frame parses the same
+        way."""
         views: List = [_PUSH.pack(-1, 0, 0)]
         if self._traced():
             views.append(_TRACE_HDR.pack(0, 0, 0))
+        if self._delta_on:
+            views.append(_DELTA_HDR.pack(0, -1))
+        return views
+
+    def _payload_views(self, rnd: int, leaves) -> List:
+        """The frame body after the trace header: dense leaves on plain
+        connections; on FEATURE_DELTA connections the delta header plus
+        either the full-frame anchor or the encoded round-over-round
+        delta, per the server's live :class:`DeltaConfig` (read fresh
+        each push, so a TreePlan actuation changes cadence without
+        touching the sender)."""
+        if not self._delta_on:
+            return _leaf_views(leaves)
+        cfg = self._handler.server.delta_cfg
+        kind, base_rnd, items = self._enc.step(rnd, leaves, cfg)
+        if kind == _OP_DELTA:
+            views = [_DELTA_HDR.pack(_OP_DELTA, base_rnd)]
+            views += _delta_leaf_views(items)
+            wire_b = sum(w for *_x, w in items)
+            _mt.inc("bf_push_bytes_total", float(wire_b), kind="delta",
+                    group=self._group)
+        else:
+            views = [_DELTA_HDR.pack(0, -1)] + _leaf_views(leaves)
+            _mt.inc("bf_push_bytes_total",
+                    float(sum(a.size * a.dtype.itemsize
+                              for _, a in leaves)),
+                    kind="full", group=self._group)
         return views
 
     def _loop(self) -> None:
-        tbl = _snap.table()
+        tbl = self._handler.server.snap_table
         self._last_send = time.monotonic()
         while not self._closed.is_set():
             gen = tbl.wait_newer(self._group, self._gen,
@@ -744,14 +836,26 @@ class _SubSender:
             if self._traced():
                 trec = _tr.get()
                 if trec is not None:
+                    # parent to the publish's stored trace context when
+                    # the publisher carried one (a relay hop parents to
+                    # the upstream push this way, so `bftrace-tpu` walks
+                    # trainer -> relay -> leaf across the whole tree)
+                    ptc = tbl.trace_ctx(self._group)
                     psp = trec.begin_span(
-                        "push", "tcp_srv", round_=max(0, rnd), parent=0,
+                        "push", "tcp_srv", round_=max(0, rnd),
+                        parent=ptc[1] if ptc else 0,
+                        trace_id=ptc[0] if ptc else None,
                         group=self._group, peer=self._peer,
                         skipped=skipped)
                 thdr = [_TRACE_HDR.pack(
                     psp.tid if psp is not None else 0,
                     psp.sid if psp is not None else 0, max(0, rnd))]
             try:
+                # the frame body is built ONCE (the delta encoder's
+                # error-feedback state advances per push; building it
+                # twice would double-apply the residual)
+                views = ([_PUSH.pack(rnd, skipped, len(leaves))] + thdr
+                         + self._payload_views(rnd, leaves))
                 act = _chaos.fire("sub", peer=self._peer,
                                   group=self._group)
                 if act is not None:
@@ -763,14 +867,9 @@ class _SubSender:
                         # the torn-mid-frame case the resuming reader
                         # must survive without consuming the fragment)
                         if act[0] == "truncate":
-                            views = ([_PUSH.pack(rnd, skipped,
-                                                 len(leaves))] + thdr
-                                     + _leaf_views(leaves))
                             self._send(views[:max(1, len(views) // 2)])
                         self.close()
                         return
-                views = ([_PUSH.pack(rnd, skipped, len(leaves))] + thdr
-                         + _leaf_views(leaves))
                 if not self._send(views):
                     return
             finally:
@@ -818,6 +917,10 @@ class _Handler(socketserver.BaseRequestHandler):
         # timing tail, push frames carry the header (set at HELLO —
         # presence is deterministic per connection)
         self._trace_granted = False
+        # FEATURE_DELTA negotiated on THIS connection: push frames carry
+        # the delta header and may be op-10 deltas (set at HELLO — same
+        # deterministic-per-connection discipline as the trace header)
+        self._delta_granted = False
         # subscription push sender (SUBSCRIBE); None = plain connection
         self._sub: Optional[_SubSender] = None
 
@@ -1091,7 +1194,7 @@ class _Handler(socketserver.BaseRequestHandler):
             names.append(
                 self._recv_name(sock, ln).decode("utf-8", "replace"))
         try:
-            rnd, leaves = _snap.table().read(
+            rnd, leaves = self.server.snap_table.read(  # type: ignore
                 group, names or None,
                 want_round=want_round if want_round >= 0 else -1)
         except _snap.RoundRolled:
@@ -1141,15 +1244,24 @@ class _Handler(socketserver.BaseRequestHandler):
             # same socket would interleave two push streams' framing
             self._send(_STATUS.pack(_ERR_BAD_OP))
             return False
+        if not self.server.sub_reserve():  # type: ignore[attr-defined]
+            # the tree plan's degree actuation: a relay at its fan-out
+            # limit refuses RETRIABLY — the reader backs off and finds a
+            # sibling (or the tree deepens at the next plan boundary)
+            _mt.inc("bf_sub_rejected_total", 1.0, reason="fanout")
+            _bb.record("sub_fanout_reject", group=group,
+                       peer=self.client_address[0])
+            self._send(_STATUS.pack(_ERR_BUSY))
+            return False
         rc = self.server.attach_sub(sid, epoch, self)  # type: ignore
         if rc < 0:
+            self.server.note_sub(-1)  # type: ignore[attr-defined]
             self._send(_STATUS.pack(rc))
             return False
         self._send(_STATUS.pack(0))
         self._sub = _SubSender(self, sock, self._wmu, group,
                                every, cursor, self.client_address[0],
                                sid=sid, epoch=epoch)
-        self.server.note_sub(1)  # type: ignore[attr-defined]
         ev = "sub_resume" if epoch > 1 else "sub_attach"
         _bb.record(ev, group=group, sub_id=sid, epoch=epoch,
                    cursor=cursor, every=max(1, every),
@@ -1223,6 +1335,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     granted = features & _SERVER_FEATURES
                     self.server.set_features(self.request, granted)  # type: ignore
                     self._trace_granted = bool(granted & FEATURE_TRACE)
+                    self._delta_granted = bool(granted & FEATURE_DELTA)
                     self._send(_STATUS.pack(granted))
                     continue
                 if op == _OP_DEPOSIT_BATCH:
@@ -1357,6 +1470,32 @@ class _Server(socketserver.ThreadingTCPServer):
         self._subs: Dict[int, list] = {}
         self._subs_mu = _lc.lock("runtime.window_server._Server._subs_mu")
         self._live_subs = 0
+        # the snapshot table THIS server serves: the process-global one
+        # by default (trainers), a private one for relay processes that
+        # re-publish upstream groups (WindowServer(snapshots=...))
+        self.snap_table: "_snap.SnapshotTable" = _snap.table()
+        # the live delta cadence/codec of this server's push senders —
+        # swapped whole (immutable dataclass) by the tree plan's
+        # actuation at round boundaries; senders read it fresh per push
+        self.delta_cfg: _delta.DeltaConfig = _delta.DeltaConfig()
+        # fan-out admission limit (None = unlimited): the TreePlan's
+        # degree knob
+        self.sub_limit: Optional[int] = None
+
+    def sub_reserve(self) -> bool:
+        """Atomically claim one subscription slot under the fan-out
+        limit (check-and-increment in ONE critical section: N children
+        of a dead relay re-parenting simultaneously must not all pass a
+        bare check and overshoot the degree the tree plan actuated).
+        The claimer releases with ``note_sub(-1)`` on any later failure
+        or teardown."""
+        with self._subs_mu:
+            if (self.sub_limit is not None
+                    and self._live_subs >= self.sub_limit):
+                return False
+            self._live_subs += 1
+            _mt.set("bf_subscribers", float(self._live_subs))
+            return True
 
     # -------------------------------------------------- subscriber lineage
     def attach_sub(self, sid: int, epoch: int, handler) -> int:
@@ -1501,22 +1640,60 @@ class WindowServer:
     ``WindowServer().start()`` binds (default: an ephemeral port on all
     interfaces) and serves deposits/reads on daemon threads.  The address
     to hand to peers is ``.address``.  Serves the native runtime's window
-    table when available, the in-process pure-Python table otherwise."""
+    table when available, the in-process pure-Python table otherwise.
 
-    def __init__(self):
+    ``snapshots`` selects the :class:`~bluefog_tpu.serving.snapshots.
+    SnapshotTable` this server's SNAPSHOT/SUBSCRIBE ops serve — the
+    process-global table by default; a relay passes its own, so one
+    process can host a trainer's table AND a relay's re-published
+    groups on separate ports without colliding.  ``delta`` configures
+    the push senders' op-10 delta cadence (see
+    :class:`~bluefog_tpu.runtime.delta.DeltaConfig`)."""
+
+    def __init__(self, *, snapshots=None, delta=None):
         self._ops = _table_ops()
         self._server: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
+        self._snapshots = snapshots
+        self._delta = delta
 
     def start(self, host: str = "0.0.0.0", port: int = 0) -> Tuple[str, int]:
         if self._server is not None:
             raise RuntimeError("server already running")
         self._server = _Server((host, port), _Handler)
         self._server.ops = self._ops  # type: ignore[attr-defined]
+        if self._snapshots is not None:
+            self._server.snap_table = self._snapshots
+        if self._delta is not None:
+            self._server.delta_cfg = self._delta
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
         self._thread.start()
         return self.address
+
+    @property
+    def snapshots(self):
+        """The snapshot table this server serves."""
+        if self._server is not None:
+            return self._server.snap_table
+        return self._snapshots if self._snapshots is not None \
+            else _snap.table()
+
+    def set_delta(self, cfg) -> None:
+        """Install a new delta cadence (whole-config swap; push senders
+        read it fresh per push).  The tree control plane calls this from
+        its round-boundary actuation."""
+        self._delta = cfg
+        if self._server is not None:
+            self._server.delta_cfg = cfg
+
+    def set_fanout_limit(self, limit: Optional[int]) -> None:
+        """Cap live subscriptions (None = unlimited) — the TreePlan's
+        degree knob; over-limit SUBSCRIBEs are refused retriably
+        (``ERR_BUSY``)."""
+        if self._server is not None:
+            self._server.sub_limit = (None if limit is None
+                                      else max(1, int(limit)))
 
     @property
     def address(self) -> Tuple[str, int]:

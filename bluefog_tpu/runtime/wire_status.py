@@ -28,9 +28,11 @@ __all__ = [
     "ERR_BAD_OP",
     "ERR_BUSY",
     "ERR_CODEC",
+    "ERR_DELTA_BASE",
     "ERR_GEOMETRY",
     "ERR_NO_SNAPSHOT",
     "ERR_NO_WINDOW",
+    "ERR_RELAY_LOOP",
     "ERR_ROUND_ROLLED",
     "ERR_STALE_EPOCH",
     "ERR_TOO_LARGE",
@@ -59,6 +61,14 @@ ERR_STALE_EPOCH = -105   # attach/batch/subscribe from a superseded epoch
 ERR_BUSY = -106          # previous stream generation could not quiesce
 ERR_ROUND_ROLLED = -107  # RETRIABLE: pinned snapshot round superseded
 ERR_NO_SNAPSHOT = -108   # group/leaf has no published snapshot (yet)
+ERR_DELTA_BASE = -109    # RETRIABLE: DELTA frame base round != the
+                         # receiver's reconstruction cursor — drop the
+                         # connection; the resumed stream resyncs with a
+                         # full-frame anchor (wire op 10, docs/serving.md)
+ERR_RELAY_LOOP = -110    # a relay refused a subscription that would
+                         # close a cycle (its upstream IS its own serving
+                         # address) — terminal: a relay tree must be a
+                         # tree
 
 STATUS_TEXT: Dict[int, str] = {
     ERR_GEOMETRY: "size/dtype mismatch with the window's geometry",
@@ -79,12 +89,20 @@ STATUS_TEXT: Dict[int, str] = {
     ERR_NO_SNAPSHOT: ("no round-stamped snapshot published for this "
                       "group/leaf (retriable while the publisher warms "
                       "up; terminal for a misspelled name)"),
+    ERR_DELTA_BASE: ("delta base round does not match the receiver's "
+                     "reconstruction cursor (retriable: drop the push "
+                     "connection and resubscribe — the resumed stream "
+                     "resyncs with a full-frame anchor)"),
+    ERR_RELAY_LOOP: ("relay subscription refused: the upstream address "
+                     "is the relay's own serving address, which would "
+                     "close a cycle — point the relay at its parent "
+                     "tier, not itself"),
 }
 
 # the v2 wire-protocol codes docs/transport.md must document (BF-DOC001)
 WIRE_V2_CODES = (ERR_BAD_OP, ERR_VERSION, ERR_CODEC, ERR_TOO_LARGE,
                  ERR_STALE_EPOCH, ERR_BUSY, ERR_ROUND_ROLLED,
-                 ERR_NO_SNAPSHOT)
+                 ERR_NO_SNAPSHOT, ERR_DELTA_BASE, ERR_RELAY_LOOP)
 
 # codes the doc may mention as explicitly-unassigned gaps (the doc lint
 # accepts these without requiring a registry constant)
@@ -92,7 +110,8 @@ UNASSIGNED_CODES = (-103,)
 
 # codes a client may retry without changing anything (vs. terminal
 # protocol rejections, where retrying only relabels the real error)
-_RETRIABLE = frozenset({ERR_BUSY, ERR_ROUND_ROLLED, ERR_NO_SNAPSHOT})
+_RETRIABLE = frozenset({ERR_BUSY, ERR_ROUND_ROLLED, ERR_NO_SNAPSHOT,
+                        ERR_DELTA_BASE})
 
 
 def is_retriable(rc: int) -> bool:

@@ -45,11 +45,19 @@ __all__ = ["Snapshot", "SnapshotClient"]
 
 @dataclass
 class Snapshot:
-    """One round-consistent snapshot: every leaf is from ``round``."""
+    """One round-consistent snapshot: every leaf is from ``round``.
+
+    ``skipped`` is the count of due rounds the sender skipped before
+    this delivery (the skip-to-latest backlog — what a relay exports as
+    the staleness its tier added); ``trace`` is the upstream push
+    span's ``(trace_id, span_id)`` on FEATURE_TRACE subscriptions, so a
+    re-publisher can parent its hop into the trainer's trace."""
 
     group: str
     round: int
     leaves: Dict[str, np.ndarray] = field(default_factory=dict)
+    skipped: int = 0
+    trace: Optional[Tuple[int, int]] = None
 
     def __getitem__(self, name: str) -> np.ndarray:
         return self.leaves[name]

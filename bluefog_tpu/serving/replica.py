@@ -57,7 +57,7 @@ class ServingReplica:
     def __init__(self, address: Tuple[str, int], group: str,
                  template=None, *, every: int = 1, cursor: int = -1,
                  reconnect=True, idle_timeout_s: float = 5.0,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, delta: bool = False):
         self.group = group
         self._packer = None
         if template is not None:
@@ -73,7 +73,7 @@ class ServingReplica:
             address, group, every=every, cursor=cursor,
             on_snapshot=self._adopt, reconnect=reconnect,
             idle_timeout_s=idle_timeout_s, timeout_s=timeout_s,
-            queue_max=2)
+            queue_max=2, delta=delta)
 
     # ------------------------------------------------------------- intake
     def _adopt(self, snap: Snapshot) -> None:

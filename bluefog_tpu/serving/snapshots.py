@@ -87,7 +87,7 @@ class _Group:
     """One publisher's double-buffered snapshot slot."""
 
     __slots__ = ("buffers", "rounds", "active", "gen", "write_mu",
-                 "published_at")
+                 "published_at", "created_at", "trace")
 
     def __init__(self):
         self.buffers: List[Dict[str, np.ndarray]] = [{}, {}]
@@ -97,6 +97,11 @@ class _Group:
         self.write_mu = _lc.lock(
             "serving.snapshots._Group.write_mu")  # serializes publishers
         self.published_at = 0.0
+        self.created_at = time.monotonic()  # idle-TTL sweep baseline
+        # (trace_id, span_id) of the latest publish, when the publisher
+        # carried one (a relay landing an upstream push) — push senders
+        # parent their push spans to it, so `bftrace-tpu` walks the tree
+        self.trace: Optional[Tuple[int, int]] = None
 
 
 class SnapshotTable:
@@ -110,14 +115,23 @@ class SnapshotTable:
 
     # ------------------------------------------------------------- publish
     def _group(self, group: str) -> _Group:
+        created = False
         with self._mu:
             g = self._groups.get(group)
             if g is None:
                 g = self._groups[group] = _Group()
-            return g
+                created = True
+                count = len(self._groups)
+        if created:
+            # the group-census gauge: long-lived processes (relays, the
+            # fleet plane) accumulate groups; the idle-TTL sweep is what
+            # bounds this number, and the gauge is what proves it
+            _mt.set("bf_snapshot_groups", float(count))
+        return g
 
     def publish(self, group: str, round_: int,
-                leaves: Dict[str, np.ndarray]) -> None:
+                leaves: Dict[str, np.ndarray], *,
+                trace: Optional[Tuple[int, int]] = None) -> None:
         """Atomically publish ``leaves`` as round ``round_`` of ``group``.
 
         Leaves are COPIED (the caller's buffers are free immediately —
@@ -157,6 +171,8 @@ class SnapshotTable:
                 g.active = tgt
                 g.gen += 1
                 g.published_at = time.monotonic()
+                g.trace = (int(trace[0]), int(trace[1])) \
+                    if trace is not None else None
                 self._cv.notify_all()
         _bb.end("snapshot_publish", key=key, group=group, round=round_)
         _mt.inc("bf_snapshot_publishes_total", 1.0, group=group)
@@ -209,27 +225,74 @@ class SnapshotTable:
 
     def wait_newer(self, group: str, gen: int,
                    timeout_s: Optional[float] = None) -> Optional[int]:
-        """Block until ``group``'s generation exceeds ``gen``; returns
-        the new generation, or None on timeout.  The subscription
-        senders live in this wait instead of polling."""
+        """Block until ``group``'s generation differs from ``gen`` —
+        EXCEEDS it (new publishes), or sits BELOW it, which means the
+        group was dropped (idle-TTL sweep, teardown) and re-created
+        with a fresh counter: everything the revived group holds is
+        newer than anything the caller consumed, so a sender parked on
+        the old high generation must wake rather than starve until the
+        new counter catches up.  Returns the current generation, or
+        None on timeout.  The subscription senders live in this wait
+        instead of polling."""
         def newer() -> bool:
             g = self._groups.get(group)
-            return g is not None and g.gen > gen
+            return g is not None and g.gen != gen and g.gen > 0
 
         with self._cv:
             if not self._cv.wait_for(newer, timeout=timeout_s):
                 return None
             return self._groups[group].gen
 
+    def trace_ctx(self, group: str) -> Optional[Tuple[int, int]]:
+        """(trace_id, span_id) the latest publish of ``group`` carried
+        (None when the publisher was untraced) — what a push sender
+        parents its push span to."""
+        with self._mu:
+            g = self._groups.get(group)
+            return g.trace if g is not None else None
+
     def groups(self) -> List[str]:
         with self._mu:
             return sorted(g for g, st in self._groups.items() if st.gen)
 
-    def drop(self, group: str) -> None:
-        """Remove a group (job teardown; unblocks nothing — waiters time
-        out on their own keepalive cadence)."""
+    def drop_group(self, group: str) -> bool:
+        """Remove a group (job teardown, relay eviction); returns
+        whether it existed.  Unblocks nothing — waiters time out on
+        their own keepalive cadence."""
         with self._mu:
-            self._groups.pop(group, None)
+            existed = self._groups.pop(group, None) is not None
+            count = len(self._groups)
+        if existed:
+            _mt.set("bf_snapshot_groups", float(count))
+        return existed
+
+    def drop(self, group: str) -> None:
+        """The original spelling of :meth:`drop_group` (kept: the run
+        teardown paths call it)."""
+        self.drop_group(group)
+
+    def sweep_idle(self, ttl_s: float, *,
+                   now: Optional[float] = None) -> List[str]:
+        """Drop every group idle for more than ``ttl_s`` seconds (no
+        publish since; never-published groups age from creation) and
+        return their names.  This is what keeps a long-lived process —
+        a relay whose upstream groups churn, the fleet plane's
+        ``bf_fleet:<rank>`` rows across elastic membership — from
+        accumulating dead groups forever; run-scoped groups are still
+        dropped eagerly at run end."""
+        ttl = float(ttl_s)
+        t = time.monotonic() if now is None else float(now)
+        with self._mu:
+            idle = [name for name, g in self._groups.items()
+                    if t - (g.published_at or g.created_at) > ttl]
+            for name in idle:
+                del self._groups[name]
+            count = len(self._groups)
+        if idle:
+            _mt.set("bf_snapshot_groups", float(count))
+            _bb.record("snapshot_sweep", dropped=len(idle),
+                       ttl_s=ttl, remaining=count)
+        return sorted(idle)
 
 
 # one process-global table, like the window fabric's window table: any

@@ -46,6 +46,7 @@ from typing import Callable, Optional, Tuple
 from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.metrics import comm as _mt
 from bluefog_tpu.runtime import resilience, wire_status
+from bluefog_tpu.runtime.delta import DeltaApplier, DeltaDesync
 from bluefog_tpu.tracing import recorder as _tr
 from bluefog_tpu.utils import lockcheck as _lc
 from bluefog_tpu.serving.client import Snapshot
@@ -85,7 +86,8 @@ class Subscriber:
                  every: int = 1, cursor: int = -1,
                  on_snapshot: Optional[Callable[[Snapshot], None]] = None,
                  reconnect=True, idle_timeout_s: float = 5.0,
-                 timeout_s: float = 10.0, queue_max: int = 16):
+                 timeout_s: float = 10.0, queue_max: int = 16,
+                 delta: bool = False):
         self.group = group
         self._group_b = group.encode()
         self._addr = (address[0], int(address[1]))
@@ -104,6 +106,17 @@ class Subscriber:
         # this reader emits a consume span parented to the server's push
         # span.  Optional want — non-grant degrades tracing silently.
         self._trace_on = False
+        # FEATURE_DELTA (wire op 10): opt-in round-over-round delta
+        # pushes.  Optional want too — a v-old server degrades to dense
+        # pushes.  The applier (receiver-side reconstruction) is
+        # per-CONNECTION: a reconnect resyncs on the first full-frame
+        # anchor, and cursor semantics are unchanged — a torn or
+        # desynced delta never advances the cursor, so its round is
+        # re-promised after resume.
+        self._want_delta = bool(delta)
+        self._delta_on = False
+        self._applier: Optional[DeltaApplier] = None
+        self.delta_frames = 0
         self.delivered = 0
         self.skipped_rounds = 0
         self.resumes = 0
@@ -143,6 +156,28 @@ class Subscriber:
                     return None
                 self._cv.wait(timeout=wait)
 
+    def reparent(self, address: Tuple[str, int]) -> None:
+        """Point this subscription at a new upstream (a relay child
+        moving to a sibling or back to the root when its parent dies).
+        The lineage — ``sub_id``, cursor — is preserved, so delivered
+        rounds stay strictly increasing across the hand-off: the new
+        upstream resumes strictly above the cursor, exactly like any
+        reconnect.  Only useful while the subscription is alive (a
+        latched error is final; build a new subscriber then)."""
+        self._addr = (address[0], int(address[1]))
+        _bb.record("sub_reparent", group=self.group, sub_id=self.sub_id,
+                   cursor=self.cursor, to=f"{address[0]}:{address[1]}")  # bfverify: shared-ok GIL-atomic int read for forensics only; the pump thread owns the authoritative cursor
+        sock = getattr(self, "_sock", None)
+        if sock is not None:
+            # kick the pump off the old connection; the reconnect loop
+            # dials the new address with (epoch+1, cursor)
+            for fn in (lambda: sock.shutdown(socket.SHUT_RDWR),
+                       sock.close):
+                try:
+                    fn()
+                except OSError:
+                    pass
+
     def close(self) -> None:
         self._closed.set()
         with self._cv:
@@ -176,6 +211,8 @@ class Subscriber:
             trace_want = _tr.get() is not None
             if trace_want:
                 want |= ws.FEATURE_TRACE
+            if self._want_delta:
+                want |= ws.FEATURE_DELTA
             ws._sendmsg_all(sock, [
                 ws._HDR.pack(ws._MAGIC, ws._OP_HELLO, 0),
                 ws._HELLO.pack(ws.PROTOCOL_VERSION, want)])
@@ -188,6 +225,13 @@ class Subscriber:
                     f"{int(granted)})")
             self._trace_on = bool(trace_want
                                   and granted & ws.FEATURE_TRACE)
+            self._delta_on = bool(self._want_delta
+                                  and granted & ws.FEATURE_DELTA)
+            # a fresh connection gets a fresh reconstruction: the first
+            # data frame is a full anchor by construction (the server's
+            # encoder is per-connection too)
+            self._applier = (DeltaApplier(self.group)
+                             if self._delta_on else None)
             self._epoch += 1
             ws._sendmsg_all(sock, [
                 ws._HDR.pack(ws._MAGIC, ws._OP_SUBSCRIBE,
@@ -199,6 +243,13 @@ class Subscriber:
             if rc < 0:
                 # one registry for status text (runtime/wire_status);
                 # no hand-carried literals on the read path
+                if wire_status.is_retriable(int(rc)):
+                    # e.g. ERR_BUSY from a relay at its fan-out limit:
+                    # back off and retry (or re-parent) instead of
+                    # latching a terminal rejection
+                    raise ConnectionError(
+                        f"subscribe to {self.group!r} deferred "
+                        f"({int(rc)}): " + wire_status.err_text(int(rc)))
                 raise RuntimeError(
                     f"subscribe to {self.group!r} rejected ({int(rc)}): "
                     + wire_status.err_text(int(rc)))
@@ -231,7 +282,8 @@ class Subscriber:
 
     def _read_frames(self, sock: socket.socket) -> None:
         """Pump push frames until the connection dies; the cursor only
-        advances after a FULL frame arrived, so torn frames are never
+        advances after a FULL frame arrived (and, for op-10 deltas,
+        decoded against the matching base), so torn frames are never
         consumed and their round is re-delivered after resume."""
         ws = _wire()
         while not self._closed.is_set():
@@ -247,9 +299,20 @@ class Subscriber:
                     ws._recv_exact(sock, ws._TRACE_HDR.size))
                 if s_id:
                     tctx = (t_id, s_id)
+            kind, base_rnd = 0, -1
+            if self._delta_on:
+                # FEATURE_DELTA connections carry the frame-kind header
+                # after the trace header on EVERY frame, keepalives
+                # included — deterministic parse, like the trace header
+                kind, base_rnd = ws._DELTA_HDR.unpack(
+                    ws._recv_exact(sock, ws._DELTA_HDR.size))
             t_con_w = time.time()
             t_con_p = time.perf_counter()
-            leaves = ws._recv_leaves(sock, count)
+            if kind == ws._OP_DELTA:
+                items = ws._recv_delta_leaves(sock, count)
+                leaves = None
+            else:
+                leaves = ws._recv_leaves(sock, count)
             if tctx is not None:
                 trec = _tr.get()
                 if trec is not None:
@@ -268,8 +331,28 @@ class Subscriber:
                 _bb.record("sub_duplicate_round", group=self.group,
                            round=rnd, cursor=self.cursor)
                 continue
+            if kind == ws._OP_DELTA:
+                try:
+                    # the whole frame is in hand: the apply either
+                    # yields the full reconstruction or refuses loudly —
+                    # the cursor NEVER advances on a refused delta, so
+                    # the resumed stream re-promises this round and
+                    # resyncs on its full-frame anchor
+                    leaves = self._applier.apply(rnd, base_rnd, items)
+                except DeltaDesync as e:
+                    _bb.record("sub_delta_desync", group=self.group,
+                               base_round=base_rnd, cursor=self.cursor,
+                               status=e.status)
+                    _mt.inc("bf_delta_desyncs_total", 1.0,
+                            group=self.group)
+                    raise ConnectionError(str(e)) from e
+                self.delta_frames += 1
+            elif self._applier is not None:
+                self._applier.anchor(rnd, leaves)
             self.cursor = rnd
-            self._deliver(Snapshot(self.group, rnd, leaves), skipped)
+            self._deliver(Snapshot(self.group, rnd, leaves,
+                                   skipped=int(skipped), trace=tctx),
+                          skipped)
 
     def _loop(self) -> None:
         bo: Optional[resilience.Backoff] = None

@@ -184,7 +184,7 @@ class LinkModel:
             if isinstance(rules, str):
                 rules = parse_spec(rules)
             inert = sorted({r.site for r in rules
-                            if r.site in ("read", "sub")})
+                            if r.site in ("read", "sub", "relay")})
             if inert:
                 raise ValueError(
                     f"chaos site(s) {inert} are read-path faults the "

@@ -41,13 +41,14 @@ from bluefog_tpu.fleet.slo import WARN, default_specs
 from bluefog_tpu.sim.fleet import FleetSim, SimConfig
 from bluefog_tpu.sim.mixing import run_sync_mixing
 from bluefog_tpu.sim.network import LinkModel
+from bluefog_tpu.sim.readers import ReaderTreeConfig, run_reader_tree
 from bluefog_tpu.topology.graphs import (ExponentialTwoGraph,
                                          FullyConnectedGraph, RingGraph)
 
 __all__ = ["Scenario", "build_suite", "run_scenario", "run_suite",
            "PREDICATES", "SCENARIO_NAMES"]
 
-_KINDS = ("fleet", "ab", "mixing")
+_KINDS = ("fleet", "ab", "mixing", "reader_tree")
 
 #: the chaos-grammar spelling of a server-delayed slow host (the
 #: BENCH_control fault, scaled up)
@@ -198,6 +199,47 @@ def _pred_control_beats_static(ctx, *, max_ratio: float,
         "ratio": a / b_floor, "max_ratio": max_ratio}
 
 
+def _pred_relay_clean(ctx):
+    """The read tree's delivery-cleanliness gate: zero torn deliveries
+    consumed, zero duplicates, zero cursor regressions — across every
+    relay and reader, through every scheduled kill."""
+    rep = ctx["reader_tree"]
+    ok = (rep["torn"] == 0 and rep["duplicates"] == 0
+          and rep["regressions"] == 0)
+    return ok, {"torn": rep["torn"], "duplicates": rep["duplicates"],
+                "regressions": rep["regressions"],
+                "deliveries": rep["deliveries"]}
+
+
+def _pred_relay_staleness_bounded(ctx, *, rounds_per_tier: float):
+    """Staleness adds per tier: tier t's worst observed staleness must
+    stay within ``t * rounds_per_tier`` rounds of the publisher."""
+    rep = ctx["reader_tree"]
+    bad = {}
+    for tier_s, worst in rep["worst_staleness_by_tier"].items():
+        tier = int(tier_s)
+        if worst > rounds_per_tier * max(1, tier):
+            bad[tier_s] = worst
+    return not bad, {"rounds_per_tier": rounds_per_tier,
+                     "worst_by_tier": rep["worst_staleness_by_tier"],
+                     "over_budget": bad}
+
+
+def _pred_relay_served(ctx, *, min_final_frac: float = 0.9):
+    """Every reader was served, and every reader's final round reached
+    at least ``min_final_frac`` of the published rounds — kills and
+    re-parents included, nobody is left behind."""
+    rep = ctx["reader_tree"]
+    rounds = ctx["reader_tree_rounds"]
+    floor_ = min_final_frac * (rounds - 1)
+    ok = (rep["readers_served"] == rep["readers"]
+          and rep["min_reader_final_round"] >= floor_)
+    return ok, {"readers": rep["readers"],
+                "readers_served": rep["readers_served"],
+                "min_final_round": rep["min_reader_final_round"],
+                "required_floor": floor_}
+
+
 def _pred_mixing_match(ctx, *, tol: float):
     """Every non-degenerate topology's geometric-mean contraction is
     within ``tol`` of its |lambda_2| prediction; one-step averagers are
@@ -224,6 +266,9 @@ PREDICATES: Dict[str, Callable] = {
     "plan_penalizes": _pred_plan_penalizes,
     "control_beats_static": _pred_control_beats_static,
     "mixing_match": _pred_mixing_match,
+    "relay_clean": _pred_relay_clean,
+    "relay_staleness_bounded": _pred_relay_staleness_bounded,
+    "relay_served": _pred_relay_served,
 }
 
 
@@ -286,17 +331,17 @@ def network_partition(n: int = 1024, seed: int = 0) -> Scenario:
     return Scenario(
         name="network_partition", kind="fleet", n_ranks=n, seed=seed,
         horizon_s=7.0,
-        # densify is disabled here (enter threshold above any reachable
-        # excess): a partition's stall is a GENUINE sustained mixing
-        # excess, and the ladder's top rung is the one-step exact
-        # averager — a million-edge plan at 1024 ranks.  Climbing it is
-        # the real decide_plan's answer and the ladder is exercised at
-        # small scale in tests/test_sim.py; at fleet scale densify-to-FC
-        # is a deliberate operator decision, not an automatic remedy.
+        # the densify ladder is ENABLED here — the size-aware cap
+        # (ControlConfig.densify_full_max) is what made that possible:
+        # a partition's stall is a genuine sustained mixing excess, and
+        # above the cap decide_plan tops the ladder out at the
+        # symmetric-exponential rung (~2·log2 m out-degree) instead of
+        # the one-step exact averager's million-edge plan at 1024
+        # ranks.  Small trims (m <= densify_full_max) still reach the
+        # FC rung, matching tests/test_sim.py's small-scale ladder
+        # climb.
         config={"control": True, "fleet_every": 8,
-                "control_cfg": {"cooldown_rounds": 8,
-                                "densify_enter": 8.0,
-                                "densify_exit": 4.0}},
+                "control_cfg": {"cooldown_rounds": 8}},
         events=(
             (1.0, "partition", {"side_a": side_a, "side_b": side_b}),
             (2.5, "merge", {}),
@@ -373,6 +418,37 @@ def cascading_slow_peers(n: int = 1024, seed: int = 0) -> Scenario:
               "control vs static A/B")
 
 
+def reader_tree(n: int = 1024, seed: int = 0) -> Scenario:
+    """The read path at planet-ish scale: a depth-2, degree-16 relay
+    tree fanning one publisher out to ~2n readers (thousands at the
+    acceptance scale; capacity 16^3 = 4096 holds them at honest
+    per-node degree), with a mid-tree relay killed while rounds roll.
+    Accepts only if every delivery chain stayed clean (zero torn/
+    duplicate/regressed deliveries), per-tier staleness stayed within
+    its additive budget, and every reader — including the dead relay's
+    re-parented children — reached the end of the run."""
+    readers = max(64, 2 * n)
+    rounds = 120
+    return Scenario(
+        name="reader_tree", kind="reader_tree", n_ranks=n, seed=seed,
+        horizon_s=rounds * 0.01 + 2.0,
+        # hops run at a meaningful fraction of the publish cadence, so
+        # the per-tier staleness budget is genuinely exercised (worst
+        # observed staleness is nonzero and must still fit the additive
+        # bound), not vacuously zero
+        config={"readers": readers, "degree": 16, "depth": 2,
+                "rounds": rounds, "publish_dt": 0.01, "hop_dt": 0.009,
+                "reparent_dt": 0.05},
+        events=((0.5, "kill", {"tier": 1, "index": 0}),),
+        accept=(
+            ("relay_clean", {}),
+            ("relay_staleness_bounded", {"rounds_per_tier": 3.0}),
+            ("relay_served", {"min_final_frac": 0.9}),
+        ),
+        notes=f"{readers} readers behind a depth-2 tree; one tier-1 "
+              "relay killed mid-run")
+
+
 def mixing_fidelity(n: int = 1024, seed: int = 0) -> Scenario:
     """The headline physics check: simulated synchronous gossip on a
     1-D consensus state must contract at the |lambda_2| the real
@@ -393,6 +469,7 @@ SCENARIO_NAMES: Tuple[str, ...] = (
     "network_partition",
     "flash_crowd",
     "cascading_slow_peers",
+    "reader_tree",
 )
 
 _FACTORIES = {
@@ -401,6 +478,7 @@ _FACTORIES = {
     "flash_crowd": flash_crowd,
     "cascading_slow_peers": cascading_slow_peers,
     "mixing_fidelity": mixing_fidelity,
+    "reader_tree": reader_tree,
 }
 
 
@@ -547,6 +625,24 @@ _MIX_TOPOLOGIES = {
 }
 
 
+def _reader_tree_ctx(sc: Scenario) -> Dict:
+    cfg = dict(sc.config)
+    kills = tuple((float(t), int(p["tier"]), int(p.get("index", 0)))
+                  for (t, action, p) in sc.events if action == "kill")
+    rt = ReaderTreeConfig(
+        readers=int(cfg.get("readers", 2048)),
+        degree=int(cfg.get("degree", 8)),
+        depth=int(cfg.get("depth", 2)),
+        rounds=int(cfg.get("rounds", 120)),
+        publish_dt_s=float(cfg.get("publish_dt", 0.01)),
+        hop_dt_s=float(cfg.get("hop_dt", 0.002)),
+        reparent_dt_s=float(cfg.get("reparent_dt", 0.05)),
+        seed=sc.seed, kill=kills)
+    rep = run_reader_tree(rt)
+    return {"reader_tree": rep.as_dict(),
+            "reader_tree_rounds": rt.rounds}
+
+
 def _mixing_ctx(sc: Scenario) -> Dict:
     rounds = max(50, int(sc.horizon_s / _BASE_ROUND_S))
     rows = []
@@ -569,6 +665,8 @@ def run_scenario(sc: Scenario) -> Dict:
         ctx = _fleet_ctx(sc)
     elif sc.kind == "ab":
         ctx = _ab_ctx(sc)
+    elif sc.kind == "reader_tree":
+        ctx = _reader_tree_ctx(sc)
     else:
         ctx = _mixing_ctx(sc)
     ctx["horizon_s"] = sc.horizon_s
@@ -596,6 +694,8 @@ def run_scenario(sc: Scenario) -> Dict:
             tr.describe() for tr in ctx["engine"].transitions][:24]
     if "mixing_runs" in ctx:
         report["mixing_runs"] = [_jsonable(r) for r in ctx["mixing_runs"]]
+    if "reader_tree" in ctx:
+        report["reader_tree"] = _jsonable(ctx["reader_tree"])
     return report
 
 

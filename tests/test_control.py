@@ -354,6 +354,40 @@ class TestDecisionTable:
         p2 = decide_plan(p1, 20, evs, cfg)
         assert p2.densify == 0
 
+    def test_densify_top_rung_is_size_aware(self):
+        """The digital twin's scale-blindness finding, fixed: above
+        ``densify_full_max`` live reporters the ladder tops out at the
+        symmetric-exponential rung (level 1) — the one-step exact
+        averager (level 2, ~m^2 edges) stays reachable only for small
+        fleets, so fleet-scale runs can keep the ladder ENABLED."""
+        cfg = ControlConfig(cooldown_rounds=1, min_lag_s=0.001,
+                            densify_full_max=16)
+        # a SMALL fleet under sustained excess climbs to the top rung
+        small = [Evidence(rank=r, round=10, lag_s={1: 0.01},
+                          mixing_excess=0.5) for r in range(8)]
+        p = decide_plan(CommPlan(densify=1, version=1), 10, small, cfg)
+        assert p.densify == 2
+        # a LARGE fleet (reporter count is the live-member proxy) is
+        # capped at the symmetric-exponential rung no matter how long
+        # the excess persists
+        big = [Evidence(rank=r, round=10, lag_s={1: 0.01},
+                        mixing_excess=0.5) for r in range(64)]
+        p = decide_plan(CommPlan(densify=1, version=1), 10, big, cfg)
+        assert p.densify == 1
+        p2 = decide_plan(p, 20, [Evidence(rank=r, round=20,
+                                          lag_s={1: 0.01},
+                                          mixing_excess=0.5)
+                                 for r in range(64)], cfg)
+        assert p2.densify == 1  # held at the cap, not oscillating
+        # a previously-FC plan shrinking INTO a big fleet is stepped
+        # back down to the capped rung
+        p3 = decide_plan(CommPlan(densify=2, version=1), 30, big, cfg)
+        assert p3.densify == 1
+
+    def test_densify_full_max_validated(self):
+        with pytest.raises(ValueError, match="densify_full_max"):
+            ControlConfig(densify_full_max=0)
+
     def test_codec_backs_off_when_consensus_grows(self):
         prev = CommPlan(version=1, round=0, codec_level=2)
         evs = [Evidence(rank=0, round=10, lag_s={1: 0.01},
