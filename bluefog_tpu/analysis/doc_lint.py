@@ -19,8 +19,17 @@ whose old doc row survives is exactly the drift the sweep previously
 could not catch).  Histogram expansion spellings in the doc
 (``<name>_p99`` etc.) normalize to their base metric.
 
+**BF-DOC003** — the transport doc's HELLO feature-bit paragraph must
+agree with the live ``FEATURE_*`` constants
+(:mod:`bluefog_tpu.runtime.window_server`), both directions: every
+live bit must appear in the paragraph as ``<value> `NAME``` with the
+right value, and every pair the paragraph spells must be a live
+constant (bits 128/256 were added after the paragraph was first
+written — exactly the drift this pins).
+
 **BF-DOC000** (warning): a doc file the lint could not read.
-**BF-DOC100** / **BF-DOC101** (info): per-check agreement summaries.
+**BF-DOC100** / **BF-DOC101** / **BF-DOC102** (info): per-check
+agreement summaries.
 """
 
 from __future__ import annotations
@@ -31,7 +40,8 @@ from typing import List, Optional, Set
 
 from bluefog_tpu.analysis.report import Diagnostic
 
-__all__ = ["check_transport_doc", "check_metrics_doc"]
+__all__ = ["check_feature_doc", "check_metrics_doc",
+           "check_transport_doc"]
 
 _PASS = "doc-lint"
 _CODE_RE = re.compile(r"-1\d\d\b")
@@ -94,6 +104,79 @@ def check_transport_doc(doc_path: Optional[str] = None
             f"all {len(registry)} wire v2 status codes documented in "
             f"{os.path.basename(path)}; no stray codes",
             pass_name=_PASS, subject="transport.md"))
+    return diags
+
+
+#: ``<value> `NAME``` pairs inside the HELLO feature-bit paragraph
+_FEATURE_PAIR_RE = re.compile(r"(\d+)\s+`([A-Z][A-Z0-9_]*)`")
+_FEATURE_PARA_RE = re.compile(
+    r"HELLO feature bits:.*?(?=\n\s*\n|\Z)", re.DOTALL)
+
+
+def check_feature_doc(doc_path: Optional[str] = None
+                      ) -> List[Diagnostic]:
+    """BF-DOC003: the transport doc's ``HELLO feature bits:`` paragraph
+    <-> the live ``FEATURE_*`` constants, pinned both directions with
+    value agreement (the BF-DOC001 status-code pattern, applied to the
+    negotiation mask)."""
+    from bluefog_tpu.runtime import window_server as ws
+
+    path = doc_path or _default_doc_path()
+    base = os.path.basename(path)
+    diags: List[Diagnostic] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        diags.append(Diagnostic(
+            "warning", "BF-DOC000",
+            f"could not read transport doc {path}: {e}",
+            pass_name=_PASS, subject=base))
+        return diags
+
+    live = {name[len("FEATURE_"):]: value
+            for name, value in vars(ws).items()
+            if name.startswith("FEATURE_") and isinstance(value, int)}
+    para = _FEATURE_PARA_RE.search(text)
+    if para is None:
+        diags.append(Diagnostic(
+            "error", "BF-DOC003",
+            f"{base} has no 'HELLO feature bits:' paragraph — the "
+            f"{len(live)} live FEATURE_* bits are undocumented",
+            pass_name=_PASS, subject=base))
+        return diags
+    doc = {m.group(2): int(m.group(1))
+           for m in _FEATURE_PAIR_RE.finditer(para.group(0))}
+
+    for name in sorted(live):
+        if name not in doc:
+            diags.append(Diagnostic(
+                "error", "BF-DOC003",
+                f"feature bit FEATURE_{name} = {live[name]} is not in "
+                f"{base}'s HELLO feature-bit paragraph — every "
+                "negotiable bit needs a doc entry (the 128/256 "
+                "late-addition drift)",
+                pass_name=_PASS, subject=name))
+        elif doc[name] != live[name]:
+            diags.append(Diagnostic(
+                "error", "BF-DOC003",
+                f"{base} documents feature bit {name} as {doc[name]} "
+                f"but FEATURE_{name} = {live[name]} — the mask in the "
+                "doc would negotiate the wrong feature",
+                pass_name=_PASS, subject=name))
+    for name in sorted(set(doc) - set(live)):
+        diags.append(Diagnostic(
+            "error", "BF-DOC003",
+            f"{base} documents feature bit {doc[name]} `{name}`, but "
+            "runtime/window_server.py defines no FEATURE_" + name +
+            " — a stale entry for a renamed or removed bit",
+            pass_name=_PASS, subject=name))
+    if not diags:
+        diags.append(Diagnostic(
+            "info", "BF-DOC102",
+            f"all {len(live)} HELLO feature bits documented in {base} "
+            "with matching values; no stale entries",
+            pass_name=_PASS, subject=base))
     return diags
 
 

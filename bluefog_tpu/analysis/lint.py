@@ -624,15 +624,32 @@ def sharding_pass(report: LintReport, size: int) -> None:
         pass_name="sharding", subject="sharding"))
 
 
+def protocol_pass(report: LintReport, size: int) -> None:
+    """Pass 13 — BF-WIRE: the static wire-protocol verifier.  Extracts
+    the encode/decode model over the whole protocol surface (struct
+    layouts cross-checked per op, status-code registry discipline,
+    feature-bit gates, claimed-length allocation bounds) and runs the
+    exhaustive connection-state model checker over the three stream
+    machines — see :mod:`bluefog_tpu.analysis.protocol_check` and the
+    ``bfwire-tpu`` CLI for the model and state graphs."""
+    from bluefog_tpu.analysis.protocol_check import check_package
+
+    _, diags = check_package()
+    report.extend(diags)
+
+
 def doc_pass(report: LintReport, size: int) -> None:
     """BF-DOC: docs/transport.md must list every wire v2 status code in
-    the one registry (:mod:`bluefog_tpu.runtime.wire_status`), and
-    docs/metrics.md must agree with the live ``bf_*`` metric names,
-    both directions."""
-    from bluefog_tpu.analysis.doc_lint import (check_metrics_doc,
+    the one registry (:mod:`bluefog_tpu.runtime.wire_status`) and every
+    HELLO feature bit with its live ``FEATURE_*`` value, and
+    docs/metrics.md must agree with the live ``bf_*`` metric names —
+    all pinned both directions."""
+    from bluefog_tpu.analysis.doc_lint import (check_feature_doc,
+                                               check_metrics_doc,
                                                check_transport_doc)
 
     report.extend(check_transport_doc())
+    report.extend(check_feature_doc())
     report.extend(check_metrics_doc())
 
 
@@ -784,6 +801,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     fleet_pass(report, size)
     sim_pass(report, size)
     concurrency_pass(report, size)
+    protocol_pass(report, size)
     doc_pass(report, size)
     examples_pass(report, size)
     if trace:

@@ -1,0 +1,431 @@
+"""Pass 13 — static wire-protocol verifier (``bfwire-tpu``).
+
+The wire v2 protocol is hand-encoded across five modules and defended,
+until this pass, only dynamically.  This pass consumes the
+:mod:`bluefog_tpu.analysis.wiremodel` extracted over the protocol
+surface plus the :mod:`bluefog_tpu.analysis.statemodel` exhaustive
+connection-machine checker and reports:
+
+**BF-WIRE001** (error) — encoder/decoder layout disagreement: the same
+struct constant defined with two formats; a struct packed somewhere but
+unpacked nowhere (or vice versa) — one side of a frame drifted; a
+hand-rolled ``struct.pack``/``struct.Struct`` inside a protocol
+function, outside the shared-constant discipline; or a per-op
+imbalance — a struct packed under op N that no op-N (or shared
+ack/push-loop) site decodes.  Waive a reviewed shape with
+``# bfwire: layout-ok <why>`` on the use (or def) line.
+
+**BF-WIRE002** (error) — status-code discipline: a negative status
+emitted or matched that the ONE registry
+(:mod:`bluefog_tpu.runtime.wire_status`) does not define; a match
+branch whose handling contradicts the registry's ``is_retriable``
+classification (a retriable code raised as terminal, or vice versa);
+or a stale ``UNASSIGNED_CODES`` (it must equal the gaps of
+``WIRE_V2_CODES`` exactly — the PR-16 regeneration).
+
+**BF-WIRE003** (error) — a feature-gated emission without the
+negotiated-bit check in scope: ops 6/7/8/9/10 and the optional
+``_TRACE_HDR``/``_DELTA_HDR`` frame headers may only be sent on a
+connection whose HELLO granted the matching ``FEATURE_*`` bit; the
+check looks for that evidence (the feature constant, or a
+``*_granted``/``*_on``/``want`` mask identifier for the feature) in
+the emitting class/function.  Waive a reviewed shape with
+``# bfwire: gate-ok <why>`` on the emitting line.
+
+**BF-WIRE004** (error) — a wire-claimed length (a variable unpacked
+from a >=32-bit frame field) reaching an allocation-shaped sink
+(``np.empty``/``bytearray``/``_recv_exact``/``recv``) without a
+lexically-prior bound (``wire_bytes_bound(...)``, a ``_MAX_*``
+constant, or a positive literal) — the PR-4 discipline: a lying peer
+must never make the owner allocate unbounded memory.  Deliberately
+unwaivable: fix the bound.
+
+**BF-WIRE005** (error) — the state-model checker found an invariant
+violation, a stuck (acceptance-unreachable) state, or an incomplete
+exploration in one of the three healthy connection machines
+(DepositStream, Subscriber, Delta).  The violating trace is minimized
+and printed as an event sequence.
+
+**BF-WIRE100/101** (info) — model summary / per-machine state counts.
+
+The standalone ``bfwire-tpu`` CLI prints the extracted model (per-op
+pack/unpack table), the state-machine exploration results, then the
+findings; ``--dot FILE`` additionally writes the explored state graphs
+as DOT.  Exit code 0 iff no error survived its waivers, 1 otherwise.
+The same checks run inside the ``bflint-tpu`` sweep as
+``protocol_pass`` (see :mod:`bluefog_tpu.analysis.lint`), which is
+what CI (and tier-1, via ``tests/test_analysis.py``) enforces.
+Conformance tests in ``tests/test_wire_verify.py`` pin the state model
+to the live code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from bluefog_tpu.analysis import statemodel
+from bluefog_tpu.analysis.report import Diagnostic
+from bluefog_tpu.analysis.wiremodel import (WireModel, build_model,
+                                            build_package_model)
+
+__all__ = ["check_model", "check_package", "check_registry",
+           "check_sources", "check_state_machines", "main"]
+
+_PASS = "protocol-check"
+
+
+def _site(file: str, line: int) -> str:
+    return "%s:%d" % (os.path.basename(file), line)
+
+
+def _finding(diags: List[Diagnostic], model: WireModel, code: str,
+             token: str, message: str, subject: str,
+             sites: Sequence[Tuple[str, int]]) -> None:
+    """Append an error, downgraded to an info ``<code>W`` when any of
+    its sites carries a reasoned ``# bfwire: <token> <why>`` waiver."""
+    for file, line in sites:
+        reason = model.waiver_at(file, line, token)
+        if reason:
+            diags.append(Diagnostic(
+                "info", code + "W",
+                message + " [waived at %s: %s]" % (_site(file, line),
+                                                   reason),
+                pass_name=_PASS, subject=subject))
+            return
+    diags.append(Diagnostic("error", code, message,
+                            pass_name=_PASS, subject=subject))
+
+
+# ---------------------------------------------------------------------------
+# BF-WIRE001: layout agreement
+# ---------------------------------------------------------------------------
+
+def _check_layout(model: WireModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for name in sorted(model.structs):
+        defs = model.structs[name]
+        fmts = sorted({d.fmt for d in defs})
+        if len(fmts) > 1:
+            _finding(
+                diags, model, "BF-WIRE001", "layout-ok",
+                "struct %s is defined with CONFLICTING formats %s (%s) "
+                "— the two sides of this frame cannot agree on a "
+                "layout" % (name, fmts,
+                            ", ".join(_site(d.file, d.line)
+                                      for d in defs)),
+                name, [(d.file, d.line) for d in defs])
+    for name in sorted(model.structs):
+        defs = model.structs[name]
+        uses = [u for u in model.uses if u.struct == name]
+        if not uses:
+            continue
+        packs = [u for u in uses if u.action == "pack"]
+        unpacks = [u for u in uses if u.action == "unpack"]
+        if packs and not unpacks:
+            _finding(
+                diags, model, "BF-WIRE001", "layout-ok",
+                "struct %s is PACKED (%s) but no protocol module ever "
+                "unpacks it — the decode side is missing or drifted to "
+                "another layout" % (
+                    name, ", ".join(sorted({_site(u.file, u.line)
+                                            for u in packs}))),
+                name,
+                [(d.file, d.line) for d in defs]
+                + [(u.file, u.line) for u in packs])
+        elif unpacks and not packs:
+            _finding(
+                diags, model, "BF-WIRE001", "layout-ok",
+                "struct %s is UNPACKED (%s) but no protocol module "
+                "ever packs it — the encode side is missing or drifted "
+                "to another layout" % (
+                    name, ", ".join(sorted({_site(u.file, u.line)
+                                            for u in unpacks}))),
+                name,
+                [(d.file, d.line) for d in defs]
+                + [(u.file, u.line) for u in unpacks])
+    for site in model.inline_sites:
+        _finding(
+            diags, model, "BF-WIRE001", "layout-ok",
+            "hand-rolled struct call%s inside protocol function %s "
+            "(%s) — frame layouts must go through a shared module-"
+            "level struct constant so both sides are cross-checked"
+            % ((" (%r)" % site.fmt) if site.fmt else "",
+               site.func, _site(site.file, site.line)),
+            site.func, [(site.file, site.line)])
+    # per-op balance: a struct packed under op N must be decoded under
+    # op N or by a shared (op-independent) loop, and vice versa
+    buckets = model.op_buckets()
+    shared = {"pack": model.opless_structs("pack"),
+              "unpack": model.opless_structs("unpack")}
+    other = {"pack": "unpack", "unpack": "pack"}
+    for op in sorted(buckets):
+        for action in ("pack", "unpack"):
+            opp = other[action]
+            for name in sorted(buckets[op][action]
+                               - buckets[op][opp] - shared[opp]):
+                sites = [(u.file, u.line) for u in model.uses
+                         if u.struct == name and u.action == action
+                         and u.ops is not None and op in u.ops]
+                _finding(
+                    diags, model, "BF-WIRE001", "layout-ok",
+                    "op %d %ss struct %s (%s) but nothing %ss it for "
+                    "that op (nor in a shared frame loop) — the other "
+                    "side of the frame drifted" % (
+                        op, action, name,
+                        ", ".join(sorted({_site(f, ln)
+                                          for f, ln in sites})), opp),
+                    name, sites)
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# BF-WIRE002: status-code discipline
+# ---------------------------------------------------------------------------
+
+def _check_status(model: WireModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for site in model.status_sites:
+        if site.value not in model.registry_values:
+            diags.append(Diagnostic(
+                "error", "BF-WIRE002",
+                "status %d %s at %s (%s) is not defined in the "
+                "runtime/wire_status.py registry — hand-carried "
+                "literals are how the codes drifted before the ONE "
+                "table existed" % (
+                    site.value,
+                    "emitted" if site.action == "emit" else "matched",
+                    _site(site.file, site.line), site.func),
+                pass_name=_PASS, subject=site.func))
+            continue
+        if site.action == "match" and site.handling is not None:
+            retri = site.value in model.retriable_values
+            if site.handling == "terminal" and retri:
+                diags.append(Diagnostic(
+                    "error", "BF-WIRE002",
+                    "status %d is RETRIABLE per wire_status but the "
+                    "match at %s (%s) raises a terminal error — a "
+                    "well-behaved client must back off and retry this "
+                    "code" % (site.value, _site(site.file, site.line),
+                              site.func),
+                    pass_name=_PASS, subject=site.func))
+            elif site.handling == "retriable" and not retri:
+                diags.append(Diagnostic(
+                    "error", "BF-WIRE002",
+                    "status %d is TERMINAL per wire_status but the "
+                    "match at %s (%s) raises a retriable/connection "
+                    "error — retrying only relabels the real failure"
+                    % (site.value, _site(site.file, site.line),
+                       site.func),
+                    pass_name=_PASS, subject=site.func))
+    return diags
+
+
+def check_registry(codes: Optional[Sequence[int]] = None,
+                   unassigned: Optional[Sequence[int]] = None
+                   ) -> List[Diagnostic]:
+    """BF-WIRE002 satellite: ``UNASSIGNED_CODES`` must equal the gaps
+    of ``WIRE_V2_CODES`` exactly, so the doc-facing gap list can never
+    go stale when a code is (un)assigned."""
+    from bluefog_tpu.runtime import wire_status as _wst
+    codes = tuple(codes if codes is not None else _wst.WIRE_V2_CODES)
+    unassigned = tuple(unassigned if unassigned is not None
+                       else _wst.UNASSIGNED_CODES)
+    expect = tuple(c for c in range(max(codes), min(codes) - 1, -1)
+                   if c not in codes)
+    if unassigned != expect:
+        return [Diagnostic(
+            "error", "BF-WIRE002",
+            "wire_status.UNASSIGNED_CODES %r is stale: the gaps of "
+            "WIRE_V2_CODES are %r — regenerate the constant from the "
+            "registry" % (tuple(unassigned), expect),
+            pass_name=_PASS, subject="wire_status")]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# BF-WIRE003: feature gates
+# ---------------------------------------------------------------------------
+
+def _check_gates(model: WireModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    seen = set()
+    for g in model.gate_sites:
+        key = (g.file, g.line, g.feature)
+        if key in seen:
+            continue
+        seen.add(key)
+        if g.satisfied:
+            continue
+        _finding(
+            diags, model, "BF-WIRE003", "gate-ok",
+            "%s is emitted at %s (%s) without %s gate evidence in "
+            "scope — a peer that did not negotiate the bit receives a "
+            "frame it cannot parse" % (
+                g.subject, _site(g.file, g.line), g.func, g.feature),
+            g.func, [(g.file, g.line)])
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# BF-WIRE004: claimed-length bounds
+# ---------------------------------------------------------------------------
+
+def _check_bounds(model: WireModel) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for b in model.bound_sites:
+        if b.guarded:
+            continue
+        diags.append(Diagnostic(
+            "error", "BF-WIRE004",
+            "wire-claimed length %r (struct field %r) reaches "
+            "%s(...) at %s (%s) without a prior bound — a lying peer "
+            "chooses the allocation size; compare it against "
+            "wire_bytes_bound()/a _MAX_* cap first (the PR-4 "
+            "discipline; not waivable)" % (
+                b.var, b.fmt_char, b.sink, _site(b.file, b.line),
+                b.func),
+            pass_name=_PASS, subject=b.func))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# BF-WIRE005: the connection state machines
+# ---------------------------------------------------------------------------
+
+def check_state_machines(*, n_batches: int = 2, rounds: int = 3,
+                         keep_edges: bool = False
+                         ) -> Tuple[List[statemodel.CheckResult],
+                                    List[Diagnostic]]:
+    """Exhaustively explore the three healthy connection machines;
+    BF-WIRE005 error per violated invariant / stuck state / incomplete
+    exploration, BF-WIRE101 info with the state counts."""
+    results = statemodel.check_all(n_batches=n_batches, rounds=rounds,
+                                   keep_edges=keep_edges)
+    diags: List[Diagnostic] = []
+    for res in results:
+        for v in res.violations:
+            diags.append(Diagnostic(
+                "error", "BF-WIRE005",
+                "state machine %s violates %s; minimized trace: %s"
+                % (res.machine, v.invariant,
+                   " -> ".join(v.trace) or "<initial state>"),
+                pass_name=_PASS, subject=res.machine))
+        for trace, st in res.stuck:
+            diags.append(Diagnostic(
+                "error", "BF-WIRE005",
+                "state machine %s has a STUCK state (no accepting "
+                "state reachable) after [%s]: %r"
+                % (res.machine, " -> ".join(trace), st),
+                pass_name=_PASS, subject=res.machine))
+        if not res.complete:
+            diags.append(Diagnostic(
+                "error", "BF-WIRE005",
+                "state machine %s exploration hit the state cap "
+                "before the fixpoint — bounds must keep the space "
+                "finite" % res.machine,
+                pass_name=_PASS, subject=res.machine))
+    diags.append(Diagnostic(
+        "info", "BF-WIRE101",
+        "state machines exhausted: " + "; ".join(
+            "%s %d states/%d transitions/depth %d" % (
+                r.machine, r.states, r.transitions, r.depth)
+            for r in results),
+        pass_name=_PASS, subject="statemodel"))
+    return results, diags
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def check_model(model: WireModel) -> List[Diagnostic]:
+    """All static checks over an extracted model (no state machines)."""
+    diags: List[Diagnostic] = []
+    for rel in model.parse_failures:
+        diags.append(Diagnostic(
+            "warning", "BF-WIRE000",
+            "could not parse protocol module %s — its frames are "
+            "unverified" % rel, pass_name=_PASS, subject=rel))
+    diags.extend(_check_layout(model))
+    diags.extend(_check_status(model))
+    diags.extend(_check_gates(model))
+    diags.extend(_check_bounds(model))
+    diags.append(Diagnostic(
+        "info", "BF-WIRE100",
+        "protocol model: %d file(s), %d struct(s), %d use site(s), "
+        "%d status site(s), %d gate site(s), %d bound site(s)" % (
+            len(model.files), len(model.structs), len(model.uses),
+            len(model.status_sites), len(model.gate_sites),
+            len(model.bound_sites)),
+        pass_name=_PASS, subject="wiremodel"))
+    return diags
+
+
+def check_sources(sources: Sequence[Tuple[str, str]]
+                  ) -> Tuple[WireModel, List[Diagnostic]]:
+    """Build the model from ``(relpath, text)`` pairs and check it
+    (static checks only — for tests and tools)."""
+    model = build_model(sources)
+    return model, check_model(model)
+
+
+def check_package(root: Optional[str] = None
+                  ) -> Tuple[WireModel, List[Diagnostic]]:
+    """The full Pass-13 sweep over the repo's protocol surface:
+    static model checks + registry staleness + the three healthy
+    state machines."""
+    model = build_package_model(root)
+    diags = check_model(model)
+    diags.extend(check_registry())
+    _results, sm_diags = check_state_machines()
+    diags.extend(sm_diags)
+    return model, diags
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bfwire-tpu",
+        description="Static wire-protocol verifier + connection-state "
+                    "model checker (BF-WIRE001..005)")
+    parser.add_argument("--root", default=None,
+                        help="package root to scan (default: the "
+                             "installed bluefog_tpu package)")
+    parser.add_argument("--dot", default=None, metavar="FILE",
+                        help="write the explored state graphs as DOT")
+    parser.add_argument("--verbose", action="store_true",
+                        help="show info diagnostics (waivers, counts)")
+    parser.add_argument("--skip-states", action="store_true",
+                        help="static model checks only")
+    args = parser.parse_args(argv)
+
+    from bluefog_tpu.analysis.report import LintReport
+    model = build_package_model(args.root)
+    print(model.format_text())
+    report = LintReport()
+    report.extend(check_model(model))
+    report.extend(check_registry())
+    if not args.skip_states:
+        results, sm_diags = check_state_machines(
+            keep_edges=args.dot is not None)
+        report.extend(sm_diags)
+        for res in results:
+            print(res.format())
+        if args.dot:
+            with open(args.dot, "w", encoding="utf-8") as fh:
+                for res in results:
+                    fh.write(statemodel.to_dot(res))
+                    fh.write("\n")
+            print("state graphs written to %s" % args.dot)
+    out = report.format(verbose=args.verbose)
+    if out:
+        print(out)
+    ok = not report.errors
+    print("bfwire: OK" if ok else "bfwire: FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

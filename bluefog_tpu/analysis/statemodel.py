@@ -1,0 +1,736 @@
+"""Bounded explicit-state model checker for the wire connection machines.
+
+The wire v2 protocol rests on three connection state machines whose
+correctness arguments have so far lived in comments and chaos tests:
+
+- **DepositStream** (runtime/window_server.py): stable stream id +
+  epoch; STREAM_ATTACH replies the applied high-water mark; the client
+  retires through the mark and replays unretired batches; the server
+  dedups ``seq <= mark``.  Claimed invariant: every batch applies
+  EXACTLY ONCE, no matter which frames die.
+- **Subscriber** (serving/subscriber.py): resumable push cursor; the
+  sender skips to latest; the receiver drops ``round <= cursor`` and
+  never advances the cursor on a torn frame.  Claimed invariant:
+  delivered rounds are STRICTLY INCREASING and the latest round always
+  eventually lands.
+- **DeltaEncoder/Applier** (runtime/delta.py): kind-10 frames encode
+  against the last round SENT; the applier refuses a base that is not
+  its reconstruction cursor (``ERR_DELTA_BASE``) and the resumed stream
+  resyncs on a full anchor.  Claimed invariant: a delta NEVER applies
+  on the wrong base — reconstruction equals the round it claims.
+
+This module encodes each machine as a hand-written transition table
+over small integer state tuples, composes it with an adversarial
+network — drop, duplicate, truncate (torn frame), crash (connection
+kill), restart, and optionally reorder — and exhaustively enumerates
+EVERY interleaving by breadth-first search to a fixpoint (the state
+spaces are finite by construction: bounded batch counts, rounds,
+channel capacity, and capped apply counters).  BFS means a violating
+trace is already shortest; a greedy event-deletion pass then minimizes
+it further before it is printed as an event sequence.
+
+Three kinds of verdict come out of :func:`explore`:
+
+- **invariant violations** — a reachable state where the machine's
+  invariant predicate names a broken property;
+- **stuck states** — a reachable state from which NO accepting state
+  is reachable (computed by reverse reachability over the explored
+  graph, so it needs no fairness assumption: the adversary may drop
+  forever, but from every healthy state there must EXIST a recovery
+  path);
+- **incompleteness** — the ``max_states`` guard tripped before the
+  fixpoint (never expected at the shipped bounds; reported, not
+  silently ignored).
+
+Transport assumptions are explicit and faithful to TCP: channels are
+FIFO, and loss is a PREFIX CUT — a live stream never loses a frame
+from the middle; bytes vanish only when the connection dies, and then
+everything after the cut dies with it.  The adversary therefore gets:
+``truncate`` (tear the next frame; delivering a torn frame kills the
+connection and everything queued behind it), ``kill`` (connection
+dies; frames already buffered remain prefix-deliverable), ``lose_*``
+(the cut: discard what a dead connection still had queued),
+``restart``/``resubscribe``/``attach`` (reconnect + replay), and
+``dup`` (duplicate a queued frame — the abstraction of every duplicate
+source at once: zombie-epoch connections, attach replay overlap — so
+the dedup discipline is checked against duplication from ANY origin).
+``reorder=True`` additionally lifts the FIFO assumption, and the
+checker then PROVES it is load-bearing — the deposit dedup discipline
+loses a batch under reordering (see ``tests/test_wire_verify.py``) —
+which is exactly why reorder is modeled but the healthy configurations
+keep FIFO.  Cross-connection interleavings, where reordering genuinely
+happens, are covered by the crash/restart events plus replay.
+
+Each machine also ships seeded-violation variants (``bug=`` flags that
+plant a real historical defect shape: retire-on-send, dedup-off,
+cursor-advance-on-torn, apply-on-wrong-base) so the checker's teeth are
+themselves regression-tested, and ``tests/test_wire_verify.py`` pins
+the model to reality by driving the live code through modeled
+transitions in lockstep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CheckResult",
+    "DeltaMachine",
+    "DepositStreamMachine",
+    "Machine",
+    "SubscriberMachine",
+    "Violation",
+    "check_all",
+    "explore",
+    "minimize_trace",
+    "replay",
+    "to_dot",
+]
+
+State = Tuple
+Event = Tuple[str, State]
+
+
+class Machine:
+    """A finite connection state machine composed with the adversary.
+
+    Subclasses provide :meth:`initial`, :meth:`events` (the FULL list
+    of enabled protocol + adversary transitions), :meth:`invariant`
+    (name of the violated property, or None) and :meth:`is_accepting`
+    (all modeled work delivered)."""
+
+    name = "machine"
+
+    def initial(self) -> State:
+        raise NotImplementedError
+
+    def events(self, state: State) -> List[Event]:
+        raise NotImplementedError
+
+    def invariant(self, state: State) -> Optional[str]:
+        raise NotImplementedError
+
+    def is_accepting(self, state: State) -> bool:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    invariant: str
+    trace: Tuple[str, ...]        # minimized event sequence
+    state: State
+
+    def format(self) -> str:
+        return "%s after [%s]" % (self.invariant,
+                                  " -> ".join(self.trace) or "<init>")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    machine: str
+    states: int
+    transitions: int
+    depth: int                    # max BFS level reached
+    complete: bool                # explored to fixpoint under max_states
+    violations: List[Violation]
+    stuck: List[Tuple[Tuple[str, ...], State]]   # (shortest trace, state)
+    accepting: int
+    edges: Optional[List[Tuple[State, str, State]]] = None
+
+    @property
+    def ok(self) -> bool:
+        return (self.complete and not self.violations
+                and not self.stuck and self.accepting > 0)
+
+    def format(self) -> str:
+        head = ("%s: %d state(s), %d transition(s), depth %d, "
+                "%d accepting%s" % (
+                    self.machine, self.states, self.transitions,
+                    self.depth, self.accepting,
+                    "" if self.complete else ", INCOMPLETE"))
+        lines = [head]
+        for v in self.violations:
+            lines.append("  VIOLATION %s" % v.format())
+        for trace, st in self.stuck:
+            lines.append("  STUCK after [%s]: %r"
+                         % (" -> ".join(trace) or "<init>", st))
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# the checker
+# --------------------------------------------------------------------------
+
+def replay(machine: Machine,
+           labels: Sequence[str]) -> Optional[List[State]]:
+    """Replay an event-label sequence from the initial state; None if
+    some label is not enabled where the replay stands.  Labels carry
+    their operands (``deliver(2,torn)``) so replay is deterministic."""
+    st = machine.initial()
+    seq = [st]
+    for lbl in labels:
+        nxt = None
+        for l, s in machine.events(st):
+            if l == lbl:
+                nxt = s
+                break
+        if nxt is None:
+            return None
+        st = nxt
+        seq.append(st)
+    return seq
+
+
+def minimize_trace(machine: Machine, labels: Sequence[str],
+                   offends: Callable[[List[State]], bool]
+                   ) -> Tuple[str, ...]:
+    """Greedy event deletion: drop any single event whose removal still
+    replays to an offending run; repeat until no event is droppable."""
+    cur = list(labels)
+    changed = True
+    while changed:
+        changed = False
+        for i in range(len(cur)):
+            cand = cur[:i] + cur[i + 1:]
+            seq = replay(machine, cand)
+            if seq is not None and offends(seq):
+                cur = cand
+                changed = True
+                break
+    return tuple(cur)
+
+
+def _trace_to(pred: Dict[State, Tuple[Optional[State], str]],
+              state: State) -> Tuple[str, ...]:
+    out: List[str] = []
+    cur: Optional[State] = state
+    while cur is not None:
+        prev, lbl = pred[cur]
+        if prev is None:
+            break
+        out.append(lbl)
+        cur = prev
+    return tuple(reversed(out))
+
+
+def explore(machine: Machine, *, max_states: int = 400_000,
+            keep_edges: bool = False) -> CheckResult:
+    """Exhaustive BFS over the machine's reachable states (fixpoint),
+    with invariant checking, stuck (accepting-unreachable) analysis,
+    and auto-minimized violation traces."""
+    init = machine.initial()
+    pred: Dict[State, Tuple[Optional[State], str]] = {init: (None, "")}
+    level: Dict[State, int] = {init: 0}
+    frontier = deque([init])
+    transitions = 0
+    depth = 0
+    complete = True
+    violations: Dict[str, Tuple[Tuple[str, ...], State]] = {}
+    adj: Dict[State, List[Tuple[str, State]]] = {}
+    accepting: List[State] = []
+
+    inv0 = machine.invariant(init)
+    if inv0:
+        violations[inv0] = ((), init)
+    if machine.is_accepting(init):
+        accepting.append(init)
+
+    while frontier:
+        st = frontier.popleft()
+        if machine.invariant(st):
+            # violating states are terminal: the run already failed
+            adj[st] = []
+            continue
+        evs = machine.events(st)
+        adj[st] = evs
+        for lbl, nxt in evs:
+            transitions += 1
+            if nxt in pred:
+                continue
+            if len(pred) >= max_states:
+                complete = False
+                continue
+            pred[nxt] = (st, lbl)
+            level[nxt] = level[st] + 1
+            depth = max(depth, level[nxt])
+            inv = machine.invariant(nxt)
+            if inv and inv not in violations:
+                violations[inv] = (_trace_to(pred, nxt), nxt)
+            if machine.is_accepting(nxt):
+                accepting.append(nxt)
+            frontier.append(nxt)
+
+    min_violations: List[Violation] = []
+    for inv, (trace, vstate) in sorted(violations.items()):
+        def offends(seq: List[State], _inv: str = inv) -> bool:
+            return any(machine.invariant(s) == _inv for s in seq)
+        min_violations.append(Violation(
+            inv, minimize_trace(machine, trace, offends), vstate))
+
+    stuck: List[Tuple[Tuple[str, ...], State]] = []
+    if not violations and complete:
+        co = set(accepting)
+        radj: Dict[State, List[State]] = {}
+        for src, evs in adj.items():
+            for _lbl, dst in evs:
+                radj.setdefault(dst, []).append(src)
+        work = deque(co)
+        while work:
+            cur = work.popleft()
+            for prev in radj.get(cur, ()):
+                if prev not in co:
+                    co.add(prev)
+                    work.append(prev)
+        dead = sorted((level[s], s) for s in pred if s not in co)
+        for _lvl, s in dead[:3]:
+            stuck.append((_trace_to(pred, s), s))
+
+    edges = None
+    if keep_edges:
+        edges = [(src, lbl, dst) for src, evs in adj.items()
+                 for lbl, dst in evs]
+    return CheckResult(machine.name, len(pred), transitions, depth,
+                       complete, min_violations, stuck, len(accepting),
+                       edges)
+
+
+def to_dot(result: CheckResult, *, max_nodes: int = 400) -> str:
+    """Render an explored state graph as DOT (explore with
+    ``keep_edges=True``); large graphs degrade to a summary node."""
+    name = result.machine.replace("-", "_")
+    lines = ["digraph %s {" % name, '  rankdir=LR;',
+             '  node [shape=box, fontsize=9];']
+    if result.edges is None or result.states > max_nodes:
+        lines.append('  summary [label="%s\\n%d states / %d transitions'
+                     '\\n(graph elided)"];' % (
+                         result.machine, result.states,
+                         result.transitions))
+        lines.append("}")
+        return "\n".join(lines)
+    ids: Dict[State, int] = {}
+
+    def nid(s: State) -> int:
+        if s not in ids:
+            ids[s] = len(ids)
+        return ids[s]
+
+    for src, lbl, dst in result.edges:
+        lines.append('  n%d -> n%d [label="%s", fontsize=8];'
+                     % (nid(src), nid(dst), lbl))
+    for s, i in ids.items():
+        lines.append('  n%d [label="%s"];'
+                     % (i, str(s).replace('"', "'")))
+    lines.append("}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# machine 1: DepositStream seq/epoch/attach-replay
+# --------------------------------------------------------------------------
+
+class DepositStreamMachine(Machine):
+    """Exactly-once batch application under the adversarial network.
+
+    State: ``(sent, retired, mark, inflight, acks, applied, alive)``
+    where ``inflight`` is the FIFO client->server channel of
+    ``(seq, torn)`` frames, ``acks`` the FIFO server->client ack
+    channel, ``applied[seq-1]`` a capped per-seq apply counter, and
+    ``mark`` the server's applied high-water mark (what STREAM_ATTACH
+    replies).
+
+    ``bug="retire_on_send"`` plants the client treating a SEND as
+    durable (retiring before the ack) — the trace
+    ``send(1), kill, lose_frames`` then violates
+    ``retired-implies-applied``.
+    ``bug="dedup_off"`` removes the server's ``seq <= mark`` dedup —
+    a duplicated frame then violates ``exactly-once-apply``.
+    ``reorder=True`` lets the adversary swap in-flight frames, proving
+    the FIFO (TCP) assumption is load-bearing."""
+
+    def __init__(self, *, n_batches: int = 2, window: int = 2,
+                 chan_cap: int = 2, bug: Optional[str] = None,
+                 reorder: bool = False):
+        self.n = n_batches
+        self.window = window
+        self.cap = chan_cap
+        self.bug = bug
+        self.reorder = reorder
+        self.name = "deposit-stream" + (("!" + bug) if bug else "")
+
+    def initial(self) -> State:
+        return (0, 0, 0, (), (), (0,) * self.n, True)
+
+    def _apply(self, seq: int, applied: Tuple[int, ...]
+               ) -> Tuple[int, ...]:
+        return tuple(min(c + (1 if i == seq - 1 else 0), 2)
+                     for i, c in enumerate(applied))
+
+    def events(self, state: State) -> List[Event]:
+        sent, retired, mark, inflight, acks, applied, alive = state
+        out: List[Event] = []
+        # client: send the next unretired batch, window-bounded
+        if alive and sent < self.n and sent - retired < self.window \
+                and len(inflight) < self.cap:
+            seq = sent + 1
+            n_retired = seq if self.bug == "retire_on_send" else retired
+            out.append(("send(%d)" % seq,
+                        (seq, n_retired, mark,
+                         inflight + ((seq, False),), acks, applied,
+                         alive)))
+        # server: process the head in-flight frame (FIFO).  On a live
+        # connection the ack lands in the return channel; a dead
+        # connection may still DRAIN frames it buffered before the
+        # crash (late processing), but the acks it writes are born dead.
+        if inflight:
+            (seq, torn), rest = inflight[0], inflight[1:]
+            if torn:
+                # a torn frame desyncs the stream: the server kills the
+                # connection; everything queued behind the tear is lost
+                out.append(("deliver(%d,torn)" % seq,
+                            (sent, retired, mark, (), acks, applied,
+                             False)))
+            elif seq <= mark and self.bug != "dedup_off":
+                # duplicate: re-ack (if the conn lives), do NOT apply
+                if not alive:
+                    out.append(("deliver(%d,dedup)" % seq,
+                                (sent, retired, mark, rest, acks,
+                                 applied, alive)))
+                elif len(acks) < self.cap:
+                    out.append(("deliver(%d,dedup)" % seq,
+                                (sent, retired, mark, rest,
+                                 acks + (seq,), applied, alive)))
+            else:
+                n_applied = self._apply(seq, applied)
+                if not alive:
+                    out.append(("deliver(%d)" % seq,
+                                (sent, retired, max(mark, seq), rest,
+                                 acks, n_applied, alive)))
+                elif len(acks) < self.cap:
+                    out.append(("deliver(%d)" % seq,
+                                (sent, retired, max(mark, seq), rest,
+                                 acks + (seq,), n_applied, alive)))
+            if not torn and alive:
+                out.append(("truncate(%d)" % seq,
+                            (sent, retired, mark,
+                             ((seq, True),) + rest, acks, applied,
+                             alive)))
+                if len(inflight) < self.cap:
+                    out.append(("dup(%d)" % seq,
+                                (sent, retired, mark,
+                                 inflight + ((seq, False),), acks,
+                                 applied, alive)))
+        if self.reorder and alive and len(inflight) >= 2:
+            swapped = (inflight[1], inflight[0]) + inflight[2:]
+            out.append(("reorder",
+                        (sent, retired, mark, swapped, acks, applied,
+                         alive)))
+        # client: consume the head ack (retire through it)
+        if acks:
+            a, rest_a = acks[0], acks[1:]
+            out.append(("ack(%d)" % a,
+                        (sent, max(retired, a), mark, inflight, rest_a,
+                         applied, alive)))
+        # crash the connection at any step; what was queued stays
+        # prefix-deliverable until the adversary cuts it
+        if alive:
+            out.append(("kill",
+                        (sent, retired, mark, inflight, acks, applied,
+                         False)))
+        else:
+            if inflight:
+                out.append(("lose_frames",
+                            (sent, retired, mark, (), acks, applied,
+                             False)))
+            if acks:
+                out.append(("lose_acks",
+                            (sent, retired, mark, inflight, (),
+                             applied, False)))
+            # STREAM_ATTACH: only once the dead connection quiesced
+            # (the server joins the old generation's worker before
+            # replying the mark — modeled as: the old channels fully
+            # drained or cut first).  The client retires through the
+            # replied mark and rewinds ``sent`` to replay every
+            # unretired batch.
+            if not inflight and not acks:
+                n_retired = max(retired, mark)
+                out.append(("attach(mark=%d)" % mark,
+                            (n_retired, n_retired, mark, (), (),
+                             applied, True)))
+        return out
+
+    def invariant(self, state: State) -> Optional[str]:
+        sent, retired, mark, inflight, acks, applied, alive = state
+        if any(c > 1 for c in applied):
+            return "exactly-once-apply"
+        live = {seq for seq, _torn in inflight}
+        for seq in range(1, retired + 1):
+            if applied[seq - 1] == 0 and seq not in live:
+                return "retired-implies-applied"
+        return None
+
+    def is_accepting(self, state: State) -> bool:
+        _sent, retired, _mark, _inf, _acks, applied, _alive = state
+        return retired == self.n and all(c == 1 for c in applied)
+
+
+# --------------------------------------------------------------------------
+# machine 2: Subscriber cursor/epoch/resume
+# --------------------------------------------------------------------------
+
+class SubscriberMachine(Machine):
+    """Strictly-increasing push delivery with torn-frame safety.
+
+    State: ``(published, pushed, chan, cursor, last_delivered,
+    alive)`` — ``chan`` is the FIFO server->client channel of
+    ``(round, torn)`` push frames; ``pushed`` the sender's last pushed
+    round on the current connection (skip-to-latest: it pushes
+    ``published`` directly); ``cursor`` the receiver's resume cursor;
+    ``last_delivered`` the last round actually handed to the consumer.
+
+    The healthy receiver drops ``round <= cursor`` and advances cursor
+    and delivery together, so ``cursor == last_delivered`` is the
+    machine invariant; ``bug="advance_on_torn"`` plants the cursor
+    advancing on a torn frame (the defect BF-WIRE's state layer exists
+    to catch), which both breaks that equality immediately and — left
+    unchecked — would silently drop the round on resume."""
+
+    def __init__(self, *, rounds: int = 3, chan_cap: int = 2,
+                 bug: Optional[str] = None):
+        self.rounds = rounds
+        self.cap = chan_cap
+        self.bug = bug
+        self.name = "subscriber" + (("!" + bug) if bug else "")
+
+    def initial(self) -> State:
+        return (0, 0, (), 0, 0, True)
+
+    def events(self, state: State) -> List[Event]:
+        published, pushed, chan, cursor, last, alive = state
+        out: List[Event] = []
+        if published < self.rounds:
+            out.append(("publish(%d)" % (published + 1),
+                        (published + 1, pushed, chan, cursor, last,
+                         alive)))
+        if alive and published > pushed and len(chan) < self.cap:
+            out.append(("push(%d)" % published,
+                        (published, published,
+                         chan + ((published, False),), cursor, last,
+                         alive)))
+        if chan:
+            (rnd, torn), rest = chan[0], chan[1:]
+            if torn:
+                # a torn push frame desyncs the read loop: the
+                # connection dies, the queue behind the tear with it —
+                # and the HEALTHY cursor does not move
+                n_cursor = (max(cursor, rnd)
+                            if self.bug == "advance_on_torn" else cursor)
+                out.append(("deliver(%d,torn)" % rnd,
+                            (published, pushed, (), n_cursor, last,
+                             False)))
+            else:
+                # a dead connection still drains frames the client had
+                # buffered before noticing the crash
+                if rnd <= cursor:
+                    out.append(("deliver(%d,dup)" % rnd,
+                                (published, pushed, rest, cursor, last,
+                                 alive)))
+                else:
+                    out.append(("deliver(%d)" % rnd,
+                                (published, pushed, rest, rnd, rnd,
+                                 alive)))
+                if alive:
+                    out.append(("truncate(%d)" % rnd,
+                                (published, pushed,
+                                 ((rnd, True),) + rest, cursor, last,
+                                 alive)))
+                    if len(chan) < self.cap:
+                        out.append(("dup(%d)" % rnd,
+                                    (published, pushed,
+                                     chan + ((rnd, False),), cursor,
+                                     last, alive)))
+        if alive:
+            out.append(("kill",
+                        (published, pushed, chan, cursor, last, False)))
+        else:
+            if chan:
+                out.append(("lose_frames",
+                            (published, pushed, (), cursor, last,
+                             False)))
+            else:
+                # resume: SUBSCRIBE carries the cursor; the sender
+                # restarts skip-to-latest strictly above it
+                out.append(("resubscribe(cursor=%d)" % cursor,
+                            (published, cursor, (), cursor, last,
+                             True)))
+        return out
+
+    def invariant(self, state: State) -> Optional[str]:
+        _published, _pushed, _chan, cursor, last, _alive = state
+        if cursor != last:
+            return "cursor-advanced-without-delivery"
+        return None
+
+    def is_accepting(self, state: State) -> bool:
+        published, _pushed, _chan, _cursor, last, _alive = state
+        return published == self.rounds and last == self.rounds
+
+
+# --------------------------------------------------------------------------
+# machine 3: DeltaEncoder/Applier base/resync
+# --------------------------------------------------------------------------
+
+class DeltaMachine(Machine):
+    """Delta frames never apply on the wrong base; desync resyncs.
+
+    State: ``(published, enc_base, cadence, pushed, chan, cursor,
+    content, alive)`` — the encoder deltas against the last round it
+    SENT (``enc_base``; -1 forces a full anchor), emitting a full
+    frame every ``full_every`` sends; ``chan`` carries
+    ``(kind, base, round, torn)`` with kind 10 = delta, 0 = full;
+    ``content`` is the round the receiver's reconstruction actually
+    equals (the thing a wrong-base apply corrupts), ``CORRUPT`` once a
+    bad apply happened.
+
+    Healthy appliers refuse ``base != content`` (ERR_DELTA_BASE: the
+    connection dies and the resumed encoder re-anchors with a full
+    frame).  Under the faithful FIFO/prefix-loss transport a healthy
+    SENDER can never put a wrong-base delta in front of the applier —
+    the checker proves that — so the seeded variants plant the sender
+    defect the base check defends against (an encoder that keeps its
+    base across reconnect and never re-anchors):
+
+    - ``bug="no_reanchor"`` — that sender against the HEALTHY applier:
+      every delta after a reconnect desyncs, the connection dies, the
+      resumed sender still refuses to anchor — a livelock the checker
+      reports as STUCK states (acceptance unreachable);
+    - ``bug="apply_wrong_base"`` — the same sender against an applier
+      missing the base check: the reconstruction silently corrupts,
+      caught by the ``delta-applied-on-wrong-base`` invariant."""
+
+    CORRUPT = -99
+
+    def __init__(self, *, rounds: int = 3, full_every: int = 2,
+                 chan_cap: int = 2, bug: Optional[str] = None):
+        self.rounds = rounds
+        self.full_every = max(1, full_every)
+        self.cap = chan_cap
+        self.bug = bug
+        self.name = "delta" + (("!" + bug) if bug else "")
+
+    def initial(self) -> State:
+        return (0, -1, 0, 0, (), 0, 0, True)
+
+    def events(self, state: State) -> List[Event]:
+        (published, enc_base, cadence, pushed, chan, cursor, content,
+         alive) = state
+        out: List[Event] = []
+        if published < self.rounds:
+            out.append(("publish(%d)" % (published + 1),
+                        (published + 1, enc_base, cadence, pushed,
+                         chan, cursor, content, alive)))
+        if alive and published > pushed and len(chan) < self.cap:
+            rnd = published
+            if self.bug in ("no_reanchor", "apply_wrong_base"):
+                # the seeded sender defect: anchor only the very first
+                # frame ever, never on cadence or reconnect
+                full = enc_base < 0
+            else:
+                full = enc_base < 0 or cadence % self.full_every == 0
+            kind, base = (0, -1) if full else (10, enc_base)
+            lbl = ("send_full(%d)" % rnd if full
+                   else "send_delta(%d,base=%d)" % (rnd, base))
+            out.append((lbl,
+                        (published, rnd, cadence + 1, rnd,
+                         chan + ((kind, base, rnd, False),), cursor,
+                         content, alive)))
+        if chan:
+            (kind, base, rnd, torn), rest = chan[0], chan[1:]
+            if torn:
+                out.append(("deliver(%d,torn)" % rnd,
+                            (published, enc_base, cadence, pushed,
+                             (), cursor, content, False)))
+            else:
+                if rnd <= cursor:
+                    out.append(("deliver(%d,dup)" % rnd,
+                                (published, enc_base, cadence, pushed,
+                                 rest, cursor, content, alive)))
+                elif kind == 0:
+                    out.append(("deliver_full(%d)" % rnd,
+                                (published, enc_base, cadence, pushed,
+                                 rest, rnd, rnd, alive)))
+                elif base != content and self.bug != "apply_wrong_base":
+                    # ERR_DELTA_BASE: refuse, drop the connection; the
+                    # resumed stream re-anchors with a full frame
+                    out.append(("deliver_delta(%d,desync)" % rnd,
+                                (published, enc_base, cadence, pushed,
+                                 rest, cursor, content, False)))
+                else:
+                    n_content = (rnd if base == content
+                                 else self.CORRUPT)
+                    out.append(("deliver_delta(%d)" % rnd,
+                                (published, enc_base, cadence, pushed,
+                                 rest, rnd, n_content, alive)))
+                if alive:
+                    out.append(("truncate(%d)" % rnd,
+                                (published, enc_base, cadence, pushed,
+                                 ((kind, base, rnd, True),) + rest,
+                                 cursor, content, alive)))
+                    if len(chan) < self.cap:
+                        out.append(("dup(%d)" % rnd,
+                                    (published, enc_base, cadence,
+                                     pushed,
+                                     chan + ((kind, base, rnd, False),),
+                                     cursor, content, alive)))
+        if alive:
+            out.append(("kill",
+                        (published, enc_base, cadence, pushed, chan,
+                         cursor, content, False)))
+        else:
+            if chan:
+                out.append(("lose_frames",
+                            (published, enc_base, cadence, pushed, (),
+                             cursor, content, False)))
+            else:
+                # resume: fresh per-connection encoder state -> the
+                # first frame of the new connection is a full anchor
+                # (the seeded sender defect keeps the stale base)
+                n_base = (enc_base
+                          if self.bug in ("no_reanchor",
+                                          "apply_wrong_base") else -1)
+                out.append(("resubscribe(cursor=%d)" % cursor,
+                            (published, n_base, 0, cursor, (), cursor,
+                             content, True)))
+        return out
+
+    def invariant(self, state: State) -> Optional[str]:
+        (_published, _enc_base, _cadence, _pushed, _chan, cursor,
+         content, _alive) = state
+        if content == self.CORRUPT:
+            return "delta-applied-on-wrong-base"
+        if content != cursor:
+            return "reconstruction-diverged-from-cursor"
+        return None
+
+    def is_accepting(self, state: State) -> bool:
+        published = state[0]
+        cursor = state[5]
+        return published == self.rounds and cursor == self.rounds
+
+
+# --------------------------------------------------------------------------
+# the shipped healthy configurations
+# --------------------------------------------------------------------------
+
+def check_all(*, n_batches: int = 2, rounds: int = 3,
+              keep_edges: bool = False) -> List[CheckResult]:
+    """Explore the three healthy machines at the shipped bounds (the
+    deposit replay window and both cursors fully covered)."""
+    return [
+        explore(DepositStreamMachine(n_batches=n_batches),
+                keep_edges=keep_edges),
+        explore(SubscriberMachine(rounds=rounds),
+                keep_edges=keep_edges),
+        explore(DeltaMachine(rounds=rounds), keep_edges=keep_edges),
+    ]

@@ -1,0 +1,951 @@
+"""AST-extracted model of every wire v2 encode/decode site (Pass 13).
+
+The wire protocol (docs/transport.md) is hand-encoded in five modules —
+the server (:mod:`bluefog_tpu.runtime.window_server`), the delta codec
+(:mod:`bluefog_tpu.runtime.delta`), the snapshot reader
+(:mod:`bluefog_tpu.serving.client`), the push subscriber
+(:mod:`bluefog_tpu.serving.subscriber`) and the relay
+(:mod:`bluefog_tpu.relay.node`) — with the status registry in
+:mod:`bluefog_tpu.runtime.wire_status` and the payload codecs in
+:mod:`bluefog_tpu.runtime.wire_codec`.  Until this pass, the two sides
+of each frame were checked against each other only dynamically (frame
+fuzzers, chaos soaks).  This module extracts a static model of the
+protocol so :mod:`bluefog_tpu.analysis.protocol_check` can cross-check
+both sides of every frame at lint time, the way
+:mod:`bluefog_tpu.analysis.lockmodel` does for locks.
+
+What is extracted (all by :mod:`ast`, no protocol module is imported):
+
+- **struct defs** — module-level ``NAME = struct.Struct("<fmt")``
+  constants, the one sanctioned way to declare a frame layout;
+- **struct uses** — every ``.pack``/``.pack_into`` and
+  ``.unpack``/``.unpack_from`` of a struct constant, attributed to its
+  enclosing function and, where derivable, to the wire **op** it
+  belongs to.  Op attribution has three sources, in order: a header
+  pack whose argument list names an ``_OP_*`` constant opens an op
+  context for the rest of the enclosing block (the client-send idiom:
+  ``_HDR.pack(_MAGIC, _OP_SNAPSHOT, n)`` followed by the op's body
+  structs); a branch guarded by ``op == _OP_X`` / ``op in _TRACED_OPS``
+  scopes its body to those ops (the server-dispatch idiom); and a
+  one-level-plus call-graph fixpoint carries ops into helpers
+  (``handle`` dispatches op 8 to ``_handle_snapshot``, which calls
+  ``_leaf_views``);
+- **status sites** — every emission of a negative status constant
+  (``_STATUS.pack(_ERR_X)``, ``self._batch_ack(seq, _ERR_X)``,
+  ``return _ERR_STALE_EPOCH``) and every match against one
+  (``rc == wire_status.ERR_ROUND_ROLLED``), with the match's handling
+  classified retriable/terminal by the exception the guarded branch
+  raises;
+- **gate sites** — every emission of a feature-gated op (6/7/8/9/10)
+  or optional header (``_TRACE_HDR``/``_DELTA_HDR``), with the
+  negotiated-bit evidence found in the enclosing scope;
+- **bound sites** — every wire-claimed length (a variable bound from a
+  >=32-bit unpack field) that flows into an allocation-shaped sink
+  (``np.empty``/``bytearray``/``_recv_exact``/``sock.recv``), with any
+  lexically-prior bound guard (``wire_bytes_bound``/``_MAX_*``)
+  recorded — the PR-4 discipline, extracted for BF-WIRE004;
+- **waivers** — ``# bfwire: layout-ok|gate-ok <reason>`` comments; a
+  bare token without a reason waives nothing (the bfverify precedent).
+
+The registry (legal status values, retriable subset) is read from the
+scanned ``wire_status``-defining module when present, so the model is
+self-contained on synthetic sources; :func:`build_package_model` always
+includes the real registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import struct as _structmod
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "BoundSite",
+    "GateSite",
+    "InlineSite",
+    "PROTOCOL_FILES",
+    "StatusSite",
+    "StructDef",
+    "StructUse",
+    "WireModel",
+    "build_model",
+    "build_package_model",
+    "package_root",
+]
+
+#: the protocol surface, relative to the ``bluefog_tpu`` package root —
+#: every module that encodes, decodes, emits, or matches wire v2 bytes
+PROTOCOL_FILES = (
+    "runtime/wire_status.py",
+    "runtime/wire_codec.py",
+    "runtime/window_server.py",
+    "runtime/delta.py",
+    "serving/client.py",
+    "serving/subscriber.py",
+    "relay/node.py",
+)
+
+_WAIVER_RE = re.compile(r"#\s*bfwire:\s*(layout-ok|gate-ok)\b[ \t]*(.*)")
+_OP_NAME_RE = re.compile(r"^_?OP_")
+_ERR_NAME_RE = re.compile(r"^_?ERR_")
+_MAX_NAME_RE = re.compile(r"(^|_)MAX_")
+
+#: ops that may only be emitted on a connection whose HELLO negotiated
+#: the matching feature bit (docs/transport.md feature-bit table)
+GATED_OPS: Dict[int, str] = {
+    6: "FEATURE_RESUME",      # STREAM_ATTACH
+    7: "FEATURE_HEARTBEAT",
+    8: "FEATURE_SNAPSHOT",
+    9: "FEATURE_SUBSCRIBE",
+    10: "FEATURE_DELTA",      # DELTA push-frame kind
+}
+
+#: optional per-frame headers gated by a feature bit (matched by struct
+#: constant name suffix so client-side ``ws._TRACE_HDR`` resolves too)
+GATED_HEADERS: Dict[str, str] = {
+    "TRACE_HDR": "FEATURE_TRACE",
+    "DELTA_HDR": "FEATURE_DELTA",
+}
+
+#: evidence vocabulary per feature: an identifier (or string literal) in
+#: the emitting scope that names the negotiated state for this feature
+_FEATURE_KEYS: Dict[str, Tuple[str, ...]] = {
+    "FEATURE_RESUME": ("resume", "attach"),
+    "FEATURE_HEARTBEAT": ("heartbeat", "hb"),
+    "FEATURE_SNAPSHOT": ("snapshot", "snap"),
+    "FEATURE_SUBSCRIBE": ("subscribe", "sub"),
+    "FEATURE_TRACE": ("trace",),
+    "FEATURE_DELTA": ("delta",),
+}
+
+#: struct format chars wide enough that a lying peer can claim an
+#: allocation-breaking length (u16 ``H`` maxes out at 65535 and is
+#: treated as inherently bounded)
+_WIDE_LEN_CHARS = frozenset("iIlLqQnN")
+
+#: allocation-shaped sinks a wire-claimed length must not reach unguarded
+_ALLOC_SINKS = frozenset({"empty", "zeros", "bytearray", "_recv_exact",
+                          "recv"})
+
+#: exceptions whose raise marks a status branch as retriable handling
+_RETRIABLE_EXC = frozenset({"ConnectionError", "BrokenPipeError",
+                            "ConnectionResetError", "TimeoutError",
+                            "OSError", "RoundRolled",
+                            "SnapshotUnavailable", "DeltaDesync"})
+#: ... and as terminal handling (anything else is unclassified)
+_TERMINAL_EXC = frozenset({"RuntimeError", "ValueError", "TypeError",
+                           "PermissionError"})
+
+
+# --------------------------------------------------------------------------
+# model records
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class StructDef:
+    """One module-level ``NAME = struct.Struct(fmt)`` declaration."""
+
+    name: str
+    fmt: str
+    file: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class StructUse:
+    """One pack/unpack of a struct constant, op-attributed."""
+
+    struct: str
+    fmt: str
+    action: str               # "pack" | "unpack"
+    ops: Optional[Tuple[int, ...]]  # None = op-independent site
+    func: str                 # enclosing qualname ("Class.method")
+    file: str
+    line: int
+    header: bool = False      # this pack OPENED the op context (frame
+    #                           header); exempt from per-op balance
+
+
+@dataclasses.dataclass(frozen=True)
+class InlineSite:
+    """A hand-rolled ``struct.pack``/``struct.Struct`` inside a protocol
+    function — a layout outside the shared-constant discipline."""
+
+    fmt: Optional[str]
+    func: str
+    file: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class StatusSite:
+    """One emission of, or match against, a negative status constant."""
+
+    value: int
+    action: str               # "emit" | "match"
+    handling: Optional[str]   # match only: "retriable" | "terminal" | None
+    func: str
+    file: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class GateSite:
+    """One emission of a feature-gated op or optional header."""
+
+    feature: str
+    subject: str              # "op 8 (_OP_SNAPSHOT)" | "header _TRACE_HDR"
+    satisfied: bool
+    evidence: str             # what satisfied the gate (or "")
+    func: str
+    file: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BoundSite:
+    """A wire-claimed length flowing into an allocation-shaped sink."""
+
+    var: str
+    fmt_char: str
+    sink: str
+    guarded: bool
+    guard: str                # description of the guard (or "")
+    func: str
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
+class WireModel:
+    """The extracted protocol model (see module docstring)."""
+
+    structs: Dict[str, List[StructDef]] = dataclasses.field(
+        default_factory=dict)
+    uses: List[StructUse] = dataclasses.field(default_factory=list)
+    inline_sites: List[InlineSite] = dataclasses.field(default_factory=list)
+    status_sites: List[StatusSite] = dataclasses.field(default_factory=list)
+    gate_sites: List[GateSite] = dataclasses.field(default_factory=list)
+    bound_sites: List[BoundSite] = dataclasses.field(default_factory=list)
+    constants: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: legal status values (registry constants + WIRE_V2_CODES)
+    registry_values: Set[int] = dataclasses.field(default_factory=set)
+    #: the retriable subset of the registry
+    retriable_values: Set[int] = dataclasses.field(default_factory=set)
+    #: (file, line) -> (token, reason) for reasoned ``# bfwire:`` waivers
+    waivers: Dict[Tuple[str, int], Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+    #: (file, line) of every comment-only source line — lets a waiver
+    #: sit in a comment block directly above its site
+    comment_lines: Set[Tuple[str, int]] = dataclasses.field(
+        default_factory=set)
+    files: List[str] = dataclasses.field(default_factory=list)
+    parse_failures: List[str] = dataclasses.field(default_factory=list)
+
+    # ---------------------------------------------------------------- query
+    def op_buckets(self) -> Dict[int, Dict[str, Set[str]]]:
+        """Per-op ``{"pack": {struct...}, "unpack": {...}}`` buckets
+        (header-opening packs excluded — a header is by definition
+        unpacked once, pre-dispatch, for every op)."""
+        out: Dict[int, Dict[str, Set[str]]] = {}
+        for use in self.uses:
+            if use.header or use.ops is None:
+                continue
+            for op in use.ops:
+                b = out.setdefault(op, {"pack": set(), "unpack": set()})
+                b[use.action].add(use.struct)
+        return out
+
+    def opless_structs(self, action: str) -> Set[str]:
+        """Structs packed/unpacked at op-independent sites (the shared
+        ack/push loops) — the per-op balance check accepts these as the
+        opposite side of any op."""
+        return {u.struct for u in self.uses
+                if u.ops is None and u.action == action}
+
+    def waiver_at(self, file: str, line: int,
+                  token: str) -> Optional[str]:
+        """Reason of a matching reasoned waiver on the site line or in
+        the contiguous comment block directly above it, else None (a
+        bare token with no reason waives nothing)."""
+        at = line
+        while True:
+            got = self.waivers.get((file, at))
+            if got is not None:
+                if got[0] == token and got[1]:
+                    return got[1]
+                return None
+            if (file, at - 1) not in self.comment_lines:
+                return None
+            at -= 1
+
+    # --------------------------------------------------------------- report
+    def format_text(self) -> str:
+        lines = ["wire model: %d file(s), %d struct(s), %d use(s), "
+                 "%d status site(s), %d gate site(s), %d bound site(s)"
+                 % (len(self.files), len(self.structs), len(self.uses),
+                    len(self.status_sites), len(self.gate_sites),
+                    len(self.bound_sites))]
+        buckets = self.op_buckets()
+        for op in sorted(buckets):
+            b = buckets[op]
+            lines.append("  op %-2d  pack {%s}  unpack {%s}" % (
+                op, ", ".join(sorted(b["pack"])) or "-",
+                ", ".join(sorted(b["unpack"])) or "-"))
+        shared_p = self.opless_structs("pack")
+        shared_u = self.opless_structs("unpack")
+        if shared_p or shared_u:
+            lines.append("  shared pack {%s}  unpack {%s}" % (
+                ", ".join(sorted(shared_p)) or "-",
+                ", ".join(sorted(shared_u)) or "-"))
+        if self.parse_failures:
+            lines.append("  PARSE FAILURES: " +
+                         ", ".join(self.parse_failures))
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# format helpers
+# --------------------------------------------------------------------------
+
+def _fmt_chars(fmt: str) -> List[str]:
+    """Expand a struct format string into one char per unpacked value."""
+    out: List[str] = []
+    count = ""
+    for ch in fmt:
+        if ch in "<>=!@ ":
+            continue
+        if ch.isdigit():
+            count += ch
+            continue
+        n = int(count) if count else 1
+        count = ""
+        if ch == "x":
+            continue
+        if ch in "sp":
+            out.append(ch)          # one bytes value regardless of count
+        else:
+            out.extend(ch * n)
+    return out
+
+
+# --------------------------------------------------------------------------
+# per-module collection (phase A)
+# --------------------------------------------------------------------------
+
+class _Module:
+    def __init__(self, rel: str, text: str, tree: ast.Module):
+        self.rel = rel
+        self.text = text
+        self.tree = tree
+        self.struct_defs: Dict[str, StructDef] = {}
+        self.int_consts: Dict[str, int] = {}
+        self.aliases: Dict[str, str] = {}          # NAME -> bare attr/name
+        self.set_consts: Dict[str, List[ast.expr]] = {}
+        self.tuple_consts: Dict[str, List[ast.expr]] = {}
+
+
+def _is_struct_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Struct":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Struct"
+
+
+def _const_int(node: ast.expr) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_int(node.operand)
+        if inner is not None:
+            return -inner
+    return None
+
+
+def _collect_module(rel: str, text: str) -> Optional[_Module]:
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return None
+    mod = _Module(rel, text, tree)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        name, val = tgt.id, node.value
+        lit = _const_int(val)
+        if lit is not None:
+            mod.int_consts[name] = lit
+        elif isinstance(val, ast.Call) and _is_struct_ctor(val) \
+                and val.args and isinstance(val.args[0], ast.Constant) \
+                and isinstance(val.args[0].value, str):
+            mod.struct_defs[name] = StructDef(name, val.args[0].value,
+                                              rel, node.lineno)
+        elif isinstance(val, ast.Attribute):
+            mod.aliases[name] = val.attr
+        elif isinstance(val, ast.Name):
+            mod.aliases[name] = val.id
+        elif isinstance(val, ast.Call) and isinstance(val.func, ast.Name) \
+                and val.func.id in ("frozenset", "set", "tuple") \
+                and val.args \
+                and isinstance(val.args[0], (ast.Tuple, ast.Set, ast.List)):
+            mod.set_consts[name] = list(val.args[0].elts)
+        elif isinstance(val, ast.Tuple):
+            mod.tuple_consts[name] = list(val.elts)
+    return mod
+
+
+def _collect_waivers(rel: str, text: str,
+                     out: Dict[Tuple[str, int], Tuple[str, str]],
+                     comments: Set[Tuple[str, int]]) -> None:
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line.lstrip().startswith("#"):
+            comments.add((rel, i))
+        m = _WAIVER_RE.search(line)
+        if m:
+            out[(rel, i)] = (m.group(1), m.group(2).strip())
+
+
+# --------------------------------------------------------------------------
+# global resolution
+# --------------------------------------------------------------------------
+
+class _Resolver:
+    """Resolve names/attributes to ints, struct names, or set values
+    across the whole scan set (bare-name matching: ``ws._HDR`` and
+    ``_HDR`` are the same constant — wire names are globally unique)."""
+
+    def __init__(self, mods: Sequence[_Module]):
+        self.structs: Dict[str, StructDef] = {}
+        self.consts: Dict[str, int] = {}
+        aliases: Dict[str, str] = {}
+        self._set_exprs: Dict[str, List[ast.expr]] = {}
+        for m in mods:
+            self.structs.update(m.struct_defs)
+            self.consts.update(m.int_consts)
+            aliases.update(m.aliases)
+            self._set_exprs.update(m.set_consts)
+            self._set_exprs.update(m.tuple_consts)
+        for _ in range(len(aliases) + 1):        # alias-chain fixpoint
+            changed = False
+            for name, target in aliases.items():
+                if name not in self.consts and target in self.consts:
+                    self.consts[name] = self.consts[target]
+                    changed = True
+                if name not in self.structs and target in self.structs:
+                    self.structs[name] = self.structs[target]
+                    changed = True
+            if not changed:
+                break
+        self.set_values: Dict[str, Tuple[int, ...]] = {}
+        for name, elts in self._set_exprs.items():
+            vals = [self.resolve_int(e) for e in elts]
+            if vals and all(v is not None for v in vals):
+                self.set_values[name] = tuple(v for v in vals
+                                              if v is not None)
+
+    def _ref_name(self, node: ast.expr) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    def resolve_int(self, node: ast.expr) -> Optional[int]:
+        lit = _const_int(node)
+        if lit is not None:
+            return lit
+        name = self._ref_name(node)
+        return self.consts.get(name) if name else None
+
+    def resolve_int_name(self, node: ast.expr
+                         ) -> Optional[Tuple[str, int]]:
+        """Resolve a NAMED constant reference (never a bare literal)."""
+        name = self._ref_name(node)
+        if name is not None and name in self.consts:
+            return name, self.consts[name]
+        return None
+
+    def struct_of(self, node: ast.expr) -> Optional[StructDef]:
+        name = self._ref_name(node)
+        return self.structs.get(name) if name else None
+
+
+# --------------------------------------------------------------------------
+# registry extraction
+# --------------------------------------------------------------------------
+
+def _extract_registry(res: _Resolver, model: WireModel) -> None:
+    vals: Set[int] = set()
+    for name, v in res.consts.items():
+        if _ERR_NAME_RE.match(name):
+            vals.add(v)
+    for key in ("WIRE_V2_CODES",):
+        vals.update(res.set_values.get(key, ()))
+    retri = set(res.set_values.get("_RETRIABLE", ()))
+    if not vals:
+        # synthetic sources without a registry module: fall back to the
+        # live table so status checks still have ground truth
+        try:
+            from bluefog_tpu.runtime import wire_status as _wst
+            vals = set(_wst.WIRE_V2_CODES) | {_wst.ERR_GEOMETRY,
+                                              _wst.ERR_NO_WINDOW}
+            retri = {c for c in vals if _wst.is_retriable(c)}
+        except Exception:  # pragma: no cover - import cycle safety
+            pass
+    model.registry_values = vals
+    model.retriable_values = retri
+
+
+# --------------------------------------------------------------------------
+# function-body scan (phase B)
+# --------------------------------------------------------------------------
+
+def _calls_in_order(node: ast.AST) -> List[ast.Call]:
+    calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    return calls
+
+
+def _scope_idents(node: ast.AST) -> Set[str]:
+    """Every identifier-ish string in a scope (names, attributes, str
+    literals) — the haystack for feature-gate evidence."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def _gate_evidence(feature: str, idents: Set[str]) -> Optional[str]:
+    if feature in idents:
+        return feature
+    keys = _FEATURE_KEYS.get(feature, ())
+    for ident in idents:
+        low = ident.lower()
+        if any(k in low for k in keys) and (
+                "granted" in low or "want" in low or low.endswith("_on")):
+            return ident
+    return None
+
+
+class _FuncScan:
+    """Scan one function body: op-context tracking plus all site kinds."""
+
+    def __init__(self, res: _Resolver, model: WireModel, rel: str,
+                 qualname: str, scope_idents: Set[str]):
+        self.res = res
+        self.model = model
+        self.rel = rel
+        self.qualname = qualname
+        self.scope_idents = scope_idents
+        self.len_vars: Dict[str, str] = {}      # wire-claimed var -> char
+        self.guards: List[Tuple[int, str, str]] = []  # (line, var, desc)
+        self.pending_sinks: List[Tuple[ast.Call, str, str]] = []
+        self.calls_out: List[Tuple[str, Optional[Tuple[int, ...]]]] = []
+        self.uses_tmp: List[StructUse] = []
+
+    # ------------------------------------------------------------- helpers
+    def _record_use(self, sd: StructDef, action: str,
+                    ops: Optional[Tuple[int, ...]], line: int,
+                    header: bool = False) -> None:
+        self.uses_tmp.append(StructUse(sd.name, sd.fmt, action, ops,
+                                       self.qualname, self.rel, line,
+                                       header))
+
+    def _emit_status(self, node: ast.expr, line: int) -> None:
+        v = self.res.resolve_int(node)
+        if v is not None and v <= -2:
+            self.model.status_sites.append(StatusSite(
+                v, "emit", None, self.qualname, self.rel, line))
+
+    def _gate_site(self, feature: str, subject: str, line: int) -> None:
+        ev = _gate_evidence(feature, self.scope_idents)
+        self.model.gate_sites.append(GateSite(
+            feature, subject, ev is not None, ev or "",
+            self.qualname, self.rel, line))
+
+    def _header_struct_feature(self, struct_name: str) -> Optional[str]:
+        for suffix, feature in GATED_HEADERS.items():
+            if struct_name.endswith(suffix):
+                return feature
+        return None
+
+    # ------------------------------------------------------- call handling
+    def _handle_call(self, call: ast.Call,
+                     ctx: Optional[Tuple[int, ...]]
+                     ) -> Optional[Tuple[int, ...]]:
+        f = call.func
+        # a) struct constant pack/unpack
+        if isinstance(f, ast.Attribute) and f.attr in (
+                "pack", "pack_into", "unpack", "unpack_from"):
+            sd = self.res.struct_of(f.value)
+            if sd is not None:
+                action = "pack" if f.attr.startswith("pack") else "unpack"
+                if action == "pack":
+                    header_op = None
+                    for arg in call.args:
+                        named = self.res.resolve_int_name(arg)
+                        if named and _OP_NAME_RE.match(named[0]):
+                            header_op = named[1]
+                            break
+                    for arg in call.args:
+                        self._emit_status(arg, call.lineno)
+                    hfeat = self._header_struct_feature(sd.name)
+                    if hfeat is not None:
+                        self._gate_site(hfeat, "header %s" % sd.name,
+                                        call.lineno)
+                    if header_op is not None:
+                        self._record_use(sd, "pack", (header_op,),
+                                         call.lineno, header=True)
+                        if header_op in GATED_OPS and hfeat is None:
+                            self._gate_site(
+                                GATED_OPS[header_op],
+                                "op %d" % header_op, call.lineno)
+                        return (header_op,)
+                self._record_use(sd, action, ctx, call.lineno)
+                return ctx
+        # b) hand-rolled struct module use inside a function
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "struct" and f.attr in (
+                    "pack", "pack_into", "unpack", "unpack_from",
+                    "calcsize", "Struct"):
+            fmt = None
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str):
+                fmt = call.args[0].value
+            self.model.inline_sites.append(InlineSite(
+                fmt, self.qualname, self.rel, call.lineno))
+            return ctx
+        # c) status constants handed to ack/send helpers
+        callee = None
+        if isinstance(f, ast.Attribute):
+            callee = f.attr
+        elif isinstance(f, ast.Name):
+            callee = f.id
+        if callee is not None:
+            low = callee.lower()
+            if "ack" in low or "send" in low or "status" in low:
+                for arg in call.args:
+                    self._emit_status(arg, call.lineno)
+            # allocation-shaped sinks fed by wire-claimed lengths
+            if callee in _ALLOC_SINKS:
+                for n in ast.walk(call):
+                    if isinstance(n, ast.Name) and n.id in self.len_vars:
+                        self.pending_sinks.append((call, n.id, callee))
+                        break
+            # min()-capping counts as an inline guard
+            if callee == "min" and len(call.args) >= 2:
+                for n in ast.walk(call):
+                    if isinstance(n, ast.Name) and n.id in self.len_vars:
+                        self.guards.append((call.lineno, n.id,
+                                            "min() cap"))
+            self.calls_out.append((callee, ctx))
+        return ctx
+
+    # -------------------------------------------------- statement handling
+    def _branch_ops(self, test: ast.expr) -> Optional[Tuple[int, ...]]:
+        comps = [n for n in ast.walk(test) if isinstance(n, ast.Compare)]
+        for cmp_ in comps:
+            if len(cmp_.ops) != 1:
+                continue
+            op_node, rhs = cmp_.ops[0], cmp_.comparators[0]
+            if isinstance(op_node, ast.Eq):
+                for side in (cmp_.left, rhs):
+                    named = self.res.resolve_int_name(side)
+                    if named and _OP_NAME_RE.match(named[0]):
+                        return (named[1],)
+            elif isinstance(op_node, ast.In):
+                name = None
+                if isinstance(rhs, ast.Name):
+                    name = rhs.id
+                elif isinstance(rhs, ast.Attribute):
+                    name = rhs.attr
+                if name and name in self.res.set_values:
+                    return self.res.set_values[name]
+                if isinstance(rhs, (ast.Tuple, ast.Set)):
+                    vals = []
+                    for e in rhs.elts:
+                        named = self.res.resolve_int_name(e)
+                        if not (named and _OP_NAME_RE.match(named[0])):
+                            vals = []
+                            break
+                        vals.append(named[1])
+                    if vals:
+                        return tuple(vals)
+        return None
+
+    def _match_handling(self, body: List[ast.stmt]) -> Optional[str]:
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Raise) or n.exc is None:
+                    continue
+                exc = n.exc
+                name = None
+                if isinstance(exc, ast.Call):
+                    exc = exc.func
+                if isinstance(exc, ast.Name):
+                    name = exc.id
+                elif isinstance(exc, ast.Attribute):
+                    name = exc.attr
+                if name in _RETRIABLE_EXC:
+                    return "retriable"
+                if name in _TERMINAL_EXC:
+                    return "terminal"
+        return None
+
+    def _status_matches(self, test: ast.expr, body: List[ast.stmt],
+                        line: int) -> None:
+        for cmp_ in (n for n in ast.walk(test)
+                     if isinstance(n, ast.Compare)):
+            if len(cmp_.ops) != 1:
+                continue
+            vals: List[int] = []
+            if isinstance(cmp_.ops[0], (ast.Eq, ast.NotEq)):
+                for side in (cmp_.left, cmp_.comparators[0]):
+                    named = self.res.resolve_int_name(side)
+                    if named and _ERR_NAME_RE.match(named[0]):
+                        vals.append(named[1])
+            elif isinstance(cmp_.ops[0], ast.In) and isinstance(
+                    cmp_.comparators[0], (ast.Tuple, ast.Set)):
+                for e in cmp_.comparators[0].elts:
+                    named = self.res.resolve_int_name(e)
+                    if named and _ERR_NAME_RE.match(named[0]):
+                        vals.append(named[1])
+            handling = self._match_handling(body) if vals else None
+            for v in vals:
+                self.model.status_sites.append(StatusSite(
+                    v, "match", handling, self.qualname, self.rel,
+                    cmp_.lineno if hasattr(cmp_, "lineno") else line))
+
+    def _note_unpack_targets(self, stmt: ast.Assign) -> None:
+        call = stmt.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("unpack", "unpack_from")):
+            return
+        sd = self.res.struct_of(call.func.value)
+        if sd is None or len(stmt.targets) != 1:
+            return
+        tgt = stmt.targets[0]
+        names: List[Optional[str]] = []
+        if isinstance(tgt, ast.Tuple):
+            names = [e.id if isinstance(e, ast.Name) else None
+                     for e in tgt.elts]
+        elif isinstance(tgt, ast.Name):
+            names = [tgt.id]
+        for name, ch in zip(names, _fmt_chars(sd.fmt)):
+            if name is not None and ch in _WIDE_LEN_CHARS:
+                self.len_vars[name] = ch
+
+    def _note_guards(self, stmt: ast.stmt) -> None:
+        for cmp_ in (n for n in ast.walk(stmt)
+                     if isinstance(n, ast.Compare)):
+            vars_here = {n.id for n in ast.walk(cmp_)
+                         if isinstance(n, ast.Name)
+                         and n.id in self.len_vars}
+            if not vars_here:
+                continue
+            desc = None
+            for n in ast.walk(cmp_):
+                if isinstance(n, ast.Call):
+                    cname = (n.func.attr if isinstance(n.func,
+                                                       ast.Attribute)
+                             else n.func.id if isinstance(n.func,
+                                                          ast.Name)
+                             else "")
+                    if "bound" in cname:
+                        desc = "%s()" % cname
+                        break
+                if isinstance(n, (ast.Name, ast.Attribute)):
+                    ident = n.id if isinstance(n, ast.Name) else n.attr
+                    if _MAX_NAME_RE.search(ident):
+                        desc = ident
+                        break
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, int) \
+                        and not isinstance(n.value, bool) \
+                        and n.value > 0:
+                    desc = "literal %d" % n.value
+            if desc is None:
+                # a bound carried by a non-wire variable — e.g. the
+                # reply length checked against the REQUEST's own
+                # n_elems: any direct operand that is a bare name not
+                # itself unpacked from the wire
+                for n in [cmp_.left, *cmp_.comparators]:
+                    if isinstance(n, ast.Name) \
+                            and n.id not in self.len_vars:
+                        desc = "vs %s" % n.id
+                        break
+            if desc:
+                for var in vars_here:
+                    self.guards.append((cmp_.lineno, var, desc))
+
+    def scan_body(self, body: List[ast.stmt],
+                  ctx: Optional[Tuple[int, ...]]) -> None:
+        cur = ctx
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                self._note_unpack_targets(stmt)
+            self._note_guards(stmt)
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                v = self.res.resolve_int(stmt.value)
+                if v is not None and v <= -2:
+                    self.model.status_sites.append(StatusSite(
+                        v, "emit", None, self.qualname, self.rel,
+                        stmt.lineno))
+            if isinstance(stmt, ast.If):
+                self._status_matches(stmt.test, stmt.body, stmt.lineno)
+                for call in _calls_in_order(stmt.test):
+                    cur = self._handle_call(call, cur)
+                branch = self._branch_ops(stmt.test)
+                self.scan_body(stmt.body, branch if branch else cur)
+                self.scan_body(stmt.orelse, cur)
+            elif isinstance(stmt, (ast.For, ast.While)):
+                for call in _calls_in_order(stmt.iter if isinstance(
+                        stmt, ast.For) else stmt.test):
+                    cur = self._handle_call(call, cur)
+                self.scan_body(stmt.body, cur)
+                self.scan_body(stmt.orelse, cur)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    for call in _calls_in_order(item.context_expr):
+                        cur = self._handle_call(call, cur)
+                self.scan_body(stmt.body, cur)
+            elif isinstance(stmt, ast.Try):
+                self.scan_body(stmt.body, cur)
+                for h in stmt.handlers:
+                    self.scan_body(h.body, cur)
+                self.scan_body(stmt.orelse, cur)
+                self.scan_body(stmt.finalbody, cur)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                continue        # nested defs scanned separately
+            else:
+                for call in _calls_in_order(stmt):
+                    cur = self._handle_call(call, cur)
+
+    def finish(self) -> None:
+        for call, var, sink in self.pending_sinks:
+            hit = [g for g in self.guards
+                   if g[1] == var and g[0] <= call.lineno]
+            self.model.bound_sites.append(BoundSite(
+                var, self.len_vars[var], sink, bool(hit),
+                hit[0][2] if hit else "", self.qualname, self.rel,
+                call.lineno))
+
+
+# --------------------------------------------------------------------------
+# assembly
+# --------------------------------------------------------------------------
+
+def _iter_functions(tree: ast.Module):
+    """Yield (qualname, func_node, scope_node) for every function; the
+    scope node (class body for methods, the function itself otherwise)
+    is where feature-gate evidence is searched."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield "%s.%s" % (node.name, sub.name), sub, node
+
+
+def build_model(sources: Sequence[Tuple[str, str]]) -> WireModel:
+    """Extract the wire model from ``(relpath, source_text)`` pairs."""
+    model = WireModel()
+    mods: List[_Module] = []
+    for rel, text in sources:
+        model.files.append(rel)
+        _collect_waivers(rel, text, model.waivers, model.comment_lines)
+        mod = _collect_module(rel, text)
+        if mod is None:
+            model.parse_failures.append(rel)
+            continue
+        mods.append(mod)
+    res = _Resolver(mods)
+    model.constants = dict(res.consts)
+    for name, sd in res.structs.items():
+        if name == sd.name:         # skip alias entries
+            model.structs.setdefault(name, [])
+            if sd not in model.structs[name]:
+                model.structs[name].append(sd)
+    # same-named struct constants DEFINED in two modules (not aliases)
+    for m in mods:
+        for name, sd in m.struct_defs.items():
+            lst = model.structs.setdefault(name, [])
+            if sd not in lst:
+                lst.append(sd)
+    _extract_registry(res, model)
+
+    scans: Dict[str, _FuncScan] = {}
+    callgraph: Dict[str, List[Tuple[str, Optional[Tuple[int, ...]]]]] = {}
+    for m in mods:
+        for qual, fn, scope in _iter_functions(m.tree):
+            idents = _scope_idents(scope)
+            scan = _FuncScan(res, model, m.rel, qual, idents)
+            scan.scan_body(fn.body, None)
+            scans["%s:%s" % (m.rel, qual)] = scan
+            callgraph["%s:%s" % (m.rel, qual)] = scan.calls_out
+
+    # op-entry fixpoint: a helper inherits the union of the op contexts
+    # at its call sites (one-level-plus: contexts flow transitively)
+    by_bare: Dict[str, List[str]] = {}
+    for key in scans:
+        by_bare.setdefault(
+            key.rsplit(":", 1)[1].rsplit(".", 1)[-1], []).append(key)
+    entry: Dict[str, Set[int]] = {key: set() for key in scans}
+    for _ in range(len(scans)):
+        changed = False
+        for key, calls in callgraph.items():
+            for callee, ctx in calls:
+                ops = set(ctx) if ctx else entry[key]
+                if not ops:
+                    continue
+                for tgt in by_bare.get(callee, ()):
+                    if not ops <= entry[tgt]:
+                        entry[tgt] |= ops
+                        changed = True
+        if not changed:
+            break
+
+    for key, scan in scans.items():
+        inherited = tuple(sorted(entry[key])) or None
+        for use in scan.uses_tmp:
+            if use.ops is None and inherited is not None:
+                use = dataclasses.replace(use, ops=inherited)
+            model.uses.append(use)
+        scan.finish()
+    return model
+
+
+def package_root() -> str:
+    """The ``bluefog_tpu`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_package_model(root: Optional[str] = None) -> WireModel:
+    """Extract the model from the repo's protocol surface
+    (:data:`PROTOCOL_FILES`)."""
+    root = root or package_root()
+    sources: List[Tuple[str, str]] = []
+    for rel in PROTOCOL_FILES:
+        path = os.path.join(root, *rel.split("/"))
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                sources.append((rel, fh.read()))
+        except OSError:
+            continue
+    return build_model(sources)

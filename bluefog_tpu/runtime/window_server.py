@@ -222,6 +222,12 @@ _SUB_KEEPALIVE_S = 1.0
 # bounds a SNAPSHOT request can claim before any allocation happens
 _MAX_SNAP_LEAVES = 4096
 _MAX_LEAF_NAME = 4096
+# largest dense payload a single snapshot/push leaf header may claim
+# before the READER allocates (the deposit path's BF-WIRE004
+# discipline, applied to the reply direction): real leaves are
+# per-window shards far below this; a lying header must never choose
+# the reader's allocation size
+_MAX_LEAF_BYTES = 1 << 31
 
 _FLAG_ACCUMULATE = 1
 _FLAG_DEFERRED_ACK = 2
@@ -629,11 +635,19 @@ def _leaf_views(leaves: List[Tuple[str, np.ndarray]]) -> List:
 def _recv_leaves(sock: socket.socket, count: int) -> Dict[str, np.ndarray]:
     """Decode ``count`` leaf entries (the :func:`_leaf_views` wire
     twin): the ONE reader for SNAPSHOT replies and subscription push
-    frames, so the two clients cannot drift apart on the leaf format."""
+    frames, so the two clients cannot drift apart on the leaf format.
+    Claimed lengths are bounded BEFORE any allocation (BF-WIRE004); a
+    malformed header raises ``ValueError``, which both clients treat
+    as a dead connection."""
     leaves: Dict[str, np.ndarray] = {}
     for _ in range(count):
         name_len, dtype_id, n_elems = _SNAP_LEAF.unpack(
             _recv_exact(sock, _SNAP_LEAF.size))
+        if (dtype_id not in _DTYPES or n_elems < 0
+                or name_len > _MAX_LEAF_NAME
+                or n_elems * _DTYPES[dtype_id].itemsize
+                > _MAX_LEAF_BYTES):
+            raise ValueError("snapshot leaf header out of bounds")
         name = _recv_exact(sock, name_len).decode("utf-8", "replace")
         out = np.empty(n_elems, _DTYPES[dtype_id])
         _recv_into(sock, memoryview(out).cast("B"))
@@ -1356,6 +1370,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     self._deferred_applied = 0
                     _bb.record("tcp_flush", peer=self.client_address[0],
                                status=rc)
+                    # bfwire: layout-ok no in-repo decoder for the op-5 reply
+                    # (wire FLUSH is a bare status round-trip only the
+                    # transport tests drive; the production stream
+                    # fences on batch ACKs instead)
                     self._send(_STATUS.pack(rc))
                     continue
                 name = self._recv_name(sock, name_len)
@@ -1797,6 +1815,13 @@ class RemoteWindow:
             return rc, None
         dtype, got = _SELF_HDR.unpack(
             _recv_exact(self._sock, _SELF_HDR.size))
+        # the reply's claimed geometry is bounded by the REQUEST's own
+        # n_elems before anything is allocated (BF-WIRE004): a lying
+        # owner must not choose this client's allocation size
+        if dtype not in _DTYPES or got < 0 or got > n_elems:
+            raise ConnectionError(
+                f"reply header out of bounds (dtype id {dtype}, "
+                f"{got} elems vs {n_elems} requested)")
         # single-allocation receive: the destination array IS the receive
         # buffer (no intermediate bytes + frombuffer().copy())
         out = np.empty(got, _DTYPES[dtype])

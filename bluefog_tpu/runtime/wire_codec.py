@@ -132,6 +132,10 @@ def decode(codec: int, payload: memoryview, n_elems: int,
     if codec == CODEC_TOPK:
         if len(payload) < _TOPK_HDR.size:
             raise ValueError("topk payload too short")
+        # bfwire: layout-ok codec payload headers are op-agnostic
+        # (encode/decode live in this module; the codec twin tests pin
+        # their symmetry, so op contexts inherited from callers can
+        # never represent a one-sided frame)
         (k,) = _TOPK_HDR.unpack_from(payload, 0)
         if k < 0 or k > n_elems or len(payload) != _TOPK_HDR.size + k * 8:
             raise ValueError("topk payload geometry mismatch")

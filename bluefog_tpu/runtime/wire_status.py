@@ -105,8 +105,14 @@ WIRE_V2_CODES = (ERR_BAD_OP, ERR_VERSION, ERR_CODEC, ERR_TOO_LARGE,
                  ERR_NO_SNAPSHOT, ERR_DELTA_BASE, ERR_RELAY_LOOP)
 
 # codes the doc may mention as explicitly-unassigned gaps (the doc lint
-# accepts these without requiring a registry constant)
-UNASSIGNED_CODES = (-103,)
+# accepts these without requiring a registry constant).  DERIVED from
+# the registry — every gap in the contiguous v2 range is by definition
+# unassigned — so adding a code can never leave this tuple stale; the
+# BF-WIRE002 check (analysis/protocol_check.py) asserts the derivation
+# holds on the live module.
+UNASSIGNED_CODES = tuple(
+    c for c in range(max(WIRE_V2_CODES), min(WIRE_V2_CODES) - 1, -1)
+    if c not in WIRE_V2_CODES)
 
 # codes a client may retry without changing anything (vs. terminal
 # protocol rejections, where retrying only relabels the real error)

@@ -440,9 +440,10 @@ class TestLintCli:
 
     # pre-existing heavyweight (a fresh interpreter + the full
     # no-trace sweep): ~20s under full-suite load, and each new lint
-    # pass (11 now) legitimately extends it — load-bearing tier-1
-    # coverage, so a reviewed override instead of slow-marking
-    @pytest.mark.duration_budget(45)
+    # pass (13 now, protocol pass included) legitimately extends it —
+    # load-bearing tier-1 coverage, so a reviewed override instead of
+    # slow-marking
+    @pytest.mark.duration_budget(60)
     def test_cli_exits_zero(self):
         # the tier-1/CI hook: the module CLI itself (subprocess, fresh
         # interpreter) must exit 0 on the repo as committed.  --no-trace
@@ -1929,3 +1930,306 @@ class TestSimLint:
                 errs += [d for d in check_file(path)
                          if d.severity == "error"]
         assert not errs, [d.format() for d in errs]
+
+
+# ---------------------------------------------------------------------------
+# Pass 13: wire-protocol verifier (bfwire-tpu)
+# ---------------------------------------------------------------------------
+
+
+class TestWireLint:
+    """BF-WIRE001..004 on synthetic sources (one seeded + one clean per
+    code), the waiver grammar, the registry staleness satellite, and
+    the repo-clean sweep.  The state-machine layer (BF-WIRE005) has its
+    own conformance suite in tests/test_wire_verify.py."""
+
+    @staticmethod
+    def _check(*sources):
+        from bluefog_tpu.analysis.protocol_check import check_sources
+
+        return check_sources(list(sources))
+
+    # ------------------------------------------------ BF-WIRE001 (layout)
+    def test_conflicting_struct_formats_caught(self):
+        _, diags = self._check(
+            ("a.py", "import struct\n_FRAME = struct.Struct('<Iq')\n"),
+            ("b.py", "import struct\n_FRAME = struct.Struct('<IqB')\n"))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE001"]
+        assert errs and "CONFLICTING" in errs[0].message, \
+            [d.format() for d in diags]
+
+    def test_packed_never_unpacked_caught(self):
+        _, diags = self._check(("a.py", (
+            "import struct\n"
+            "_ONLY = struct.Struct('<q')\n"
+            "def emit(sock, n):\n"
+            "    sock.sendall(_ONLY.pack(n))\n")))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE001"]
+        assert errs and "no protocol module ever unpacks" in \
+            errs[0].message, [d.format() for d in diags]
+
+    def test_inline_struct_call_caught(self):
+        _, diags = self._check(("a.py", (
+            "import struct\n"
+            "def emit(sock, n):\n"
+            "    sock.sendall(struct.pack('<q', n))\n")))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE001"]
+        assert errs and "hand-rolled" in errs[0].message
+
+    def test_per_op_imbalance_caught(self):
+        # op 0 packs _REQ; the decode side unpacks it only under op 1 —
+        # the other side of the frame drifted to a different dispatch
+        _, diags = self._check(("a.py", (
+            "import struct\n"
+            "_MAGIC = 7\n"
+            "_OP_A = 0\n"
+            "_OP_B = 1\n"
+            "_HDR = struct.Struct('<IBH')\n"
+            "_REQ = struct.Struct('<q')\n"
+            "def send(sock, n):\n"
+            "    sock.sendall(_HDR.pack(_MAGIC, _OP_A, 0)"
+            " + _REQ.pack(n))\n"
+            "def handle(sock, op, payload):\n"
+            "    magic, op, nl = _HDR.unpack(payload)\n"
+            "    if op == _OP_B:\n"
+            "        (x,) = _REQ.unpack(payload)\n")))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE001"]
+        assert any("op 0 packs struct _REQ" in d.message for d in errs), \
+            [d.format() for d in diags]
+
+    def test_balanced_ops_clean(self):
+        _, diags = self._check(("a.py", (
+            "import struct\n"
+            "_MAGIC = 7\n"
+            "_OP_A = 0\n"
+            "_HDR = struct.Struct('<IBH')\n"
+            "_REQ = struct.Struct('<q')\n"
+            "def send(sock, n):\n"
+            "    sock.sendall(_HDR.pack(_MAGIC, _OP_A, 0)"
+            " + _REQ.pack(n))\n"
+            "def handle(sock, op, payload):\n"
+            "    magic, op, nl = _HDR.unpack(payload)\n"
+            "    if op == _OP_A:\n"
+            "        (x,) = _REQ.unpack(payload)\n")))
+        assert not [d for d in _errors(diags) if d.code == "BF-WIRE001"]
+
+    # ------------------------------------------------------ waiver grammar
+    def test_reasoned_waiver_downgrades_to_info(self):
+        _, diags = self._check(("a.py", (
+            "import struct\n"
+            "# bfwire: layout-ok decoder lives in the relay binary\n"
+            "_ONLY = struct.Struct('<q')\n"
+            "def emit(sock, n):\n"
+            "    sock.sendall(_ONLY.pack(n))\n")))
+        assert not [d for d in _errors(diags) if d.code == "BF-WIRE001"]
+        infos = [d for d in diags if d.code == "BF-WIRE001W"]
+        assert infos and "relay binary" in infos[0].message
+
+    def test_bare_waiver_token_waives_nothing(self):
+        _, diags = self._check(("a.py", (
+            "import struct\n"
+            "# bfwire: layout-ok\n"
+            "_ONLY = struct.Struct('<q')\n"
+            "def emit(sock, n):\n"
+            "    sock.sendall(_ONLY.pack(n))\n")))
+        assert [d for d in _errors(diags) if d.code == "BF-WIRE001"]
+
+    # ------------------------------------------------ BF-WIRE002 (status)
+    def test_unregistered_status_literal_caught(self):
+        # no registry in the synthetic source: the live wire_status
+        # table is the fallback ground truth, and -142 is not in it
+        _, diags = self._check(("a.py", (
+            "def reply(self, sock):\n"
+            "    self._send_status(-142)\n")))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE002"]
+        assert errs and "-142" in errs[0].message
+
+    def test_registered_status_emit_clean(self):
+        _, diags = self._check(("a.py", (
+            "def reply(self, sock):\n"
+            "    self._send_status(-106)\n")))
+        assert not [d for d in _errors(diags) if d.code == "BF-WIRE002"]
+
+    def test_retriable_code_raised_terminal_caught(self):
+        _, diags = self._check(("a.py", (
+            "_ERR_BUSY = -106\n"
+            "_RETRIABLE = frozenset({_ERR_BUSY})\n"
+            "def check(rc):\n"
+            "    if rc == _ERR_BUSY:\n"
+            "        raise RuntimeError('busy')\n")))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE002"]
+        assert errs and "RETRIABLE per wire_status" in errs[0].message
+
+    def test_terminal_code_raised_retriable_caught(self):
+        _, diags = self._check(("a.py", (
+            "_ERR_GONE = -105\n"
+            "def check(rc):\n"
+            "    if rc == _ERR_GONE:\n"
+            "        raise ConnectionError('retry?')\n")))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE002"]
+        assert errs and "TERMINAL per wire_status" in errs[0].message
+
+    def test_matching_handling_clean(self):
+        _, diags = self._check(("a.py", (
+            "_ERR_BUSY = -106\n"
+            "_RETRIABLE = frozenset({_ERR_BUSY})\n"
+            "def check(rc):\n"
+            "    if rc == _ERR_BUSY:\n"
+            "        raise ConnectionError('backing off')\n")))
+        assert not [d for d in _errors(diags) if d.code == "BF-WIRE002"]
+
+    def test_stale_unassigned_codes_caught(self):
+        from bluefog_tpu.analysis.protocol_check import check_registry
+
+        diags = check_registry(codes=(-100, -101, -104), unassigned=())
+        assert diags and diags[0].code == "BF-WIRE002"
+        assert "-102" in diags[0].message and "-103" in diags[0].message
+        # the live registry's gap list is generated, hence never stale
+        assert not check_registry()
+
+    # ------------------------------------------------- BF-WIRE003 (gates)
+    _GATE_PRELUDE = ("import struct\n"
+                     "_MAGIC = 7\n"
+                     "_OP_STREAM_ATTACH = 6\n"
+                     "_HDR = struct.Struct('<IBH')\n")
+
+    def test_ungated_feature_op_caught(self):
+        _, diags = self._check(("a.py", self._GATE_PRELUDE + (
+            "def attach(sock):\n"
+            "    sock.sendall(_HDR.pack(_MAGIC, _OP_STREAM_ATTACH, 0))\n"
+        )))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE003"]
+        assert errs and "FEATURE_RESUME" in errs[0].message
+
+    def test_gate_evidence_in_scope_clean(self):
+        _, diags = self._check(("a.py", self._GATE_PRELUDE + (
+            "def attach(sock, granted):\n"
+            "    if granted & FEATURE_RESUME:\n"
+            "        sock.sendall(_HDR.pack(_MAGIC,"
+            " _OP_STREAM_ATTACH, 0))\n")))
+        assert not [d for d in _errors(diags) if d.code == "BF-WIRE003"]
+
+    def test_gate_ok_waiver_downgrades_to_info(self):
+        _, diags = self._check(("a.py", self._GATE_PRELUDE + (
+            "def attach(sock):\n"
+            "    # bfwire: gate-ok caller negotiated the bit\n"
+            "    sock.sendall(_HDR.pack(_MAGIC, _OP_STREAM_ATTACH, 0))\n"
+        )))
+        assert not [d for d in _errors(diags) if d.code == "BF-WIRE003"]
+        assert any(d.code == "BF-WIRE003W" for d in diags)
+
+    # ------------------------------------------------ BF-WIRE004 (bounds)
+    _BOUND_PRELUDE = ("import struct\n"
+                      "import numpy as np\n"
+                      "_MAX_BLOB = 1024\n"
+                      "_CNT = struct.Struct('<q')\n"
+                      "def send(sock, n):\n"
+                      "    sock.sendall(_CNT.pack(n))\n")
+
+    def test_unguarded_wire_length_caught(self):
+        _, diags = self._check(("a.py", self._BOUND_PRELUDE + (
+            "def read(sock):\n"
+            "    (n,) = _CNT.unpack(_recv_exact(sock, 8))\n"
+            "    return np.empty(n)\n")))
+        errs = [d for d in _errors(diags) if d.code == "BF-WIRE004"]
+        assert errs and "'n'" in errs[0].message and \
+            "np" not in errs[0].subject
+
+    def test_bounded_wire_length_clean(self):
+        _, diags = self._check(("a.py", self._BOUND_PRELUDE + (
+            "def read(sock):\n"
+            "    (n,) = _CNT.unpack(_recv_exact(sock, 8))\n"
+            "    if n < 0 or n > _MAX_BLOB:\n"
+            "        raise ValueError('bad frame')\n"
+            "    return np.empty(n)\n")))
+        assert not [d for d in _errors(diags) if d.code == "BF-WIRE004"]
+
+    # --------------------------------------------------------- repo sweep
+    def test_repo_protocol_surface_is_clean(self):
+        from bluefog_tpu.analysis.protocol_check import check_package
+
+        model, diags = check_package()
+        assert not _errors(diags), [d.format() for d in _errors(diags)]
+        assert any(d.code == "BF-WIRE100" for d in diags)
+        assert any(d.code == "BF-WIRE101" for d in diags)
+        # the triaged waivers surface as infos, never silently
+        assert any(d.code == "BF-WIRE001W" for d in diags)
+        # the model actually covers the protocol surface
+        assert len(model.files) == 7
+        assert model.structs and model.uses and model.status_sites
+
+    def test_cli_exits_zero_on_repo(self):
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "bluefog_tpu.analysis.protocol_check", "--verbose"],
+            capture_output=True, text=True, timeout=300,
+            cwd=REPO, env=clean_env())
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "bfwire: OK" in proc.stdout
+        assert "deposit-stream:" in proc.stdout  # state counts reported
+
+
+class TestFeatureDocLint:
+    """BF-DOC003: the transport doc's HELLO feature-bit paragraph <->
+    the live FEATURE_* constants, both directions with value
+    agreement."""
+
+    @staticmethod
+    def _live_bits():
+        from bluefog_tpu.runtime import window_server as ws
+
+        return {n[len("FEATURE_"):]: v for n, v in vars(ws).items()
+                if n.startswith("FEATURE_") and isinstance(v, int)}
+
+    @staticmethod
+    def _doc(tmp_path, pairs):
+        doc = tmp_path / "transport.md"
+        doc.write_text("HELLO feature bits: " + ", ".join(
+            "%d `%s`" % (v, n) for n, v in pairs) + ".\n")
+        return str(doc)
+
+    def test_repo_feature_doc_matches_live_bits(self):
+        from bluefog_tpu.analysis.doc_lint import check_feature_doc
+
+        diags = check_feature_doc()
+        assert not _errors(diags), [d.format() for d in diags]
+        assert any(d.code == "BF-DOC102" for d in diags)
+
+    def test_missing_bit_is_error(self, tmp_path):
+        from bluefog_tpu.analysis.doc_lint import check_feature_doc
+
+        live = self._live_bits()
+        path = self._doc(tmp_path, [(n, v) for n, v in live.items()
+                                    if n != "DELTA"])
+        errs = [d for d in _errors(check_feature_doc(path))
+                if d.code == "BF-DOC003"]
+        assert len(errs) == 1 and "FEATURE_DELTA" in errs[0].message
+
+    def test_wrong_value_is_error(self, tmp_path):
+        from bluefog_tpu.analysis.doc_lint import check_feature_doc
+
+        live = self._live_bits()
+        path = self._doc(tmp_path,
+                         [(n, 999 if n == "TRACE" else v)
+                          for n, v in live.items()])
+        errs = [d for d in _errors(check_feature_doc(path))
+                if d.code == "BF-DOC003"]
+        assert len(errs) == 1 and "999" in errs[0].message
+
+    def test_stale_doc_entry_is_error(self, tmp_path):
+        from bluefog_tpu.analysis.doc_lint import check_feature_doc
+
+        pairs = list(self._live_bits().items()) + [("WORMHOLE", 4096)]
+        path = self._doc(tmp_path, pairs)
+        errs = [d for d in _errors(check_feature_doc(path))
+                if d.code == "BF-DOC003"]
+        assert len(errs) == 1 and "WORMHOLE" in errs[0].message
+
+    def test_missing_paragraph_is_error(self, tmp_path):
+        from bluefog_tpu.analysis.doc_lint import check_feature_doc
+
+        doc = tmp_path / "transport.md"
+        doc.write_text("no feature bit paragraph here\n")
+        errs = [d for d in _errors(check_feature_doc(str(doc)))
+                if d.code == "BF-DOC003"]
+        assert errs and "paragraph" in errs[0].message

@@ -1,0 +1,458 @@
+"""bfwire-tpu's teeth, pinned to reality.
+
+Three layers of evidence that the Pass-13 model checker
+(`analysis/statemodel.py`) proves something about the SHIPPED wire
+code rather than about a convenient abstraction:
+
+- **exhaustiveness + seeded violations** — the three healthy machines
+  explore to a fixpoint with zero violations and zero stuck states,
+  while every ``bug=`` variant (one per historical defect shape) is
+  caught with a minimized trace that replays, is 1-minimal, and ends
+  in the claimed invariant; ``reorder=True`` proves the FIFO (TCP)
+  transport assumption is load-bearing.
+- **model <-> live-code conformance** — a modeled healthy path drives
+  the real :class:`DeltaEncoder`/:class:`DeltaApplier` in lockstep
+  (kind, base and cursor agree at every step), and the modeled sender
+  defect (stale encoder across reconnect) makes the live applier raise
+  :class:`DeltaDesync` exactly where the model's base check refuses.
+- **trace -> live scenario** — the minimized ``advance_on_torn``
+  violation is replayed against a REAL ``WindowServer`` + ``Subscriber``
+  through a byte-counting proxy that tears the first push frame
+  mid-leaf: the live cursor must NOT advance (the healthy discipline
+  the seeded model broke) and the torn round is re-delivered exactly
+  once after resume.
+
+Plus regression coverage for the two BF-WIRE004 findings the first
+sweep surfaced: wire-claimed lengths are bounded BEFORE allocation in
+``_recv_leaves`` and ``RemoteWindow._roundtrip``.
+"""
+
+import re
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.analysis import statemodel as sm
+from tests._util import uniq as _uniq
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolated():
+    from bluefog_tpu import chaos
+
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# exhaustive exploration of the healthy machines
+# ---------------------------------------------------------------------------
+
+
+class TestExhaustiveExploration:
+    def test_healthy_machines_explore_clean_to_fixpoint(self):
+        results = sm.check_all()
+        assert [r.machine for r in results] == [
+            "deposit-stream", "subscriber", "delta"]
+        for r in results:
+            assert r.complete, f"{r.machine} hit the state bound"
+            assert not r.violations, r.format()
+            assert not r.stuck, r.format()
+            assert r.ok
+            # exhaustive means the whole interleaving space, not a
+            # sampled corner: every machine has a real diameter and
+            # many distinct recovery paths to acceptance
+            assert r.states >= 100
+            assert r.transitions > r.states
+            assert r.depth >= 9
+            assert r.accepting >= 5
+
+    def test_exploration_is_deterministic(self):
+        a, b = sm.check_all(), sm.check_all()
+        for ra, rb in zip(a, b):
+            assert (ra.states, ra.transitions, ra.depth, ra.accepting) \
+                == (rb.states, rb.transitions, rb.depth, rb.accepting)
+
+    def test_state_bound_reported_not_swallowed(self):
+        res = sm.explore(sm.DepositStreamMachine(), max_states=20)
+        assert not res.complete
+        assert not res.ok
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: the checker's teeth are themselves regression-tested
+# ---------------------------------------------------------------------------
+
+
+def _caught(machine, invariant):
+    """Explore a seeded machine; assert the invariant is caught with a
+    trace that replays, violates, and is 1-minimal; return it."""
+    res = sm.explore(machine)
+    assert res.complete
+    v = next((v for v in res.violations if v.invariant == invariant),
+             None)
+    assert v is not None, (
+        f"{machine.name} did not violate {invariant}: {res.format()}")
+
+    def violates(labels):
+        seq = sm.replay(machine, labels)
+        return seq is not None and any(
+            machine.invariant(s) == invariant for s in seq)
+
+    assert violates(v.trace), "minimized trace does not replay"
+    for i in range(len(v.trace)):
+        shorter = list(v.trace[:i]) + list(v.trace[i + 1:])
+        assert not violates(shorter), (
+            f"trace not minimal: dropping {v.trace[i]!r} still violates")
+    return v
+
+
+class TestSeededViolations:
+    def test_retire_on_send_breaks_retired_implies_applied(self):
+        v = _caught(sm.DepositStreamMachine(bug="retire_on_send"),
+                    "retired-implies-applied")
+        # the defect fires without the server ever APPLYING anything
+        # (torn/dedup deliveries are fine — they apply nothing)
+        assert not any(re.match(r"deliver\(\d+\)$", l) for l in v.trace)
+
+    def test_dedup_off_breaks_exactly_once_apply(self):
+        v = _caught(sm.DepositStreamMachine(bug="dedup_off"),
+                    "exactly-once-apply")
+        assert any(l.startswith("dup(") or l.startswith("attach(")
+                   for l in v.trace)
+
+    def test_reorder_proves_fifo_assumption_load_bearing(self):
+        # the HEALTHY discipline under a reordering network loses a
+        # batch: the dedup mark assumes TCP's FIFO delivery.  This is
+        # why the model ships reorder but the checked configurations
+        # keep FIFO.
+        res = sm.explore(sm.DepositStreamMachine(reorder=True))
+        assert res.complete
+        assert res.violations, "reordering should break the dedup mark"
+        assert any("reorder" in v.trace for v in res.violations)
+
+    def test_advance_on_torn_breaks_cursor_delivery_lockstep(self):
+        v = _caught(sm.SubscriberMachine(bug="advance_on_torn"),
+                    "cursor-advanced-without-delivery")
+        assert any(re.match(r"deliver\(\d+,torn\)", l) for l in v.trace)
+
+    def test_apply_wrong_base_breaks_delta_base_invariant(self):
+        v = _caught(sm.DeltaMachine(bug="apply_wrong_base"),
+                    "delta-applied-on-wrong-base")
+        # the corrupting apply happens after a reconnect kept the base
+        assert any(l.startswith("resubscribe(") for l in v.trace)
+
+    def test_no_reanchor_livelocks_as_stuck_states(self):
+        res = sm.explore(sm.DeltaMachine(bug="no_reanchor"))
+        assert res.complete
+        assert not res.violations  # the healthy applier refuses cleanly
+        assert res.stuck, "never-reanchoring sender should livelock"
+        assert not res.ok
+        for trace, st in res.stuck:
+            seq = sm.replay(sm.DeltaMachine(bug="no_reanchor"), trace)
+            assert seq is not None and seq[-1] == st
+
+
+class TestDotOutput:
+    def test_edges_render_as_digraph(self):
+        res = sm.explore(sm.SubscriberMachine(rounds=2),
+                         keep_edges=True)
+        dot = sm.to_dot(res, max_nodes=100_000)
+        assert dot.startswith("digraph")
+        assert "->" in dot and dot.rstrip().endswith("}")
+
+    def test_large_graph_elides_to_summary(self):
+        res = sm.explore(sm.SubscriberMachine(rounds=3))
+        dot = sm.to_dot(res)
+        assert "graph elided" in dot
+
+
+# ---------------------------------------------------------------------------
+# model <-> live-code conformance (runtime/delta.py)
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaConformance:
+    def test_model_and_live_encoder_applier_agree_in_lockstep(self):
+        from bluefog_tpu.runtime.delta import (DeltaApplier, DeltaConfig,
+                                               DeltaEncoder)
+
+        m = sm.DeltaMachine(rounds=3, full_every=2)
+        cfg = DeltaConfig(full_every=2, codec="topk", topk_ratio=1.0,
+                          min_delta_elems=0)
+        leaves = {r: np.full(8, float(r), np.float32) for r in (1, 2, 3)}
+        enc, app = DeltaEncoder(), DeltaApplier("g")
+        # the healthy full/delta cadence the model enables at
+        # full_every=2 — every send is checked against the LIVE
+        # encoder's (kind, base), every deliver against the LIVE
+        # applier's cursor and reconstruction
+        path = ["publish(1)", "send_full(1)", "deliver_full(1)",
+                "publish(2)", "send_delta(2,base=1)", "deliver_delta(2)",
+                "publish(3)", "send_full(3)", "deliver_full(3)"]
+        st = m.initial()
+        pending = None
+        for lbl in path:
+            nxt = dict(m.events(st)).get(lbl)
+            assert nxt is not None, (
+                f"model does not enable {lbl!r} at {st!r}")
+            send_f = re.match(r"send_full\((\d+)\)$", lbl)
+            send_d = re.match(r"send_delta\((\d+),base=(\d+)\)$", lbl)
+            if send_f or send_d:
+                r = int((send_f or send_d).group(1))
+                kind, base, items = enc.step(r, [("w", leaves[r])], cfg)
+                if send_f:
+                    assert (kind, items) == (0, None), (
+                        "live encoder sent a delta where the model "
+                        "anchors")
+                    pending = ("full", r, None)
+                else:
+                    assert kind == 10
+                    assert base == int(send_d.group(2)), (
+                        "live encoder deltas against a different base "
+                        "than the model")
+                    pending = ("delta", r, (base, items))
+            recv_f = re.match(r"deliver_full\((\d+)\)$", lbl)
+            recv_d = re.match(r"deliver_delta\((\d+)\)$", lbl)
+            if recv_f:
+                r = int(recv_f.group(1))
+                assert pending == ("full", r, None)
+                app.anchor(r, {"w": leaves[r]})
+            elif recv_d:
+                r = int(recv_d.group(1))
+                tag, pr, (base, items) = pending
+                assert (tag, pr) == ("delta", r)
+                got = app.apply(r, base, [
+                    (n, dt, c, ne,
+                     memoryview(b"".join(bytes(v) for v in views)))
+                    for n, dt, c, ne, views, _wb in items])
+                np.testing.assert_allclose(got["w"], leaves[r])
+            st = nxt
+            if recv_f or recv_d:
+                assert app.base_round == st[5], (
+                    "live applier cursor diverged from the model's")
+        assert m.is_accepting(st)
+
+    def test_stale_encoder_across_reconnect_raises_desync_live(self):
+        # the modeled sender defect (bug="no_reanchor"/
+        # "apply_wrong_base"): an encoder that survives a reconnect
+        # keeps its base while the receiver starts fresh.  The live
+        # applier must refuse — proving the base check enforces
+        # exactly what the healthy model assumes.
+        from bluefog_tpu.runtime.delta import (DeltaApplier, DeltaConfig,
+                                               DeltaDesync, DeltaEncoder)
+
+        cfg = DeltaConfig(full_every=4, codec="none", min_delta_elems=0)
+        enc = DeltaEncoder()
+        kind, _, _ = enc.step(1, [("w", np.full(8, 1.0, np.float32))],
+                              cfg)
+        assert kind == 0  # the anchor the OLD connection consumed
+        app = DeltaApplier("g")  # fresh receiver: cursor gap
+        kind, base, items = enc.step(
+            2, [("w", np.full(8, 2.0, np.float32))], cfg)
+        assert (kind, base) == (10, 1)  # the stale base the model plants
+        with pytest.raises(DeltaDesync):
+            app.apply(2, base, [
+                (n, dt, c, ne,
+                 memoryview(b"".join(bytes(v) for v in views)))
+                for n, dt, c, ne, views, _wb in items])
+        assert app.base_round == -1  # refused, not corrupted
+
+
+# ---------------------------------------------------------------------------
+# BF-WIRE004 regressions: claimed lengths bounded before allocation
+# ---------------------------------------------------------------------------
+
+
+class TestClaimedLengthBounds:
+    def test_snapshot_leaf_header_bounded_before_alloc(self):
+        from bluefog_tpu.runtime import window_server as ws
+
+        for name_len, dtype_id, n_elems in (
+                (1, 0, 1 << 40),   # absurd claimed payload
+                (1, 7, 8),         # unknown dtype id
+                (1, 0, -1),        # negative element count
+                (1 << 13, 0, 8)):  # name beyond _MAX_LEAF_NAME
+            a, b = socket.socketpair()
+            try:
+                a.sendall(ws._SNAP_LEAF.pack(name_len, dtype_id,
+                                             n_elems))
+                with pytest.raises(ValueError, match="out of bounds"):
+                    ws._recv_leaves(b, 1)
+            finally:
+                a.close()
+                b.close()
+
+    def test_well_formed_leaf_still_parses(self):
+        from bluefog_tpu.runtime import window_server as ws
+
+        payload = np.arange(4, dtype=np.float32)
+        a, b = socket.socketpair()
+        try:
+            a.sendall(ws._SNAP_LEAF.pack(1, 0, 4) + b"x"
+                      + payload.tobytes())
+            leaves = ws._recv_leaves(b, 1)
+        finally:
+            a.close()
+            b.close()
+        assert (leaves["x"] == payload).all()
+
+    def test_remote_read_refuses_oversized_reply_header(self):
+        from bluefog_tpu.runtime import window_server as ws
+
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+
+        def lying_owner():
+            conn, _ = lsock.accept()
+            with conn:
+                _magic, _op, name_len = ws._HDR.unpack(
+                    ws._recv_exact(conn, ws._HDR.size))
+                ws._recv_exact(conn, name_len + ws._BODY.size)
+                # rc=0 then a header claiming 2^40 elements: the client
+                # asked for 16, and must refuse before allocating
+                conn.sendall(ws._STATUS.pack(0)
+                             + ws._SELF_HDR.pack(0, 1 << 40))
+                conn.recv(1)  # hold open until the client gives up
+
+        t = threading.Thread(target=lying_owner, daemon=True)
+        t.start()
+        win = ws.RemoteWindow(lsock.getsockname(), _uniq("lying"),
+                              timeout_s=5)
+        try:
+            with pytest.raises(ConnectionError):
+                win.read_self(16)
+            # the bound trip latches the handle like any transport fail
+            with pytest.raises(RuntimeError, match="latched"):
+                win.read_self(16)
+        finally:
+            win.close()
+            lsock.close()
+            t.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# minimized model trace -> live two-process scenario
+# ---------------------------------------------------------------------------
+
+
+class _CuttingProxy:
+    """TCP proxy that forwards connection 0 until ``cut_after`` bytes
+    have flowed server->client, then closes both sides abruptly —
+    tearing whatever frame those bytes landed inside.  Every later
+    connection passes through untouched, so the client's resume path
+    runs against the real server."""
+
+    def __init__(self, target, cut_after: int):
+        self._target = target
+        self._cut_after = cut_after
+        self.cut_done = threading.Event()
+        self._lsock = socket.socket()
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(8)
+        self.addr = self._lsock.getsockname()
+        self._conn_i = 0
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                client, _ = self._lsock.accept()
+            except OSError:
+                return
+            i, self._conn_i = self._conn_i, self._conn_i + 1
+            try:
+                server = socket.create_connection(self._target,
+                                                  timeout=10)
+            except OSError:
+                client.close()
+                continue
+            limit = self._cut_after if i == 0 else None
+            threading.Thread(target=self._pump,
+                             args=(client, server, None, client, server),
+                             daemon=True).start()
+            threading.Thread(target=self._pump,
+                             args=(server, client, limit, client, server),
+                             daemon=True).start()
+
+    def _pump(self, src, dst, limit, client, server):
+        sent = 0
+        try:
+            while limit is None or sent < limit:
+                want = 4096 if limit is None else min(4096, limit - sent)
+                data = src.recv(want)
+                if not data:
+                    break
+                dst.sendall(data)
+                sent += len(data)
+        except OSError:
+            pass
+        for s in (client, server):
+            try:
+                s.close()
+            except OSError:
+                pass
+        if limit is not None:
+            self.cut_done.set()
+
+    def close(self):
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+class TestLiveTornFrame:
+    def test_minimized_torn_trace_realized_against_live_server(self):
+        from bluefog_tpu.runtime import window_server as ws
+        from bluefog_tpu.serving import Subscriber, table
+
+        # 1. the checker finds the seeded cursor-advance-on-torn
+        #    violation and minimizes it to its essential events
+        buggy = sm.SubscriberMachine(rounds=1, bug="advance_on_torn")
+        res = sm.explore(buggy)
+        v = next(v for v in res.violations
+                 if v.invariant == "cursor-advanced-without-delivery")
+        publishes = [int(m.group(1)) for m in
+                     (re.match(r"publish\((\d+)\)$", l)
+                      for l in v.trace) if m]
+        torn = [int(m.group(1)) for m in
+                (re.match(r"deliver\((\d+),torn\)$", l)
+                 for l in v.trace) if m]
+        assert publishes and len(torn) == 1
+        torn_round = torn[0]
+
+        # 2. realize the trace: publish the modeled rounds, tear the
+        #    first push frame mid-leaf (after both handshake statuses
+        #    + the push header + 5 bytes of the first leaf header)
+        srv, addr = None, None
+        from bluefog_tpu.runtime.window_server import WindowServer
+        srv = WindowServer()
+        addr = srv.start("127.0.0.1")
+        g = _uniq("torn")
+        tbl = table()
+        for r in publishes:
+            tbl.publish(g, r, {"x": np.full(16, float(r))})
+        cut_after = 2 * ws._STATUS.size + ws._PUSH.size + 5
+        proxy = _CuttingProxy(addr, cut_after)
+        sub = Subscriber(proxy.addr, g, every=1)
+        try:
+            assert proxy.cut_done.wait(10), "proxy never saw the frame"
+            # 3. the LIVE code must uphold the invariant the seeded
+            #    model broke: the torn round is not consumed — the
+            #    cursor stays put and the round is re-delivered exactly
+            #    once after the automatic resume
+            snap = sub.get(timeout_s=15)
+            assert snap is not None and snap.round == torn_round
+            assert (snap["x"] == float(torn_round)).all()
+            assert sub.cursor == torn_round
+            assert sub.delivered == 1
+            assert sub.resumes >= 1
+        finally:
+            sub.close()
+            proxy.close()
+            srv.stop()
+            tbl.drop(g)
