@@ -27,9 +27,15 @@ right value, and every pair the paragraph spells must be a live
 constant (bits 128/256 were added after the paragraph was first
 written — exactly the drift this pins).
 
+**BF-DOC004** — ``docs/API.md`` must name every CLI entry point
+``pyproject.toml`` installs (``[project.scripts]``), and every
+``bf*-tpu`` token the doc mentions must be an installed script —
+both directions, so a new console script cannot ship undocumented and
+a renamed one cannot leave a stale doc row behind.
+
 **BF-DOC000** (warning): a doc file the lint could not read.
-**BF-DOC100** / **BF-DOC101** / **BF-DOC102** (info): per-check
-agreement summaries.
+**BF-DOC100** / **BF-DOC101** / **BF-DOC102** / **BF-DOC103** (info):
+per-check agreement summaries.
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ from typing import List, Optional, Set
 
 from bluefog_tpu.analysis.report import Diagnostic
 
-__all__ = ["check_feature_doc", "check_metrics_doc",
+__all__ = ["check_cli_doc", "check_feature_doc", "check_metrics_doc",
            "check_transport_doc"]
 
 _PASS = "doc-lint"
@@ -177,6 +183,86 @@ def check_feature_doc(doc_path: Optional[str] = None
             f"all {len(live)} HELLO feature bits documented in {base} "
             "with matching values; no stale entries",
             pass_name=_PASS, subject=base))
+    return diags
+
+
+#: a console-script token as the docs spell them (``bfprof-tpu``,
+#: ``ibfrun-tpu``) — the same shape ``[project.scripts]`` declares
+_CLI_RE = re.compile(r"\bi?bf[a-z0-9]+-tpu\b")
+#: one ``name = "module:func"`` line inside ``[project.scripts]``
+_SCRIPT_LINE_RE = re.compile(
+    r"^\s*([A-Za-z0-9_-]+)\s*=\s*[\"'][\w.]+:[\w.]+[\"']\s*$")
+
+
+def _installed_scripts(pyproject_path: str) -> Set[str]:
+    """The ``[project.scripts]`` names, parsed with a line scanner
+    (tomllib is 3.11+; the table's shape — ``name = "mod:func"`` — is
+    regular enough that a full TOML parser buys nothing here)."""
+    names: Set[str] = set()
+    in_scripts = False
+    with open(pyproject_path, "r", encoding="utf-8") as f:
+        for line in f:
+            stripped = line.strip()
+            if stripped.startswith("["):
+                in_scripts = stripped == "[project.scripts]"
+                continue
+            if in_scripts:
+                m = _SCRIPT_LINE_RE.match(line)
+                if m:
+                    names.add(m.group(1))
+    return names
+
+
+def check_cli_doc(doc_path: Optional[str] = None,
+                  pyproject_path: Optional[str] = None
+                  ) -> List[Diagnostic]:
+    """BF-DOC004: ``docs/API.md`` <-> ``[project.scripts]``, pinned
+    both directions (the BF-DOC001 pattern applied to the console
+    scripts): every installed CLI needs a doc mention, and every
+    ``bf*-tpu`` token the doc spells must be installable."""
+    path = doc_path or os.path.join(_repo_root(), "docs", "API.md")
+    ppath = pyproject_path or os.path.join(_repo_root(), "pyproject.toml")
+    base = os.path.basename(path)
+    diags: List[Diagnostic] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        diags.append(Diagnostic(
+            "warning", "BF-DOC000",
+            f"could not read API doc {path}: {e}",
+            pass_name=_PASS, subject=base))
+        return diags
+    try:
+        installed = _installed_scripts(ppath)
+    except OSError as e:
+        diags.append(Diagnostic(
+            "warning", "BF-DOC000",
+            f"could not read {ppath}: {e}",
+            pass_name=_PASS, subject="pyproject.toml"))
+        return diags
+
+    doc_clis = set(_CLI_RE.findall(text))
+    for name in sorted(installed - doc_clis):
+        diags.append(Diagnostic(
+            "error", "BF-DOC004",
+            f"console script {name} is installed by pyproject.toml's "
+            f"[project.scripts] but never mentioned in {base} — every "
+            "CLI entry point needs a doc row (add it to the CLI table)",
+            pass_name=_PASS, subject=name))
+    for name in sorted(doc_clis - installed):
+        diags.append(Diagnostic(
+            "error", "BF-DOC004",
+            f"{base} mentions {name}, which [project.scripts] does not "
+            "install — a stale row for a renamed or removed CLI (fix "
+            "the doc, or add the entry point)",
+            pass_name=_PASS, subject=name))
+    if not diags:
+        diags.append(Diagnostic(
+            "info", "BF-DOC103",
+            f"all {len(installed)} console scripts documented in "
+            f"{base}; no stray CLI names",
+            pass_name=_PASS, subject="API.md"))
     return diags
 
 

@@ -641,16 +641,48 @@ def protocol_pass(report: LintReport, size: int) -> None:
 def doc_pass(report: LintReport, size: int) -> None:
     """BF-DOC: docs/transport.md must list every wire v2 status code in
     the one registry (:mod:`bluefog_tpu.runtime.wire_status`) and every
-    HELLO feature bit with its live ``FEATURE_*`` value, and
-    docs/metrics.md must agree with the live ``bf_*`` metric names —
-    all pinned both directions."""
-    from bluefog_tpu.analysis.doc_lint import (check_feature_doc,
+    HELLO feature bit with its live ``FEATURE_*`` value,
+    docs/metrics.md must agree with the live ``bf_*`` metric names,
+    and docs/API.md must agree with the installed ``[project.scripts]``
+    CLI entry points — all pinned both directions."""
+    from bluefog_tpu.analysis.doc_lint import (check_cli_doc,
+                                               check_feature_doc,
                                                check_metrics_doc,
                                                check_transport_doc)
 
     report.extend(check_transport_doc())
     report.extend(check_feature_doc())
     report.extend(check_metrics_doc())
+    report.extend(check_cli_doc())
+
+
+def profiling_pass(report: LintReport, size: int) -> None:
+    """BF-PROF source lint over the continuous profiler: the sampling
+    hot path (every function reachable from a ``sys._current_frames``
+    caller through intra-module calls) must never acquire a lock, do
+    IO, serialize, sleep, or touch metrics — the sampler observes
+    threads that may hold ANY package lock, so one acquire there is a
+    latent process-wide deadlock — and every deque the sampler feeds
+    must be bounded.  See :mod:`bluefog_tpu.analysis.profiling_lint`."""
+    import glob
+
+    from bluefog_tpu.analysis.profiling_lint import check_file
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = sorted(glob.glob(os.path.join(
+        root, "bluefog_tpu", "profiling", "*.py")))
+    n = 0
+    for path in targets:
+        if not os.path.exists(path):
+            continue
+        n += 1
+        report.extend(check_file(path))
+    report.add(Diagnostic(
+        "info", "BF-PROF101",
+        f"profiling-lint scanned {n} file(s) for hot-path lock/IO "
+        "violations and unbounded rings",
+        pass_name="profiling-lint", subject="profiling"))
 
 
 def serving_pass(report: LintReport, size: int) -> None:
@@ -801,6 +833,7 @@ def run_all(*, size: int = 8, trace: bool = True) -> LintReport:
     fleet_pass(report, size)
     sim_pass(report, size)
     concurrency_pass(report, size)
+    profiling_pass(report, size)
     protocol_pass(report, size)
     doc_pass(report, size)
     examples_pass(report, size)

@@ -1,0 +1,210 @@
+"""BF-PROF: the sampling profiler's hot-path discipline, checked.
+
+The sampler thread walks ``sys._current_frames()`` while the sampled
+threads may hold ANY package lock — so one lock acquire on the
+per-sample path is a latent deadlock against every lock in the package,
+and one syscall or serialization there multiplies by the sampling rate.
+The discipline (:mod:`bluefog_tpu.profiling.sampler`'s module
+docstring) is machine-checked here, the same posture as BF-TRC/BF-SIM:
+a comment is a wish, a lint is a contract.
+
+**BF-PROF001** (error) — a forbidden operation is reachable on the
+sampling hot path.  The hot path is every function that calls
+``sys._current_frames`` plus everything it can reach through
+intra-module calls (``self.method()`` / module functions).  Forbidden
+there: acquiring anything (``.acquire()``, ``with <lock-ish>``),
+file/stream IO (``open``/``.write``/``.flush``/``os.makedirs``), JSON
+(``dumps``/``loads``), sleeping, printing, metrics-registry calls
+(``inc``/``observe``), and ``import`` statements (the import machinery
+takes locks; even the cached fast path is sys.modules traffic a
+per-sample loop must not pay).
+
+**BF-PROF002** (error) — an unbounded ``deque()`` in a profiling
+module.  Every ring the sampler feeds must pass ``maxlen=``: an
+always-on profiler with an unbounded buffer is a slow memory leak in
+exactly the long-lived process it exists to observe.
+
+**BF-PROF100** (info) — per-file summary of hot-path functions found.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from bluefog_tpu.analysis.report import Diagnostic
+
+__all__ = ["check_file"]
+
+_PASS = "profiling-lint"
+
+#: attribute-call names forbidden on the hot path (lock/IO/serialize/
+#: sleep/metrics surfaces — see the module docstring for why each)
+#: (``.join`` is deliberately absent: ``";".join(parts)`` IS the hot
+#: path's folding step, and a thread join there would surface as the
+#: ``.wait``/lock rules anyway)
+_FORBIDDEN_ATTRS = frozenset((
+    "acquire", "sleep", "dumps", "loads", "write", "writelines",
+    "flush", "fsync", "makedirs", "inc", "observe", "record", "begin",
+    "end", "wait",
+))
+#: bare-name calls forbidden on the hot path
+_FORBIDDEN_NAMES = frozenset(("open", "print"))
+
+
+def _func_defs(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Module functions and class methods by bare name (one namespace:
+    the lint resolves ``self.x()`` and ``x()`` alike — a collision
+    would only make the walk more conservative)."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    """Names this function calls that could resolve intra-module:
+    ``name(...)`` and ``self.name(...)``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name)
+              and f.value.id in ("self", "cls")):
+            out.add(f.attr)
+    return out
+
+
+def _calls_current_frames(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_current_frames"):
+            return True
+    return False
+
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    """A with-context expression that names a lock: ``self._io_lock``,
+    ``some_lock``, ``x.lock()`` — matched by name convention, which is
+    what the lockcheck registry enforces package-wide."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Call):
+        return _lockish(expr.func)
+    else:
+        return None
+    low = name.lower()
+    if "lock" in low or low.endswith("_mu") or low == "mu":
+        return name
+    return None
+
+
+def _violations(fn: ast.AST) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            out.append((node.lineno,
+                        "import statement (the import machinery takes "
+                        "locks; resolve before the loop)"))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = _lockish(item.context_expr)
+                if name is not None:
+                    out.append((node.lineno,
+                                f"acquires lock-like context {name!r}"))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _FORBIDDEN_NAMES:
+                out.append((node.lineno, f"calls {f.id}()"))
+            elif isinstance(f, ast.Attribute):
+                if f.attr in _FORBIDDEN_ATTRS:
+                    out.append((node.lineno, f"calls .{f.attr}()"))
+    return out
+
+
+def _deque_unbounded(tree: ast.Module) -> List[int]:
+    lines: List[int] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_deque = ((isinstance(f, ast.Name) and f.id == "deque")
+                    or (isinstance(f, ast.Attribute)
+                        and f.attr == "deque"))
+        if not is_deque:
+            continue
+        if len(node.args) >= 2:
+            continue  # positional maxlen
+        if any(kw.arg == "maxlen" for kw in node.keywords):
+            continue
+        lines.append(node.lineno)
+    return lines
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    base = os.path.basename(path)
+    diags: List[Diagnostic] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+    except (OSError, SyntaxError) as e:
+        diags.append(Diagnostic(
+            "warning", "BF-PROF000",
+            f"could not parse {path}: {e}",
+            pass_name=_PASS, subject=base))
+        return diags
+
+    defs = _func_defs(tree)
+    roots = sorted(name for name, fn in defs.items()
+                   if _calls_current_frames(fn))
+
+    # the hot path: the _current_frames callers plus their intra-module
+    # call closure
+    hot: Set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in hot:
+            continue
+        hot.add(name)
+        for callee in _called_names(defs[name]):
+            if callee in defs and callee not in hot:
+                frontier.append(callee)
+
+    for name in sorted(hot):
+        for lineno, what in _violations(defs[name]):
+            diags.append(Diagnostic(
+                "error", "BF-PROF001",
+                f"{base}:{lineno}: {what} inside {name}(), which is on "
+                "the sampling hot path (reachable from a "
+                "sys._current_frames caller) — the sampler observes "
+                "threads that may hold any package lock, so the "
+                "per-sample path must never lock, do IO, serialize, "
+                "sleep, or touch metrics (see profiling/sampler.py)",
+                pass_name=_PASS, subject=f"{base}:{name}"))
+
+    for lineno in _deque_unbounded(tree):
+        diags.append(Diagnostic(
+            "error", "BF-PROF002",
+            f"{base}:{lineno}: deque() without maxlen in a profiling "
+            "module — an always-on sampler's rings must be bounded or "
+            "the profiler becomes the leak it exists to find",
+            pass_name=_PASS, subject=f"{base}:{lineno}"))
+
+    if roots and not diags:
+        diags.append(Diagnostic(
+            "info", "BF-PROF100",
+            f"{base}: hot path rooted at {roots} spans "
+            f"{len(hot)} function(s); no forbidden operations",
+            pass_name=_PASS, subject=base))
+    return diags

@@ -9,8 +9,12 @@ for crashes, the dying process's own) last word.  One JSONL file per rank
 3. ``{"open_spans": [...]}`` — rounds begun but never ended (the round a
    stuck rank is wedged in), from the recorder AND the timeline writer;
 4. ``{"stacks": [...]}`` — every thread's Python stack;
-5. ``{"metrics": ...}`` — a metrics-registry snapshot when metrics are on;
-6. ``{"end": true, ...}`` — the completeness marker (a dump without it
+5. ``{"profile": ...}`` — the continuous profiler's last ~30s of
+   phase-attributed folded stacks, when sampling is armed (what the
+   rank was BUSY with leading into the incident, not just where it
+   stands now);
+6. ``{"metrics": ...}`` — a metrics-registry snapshot when metrics are on;
+7. ``{"end": true, ...}`` — the completeness marker (a dump without it
    was torn mid-write; :mod:`merge` still reads what landed).
 
 Files are written to ``BLUEFOG_TPU_BLACKBOX_DIR`` (default ``blackbox/``)
@@ -112,6 +116,23 @@ def _timeline_open_spans() -> List[dict]:
     return []
 
 
+def _profile_snapshot() -> Optional[dict]:
+    # the sampler's last ~30s of folded stacks: what this rank was
+    # BUSY with leading into the incident — complements the stacks
+    # section (an instantaneous snapshot) with a time-weighted one.
+    # Read from the in-memory recent ring, never the profile files:
+    # the dump path must not do cross-file IO
+    try:
+        from bluefog_tpu.profiling import sampler as _ps
+
+        prof = _ps.get() if _ps.enabled() else None
+        if prof is not None:
+            return prof.recent_folded()
+    except Exception:
+        pass
+    return None
+
+
 def _metrics_snapshot() -> Optional[dict]:
     # drain=False: a watchdog thread dumping while the main thread is
     # wedged in a device collective must never block on that device's
@@ -192,6 +213,10 @@ def dump(reason: str, *, directory: Optional[str] = None,
                     dropped = 0
                 f.write(json.dumps({"stacks": _thread_stacks()},
                                    default=str) + "\n")
+                prof = _profile_snapshot()
+                if prof is not None:
+                    f.write(json.dumps({"profile": prof}, default=str)
+                            + "\n")
                 snap = _metrics_snapshot()
                 if snap is not None:
                     f.write(json.dumps({"metrics": snap}, default=str,

@@ -238,6 +238,26 @@ class FlightRecorder:
         # window recounts instead of skipping events
         return got if got else (int(seq), {})
 
+    def recent(self, seconds: float = 30.0) -> List[dict]:
+        """Ring events from the last ``seconds`` of wall time, oldest
+        first — the dump/profile "what just happened" window.  Same
+        timeout-acquire snapshot discipline as :meth:`events`, and the
+        same newest-first early-stop walk as :meth:`counts_since`
+        (timestamps are monotone within the ring, so the first
+        too-old event ends the scan instead of copying 4096 slots)."""
+        cutoff = time.time() - float(seconds)
+
+        def pull():
+            out: List[dict] = []
+            for ev in reversed(self._events):
+                if ev["t"] < cutoff:
+                    break
+                out.append(dict(ev))
+            out.reverse()
+            return out
+
+        return self._snapshot(pull)
+
     def open_spans(self) -> List[dict]:
         return self._snapshot(
             lambda: [dict(e) for e in self._open.values()])

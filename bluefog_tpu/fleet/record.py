@@ -113,7 +113,10 @@ class FleetRecord:
     consensus, comparable across ranks at the same round); ``dis`` is
     the round's local disagreement (NaN when not measured);
     ``staleness`` is rounds since the last serving snapshot publish
-    (None when serving is off)."""
+    (None when serving is off); ``profile`` maps hot frame label ->
+    self-sample fraction over the continuous profiler's recent window
+    (empty when sampling is disarmed — the fleet-wide "what is every
+    rank busy with" digest, a few entries, never the full profile)."""
 
     rank: int
     round: int
@@ -128,6 +131,7 @@ class FleetRecord:
     events: Mapping[str, int] = dataclasses.field(default_factory=dict)
     host: Mapping[str, float] = dataclasses.field(default_factory=dict)
     metrics: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    profile: Mapping[str, float] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         object.__setattr__(self, "round_s",
@@ -147,6 +151,9 @@ class FleetRecord:
         object.__setattr__(self, "metrics",
                            {str(k): float(v)
                             for k, v in (self.metrics or {}).items()})
+        object.__setattr__(self, "profile",
+                           {str(k): float(v)
+                            for k, v in (self.profile or {}).items()})
 
     def to_json(self) -> str:
         """Canonical encoding: sorted keys, NaN spelled ``null`` — two
@@ -168,7 +175,9 @@ class FleetRecord:
                         for k, v in sorted(self.events.items())},
              "host": {k: _num(v) for k, v in sorted(self.host.items())},
              "metrics": {k: _num(v)
-                         for k, v in sorted(self.metrics.items())}},
+                         for k, v in sorted(self.metrics.items())},
+             "profile": {k: _num(v)
+                         for k, v in sorted(self.profile.items())}},
             sort_keys=True, separators=(",", ":"))
 
     @staticmethod
@@ -199,7 +208,9 @@ class FleetRecord:
             host={str(k): num(v)
                   for k, v in (d.get("host") or {}).items()},
             metrics={str(k): num(v)
-                     for k, v in (d.get("metrics") or {}).items()})
+                     for k, v in (d.get("metrics") or {}).items()},
+            profile={str(k): num(v)
+                     for k, v in (d.get("profile") or {}).items()})
 
 
 # ------------------------------------------------------------- host gauges
@@ -344,6 +355,22 @@ class TelemetryPublisher:
         self._prev_counters = fams
         return out
 
+    def _profile_digest(self) -> Dict[str, float]:
+        """Top self-sample frames over the continuous profiler's recent
+        window (empty when sampling is disarmed).  Reads the sampler's
+        in-memory ring — no profile-file IO on the publish path — and is
+        process-global like events/host/metrics, so rank-threads elect
+        one carrier via ``process_stats``."""
+        try:
+            from bluefog_tpu.profiling import sampler as _ps
+
+            prof = _ps.get() if _ps.enabled() else None
+            if prof is None:
+                return {}
+            return {label: frac for label, frac in prof.top_frames(3)}
+        except Exception:
+            return {}
+
     def _host(self) -> Dict[str, float]:
         now = time.monotonic()
         if now - self._host_t < HOST_SAMPLE_MIN_S:
@@ -381,6 +408,8 @@ class TelemetryPublisher:
             events=self._event_counts() if self.process_stats else {},
             host=self._host() if self.process_stats else {},
             metrics=(self._metric_deltas() if self.process_stats
+                     else {}),
+            profile=(self._profile_digest() if self.process_stats
                      else {}))
         if self._fh is None:
             self._fh = open(self._path, "ab")

@@ -1,0 +1,57 @@
+"""Continuous profiling — the seventh observability leg.
+
+An always-on-capable, off-by-default sampling profiler: a dedicated
+daemon thread walks ``sys._current_frames()`` at a configurable rate,
+tags every sample with the sampled thread's current tracing phase
+(compute / gossip / publish / net-wait, read lock-free from the
+tracing plane's cross-thread span map), and appends folded-stack
+windows to per-rank JSONL.  ``bfprof-tpu`` merges ranks, renders
+flamegraphs, joins against ``bftrace-tpu`` critical paths, and gates
+A/B differential profiles with an exit code.
+
+Arming follows the tracing plane's env-lazy pattern: set
+``BLUEFOG_TPU_PROFILE=<dir>`` (and optionally
+``BLUEFOG_TPU_PROFILE_HZ``) or call :func:`configure` explicitly.
+When disarmed there is no sampler thread, no import-time side effect,
+and zero change to compiled programs.
+"""
+
+from bluefog_tpu.profiling.sampler import (
+    PHASES,
+    Profiler,
+    configure,
+    enabled,
+    flush,
+    get,
+    phase_for_span,
+    reset,
+    set_rank,
+)
+from bluefog_tpu.profiling.report import (
+    diff,
+    load_profiles,
+    merge,
+    phase_frames,
+    render_folded,
+    render_svg,
+    top_table,
+)
+
+__all__ = [
+    "PHASES",
+    "Profiler",
+    "configure",
+    "diff",
+    "enabled",
+    "flush",
+    "get",
+    "load_profiles",
+    "merge",
+    "phase_for_span",
+    "phase_frames",
+    "render_folded",
+    "render_svg",
+    "reset",
+    "set_rank",
+    "top_table",
+]

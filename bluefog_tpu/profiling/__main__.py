@@ -1,0 +1,8 @@
+"""``python -m bluefog_tpu.profiling`` == ``bfprof-tpu``."""
+
+import sys
+
+from bluefog_tpu.profiling.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -930,6 +930,7 @@ def run_async_dsgd(
     control: Optional[_ControlConfig] = None,
     stop_after_steps: Optional[int] = None,
     fleet: Optional[_FleetConfig] = None,
+    profile: Optional[str] = None,
 ) -> DSGDReport:
     """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
     over the passive-target windows: the execution model of the reference's
@@ -1043,6 +1044,13 @@ def run_async_dsgd(
         controller's evidence as SUSPECT
         (:meth:`~bluefog_tpu.control.CommController.note_alert`).
         The publisher only reads — the exact mass audit is unchanged.
+      profile: directory for the continuous sampling profiler
+        (:mod:`bluefog_tpu.profiling`): arms a process-wide sampler for
+        the run and writes phase-attributed folded stacks to
+        ``profile-rank0.jsonl`` there (rank threads share one process,
+        so one file carries every thread's samples).  When the env var
+        ``BLUEFOG_TPU_PROFILE`` already armed a profiler, that one is
+        left alone — the runner only owns what it started.
     """
     n = topology.size
     if fleet is not None and fleet.dir is None:
@@ -1569,6 +1577,21 @@ def run_async_dsgd(
             if flt is not None:
                 flt.close()  # records are on disk line by line already
 
+    # continuous profiling: own the sampler only when this call armed it
+    # (an env-armed profiler spans runs and is not ours to stop)
+    prof_owned = False
+    from bluefog_tpu.profiling import sampler as _profiling
+
+    if profile is not None:
+        if _profiling.get() is None:
+            _profiling.configure(profile, rank=0)
+            prof_owned = True
+    else:
+        # no explicit profile= — still poke the sampler so a
+        # BLUEFOG_TPU_PROFILE env arming takes effect for this run
+        # (atexit owns its tail flush, not us)
+        _profiling.set_rank(0)
+
     threads = [threading.Thread(target=rank_loop, args=(r,), daemon=True)
                for r in range(n)]
     t0 = time.perf_counter()
@@ -1579,6 +1602,10 @@ def run_async_dsgd(
     join_budget = max(skew) * 4 + 30.0  # a rank may be mid-gradient
     for t in threads:
         t.join(timeout=join_budget)
+    if prof_owned:
+        from bluefog_tpu.profiling import sampler as _profiling
+
+        _profiling.reset()  # flushes the tail window before the audit
     if any(t.is_alive() for t in threads):
         raise RuntimeError("async DSGD rank threads failed to stop within "
                            f"{join_budget:.1f}s; aborting without freeing")
@@ -1930,6 +1957,7 @@ def run_async_dsgd_rank(
     stop_after_steps: Optional[int] = None,
     stream_options: Optional[Dict] = None,
     fleet: Optional[_FleetConfig] = None,
+    profile: Optional[str] = None,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -2057,6 +2085,14 @@ def run_async_dsgd_rank(
     publisher reads, never moves, mass — the exact audit is unchanged
     with it active (asserted by the bench and the MP acceptance test).
 
+    ``profile`` names a (shared) directory for the continuous sampling
+    profiler (:mod:`bluefog_tpu.profiling`): this process arms a
+    sampler writing phase-attributed folded stacks to
+    ``profile-rank<rank>.jsonl`` there, and stops it when the rank
+    returns.  A profiler already armed via ``BLUEFOG_TPU_PROFILE`` is
+    left running (the runner only owns what it started); merge the
+    per-rank files with ``bfprof-tpu <dir>``.
+
     Returns a :class:`DSGDReport` on rank 0 (``losses`` filled only at index
     ``rank`` — other ranks' loss curves stay in their processes), ``None``
     elsewhere (including joiners and leavers).
@@ -2098,6 +2134,21 @@ def run_async_dsgd_rank(
         raise ValueError(
             f"transport must be 'shm', 'tcp' or 'tcp-sync', got "
             f"{transport!r}")
+    # continuous profiling: per-process, so each rank writes its own
+    # profile-rank<k>.jsonl into the shared directory.  Owned only when
+    # this call armed it (env-armed profilers span runs)
+    prof_owned = False
+    from bluefog_tpu.profiling import sampler as _profiling
+
+    if profile is not None:
+        if _profiling.get() is None:
+            _profiling.configure(profile, rank=rank)
+            prof_owned = True
+    else:
+        # no explicit profile= — still poke the sampler so a
+        # BLUEFOG_TPU_PROFILE env arming takes effect, stamped with
+        # this process's true rank (atexit owns its tail flush)
+        _profiling.set_rank(rank)
     # the transport may already hold live resources (the TCP server thread +
     # socket start in its constructor): EVERYTHING from here on — including
     # setup failures like a TreePacker TypeError or a window-name collision
@@ -2149,6 +2200,10 @@ def run_async_dsgd_rank(
             snapshot_every=snapshot_every, control=control,
             stop_after_steps=stop_after_steps, fleet=fleet)
     finally:
+        if prof_owned:
+            from bluefog_tpu.profiling import sampler as _profiling
+
+            _profiling.reset()  # joins the sampler + flushes the tail
         if snapshot_every:
             _snapshots.table().drop(f"{name}:{rank}")
         # land this rank's spans before the process exits the run (the

@@ -236,6 +236,12 @@ class TimelineWriter:
     def end(self, name: bytes, cat: bytes, tid: int = 0):
         self._lib.bf_timeline_end(name, cat, tid)
 
+    def begin_async(self, name: bytes, cat: bytes, tid: int = 0):
+        self._lib.bf_timeline_async_begin(name, cat, tid)
+
+    def end_async(self, name: bytes, cat: bytes, tid: int = 0):
+        self._lib.bf_timeline_async_end(name, cat, tid)
+
     def instant(self, name: bytes, cat: bytes):
         self._lib.bf_timeline_instant(name, cat)
 

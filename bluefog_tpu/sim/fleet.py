@@ -74,6 +74,10 @@ from bluefog_tpu.metrics.registry import quantile as _quantile
 from bluefog_tpu.sim.core import EventLoop, rng_for
 from bluefog_tpu.sim.network import LinkModel
 from bluefog_tpu.topology.graphs import Topology, heal, replan
+# phase spans only: when the continuous profiler is armed these tag the
+# sim's handlers as compute/gossip/publish for sample attribution; the
+# context managers carry NO wall-clock reads, so determinism holds
+from bluefog_tpu.tracing import recorder as _tr
 
 __all__ = ["SimConfig", "FleetSim", "ST_HEALTHY", "ST_SUSPECT", "ST_DEAD"]
 
@@ -399,37 +403,41 @@ class FleetSim:
             self._leave_now(r)
             return
 
-        # ---- consume the mailbox (the observing consume) ----
-        if self.mp[r] != 0.0 or self.mx[r] != 0.0:
-            if self.mp[r] > 0 and self.p[r] > 0:
-                dis = abs(self.mx[r] / self.mp[r]
-                          - self.x[r] / self.p[r])
-                self._dis_last[r] = dis
-                self.ctl[r].note_disagreement(dis)
-            self.x[r] += self.mx[r]
-            self.p[r] += self.mp[r]
-            self.mx[r] = 0.0
-            self.mp[r] = 0.0
+        with _tr.span("round", "sim", round_=step):
+            # ---- consume the mailbox (the observing consume) ----
+            if self.mp[r] != 0.0 or self.mx[r] != 0.0:
+                if self.mp[r] > 0 and self.p[r] > 0:
+                    dis = abs(self.mx[r] / self.mp[r]
+                              - self.x[r] / self.p[r])
+                    self._dis_last[r] = dis
+                    self.ctl[r].note_disagreement(dis)
+                self.x[r] += self.mx[r]
+                self.p[r] += self.mp[r]
+                self.mx[r] = 0.0
+                self.mp[r] = 0.0
 
-        # ---- gossip (plan cadence) ----
-        fence = 0.0
-        if step % self.plan.gossip_every == 0:
-            fence = self._gossip(r, t)
+            # ---- gossip (plan cadence) ----
+            fence = 0.0
+            if step % self.plan.gossip_every == 0:
+                with _tr.span("gossip", "sim", round_=step):
+                    fence = self._gossip(r, t)
 
-        # ---- telemetry at boundaries ----
-        nxt = step + 1
-        if nxt % self.cfg.fleet_every == 0:
-            self._publish_fleet(r, nxt, t)
-        if nxt % self.cfg.evidence_every == 0:
-            self._publish_evidence(r, nxt)
+            # ---- telemetry at boundaries ----
+            nxt = step + 1
+            if nxt % self.cfg.fleet_every == 0:
+                with _tr.span("publish", "sim", round_=nxt):
+                    self._publish_fleet(r, nxt, t)
+            if nxt % self.cfg.evidence_every == 0:
+                with _tr.span("publish", "sim", round_=nxt):
+                    self._publish_evidence(r, nxt)
 
-        comp = (self.cfg.base_round_s * self.compute_scale.get(r, 1.0)
-                * (1.0 + self.cfg.compute_jitter
-                   * (2.0 * self._compute_rng[r].random() - 1.0)))
-        dur = comp + extra + fence
-        self._round_samples[r].append(dur)
-        self.round_no[r] = nxt
-        self.loop.at(t + dur, self._round_fn(r))
+            comp = (self.cfg.base_round_s * self.compute_scale.get(r, 1.0)
+                    * (1.0 + self.cfg.compute_jitter
+                       * (2.0 * self._compute_rng[r].random() - 1.0)))
+            dur = comp + extra + fence
+            self._round_samples[r].append(dur)
+            self.round_no[r] = nxt
+            self.loop.at(t + dur, self._round_fn(r))
 
     def _gossip(self, r: int, t: float) -> float:
         """Split (x, p) over self + out-neighbors and ship the shares;
@@ -494,19 +502,20 @@ class FleetSim:
 
     def _deliver(self, src: int,
                  items: List[Tuple[int, float, float]]) -> None:
-        t = self.loop.now
-        fw = self._forward_to
-        for j, dx, dp in items:
-            # the heir may itself have drained since: walk the chain
-            # (always toward a later-live rank, so it terminates)
-            while fw and j in fw:
-                j = fw[j]
-            self.mx[j] += dx
-            self.mp[j] += dp
-            self._inflight_x -= dx
-            self._inflight_p -= dp
-            # receiver-side freshness clock (the thread-mode lag twin)
-            self._last_recv[j][src] = t
+        with _tr.span("apply", "sim"):
+            t = self.loop.now
+            fw = self._forward_to
+            for j, dx, dp in items:
+                # the heir may itself have drained since: walk the chain
+                # (always toward a later-live rank, so it terminates)
+                while fw and j in fw:
+                    j = fw[j]
+                self.mx[j] += dx
+                self.mp[j] += dp
+                self._inflight_x -= dx
+                self._inflight_p -= dp
+                # receiver-side freshness clock (the thread-mode lag twin)
+                self._last_recv[j][src] = t
 
     # ----------------------------------------------------- graceful leave
     def _leave_now(self, r: int) -> None:
@@ -594,7 +603,8 @@ class FleetSim:
         while not self._await_left:
             if not any(self.alive):
                 return
-            self._epoch_barrier(self._epoch_decided + 1)
+            with _tr.span("control", "sim"):
+                self._epoch_barrier(self._epoch_decided + 1)
             nxt = (self._epoch_decided + 1) * e
             self._await_left = {
                 m for m in self.members()

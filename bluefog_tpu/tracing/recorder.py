@@ -62,12 +62,15 @@ from bluefog_tpu.utils import lockcheck as _lc
 __all__ = [
     "Span",
     "SpanRecorder",
+    "active_phases",
     "configure",
     "current_ctx",
     "enabled",
     "flush",
     "get",
+    "phase_tracking",
     "reset",
+    "set_phase_tracking",
     "set_rank",
     "span",
     "trace_id_for",
@@ -103,6 +106,65 @@ class _Ctx(threading.local):
 
 
 _ctx = _Ctx()
+
+#: thread ident -> (innermost span name, round) — the cross-thread
+#: mirror of ``_ctx.stack``'s top.  A ``threading.local`` cannot be
+#: read from another thread, and the profiling sampler thread must tag
+#: every sample with the SAMPLED thread's open span; this dict is
+#: written with single GIL-atomic assignments by the span context
+#: managers (save-prev on enter, restore-or-delete on exit) and read
+#: lock-free by the sampler (:mod:`bluefog_tpu.profiling`) — no lock
+#: anywhere, by construction.
+_ACTIVE: Dict[int, Tuple[str, Optional[int]]] = {}
+
+#: when True, :func:`span` maintains ``_ACTIVE`` even with tracing OFF
+#: (a near-free phase-only context manager) — armed by the profiler so
+#: ``profile=`` users get phase attribution without paying for full
+#: span recording
+_PHASE_TRACK = False
+
+
+def set_phase_tracking(on: bool) -> None:
+    """Arm/disarm phase-only context tracking (the profiler's switch).
+    Idempotent; a plain bool flip — safe from any thread."""
+    global _PHASE_TRACK
+    _PHASE_TRACK = bool(on)
+
+
+def phase_tracking() -> bool:
+    return _PHASE_TRACK
+
+
+def active_phases() -> Dict[int, Tuple[str, Optional[int]]]:
+    """The live thread-ident -> (span name, round) map.  Returned BY
+    REFERENCE for the sampler's lock-free per-tick reads; treat it as
+    read-only everywhere else."""
+    return _ACTIVE
+
+
+class _PhaseCm:
+    """Phase-only span: maintains ``_ACTIVE`` with no recorder, no
+    timestamps, no allocation beyond the CM itself — what :func:`span`
+    returns when tracing is off but the profiler wants attribution."""
+
+    __slots__ = ("name", "round", "_ident", "_prev")
+
+    def __init__(self, name, round_):
+        self.name = name
+        self.round = round_
+
+    def __enter__(self):
+        self._ident = threading.get_ident()
+        self._prev = _ACTIVE.get(self._ident)
+        _ACTIVE[self._ident] = (self.name, self.round)
+        return None
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            _ACTIVE.pop(self._ident, None)
+        else:
+            _ACTIVE[self._ident] = self._prev
+        return False
 
 
 class Span:
@@ -309,7 +371,8 @@ class SpanRecorder:
 
 
 class _SpanCm:
-    __slots__ = ("rec", "name", "cat", "round", "fields", "sp")
+    __slots__ = ("rec", "name", "cat", "round", "fields", "sp",
+                 "_ident", "_prev")
 
     def __init__(self, rec, name, cat, round_, fields):
         self.rec = rec
@@ -323,10 +386,19 @@ class _SpanCm:
         self.sp = self.rec.begin_span(self.name, self.cat,
                                       round_=self.round, **self.fields)
         _ctx.stack.append((self.sp.tid, self.sp.sid, self.sp.round))
+        # cross-thread phase mirror for the profiling sampler: one
+        # GIL-atomic dict assignment, restored on exit
+        self._ident = threading.get_ident()
+        self._prev = _ACTIVE.get(self._ident)
+        _ACTIVE[self._ident] = (self.name, self.sp.round)
         return self.sp
 
     def __exit__(self, *exc):
         try:
+            if self._prev is None:
+                _ACTIVE.pop(self._ident, None)
+            else:
+                _ACTIVE[self._ident] = self._prev
             if _ctx.stack:
                 _ctx.stack.pop()
         finally:
@@ -414,9 +486,13 @@ def flush() -> None:
 def span(name: str, cat: str = "", *, round_: Optional[int] = None,
          **fields):
     """Module-level convenience: a no-op context manager when tracing
-    is off (one env read + a None test)."""
+    is off (one env read + a None test) — unless the profiler armed
+    phase tracking, in which case a near-free phase-only CM maintains
+    the sampler's thread->phase map without any span recording."""
     rec = get()
     if rec is None:
+        if _PHASE_TRACK:
+            return _PhaseCm(name, round_)
         return _NULL_CM
     return rec.span(name, cat, round_=round_, **fields)
 

@@ -156,18 +156,26 @@ class Timeline:
                     tid: int = 0) -> int:
         """Open an async span instance (``ph: "b"``); returns its id.
 
-        Native-writer caveat: the C++ writer's API has only B/E duration
-        events, so when it is loaded async spans DEGRADE to name-matched
-        B/E (interleaved same-name instances can render crossed there,
-        and open-span bookkeeping is skipped).  The no-mis-nest
-        guarantee holds on the pure-Python writer — the always-available
-        path, and the only one in containers without the native lib."""
+        Native-writer caveat: the C++ writer is only used for the async
+        flavor when a once-per-process runtime probe showed its async
+        events are faithful (``ph: "b"/"e"``, preserved lane, unique
+        FIFO-paired per-instance ids — see :func:`_try_native`).  A lib
+        that fails the probe routes the WHOLE timeline through the
+        pure-Python writer, whose no-mis-nest guarantee is tested;
+        open-span bookkeeping is kept python-side in both cases so
+        :meth:`open_spans` forensics never depend on the C++ path."""
         if getattr(self, "_closed", False):
             return 0
-        if self._native is not None:
-            self._native.begin(name.encode(), category.encode(), tid)
-            return 0
         aid = next(self._async_ids)
+        if self._native is not None:
+            # the probe verified the native writer mints its own
+            # faithful per-instance ids; the python-side aid only keys
+            # the open-span table for blackbox forensics
+            self._native.begin_async(name.encode(), category.encode(), tid)
+            with self._lock:
+                self._open_push(self._open_async, (name, category, tid),
+                                (aid, self._now_us()))
+            return aid
         ev = {"name": name, "cat": category, "ph": "b", "ts": self._now_us(),
               "pid": os.getpid(), "tid": tid, "id": f"0x{aid:x}"}
         with self._lock:
@@ -184,8 +192,16 @@ class Timeline:
         if getattr(self, "_closed", False):
             return 0
         if self._native is not None:
-            self._native.end(name.encode(), category.encode(), tid)
-            return 0
+            self._native.end_async(name.encode(), category.encode(), tid)
+            with self._lock:
+                q = self._open_async.get((name, category, tid))
+                if q:
+                    aid = q.popleft()[0]
+                    if not q:
+                        self._open_async.pop((name, category, tid), None)
+                else:
+                    aid = next(self._async_ids)
+            return aid
         with self._lock:
             q = self._open_async.get((name, category, tid))
             if q:
@@ -277,11 +293,77 @@ class Timeline:
                 self._finalized = True
 
 
+#: cached once-per-process verdict of :func:`_probe_native_async`
+_NATIVE_ASYNC_OK: Optional[bool] = None
+
+
+def _probe_native_async() -> bool:
+    """Runtime fidelity probe of the native writer's ASYNC events.
+
+    Some builds of the C++ writer export ``bf_timeline_async_begin/end``
+    but emit unusable records (observed in this container: the ``tid``
+    argument written into ``"id"``, the lane forced to 0, and one id
+    reused across instances — every span-rendering guarantee the async
+    flavor exists for, broken).  Rather than trust the symbol table,
+    emit two interleaved same-name instances on one lane into a scratch
+    file and check what actually lands: ``ph: "b"/"e"``, the lane
+    preserved, two distinct per-instance ids, FIFO-paired (first end
+    closes the first begin).  Any miss routes the whole timeline through
+    the pure-Python writer, whose semantics are tested."""
+    import tempfile
+
+    from bluefog_tpu.runtime import native
+
+    fd, path = tempfile.mkstemp(prefix="bf-tl-probe-", suffix=".json")
+    os.close(fd)
+    try:
+        w = native.TimelineWriter(path)
+        try:
+            for _ in range(2):
+                w.begin_async(b"probe", b"cat", 7)
+            for _ in range(2):
+                w.end_async(b"probe", b"cat", 7)
+        finally:
+            w.close()
+        with open(path) as f:
+            events = json.load(f)
+        evs = [e for e in events if e.get("name") == "probe"]
+        begins = [e for e in evs if e.get("ph") == "b"]
+        ends = [e for e in evs if e.get("ph") == "e"]
+        if len(begins) != 2 or len(ends) != 2:
+            return False
+        if any(e.get("tid") != 7 for e in begins + ends):
+            return False
+        b_ids = [e.get("id") for e in begins]
+        e_ids = [e.get("id") for e in ends]
+        # distinct per-instance ids, FIFO-paired, none missing
+        return (None not in b_ids and len(set(b_ids)) == 2
+                and b_ids == e_ids)
+    except Exception:
+        return False
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def _try_native(path: str):
-    """Use the C++ timeline writer when the native runtime is built."""
+    """Use the C++ timeline writer when the native runtime is built AND
+    its async events pass the once-per-process fidelity probe — a lib
+    whose async records are broken (see :func:`_probe_native_async`)
+    must not silently eat the span guarantees ``device_stage`` and the
+    span tests rely on."""
+    global _NATIVE_ASYNC_OK
     try:
         from bluefog_tpu.runtime import native
 
+        if native.load() is None:
+            return None
+        if _NATIVE_ASYNC_OK is None:
+            _NATIVE_ASYNC_OK = _probe_native_async()
+        if not _NATIVE_ASYNC_OK:
+            return None
         return native.TimelineWriter(path)
     except Exception:
         return None

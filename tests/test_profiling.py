@@ -1,0 +1,393 @@
+"""Continuous profiling: sampler attribution, zero-cost disabled path,
+torn-tail merge, the differential gate's exit codes, and the BF-PROF /
+BF-DOC004 lint rules."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import bluefog_tpu.profiling as bp
+from bluefog_tpu.profiling import sampler as ps
+from bluefog_tpu.profiling import report as pr
+from bluefog_tpu.profiling.cli import main as prof_main
+from bluefog_tpu.tracing import recorder as tr
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_profiler():
+    """Every test leaves the process with no sampler thread and phase
+    tracking off (the disabled-path tests depend on it)."""
+    yield
+    ps.reset()
+    assert not [t for t in threading.enumerate()
+                if t.name == ps.Profiler.THREAD_NAME]
+
+
+def _busy_until(deadline):
+    x = 0
+    while time.perf_counter() < deadline:
+        x += 1
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Attribution
+# ---------------------------------------------------------------------------
+
+
+def test_phase_attribution_80_20(tmp_path):
+    """A worker spending ~80% of its wall time in a compute span and
+    ~20% in a gossip span attributes within ±10 percentage points."""
+    bp.configure(str(tmp_path), rank=0, hz=400)
+    stop = time.perf_counter() + 1.6
+    # run the workload on ITS OWN thread: the sampler never samples a
+    # thread it cannot see, and the main thread carries pytest frames
+    def worker():
+        while time.perf_counter() < stop:
+            with tr.span("compute", "test"):
+                _busy_until(time.perf_counter() + 0.008)
+            with tr.span("gossip", "test"):
+                _busy_until(time.perf_counter() + 0.002)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join()
+    ps.reset()
+
+    rep = bp.merge(str(tmp_path))
+    comp = rep["phases"].get("compute", 0)
+    goss = rep["phases"].get("gossip", 0)
+    assert comp + goss >= 100, rep["phases"]  # enough samples to judge
+    frac = comp / (comp + goss)
+    assert 0.70 <= frac <= 0.90, frac
+    # and the report's attribution covers the worker's share of samples
+    assert rep["attributed_frac"] > 0.0
+    assert rep["ranks"] == [0]
+
+
+def test_phase_only_tracking_without_tracing(tmp_path):
+    """span() maintains the phase map for the sampler even when tracing
+    is off — and drops back to the free null CM once disarmed."""
+    assert tr.span("compute", "t") is tr._NULL_CM
+    bp.configure(str(tmp_path), rank=0, hz=50)
+    cm = tr.span("compute", "t", round_=3)
+    assert cm is not tr._NULL_CM
+    with cm:
+        assert tr.active_phases()[threading.get_ident()] == ("compute", 3)
+    assert threading.get_ident() not in tr.active_phases()
+    ps.reset()
+    assert tr.span("compute", "t") is tr._NULL_CM
+
+
+# ---------------------------------------------------------------------------
+# Disabled path: exactly nothing
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_no_thread_and_identical_hlo(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    assert ps.get() is None
+    assert not [t for t in threading.enumerate()
+                if t.name == ps.Profiler.THREAD_NAME]
+
+    @jax.jit
+    def fn(x):
+        return (x * 2.0).sum()
+
+    x = jnp.arange(8.0)
+    hlo_off = fn.lower(x).compile().as_text()
+
+    bp.configure(str(tmp_path), rank=0, hz=50)
+    try:
+        assert [t for t in threading.enumerate()
+                if t.name == ps.Profiler.THREAD_NAME]
+        hlo_on = fn.lower(x).compile().as_text()
+    finally:
+        ps.reset()
+    assert hlo_on == hlo_off  # byte-identical: no callbacks, no hooks
+
+
+def test_env_lazy_arming_and_sticky_reset(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TPU_PROFILE", str(tmp_path))
+    # a prior test's reset() left the sticky stop set (by design: env
+    # alone never resurrects a stopped sampler) — model a fresh process
+    monkeypatch.setattr(ps, "_STOPPED", False)
+    prof = ps.get()
+    assert prof is not None and prof.directory == str(tmp_path)
+    ps.reset()
+    # sticky: the env var alone must not resurrect a reset profiler
+    assert ps.get() is None
+    # but an explicit configure un-sticks
+    assert ps.configure(str(tmp_path), rank=1) is ps.get()
+    ps.reset()
+
+
+def test_bad_hz_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        ps.Profiler(str(tmp_path), hz=-5)
+    with pytest.raises(ValueError):
+        ps.Profiler(str(tmp_path), hz=5000)
+
+
+# ---------------------------------------------------------------------------
+# Merge: torn tails, multi-rank
+# ---------------------------------------------------------------------------
+
+
+def _window(rank, t0, t1, stacks):
+    phases = {}
+    for ph, _, n in stacks:
+        phases[ph] = phases.get(ph, 0) + n
+    return {"kind": "window", "t0": t0, "t1": t1, "rank": rank,
+            "hz": 97.0, "samples": sum(n for _, _, n in stacks),
+            "phases": phases, "stacks": stacks}
+
+
+def test_merge_tolerates_torn_tail(tmp_path):
+    p0 = tmp_path / "profile-rank0.jsonl"
+    lines = [
+        json.dumps({"kind": "meta", "rank": 0, "pid": 1, "hz": 97.0,
+                    "t0": 10.0}),
+        json.dumps(_window(0, 10.0, 11.0, [["compute", "a;b", 5]])),
+        json.dumps(_window(0, 11.0, 12.0,
+                           [["compute", "a;b", 3],
+                            ["net-wait", "a;c", 2]])),
+    ]
+    # a crashed writer's torn tail: half a JSON object, no newline
+    p0.write_text("\n".join(lines) + "\n" + '{"kind": "wind')
+    p1 = tmp_path / "profile-rank1.jsonl"
+    p1.write_text(json.dumps(
+        _window(1, 10.5, 11.5, [["compute", "a;b", 4]])) + "\n")
+
+    rep = pr.merge(str(tmp_path))
+    assert rep["ranks"] == [0, 1]
+    assert rep["samples"] == 14  # the torn record contributes nothing
+    assert rep["frames"]["b"]["self"] == 12
+    assert rep["frames"]["a"]["total"] == 14
+    assert rep["wall_s"] == 2.0
+    # folded render keeps the phase as the root frame
+    folded = pr.render_folded(rep)
+    assert "compute;a;b 12" in folded
+    svg = pr.render_svg(rep)
+    assert svg.startswith("<svg") and "compute" in svg
+
+
+def test_phase_frames_names_leafs():
+    rep = {"stacks": [["net-wait", "a;b;wait_loop", 7],
+                      ["net-wait", "a;wait_loop", 3],
+                      ["compute", "a;matmul", 9]]}
+    assert pr.phase_frames(rep, "net-wait")[0] == ("wait_loop", 10)
+
+
+# ---------------------------------------------------------------------------
+# The differential gate
+# ---------------------------------------------------------------------------
+
+
+def _report_json(tmp_path, name, frames, samples):
+    rep = {"kind": "bfprof_report", "samples": samples,
+           "frames": {fr: {"self": n, "total": n}
+                      for fr, n in frames.items()},
+           "phases": {}, "phase_frac": {}, "attributed_frac": 0.0,
+           "ranks": [0], "stacks": []}
+    path = tmp_path / name
+    path.write_text(json.dumps(rep))
+    return str(path)
+
+
+def test_diff_exit_codes(tmp_path, capsys):
+    base = _report_json(tmp_path, "base.json",
+                        {"hot": 500, "warm": 300, "cold": 200}, 1000)
+    clean = _report_json(tmp_path, "clean.json",
+                         {"hot": 510, "warm": 290, "cold": 200}, 1000)
+    # seeded >= 20% relative regression on an established hot frame
+    regr = _report_json(tmp_path, "regr.json",
+                        {"hot": 700, "warm": 150, "cold": 150}, 1000)
+
+    assert prof_main(["--diff", base, clean]) == 0
+    assert prof_main(["--diff", base, regr]) == 3
+    out = capsys.readouterr().out
+    assert '"ok": false' in out and "hot" in out
+    # a tighter threshold flips the clean pair too
+    assert prof_main(["--diff", base, regr, "--threshold", "0.9"]) == 0
+    # load errors exit 2, not 3
+    assert prof_main(["--diff", base, str(tmp_path / "missing.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert prof_main(["--diff", base, str(bad)]) == 2
+
+
+def test_diff_flags_new_hot_frame():
+    base = {"samples": 1000, "frames": {"a": {"self": 1000}}}
+    head = {"samples": 1000, "frames": {"a": {"self": 900},
+                                        "newcomer": {"self": 100}}}
+    v = pr.diff(base, head)
+    assert not v["ok"]
+    assert v["regressions"][0]["frame"] == "newcomer"
+    assert v["regressions"][0]["new"] is True
+
+
+def test_cli_report_and_empty_dir(tmp_path, capsys):
+    assert prof_main([str(tmp_path)]) == 2  # no samples: usage error
+    capsys.readouterr()
+    (tmp_path / "profile-rank0.jsonl").write_text(
+        json.dumps(_window(0, 0.0, 1.0, [["compute", "m:f", 10]])) + "\n")
+    assert prof_main([str(tmp_path), "--top", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "m:f" in out and "compute" in out
+    svg_path = tmp_path / "fg.svg"
+    assert prof_main([str(tmp_path), "--svg", str(svg_path)]) == 0
+    assert svg_path.read_text().startswith("<svg")
+
+
+# ---------------------------------------------------------------------------
+# Wiring: runner, blackbox dump, fleet record
+# ---------------------------------------------------------------------------
+
+
+def test_thread_runner_profile_wiring(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.runtime import async_windows as aw
+    from bluefog_tpu.topology import RingGraph
+
+    def loss_and_grad(rank, step, params):
+        return 0.0, {"x": params["x"] * 0.0}
+
+    report = aw.run_async_dsgd(
+        RingGraph(2), {"x": jnp.zeros(4)}, loss_and_grad,
+        duration_s=1.0, name=f"dsgd_prof_{os.getpid()}",
+        profile=str(tmp_path))
+    assert abs(report.total_mass - 2) < 1e-9
+    # the runner stopped the sampler it started…
+    assert ps.get() is None
+    assert not [t for t in threading.enumerate()
+                if t.name == ps.Profiler.THREAD_NAME]
+    # …after it wrote this run's per-rank profile
+    assert (tmp_path / "profile-rank0.jsonl").exists()
+    rep = pr.merge(str(tmp_path))
+    assert rep["samples"] > 0
+
+
+def test_blackbox_dump_embeds_profile(tmp_path):
+    import importlib
+    bdump = importlib.import_module("bluefog_tpu.blackbox.dump")
+
+    bp.configure(str(tmp_path / "prof"), rank=0, hz=200)
+    t = threading.Thread(target=_busy_until,
+                         args=(time.perf_counter() + 0.4,), daemon=True)
+    t.start()
+    t.join()
+    path = bdump.dump("test_profile_embed",
+                      directory=str(tmp_path / "bb"), rank=3)
+    ps.reset()
+    assert path is not None
+    lines = [json.loads(line)
+             for line in open(path).read().splitlines()]
+    prof_lines = [ln["profile"] for ln in lines if "profile" in ln]
+    assert len(prof_lines) == 1
+    assert prof_lines[0]["samples"] > 0
+    assert prof_lines[0]["window_s"] == ps.RECENT_WINDOW_S
+    assert prof_lines[0]["stacks"]
+
+
+def test_fleet_record_profile_digest_roundtrip():
+    from bluefog_tpu.fleet.record import FleetRecord
+
+    rec = FleetRecord(rank=1, round=4, t=1.0,
+                      profile={"mod.py:hot": 0.62, "mod.py:warm": 0.2})
+    back = FleetRecord.from_json(rec.to_json())
+    assert back.profile == {"mod.py:hot": 0.62, "mod.py:warm": 0.2}
+    # canonical bytes stay canonical
+    assert back.to_json() == rec.to_json()
+    # pre-profile records (older writers) parse with an empty digest
+    old = json.loads(rec.to_json())
+    del old["profile"]
+    assert FleetRecord.from_json(json.dumps(old)).profile == {}
+
+
+def test_recorder_recent_window():
+    from bluefog_tpu.blackbox.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=16)
+    for i in range(3):
+        rec.record("tick", i=i)
+    got = rec.recent(60.0)
+    assert [e["i"] for e in got] == [0, 1, 2]  # oldest first
+    # age the first event out of the window (the ring stores wall
+    # times; aging one directly beats sleeping in a tier-1 test)
+    rec._events[0]["t"] -= 120.0
+    got = rec.recent(60.0)
+    assert [e["i"] for e in got] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Lint rules
+# ---------------------------------------------------------------------------
+
+
+def test_profiling_lint_clean_on_package():
+    import glob
+    from bluefog_tpu.analysis.profiling_lint import check_file
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bluefog_tpu", "profiling")
+    errors = []
+    for p in sorted(glob.glob(os.path.join(root, "*.py"))):
+        errors += [d for d in check_file(p) if d.severity == "error"]
+    assert not errors, [d.message for d in errors]
+
+
+def test_profiling_lint_catches_hot_path_violations(tmp_path):
+    from bluefog_tpu.analysis.profiling_lint import check_file
+
+    bad = tmp_path / "bad_sampler.py"
+    bad.write_text(
+        "import sys, json, collections\n"
+        "ring = collections.deque()\n"          # BF-PROF002
+        "def _log(rec):\n"
+        "    return json.dumps(rec)\n"          # reachable: BF-PROF001
+        "def sample(lock):\n"
+        "    frames = sys._current_frames()\n"
+        "    with lock:\n"                      # BF-PROF001 (lock name)
+        "        _log(frames)\n")
+    codes = [d.code for d in check_file(str(bad))
+             if d.severity == "error"]
+    assert "BF-PROF002" in codes
+    assert codes.count("BF-PROF001") == 2, codes
+
+
+def test_cli_doc_lint_both_directions(tmp_path):
+    from bluefog_tpu.analysis.doc_lint import check_cli_doc
+
+    # the live repo agrees
+    assert not [d for d in check_cli_doc() if d.severity == "error"]
+
+    pyproject = tmp_path / "pyproject.toml"
+    pyproject.write_text(
+        "[project.scripts]\n"
+        'bfx-tpu = "m.cli:main"\n'
+        'bfy-tpu = "m.cli:other"\n')
+    doc = tmp_path / "API.md"
+    doc.write_text("`bfx-tpu` does things; `bfstale-tpu` was renamed.\n")
+    diags = check_cli_doc(doc_path=str(doc),
+                          pyproject_path=str(pyproject))
+    subjects = {d.subject for d in diags if d.severity == "error"}
+    assert subjects == {"bfy-tpu", "bfstale-tpu"}
+
+
+def test_lint_run_all_includes_profiling_pass():
+    # registration, not a full sweep (bflint runs the whole thing in
+    # test_analysis): the pass list must name profiling-lint
+    from bluefog_tpu.analysis import lint as L
+    from bluefog_tpu.analysis.report import LintReport
+
+    report = LintReport()
+    L.profiling_pass(report, 8)
+    assert any(d.code == "BF-PROF101" for d in report.diagnostics)
+    assert not [d for d in report.diagnostics if d.severity == "error"]
