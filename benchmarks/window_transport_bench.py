@@ -6,7 +6,7 @@ window table over loopback TCP, for a ResNet-50-sized parameter tree split
 into per-leaf windows — the deposit shape of one async-dsgd gossip round
 toward one out-neighbor.
 
-Three variants, same byte stream:
+Five deposit variants, same byte stream:
 
 - ``sync``       — the v1-wire-equivalent baseline: one blocking
                    request/response round-trip per leaf with v1's client
@@ -19,6 +19,27 @@ Three variants, same byte stream:
 - ``pipelined_f32`` — pipelined + f32 wire codec (halves f64 bytes; the
                    compression leg of the DCN story).  ``--codec topk``
                    swaps in the top-k codec.
+- ``shm``        — same stream, ``shm=True``: the owner is co-located, so
+                   deposits route through the named-shm window table and
+                   the loopback TCP hop disappears (skipped when the
+                   native runtime is unavailable).
+- ``striped``    — :class:`StripedDepositStream`: N parallel connections
+                   to the one peer, window names spread by
+                   :func:`stripe_of` — N senders and N server-side
+                   appliers instead of one of each (``--stripes``).
+
+Plus a compute/gossip **overlap** A/B (``--no-overlap`` to skip): a real
+3-rank mp-dsgd run, traced, serial vs ``overlap=True`` — the tracer's
+per-round ``overlap`` field is the measured hidden-fold fraction, and the
+before/after :func:`bluefog_tpu.tracing.analyze.analyze` reports are the
+PROFILE §6 evidence (``--profiles DIR`` writes them as
+``TRACE_transport_before.json`` / ``TRACE_transport_after.json``).
+
+The committed ``BENCH_transport.json`` carries ``*_ok`` gate booleans
+(pipelined/shm/striped beat their single-stream baselines on the median
+of interleaved per-trial ratios; measured overlap fraction > 0), which
+``bffleet-tpu --check`` and the tier-1 suite verify like every other
+committed bench trajectory.
 
 The server runs in a SEPARATE OS process (like production: the owner's
 daemon thread receives while the owner computes), so client and server do
@@ -57,11 +78,20 @@ os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 os.environ['PALLAS_AXON_POOL_IPS'] = ''
 import numpy as np
 sys.path.insert(0, {repo!r})
-from bluefog_tpu.runtime.async_windows import AsyncWindow, _fallback
+from bluefog_tpu.runtime.async_windows import (AsyncWindow, _fallback,
+                                               shm_unlink_window)
 from bluefog_tpu.runtime import native
 from bluefog_tpu.runtime.window_server import WindowServer
 sizes = {sizes!r}
-wins = [AsyncWindow(f'tpb:{{i}}', 1, n, np.{dtype}) for i, n in enumerate(sizes)]
+# shm-backed windows when the native runtime allows: the same window
+# table serves both the TCP variants (server-side apply lands in shm)
+# and the shm fast-path variant (client-side apply, no wire)
+shm_ok = native.load() is not None
+if shm_ok:
+    for i in range(len(sizes)):
+        shm_unlink_window(f'tpb:{{i}}')
+wins = [AsyncWindow(f'tpb:{{i}}', 1, n, np.{dtype}, shm=shm_ok)
+        for i, n in enumerate(sizes)]
 srv = WindowServer()
 _, port = srv.start('127.0.0.1')
 
@@ -115,7 +145,7 @@ ls = socket.socket(); ls.bind(('127.0.0.1', 0)); ls.listen(64)
 v1_port = ls.getsockname()[1]
 threading.Thread(target=_v1_listen, args=(ls,), daemon=True).start()
 
-print(f'PORT {{port}} {{v1_port}}', flush=True)
+print(f'PORT {{port}} {{v1_port}} {{int(shm_ok)}}', flush=True)
 sys.stdin.readline()          # parent: all variants done
 ls.close()
 srv.stop()
@@ -199,7 +229,8 @@ def bench_sync(port, sizes, payloads, rounds, dtype):
     return dt, lat
 
 
-def bench_pipelined(port, sizes, payloads, rounds, dtype, codec=None):
+def bench_pipelined(port, sizes, payloads, rounds, dtype, codec=None,
+                    shm=False):
     """ONE :class:`DepositStream` to the peer: a round's leaves coalesce
     into batched multi-deposit frames (the per-peer progress-engine
     deployment shape).  Two phases: round LATENCY is measured honestly —
@@ -210,7 +241,7 @@ def bench_pipelined(port, sizes, payloads, rounds, dtype, codec=None):
     from bluefog_tpu.runtime.window_server import DepositStream
 
     stream = DepositStream(("127.0.0.1", port), codec=codec,
-                           max_in_flight=8)
+                           max_in_flight=8, shm=shm)
     names = [f"tpb:{i}".encode() for i in range(len(sizes))]
 
     def one_round():
@@ -233,8 +264,133 @@ def bench_pipelined(port, sizes, payloads, rounds, dtype, codec=None):
         one_round()
     stream.flush(timeout_s=600)
     dt = time.perf_counter() - t0
+    if shm:
+        # the variant must measure what it claims: every deposit after
+        # warmup routed through the shm table, none fell back to TCP
+        assert stream.shm_deposits > 0, "shm fast path never engaged"
     stream.close()
     return dt, lat
+
+
+def bench_striped(port, sizes, payloads, rounds, dtype, n_stripes):
+    """:class:`StripedDepositStream`: the line-rate DCN shape — N
+    parallel connections to the one peer, window names spread across
+    stripes by :func:`stripe_of`, one fence across all stripes at the
+    end (same audit discipline as one stream)."""
+    from bluefog_tpu.runtime.window_server import StripedDepositStream
+
+    stream = StripedDepositStream(("127.0.0.1", port),
+                                  n_stripes=n_stripes,
+                                  max_in_flight=8)
+    names = [f"tpb:{i}".encode() for i in range(len(sizes))]
+
+    def one_round():
+        for nm, p in zip(names, payloads):
+            stream.deposit_async(nm, 0, p, accumulate=True, copy=False)
+
+    one_round()               # warmup (threads, buffers, cwnd)
+    stream.flush(timeout_s=600)
+    lat = []
+    for _ in range(rounds):   # latency phase: fence every round
+        r0 = time.perf_counter()
+        one_round()
+        stream.flush(timeout_s=600)
+        lat.append(time.perf_counter() - r0)
+    t0 = time.perf_counter()
+    for _ in range(rounds):   # throughput phase: fence once at the end
+        one_round()
+    stream.flush(timeout_s=600)
+    dt = time.perf_counter() - t0
+    stream.close()
+    return dt, lat
+
+
+_AB_WORKER_CODE = """
+import os, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ['PALLAS_AXON_POOL_IPS'] = ''
+os.environ['BLUEFOG_TPU_TRACE'] = {tdir!r}
+sys.path.insert(0, {repo!r})
+import numpy as np
+from bluefog_tpu.runtime.async_windows import FileBarrier, run_async_dsgd_rank
+from bluefog_tpu.topology.graphs import RingGraph
+
+def lg(rank, step, z):
+    acc = z
+    for _ in range({spin}):          # compute leg: the overlap's cover
+        acc = acc * 0.999 + z * 0.001
+    return float(np.sum(acc ** 2)), 2 * acc
+
+rep = run_async_dsgd_rank(
+    RingGraph(3), {rank}, np.ones({d}), lg,
+    barrier=FileBarrier({bdir!r}, 3, {rank}), duration_s=120.0,
+    stop_after_steps={steps}, transport='tcp', name={name!r},
+    stream_options={stream_options!r}, overlap={overlap!r})
+print('MASS', rep.total_mass if rep is not None else None, flush=True)
+"""
+
+
+def bench_overlap_ab(repo, env, *, small, profiles_dir=None):
+    """Compute/gossip overlap, measured on the real thing: a 3-rank
+    mp-dsgd ring over loopback TCP, traced, run twice — serial
+    (``overlap=False``, plain single-stream TCP: the BEFORE profile)
+    and with the full hot path on (``overlap=True`` + shm fast path +
+    2 stripes: the AFTER profile).  The tracer's per-round ``overlap``
+    field is the measured hidden-fold fraction (exactly 0 in the
+    before run); the two :func:`~bluefog_tpu.tracing.analyze.analyze`
+    reports are the PROFILE §6 critical-path evidence."""
+    import shutil
+    import tempfile
+
+    from bluefog_tpu.tracing.analyze import analyze
+
+    d = 4096 if small else 65536
+    steps = 12 if small else 30
+    spin = 4 if small else 12
+    out = {}
+    reports = {}
+    for tag, overlap, opts in (
+            ("before", False, {}),
+            ("after", True, {"shm": True, "stripes": 2})):
+        tdir = tempfile.mkdtemp(prefix=f"tpb_trace_{tag}_")
+        bdir = tempfile.mkdtemp(prefix=f"tpb_bar_{tag}_")
+        try:
+            procs = [subprocess.Popen(
+                [sys.executable, "-c", _AB_WORKER_CODE.format(
+                    tdir=tdir, repo=repo, spin=spin, rank=r, d=d,
+                    bdir=bdir, steps=steps, name=f"tpov_{tag}",
+                    stream_options=opts, overlap=overlap)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo) for r in range(3)]
+            outs = [p.communicate(timeout=300)[0] for p in procs]
+            assert all(p.returncode == 0 for p in procs), outs
+            assert any("MASS 3.0" in o or "MASS 2.99" in o
+                       for o in outs), outs
+            rep = analyze(tdir)
+            reports[tag] = rep
+            rr = rep["rounds"]["per_rank"]
+            ovs = [st["overlap_mean"] for st in rr.values()
+                   if "overlap_mean" in st]
+            out[tag] = {
+                "round_mean_ms": round(1e3 * sum(
+                    st["round_mean_s"] for st in rr.values())
+                    / max(1, len(rr)), 2),
+                "overlap_mean": round(sum(ovs) / len(ovs), 4) if ovs
+                                else 0.0,
+                "gating_edge": rep["critical_path"].get("gating_edge"),
+                "dominant_phase":
+                    rep["critical_path"].get("dominant_phase"),
+            }
+        finally:
+            if profiles_dir and tag in reports:
+                with open(os.path.join(
+                        profiles_dir,
+                        f"TRACE_transport_{tag}.json"), "w") as f:
+                    json.dump(reports[tag], f, indent=1, sort_keys=True)
+            shutil.rmtree(tdir, ignore_errors=True)
+            shutil.rmtree(bdir, ignore_errors=True)
+    out["overlap_ok"] = out["after"]["overlap_mean"] > 0.0
+    return out
 
 
 def main():
@@ -249,6 +405,15 @@ def main():
                     choices=["float32", "float64"])
     ap.add_argument("--codec", default="f32", choices=["f32", "topk"],
                     help="wire codec for the compressed variant")
+    ap.add_argument("--stripes", type=int, default=2,
+                    help="stripe count for the striped variant (the "
+                    "autotuner's first widening step; raise on multi-core "
+                    "DCN hosts where parallel appliers pay off)")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="skip the traced compute/gossip overlap A/B")
+    ap.add_argument("--profiles", default=None, metavar="DIR",
+                    help="write TRACE_transport_{before,after}.json "
+                    "(full bftrace analyze reports) into DIR")
     args = ap.parse_args()
 
     sizes = _SMALL_LEAVES if args.small else _RESNET50_LEAVES
@@ -271,10 +436,12 @@ def main():
         stderr=subprocess.STDOUT, text=True, env=env, cwd=repo)
     try:
         port = v1_port = None
+        shm_capable = False
         for line in owner.stdout:
             if line.startswith("PORT "):
-                _, a, b = line.split()
+                _, a, b, c = line.split()
                 port, v1_port = int(a), int(b)
+                shm_capable = bool(int(c))
                 break
         assert port and v1_port, "owner never published its ports"
 
@@ -289,7 +456,12 @@ def main():
                 port, sizes, payloads, rounds, dtype)),
             (f"pipelined_{args.codec}", lambda: bench_pipelined(
                 port, sizes, payloads, rounds, dtype, codec=args.codec)),
+            ("striped", lambda: bench_striped(
+                port, sizes, payloads, rounds, dtype, args.stripes)),
         ]
+        if shm_capable:
+            bench_fns.append(("shm", lambda: bench_pipelined(
+                port, sizes, payloads, rounds, dtype, shm=True)))
         trials = max(1, args.trials)
         runs = {name: [] for name, _ in bench_fns}
         for _ in range(trials):
@@ -306,8 +478,18 @@ def main():
                 "trial_MBps": [round(dense_mb * rounds / d, 1)
                                for d, _ in runs[name]],
             }
-        ratios = sorted(s / p for (p, _), (s, _)
-                        in zip(runs["pipelined"], runs["sync"]))
+
+        def _median_ratio(fast, slow):
+            # per-trial ratios of temporally adjacent runs (see above)
+            rs = sorted(s / f for (f, _), (s, _)
+                        in zip(runs[fast], runs[slow]))
+            return rs, rs[len(rs) // 2]
+
+        ratios, speedup = _median_ratio("pipelined", "sync")
+        _, striped_speedup = _median_ratio("striped", "pipelined")
+        shm_speedup = None
+        if shm_capable:
+            _, shm_speedup = _median_ratio("shm", "pipelined")
         owner.stdin.write("done\n")
         owner.stdin.flush()
         tail = owner.stdout.read()
@@ -317,8 +499,7 @@ def main():
             owner.kill()
             owner.wait()
 
-    speedup = ratios[len(ratios) // 2]  # median of per-trial ratios
-    print(json.dumps({
+    doc = {
         "metric": "window_transport_MBps",
         "sync_baseline": "v1 wire end to end: per-deposit blocking ack, "
                          "client tobytes + frame-join copies, server "
@@ -330,10 +511,23 @@ def main():
         "rounds": rounds,
         "dtype": args.dtype,
         "codec": args.codec,
+        "stripes": args.stripes,
         "variants": variants,
         "trial_speedups": [round(r, 2) for r in ratios],
         "speedup_pipelined_vs_sync": round(speedup, 2),
-    }))
+        "pipelined_ok": speedup > 1.0,
+        "speedup_striped_vs_pipelined": round(striped_speedup, 2),
+        "striped_ok": striped_speedup > 1.0,
+    }
+    if shm_speedup is not None:
+        doc["speedup_shm_vs_tcp"] = round(shm_speedup, 2)
+        doc["shm_ok"] = shm_speedup > 1.0
+    if not args.no_overlap:
+        repo_env = dict(env)
+        doc["overlap"] = bench_overlap_ab(
+            repo, repo_env, small=args.small,
+            profiles_dir=args.profiles)
+    print(json.dumps(doc))
     return 0
 
 

@@ -27,6 +27,19 @@ deposits and never fences at all (no barrier either — the handle may
 escape, but a loop-local handle that is never flushed usually means the
 fence lives in no one's code).  BF-WIN100 (info): scan summary.
 
+BF-WIN004 (error): the compute/gossip-overlap apply.  The
+:class:`~bluefog_tpu.runtime.async_windows.DoubleBuffer` harvester
+stages round-(k-1) deposits while round-k compute runs; the ONLY legal
+place to fold that staged mass into ``(x, p)`` is a round boundary —
+applying it mid-step mixes stale neighbor state into a half-finished
+gradient update and silently breaks the byte-identity-with-serial
+contract.  The rule is the BF-CTL001 / BF-RES002 discipline applied to
+the overlap path: a call of ``apply_staged`` is legal only inside a
+function whose NAME carries the round-boundary/quiesce vocabulary
+(``_BOUNDARY_RE``, shared with the control lint), so the apply is
+reachable only from boundary code.  ``close()`` is exempt — drains are
+terminal, not mid-round.
+
 Line numbers approximate dominance (Python source order); that is the
 right fidelity for a lint — the seeded-violation test pins the contract.
 """
@@ -37,11 +50,13 @@ import ast
 import os
 from typing import List, Optional
 
+from bluefog_tpu.analysis.control_lint import _BOUNDARY_RE
 from bluefog_tpu.analysis.report import Diagnostic
 
 __all__ = ["check_pipelined_flush", "check_file"]
 
 _PIPELINED_CTORS = ("PipelinedRemoteWindow",)
+_STAGED_APPLY = "apply_staged"
 
 
 def _call_name(node: ast.Call) -> Optional[str]:
@@ -129,6 +144,44 @@ def _scan_function(fn: ast.AST, name: str, filename: str, *,
     return diags
 
 
+def _scan_staged_applies(tree: ast.AST, short: str) -> List[Diagnostic]:
+    """BF-WIN004: every ``apply_staged`` call site must sit inside a
+    function whose NAME carries the round-boundary vocabulary (the
+    innermost enclosing def decides — a boundary-named closure inside a
+    hot loop is exactly the sanctioned shape)."""
+    diags: List[Diagnostic] = []
+
+    def walk(node: ast.AST, fn_name: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # the DoubleBuffer method definition itself is the
+                # primitive, not a caller — descend with its name so a
+                # self-call inside it is still judged against it
+                walk(child, child.name)
+                continue
+            if (isinstance(child, ast.Call)
+                    and _call_name(child) == _STAGED_APPLY
+                    and not (fn_name == _STAGED_APPLY
+                             or (fn_name is not None
+                                 and _BOUNDARY_RE.search(fn_name.lower())))):
+                where = fn_name if fn_name is not None else "<module>"
+                diags.append(Diagnostic(
+                    "error", "BF-WIN004",
+                    f"apply_staged() at {short}:{child.lineno} inside "
+                    f"{where!r} — folding the overlap buffer's staged "
+                    "round-(k-1) mass is legal only at a round boundary; "
+                    "call it from a function whose name carries the "
+                    "boundary/quiesce vocabulary (round/boundary/barrier/"
+                    "fence/flush/quiesce/...) so stale mixing can never "
+                    "apply mid-step",
+                    pass_name="window-lint",
+                    subject=f"{short}:{child.lineno}"))
+            walk(child, fn_name)
+
+    walk(tree, None)
+    return diags
+
+
 def check_pipelined_flush(source: str, *, filename: str = "<source>"
                           ) -> List[Diagnostic]:
     """Lint one Python source blob for the fence-before-barrier rule."""
@@ -163,6 +216,7 @@ def check_pipelined_flush(source: str, *, filename: str = "<source>"
     diags.extend(_scan_function(mod, "<module>", short))
     # methods live inside ClassDef bodies; walk covers them via the
     # FunctionDef case above
+    diags.extend(_scan_staged_applies(tree, short))
     return diags
 
 

@@ -46,6 +46,8 @@ from bluefog_tpu.control.evidence import (Evidence, EvidenceBoard,
                                           canonicalize, clear_evidence,
                                           read_evidence, write_evidence)
 from bluefog_tpu.control.plan import CODEC_LADDER, CommPlan, ControlConfig
+from bluefog_tpu.control.transport import (TransportConfig, TransportPlan,
+                                           decide_transport_plan)
 from bluefog_tpu.control.tree import (TreeConfig, TreeEvidence, TreePlan,
                                       decide_tree_plan, tree_capacity)
 
@@ -56,12 +58,15 @@ __all__ = [
     "ControlConfig",
     "Evidence",
     "EvidenceBoard",
+    "TransportConfig",
+    "TransportPlan",
     "TreeConfig",
     "TreeEvidence",
     "TreePlan",
     "canonicalize",
     "clear_evidence",
     "decide_plan",
+    "decide_transport_plan",
     "decide_tree_plan",
     "plan_topology",
     "read_evidence",

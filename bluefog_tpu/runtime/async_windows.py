@@ -63,6 +63,10 @@ from bluefog_tpu.blackbox import recorder as _bb
 from bluefog_tpu.control import (CommController as _CommController,
                                  ControlConfig as _ControlConfig,
                                  EvidenceBoard as _EvidenceBoard,
+                                 TransportConfig as _TransportConfig,
+                                 TransportPlan as _TransportPlan,
+                                 decide_transport_plan
+                                 as _decide_transport_plan,
                                  evidence as _ctlev)
 from bluefog_tpu.fleet.wiring import (FleetConfig as _FleetConfig,
                                       FleetRuntime as _FleetRuntime)
@@ -105,6 +109,7 @@ __all__ = [
     "AsyncWinPutOptimizer",
     "PushSumReport",
     "DSGDReport",
+    "DoubleBuffer",
     "FileBarrier",
     "shm_unlink_window",
 ]
@@ -913,6 +918,162 @@ class DSGDReport:
     plan_changes: int = 0
 
 
+class DoubleBuffer:
+    """Compute/gossip overlap for the dsgd runners: a background
+    harvester consumes landed neighbor deposits from this rank's OWN
+    landing window WHILE the round's gradient compute runs, staging them
+    per slot; the staged round-(k-1) mass is applied only at the next
+    ROUND BOUNDARY (:meth:`apply_staged` — the BF-WIN004 lint holds its
+    call sites to round-boundary vocabulary, so a future edit cannot
+    fold stale mixing mid-step).
+
+    Correctness invariants:
+
+    - **Mass moves exactly once.**  A harvested read is the window's
+      consume-exactly-once take; the taken (x, p) sits in the per-slot
+      staging buffer until a boundary applies it (or :meth:`close`
+      hands the remainder back).  Between the take and the apply the
+      mass is IN this object — :meth:`staged_mass` (after
+      :meth:`pause`) is what a quiesce-rendezvous adds to local mass so
+      harvested-but-unapplied mass stays visible to the exactness
+      audit.
+    - **Fold order is the serial order.**  Staging accumulates per slot
+      in deposit order and :meth:`apply_staged` returns entries in SLOT
+      order — the identical floating-point op sequence the serial
+      gossip-IN loop performs, which is what makes the overlap fold
+      byte-identical to serial for the same landed deposits (pinned by
+      test).
+    - **The wire is quiesced at every boundary.**  ``apply_staged`` /
+      ``pause`` disarm the harvester and WAIT for its in-flight sweep
+      to finish, so a round-boundary audit never races a half-taken
+      slot.
+
+    Overlap measurement: the harvester accumulates only the seconds it
+    actually spends taking/staging (sweep-gap sleeps excluded);
+    ``apply_staged`` returns that hidden time so the runner can report
+    ``bf_overlap_fraction`` = hidden / (hidden + boundary-apply)
+    seconds per round — 0 is the serial shape, 1 means every bit of
+    gossip-IN work rode under compute.
+    """
+
+    def __init__(self, win, slots: Sequence[int], n_elems: int, *,
+                 poll_s: float = 0.0005):
+        self._win = win
+        self._slots = [int(s) for s in slots]
+        self._n = int(n_elems)
+        self._poll_s = float(poll_s)
+        self._mu = _lc.lock("runtime.async_windows.DoubleBuffer._mu")
+        # _sweep_mu serializes sweeps against pause(): pause clears the
+        # arm flag then acquires it, so on return no sweep is running
+        # and none can start (the flag is re-checked under the lock)
+        self._sweep_mu = _lc.lock(
+            "runtime.async_windows.DoubleBuffer._sweep_mu")
+        self._staged: Dict[int, np.ndarray] = {}
+        self._fresh: Dict[int, int] = {}
+        self._busy_s = 0.0
+        self._armed = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._harvest_loop, daemon=True,
+            name=f"bf-harvest-{getattr(win, 'name', 'win')}")
+        self._thread.start()
+
+    # ------------------------------------------------------- harvester
+    def _sweep(self, *, count_busy: bool) -> None:
+        t0 = time.perf_counter() if count_busy else 0.0
+        for k in self._slots:
+            buf, fresh = self._win.read(k, consume=True)
+            if fresh > 0:
+                with self._mu:
+                    st = self._staged.get(k)
+                    if st is None:
+                        self._staged[k] = buf
+                    else:
+                        st += buf
+                    self._fresh[k] = self._fresh.get(k, 0) + int(fresh)
+        if count_busy:
+            with self._mu:
+                self._busy_s += time.perf_counter() - t0
+
+    def _harvest_loop(self) -> None:
+        while True:
+            self._armed.wait()
+            if self._stopped:
+                return
+            with self._sweep_mu:
+                # re-check under the lock: a pause() between the wait
+                # and here must win (its return promises quiescence)
+                if self._armed.is_set() and not self._stopped:
+                    try:
+                        self._sweep(count_busy=True)
+                    except RuntimeError:
+                        # the window vanished under us (an abnormal
+                        # teardown): disarm and go idle — the boundary's
+                        # own inline sweep surfaces the real error
+                        self._armed.clear()
+            if self._poll_s > 0:
+                time.sleep(self._poll_s)
+
+    # ------------------------------------------------------ boundaries
+    def begin(self) -> None:
+        """Arm one harvest window: from here until the next boundary
+        (:meth:`apply_staged` / :meth:`pause`) the harvester sweeps this
+        rank's landing slots concurrently with whatever the caller runs
+        — the round's gradient compute, in the dsgd loops."""
+        self._armed.set()
+
+    def pause(self) -> None:
+        """Disarm and WAIT for the in-flight sweep to finish.  On
+        return the harvester is quiescent and the staging buffers are
+        stable — the precondition for :meth:`staged_mass` inside a
+        quiesce-rendezvous.  Staged mass is kept; the next boundary's
+        :meth:`apply_staged` folds it."""
+        self._armed.clear()
+        with self._sweep_mu:
+            pass
+
+    def apply_staged(self) -> Tuple[List[Tuple[int, np.ndarray, int]],
+                                    float]:
+        """ROUND-BOUNDARY apply: quiesce the harvester, take one final
+        inline sweep (a round folds at least what the serial path
+        would), and return ``([(slot, payload, fresh)...] in slot
+        order, hidden_harvest_seconds)``.  The caller folds the entries
+        in the returned order — that IS the serial gossip-IN fold — and
+        re-arms with :meth:`begin` when another round follows.  The
+        BF-WIN004 lint restricts call sites of this method to functions
+        speaking round-boundary vocabulary."""
+        self.pause()
+        self._sweep(count_busy=False)
+        with self._mu:
+            entries = [(k, self._staged.pop(k), self._fresh.pop(k, 0))
+                       for k in self._slots if k in self._staged]
+            busy, self._busy_s = self._busy_s, 0.0
+        return entries, busy
+
+    def staged_mass(self) -> float:
+        """Sum of staged push-sum weight (last element of each staged
+        payload).  Call after :meth:`pause` — the quiesce-rendezvous
+        adds this to local mass so taken-but-unapplied mass cannot hide
+        from the exactness audit."""
+        with self._mu:
+            return float(sum(float(buf[-1])
+                             for buf in self._staged.values()))
+
+    def close(self) -> List[Tuple[int, np.ndarray, int]]:
+        """Stop the harvester and hand back whatever is staged (slot
+        order).  The caller folds it — the end-of-run drain, a leaver's
+        handoff, or a chaos corpse's last will — so taken mass is never
+        dropped.  Idempotent (a second close returns [])."""
+        self._stopped = True
+        self._armed.set()  # release a parked wait
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+        with self._mu:
+            entries = [(k, self._staged.pop(k), self._fresh.pop(k, 0))
+                       for k in self._slots if k in self._staged]
+        return entries
+
+
 def run_async_dsgd(
     topology: Topology,
     params0,
@@ -931,6 +1092,7 @@ def run_async_dsgd(
     stop_after_steps: Optional[int] = None,
     fleet: Optional[_FleetConfig] = None,
     profile: Optional[str] = None,
+    overlap: bool = False,
 ) -> DSGDReport:
     """Asynchronous decentralized SGD (subgradient-push, Nedić & Olshevsky)
     over the passive-target windows: the execution model of the reference's
@@ -1051,6 +1213,16 @@ def run_async_dsgd(
         so one file carries every thread's samples).  When the env var
         ``BLUEFOG_TPU_PROFILE`` already armed a profiler, that one is
         left alone — the runner only owns what it started.
+      overlap: compute/gossip overlap via :class:`DoubleBuffer` — a
+        per-rank harvester consumes landed neighbor deposits WHILE the
+        gradient compute runs, and the staged round-(k-1) mixing is
+        applied only at the next round boundary, in slot order (the
+        serial fold order, so results are byte-identical to the serial
+        path for the same landed deposits).  The boundary still sees a
+        quiesced wire (the apply waits out any in-flight harvest
+        sweep), staged mass stays visible to the exactness audit (a
+        chaos corpse's last will and every drain fold it in), and the
+        hidden-time share is reported as ``bf_overlap_fraction``.
     """
     n = topology.size
     if fleet is not None and fleet.dir is None:
@@ -1196,7 +1368,7 @@ def run_async_dsgd(
                if fleet is not None else None)
         fleet_dis: Optional[float] = None
 
-        def consume(x, p, observe: bool = False):
+        def consume(x, p, observe: bool = False, staged=None):
             nonlocal fleet_dis
             dis = None
             z0 = None
@@ -1204,10 +1376,20 @@ def run_async_dsgd(
             if observe and (ctl is not None or fleet_due):
                 z0 = x / p
             now = time.perf_counter()
-            for k in my_slots:
-                if cap_slots and k == r:
-                    continue
-                buf, fresh = wins[r].read(k, consume=True)
+            if staged is None:
+                # serial path: take the slots here, in slot order
+                staged = []
+                for k in my_slots:
+                    if cap_slots and k == r:
+                        continue
+                    buf, fresh = wins[r].read(k, consume=True)
+                    if fresh > 0:
+                        staged.append((k, buf, fresh))
+            # the fold — identical whether the entries were read just
+            # above or harvested under compute by the DoubleBuffer
+            # (apply_staged returns slot order, so the floating-point
+            # op sequence matches the serial path byte for byte)
+            for k, buf, fresh in staged:
                 if fresh > 0:
                     if z0 is not None and buf[-1] > 0:
                         dj = float(np.linalg.norm(
@@ -1227,6 +1409,32 @@ def run_async_dsgd(
                 ctl.note_disagreement(dis)
             if fleet_due:
                 fleet_dis = dis
+            return p
+
+        # compute/gossip overlap (opt-in): the harvester that consumes
+        # landed deposits from this rank's landing window while the
+        # gradient compute runs.  Disarmed until the first boundary
+        # fold re-arms it, so round 0 behaves exactly like serial.
+        db = (DoubleBuffer(
+            wins[r],
+            [k for k in my_slots if not (cap_slots and k == r)],
+            d + 1) if overlap else None)
+
+        def fold_staged_at_round_boundary(x, p, *, rearm,
+                                          observe: bool = False):
+            """ROUND-BOUNDARY apply of the overlapped gossip-IN: quiesce
+            the harvester, fold its staged round-(k-1) mass (plus one
+            final inline sweep — a round folds at least what serial
+            would), report the hidden/total split as
+            bf_overlap_fraction, and re-arm for the next compute."""
+            t_b = time.perf_counter()
+            staged, busy = db.apply_staged()
+            p = consume(x, p, observe=observe, staged=staged)
+            tot = busy + (time.perf_counter() - t_b)
+            if tot > 0:
+                _mt.set("bf_overlap_fraction", busy / tot, rank=str(r))
+            if rearm:
+                db.begin()
             return p
 
         def harvest_evidence_at_round_boundary():
@@ -1329,6 +1537,15 @@ def run_async_dsgd(
                     if board is not None:
                         board.admit(r)  # its own first round boundary
                     is_member = True
+                    if overlap and db is None:
+                        # a flapping member re-joining after a graceful
+                        # leave closed its harvester: fresh buffer,
+                        # disarmed until its first boundary fold
+                        db = DoubleBuffer(
+                            wins[r],
+                            [k for k in my_slots
+                             if not (cap_slots and k == r)],
+                            d + 1)
                     _mt.observe("bf_join_warmstart_seconds",
                                 time.perf_counter() - t_ws)
                     _bb.record("peer_join", peer=f"rank{r}", rank=r,
@@ -1421,7 +1638,11 @@ def run_async_dsgd(
                                       op="async_dsgd_round",
                                       cid="async_dsgd_round",
                                       step=steps[r], rank=r, peers=my_out)
-                        p = consume(x, p, observe=True)
+                        if db is not None:
+                            p = fold_staged_at_round_boundary(
+                                x, p, rearm=True, observe=True)
+                        else:
+                            p = consume(x, p, observe=True)
                         if elastic:
                             # publish a coherent (x, p) snapshot: what a
                             # JOINING peer warm-starts from
@@ -1510,7 +1731,11 @@ def run_async_dsgd(
 
                 if not want_leave:
                     # run ended: drain in-flight mass so the audit below
-                    # is exact, publish the final state
+                    # is exact, publish the final state.  The overlap
+                    # harvester goes first — its staged-but-unapplied
+                    # take is mass this rank already owns
+                    if db is not None:
+                        p = consume(x, p, staged=db.close())
                     p = consume(x, p)
                     finals[r] = x / p
                     wins[r].set_self(np.concatenate([x, [p]]))
@@ -1526,6 +1751,11 @@ def run_async_dsgd(
                 # mass is CONSERVED in the audit, never written off like
                 # a corpse's
                 wins[r].flush()
+                if db is not None:
+                    # stop the harvester for good: the leaver hands its
+                    # mass off below, and a re-join recreates the buffer
+                    p = consume(x, p, staged=db.close())
+                    db = None
                 p = consume(x, p)
                 with mem_mu:
                     live = sorted(members - {r})
@@ -1567,13 +1797,19 @@ def run_async_dsgd(
                 # this rank (a flapping member)
         except _chaos.ChaosKill:
             # simulated death: no drain, no final publish; the last will
-            # (mass carried to the grave) keeps the audit exact
+            # (mass carried to the grave) keeps the audit exact — and
+            # the grave includes what the overlap harvester had taken
+            # from the window but not yet applied
             died[r] = True
+            if db is not None:
+                p += sum(float(buf[-1]) for _, buf, _ in db.close())
             died_mass[r] = p
         except BaseException as e:
             errors.append(e)
             stop.set()
         finally:
+            if db is not None:
+                db.close()  # idempotent; stops the harvester thread
             if flt is not None:
                 flt.close()  # records are on disk line by line already
 
@@ -1847,7 +2083,27 @@ class _TcpTransport:
     (batched frames, windowed acks) and the dsgd loop fences with
     ``flush()`` before its audit barrier.  ``wire_codec`` selects optional
     DCN wire compression (``"f32"``/``"topk"``) — lossy, so it is opt-in
-    and must stay off when the exact push-sum mass audit matters."""
+    and must stay off when the exact push-sum mass audit matters.
+
+    Three raw-speed knobs ride ``stream_options`` (popped here, the rest
+    forwards to the per-peer :class:`~bluefog_tpu.runtime.window_server.
+    DepositStream`):
+
+    - ``shm=True`` — same-host fast path: this rank's OWN windows go
+      into named shared memory (so co-located peers can attach them)
+      and its deposit streams route same-host deposits through the shm
+      table instead of TCP, falling back transparently when detection
+      fails (remote peer, no native runtime).
+    - ``stripes=N`` — striped DCN: one shared
+      :class:`~bluefog_tpu.runtime.window_server.StripedDepositStream`
+      per peer (N parallel connections, window names spread by
+      :func:`~bluefog_tpu.runtime.window_server.stripe_of`) instead of
+      a private stream per window.
+    - ``transport_tuning=True | TransportConfig(...)`` — arms the
+      closed-loop stripe/coalesce autotuner: the runner calls
+      :meth:`retune_transport_at_round_boundary` at its round
+      boundaries and the per-peer plan follows the ack-latency/phase
+      EWMAs the streams already collect."""
 
     def __init__(self, bind_host: str = "0.0.0.0", *, pipeline: bool = True,
                  wire_codec: Optional[str] = None,
@@ -1865,9 +2121,28 @@ class _TcpTransport:
         # honest backpressure — the producer then feels a slow peer
         # instead of buffering unboundedly toward it
         self._stream_options = dict(stream_options or {})
+        self._shm = bool(self._stream_options.pop("shm", False))
+        self._n_stripes = int(self._stream_options.pop("stripes", 0))
+        tuning = self._stream_options.pop("transport_tuning", None)
+        self._tuning = (_TransportConfig() if tuning is True
+                        else tuning)  # None or a TransportConfig
+        if self._tuning is not None and self._n_stripes <= 0:
+            # the autotuner's knobs live on the striped stream; arm a
+            # minimal pool it can widen from
+            self._n_stripes = 1
+        self._striped: Dict[int, object] = {}  # owner -> striped stream
+        self._plans: Dict[int, _TransportPlan] = {}
         self._addrs: Dict[int, Tuple[str, int]] = {}
 
     def create(self, wname: str, n_slots: int, n_elems: int) -> AsyncWindow:
+        if self._shm and native.load() is not None:
+            # same-host fast path: this rank's windows go into the
+            # named-shm table so co-located peers' deposit streams can
+            # attach them directly.  The name is rank-owned, so a
+            # leftover segment can only be a stale crash artifact
+            shm_unlink_window(wname)
+            return AsyncWindow(wname, n_slots, n_elems, np.float64,
+                               shm=True)
         return AsyncWindow(wname, n_slots, n_elems, np.float64)
 
     def publish(self, barrier: FileBarrier, rank: int) -> None:
@@ -1904,17 +2179,47 @@ class _TcpTransport:
 
     def open(self, owner: int, wname: str, n_slots: int, n_elems: int):
         from bluefog_tpu.runtime.window_server import (PipelinedRemoteWindow,
-                                                       RemoteWindow)
+                                                       RemoteWindow,
+                                                       StripedDepositStream)
 
         if self._pipeline:
             cfg = self._resilience
-            if cfg is not None:
+            if self._n_stripes > 0:
+                # striped DCN: ONE shared per-peer stripe pool; every
+                # window bound for this owner rides it (stripe_of
+                # spreads the window names over the connections), and
+                # the handle's flush fences all stripes at once
+                st = self._striped.get(owner)
+                if st is None:
+                    kw = dict(codec=self._codec, shm=self._shm,
+                              **self._stream_options)
+                    if cfg is not None:
+                        kw.update(
+                            reconnect=cfg.backoff_kwargs(),
+                            heartbeat_interval_s=(
+                                cfg.heartbeat_interval_s or 0.0),
+                            suspect_after_s=cfg.suspect_after_s,
+                            dead_after_s=cfg.dead_after_s)
+                    st = StripedDepositStream(
+                        self._addrs[owner], n_stripes=self._n_stripes,
+                        **kw)
+                    self._striped[owner] = st
+                    self._plans[owner] = _TransportPlan(
+                        stripes=st.n_stripes,
+                        coalesce_bytes=self._stream_options.get(
+                            "max_batch_bytes", 16 << 20))
+                rw = PipelinedRemoteWindow(
+                    self._addrs[owner], wname, stream=st,
+                    sync_retry=(cfg.backoff_kwargs()
+                                if cfg is not None else None))
+            elif cfg is not None:
                 rw = PipelinedRemoteWindow(
                     self._addrs[owner], wname, codec=self._codec,
                     reconnect=cfg.backoff_kwargs(),
                     heartbeat_interval_s=cfg.heartbeat_interval_s or None,
                     suspect_after_s=cfg.suspect_after_s,
                     dead_after_s=cfg.dead_after_s,
+                    shm=self._shm or None,
                     # the runner's own sync READS (warm-start read_self,
                     # meta/audit reads) retry torn/timed-out replies on
                     # a fresh connection under the same bounded budget —
@@ -1924,12 +2229,38 @@ class _TcpTransport:
             else:
                 rw = PipelinedRemoteWindow(self._addrs[owner], wname,
                                            codec=self._codec,
+                                           shm=self._shm or None,
                                            **self._stream_options)
         else:
             rw = RemoteWindow(self._addrs[owner], wname)
         return _RemoteHandle(rw, n_slots, n_elems)
 
+    def retune_transport_at_round_boundary(self, round_: int) -> None:
+        """Closed-loop transport autotune, called by the dsgd runner AT
+        ITS ROUND BOUNDARIES (nothing of this rank's is in flight — the
+        quiesce every plan actuation requires): per peer, feed the
+        stripe pool's ack-latency + wire-phase EWMAs through the pure
+        :func:`~bluefog_tpu.control.decide_transport_plan` step and
+        actuate only when a hysteresis band was actually crossed (the
+        no-change case returns the previous plan object itself)."""
+        if self._tuning is None:
+            return
+        for owner, st in self._striped.items():
+            prev = self._plans[owner]
+            plan = _decide_transport_plan(
+                prev, round_, ack_ewma_s=st.ack_ewma(),
+                phase_s=st.phase_ewma(), cfg=self._tuning)
+            if plan is not prev:
+                st.apply_plan(plan)
+                self._plans[owner] = plan
+
     def close(self) -> None:
+        for st in self._striped.values():
+            try:
+                st.close()
+            except Exception:
+                pass
+        self._striped.clear()
         self._server.stop()
 
 
@@ -1958,6 +2289,7 @@ def run_async_dsgd_rank(
     stream_options: Optional[Dict] = None,
     fleet: Optional[_FleetConfig] = None,
     profile: Optional[str] = None,
+    overlap: bool = False,
 ) -> Optional[DSGDReport]:
     """One rank of an asynchronous decentralized SGD run where every rank is
     its own OS PROCESS — the reference's actual deployment shape
@@ -2069,7 +2401,24 @@ def run_async_dsgd_rank(
     ``stream_options`` forwards DepositStream tuning
     (``max_in_flight``/``max_queue_items``) through the tcp transport —
     a BOUNDED queue is how a deployment opts into honest backpressure
-    instead of buffering unboundedly toward a slow peer.
+    instead of buffering unboundedly toward a slow peer.  Three
+    raw-speed keys are consumed by the transport itself rather than
+    forwarded: ``shm=True`` (same-host shared-memory fast path with
+    transparent TCP fallback), ``stripes=N`` (striped per-peer DCN
+    streams), and ``transport_tuning=True | TransportConfig(...)``
+    (the closed-loop stripe/coalesce autotuner, actuated at round
+    boundaries) — see :class:`_TcpTransport`.
+
+    ``overlap=True`` turns on compute/gossip overlap
+    (:class:`DoubleBuffer`): landed neighbor deposits are harvested
+    from this rank's landing window WHILE the gradient compute runs
+    and the staged round-(k-1) mixing is applied at the next round
+    boundary (in slot order — byte-identical results vs the serial
+    fold for the same landed deposits).  Fence discipline is
+    preserved: every quiesce-rendezvous pauses the harvester and
+    counts its staged mass, so the exact audit holds; the per-round
+    hidden-time share is the ``bf_overlap_fraction`` gauge and the
+    ``overlap=`` field on the traced round spans.
 
     ``fleet`` (:class:`~bluefog_tpu.fleet.FleetConfig`) arms the fleet
     health plane's telemetry publisher: every ``fleet.every``-th round
@@ -2154,6 +2503,7 @@ def run_async_dsgd_rank(
     # setup failures like a TreePacker TypeError or a window-name collision
     # — must release them, so the try begins immediately
     opened: List = []
+    db: Optional[DoubleBuffer] = None
     try:
         if (join or leave_after_s is not None
                 or initial_members is not None) and transport != "tcp":
@@ -2178,6 +2528,16 @@ def run_async_dsgd_rank(
             n_slots = max(len(list(topology.in_neighbors(rank))), 1)
         win = tx.create(f"{name}:{rank}", n_slots, d + 1)
         opened.append(win)
+        if overlap:
+            # compute/gossip overlap: the harvester lives HERE (not in
+            # the body) so the finally below stops its thread before
+            # any window is freed, on every exit path
+            cap = (join or leave_after_s is not None
+                   or initial_members is not None or control is not None)
+            db = DoubleBuffer(
+                win,
+                [k for k in range(n_slots) if not (cap and k == rank)],
+                d + 1)
 
         def _create(wname, n_slots, n_elems):
             w = tx.create(wname, n_slots, n_elems)
@@ -2198,8 +2558,11 @@ def run_async_dsgd_rank(
             join=join, leave_after_s=leave_after_s,
             initial_members=initial_members,
             snapshot_every=snapshot_every, control=control,
-            stop_after_steps=stop_after_steps, fleet=fleet)
+            stop_after_steps=stop_after_steps, fleet=fleet,
+            overlap_buffer=db)
     finally:
+        if db is not None:
+            db.close()  # idempotent; must precede the window frees
         if prof_owned:
             from bluefog_tpu.profiling import sampler as _profiling
 
@@ -2223,7 +2586,8 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                         transport, create_window, open_window,
                         resilience=None, join=False, leave_after_s=None,
                         initial_members=None, snapshot_every=0,
-                        control=None, stop_after_steps=None, fleet=None):
+                        control=None, stop_after_steps=None, fleet=None,
+                        overlap_buffer=None):
     n = topology.size
     packer = TreePacker(params0, np.float64)
     d = packer.size
@@ -2308,6 +2672,16 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     cap_slots = elastic or control is not None
     in_nbrs = list(topology.in_neighbors(rank))
     my_slots = (range(n) if cap_slots else range(len(in_nbrs)))
+    # compute/gossip overlap (opt-in; owned/closed by the caller):
+    # harvests landed deposits from the landing window while the
+    # gradient compute runs; the staged mixing applies at the next
+    # round boundary via _fold_staged_at_round_boundary below
+    db: Optional[DoubleBuffer] = overlap_buffer
+    # striped-transport autotuner hook (tcp transport with
+    # transport_tuning armed): the runner drives the closed loop at its
+    # round boundaries
+    retune = getattr(transport, "retune_transport_at_round_boundary",
+                     None)
 
     def _peer_slots(j: int) -> int:
         return (n if cap_slots
@@ -2367,8 +2741,14 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
 
     def _local_mass() -> float:
         """Own p + unconsumed landing-slot mass, valid only while
-        nothing is in flight (inside a quiesce-rendezvous)."""
+        nothing is in flight (inside a quiesce-rendezvous).  With the
+        overlap harvester armed, its staged-but-unapplied take is mass
+        this rank already holds: pause the harvester (quiescing the
+        window) and count it, or it would hide from the audit."""
         local = p
+        if db is not None:
+            db.pause()
+            local += db.staged_mass()
         for k in my_slots:
             if cap_slots and k == rank:
                 continue
@@ -2376,6 +2756,34 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             if fresh > 0:
                 local += float(buf[-1])
         return local
+
+    def _fold_staged_at_round_boundary(z_pre):
+        """ROUND-BOUNDARY apply of the overlapped gossip-IN: quiesce
+        the harvester, fold the round-(k-1) mass it staged under the
+        last compute (plus one final inline sweep, in slot order — the
+        serial fold's exact floating-point op sequence), report the
+        hidden/total time split as ``bf_overlap_fraction``, and re-arm
+        the harvester for the coming compute.  Returns ``(dis, ov)`` —
+        the disagreement observation and the overlap fraction.  The
+        BF-WIN004 lint restricts ``apply_staged`` call sites to
+        round-boundary vocabulary like this function's."""
+        nonlocal x, p
+        t_b = time.perf_counter()
+        staged, busy = db.apply_staged()
+        dis = None
+        for k, buf, fresh in staged:
+            if fresh > 0:
+                if z_pre is not None and buf[-1] > 0:
+                    dj = float(np.linalg.norm(
+                        buf[:-1] / buf[-1] - z_pre))
+                    dis = dj if dis is None else max(dis, dj)
+                x += buf[:-1]
+                p += buf[-1]
+        tot = busy + (time.perf_counter() - t_b)
+        ov = (busy / tot) if tot > 0 else 0.0
+        _mt.set("bf_overlap_fraction", ov, rank=str(rank))
+        db.begin()
+        return dis, ov
 
     def _ctl_round_boundary() -> None:
         """Control-plane work at a round boundary: harvest the streams'
@@ -2685,7 +3093,14 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         _mship.write_record(barrier.path, "leaving", rank, token)
         barrier.wait(stage, timeout_s=cfg.barrier_timeout_s)
         # every member fenced its stream to us before entering the
-        # barrier: nothing is in flight toward this window anymore
+        # barrier: nothing is in flight toward this window anymore.
+        # The leaver's overlap harvester retires first — its staged
+        # take joins the mass handed off below
+        if db is not None:
+            for j, buf, fresh in db.close():
+                if fresh > 0:
+                    x += buf[:-1]
+                    p += buf[-1]
         for j in range(n):
             if j == rank:
                 continue
@@ -2905,6 +3320,14 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                 and steps % control.evidence_every == 0:
             with _tr.span("control", "dsgd", round_=steps):
                 _ctl_round_boundary()
+        if retune is not None and steps > 0 and steps % 16 == 0:
+            # transport autotune at this round boundary: the striped
+            # streams' ack/phase EWMAs in, a (possibly unchanged)
+            # TransportPlan actuated — same cadence as the tombstone
+            # poll, cheap either way (the no-change case is a pure
+            # function call per peer)
+            with _tr.span("control", "dsgd", round_=steps):
+                retune(steps)
         trec = _tr.get()
         if trec is not None:
             t_rnd_w = time.time()
@@ -2919,19 +3342,26 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
                            or (flt is not None and flt.due(steps)))
                  else None)
         dis = None
+        ov = None
         with _tr.span("gossip", "dsgd", round_=steps):
-            # gossip-IN: consume landed neighbor mass
-            for k in my_slots:
-                if cap_slots and k == rank:
-                    continue
-                buf, fresh = win.read(k, consume=True)
-                if fresh > 0:
-                    if z_pre is not None and buf[-1] > 0:
-                        dj = float(np.linalg.norm(
-                            buf[:-1] / buf[-1] - z_pre))
-                        dis = dj if dis is None else max(dis, dj)
-                    x += buf[:-1]
-                    p += buf[-1]
+            if db is not None:
+                # overlapped gossip-IN: apply the mass harvested under
+                # the previous round's compute (round-(k-1) mixing),
+                # then re-arm the harvester for this round's compute
+                dis, ov = _fold_staged_at_round_boundary(z_pre)
+            else:
+                # gossip-IN: consume landed neighbor mass
+                for k in my_slots:
+                    if cap_slots and k == rank:
+                        continue
+                    buf, fresh = win.read(k, consume=True)
+                    if fresh > 0:
+                        if z_pre is not None and buf[-1] > 0:
+                            dj = float(np.linalg.norm(
+                                buf[:-1] / buf[-1] - z_pre))
+                            dis = dj if dis is None else max(dis, dj)
+                        x += buf[:-1]
+                        p += buf[-1]
         if ctl is not None and dis is not None:
             ctl.note_disagreement(dis)
         if elastic:
@@ -2960,7 +3390,9 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
             if trec is not None:
                 trec.emit("round", "dsgd", t0=t_rnd_w,
                           dur=time.perf_counter() - t_rnd_p,
-                          round_=steps, step=steps)
+                          round_=steps, step=steps,
+                          **({} if ov is None
+                             else {"overlap": round(ov, 4)}))
             _round_end_telemetry(z, dis)
             steps += 1
             if skew_s > 0 or poll_interval_s > 0:
@@ -3047,7 +3479,9 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
         if trec is not None:
             trec.emit("round", "dsgd", t0=t_rnd_w,
                       dur=time.perf_counter() - t_rnd_p, round_=steps,
-                      step=steps)
+                      step=steps,
+                      **({} if ov is None
+                         else {"overlap": round(ov, 4)}))
         _round_end_telemetry(z, dis)
         steps += 1
         if skew_s > 0 or poll_interval_s > 0:
@@ -3085,6 +3519,14 @@ def _run_dsgd_rank_body(topology, rank, params0, loss_and_grad, *, barrier,
     # no rank deposits after this barrier, so the drain below is exact
     _wait_resilient("stopped")
     wall = time.perf_counter() - t0
+    if db is not None:
+        # stop the overlap harvester and fold its staged take before
+        # the final window sweep — mass it consumed from the window is
+        # mass this rank owns
+        for k, buf, fresh in db.close():
+            if fresh > 0:
+                x += buf[:-1]
+                p += buf[-1]
     for k in my_slots:
         if cap_slots and k == rank:
             continue

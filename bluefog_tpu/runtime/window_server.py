@@ -139,7 +139,8 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -1939,6 +1940,97 @@ class _Item:
         self.t_enq = t_enq    # perf_counter at enqueue (enqueue span)
 
 
+_LOOPBACK_HOSTS = ("127.0.0.1", "::1", "localhost")
+
+
+def _is_local_host(host: str) -> bool:
+    """True when ``host`` names THIS machine — the co-location test of
+    the same-host shm fast path.  Loopback spellings are local by
+    definition; otherwise the host must equal this machine's hostname or
+    one of its resolved addresses.  Resolution failures return False
+    (detection failure = TCP, never an error)."""
+    if host in _LOOPBACK_HOSTS or host.startswith("127."):
+        return True
+    try:
+        names = {socket.gethostname(), socket.getfqdn()}
+        if host in names:
+            return True
+        addrs = set()
+        for n in names:
+            try:
+                addrs.update(info[4][0]
+                             for info in socket.getaddrinfo(n, None))
+            except OSError:
+                pass
+        if host in addrs:
+            return True
+    except OSError:
+        return False
+    # hostname resolution often maps only to loopback while the server
+    # publishes its interface address: the authoritative test is a bind
+    # probe — an OS will only bind a socket to one of ITS OWN addresses
+    try:
+        infos = socket.getaddrinfo(host, None, type=socket.SOCK_DGRAM)
+    except OSError:
+        return False
+    for family, stype, proto, _, sockaddr in infos[:4]:
+        try:
+            s = socket.socket(family, stype, proto)
+        except OSError:
+            continue
+        try:
+            s.bind((sockaddr[0], 0))
+            return True
+        except OSError:
+            continue
+        finally:
+            s.close()
+    return False
+
+
+class _LocalWindowRef:
+    """Same-process twin of an shm attach: the target window already
+    lives in THIS process's native table (the owner and the depositor
+    are the same process — unit tests, single-host self-loops), where a
+    second ``bf_win_attach_shm`` mapping is refused.  Deposits go
+    straight through the table by name; geometry comes from
+    ``bf_win_info`` so the fast path keeps the same dtype/size guard as
+    the attached case."""
+
+    def __init__(self, name: str):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        ns = ctypes.c_int()
+        ne = ctypes.c_longlong()
+        dt = ctypes.c_int()
+        if lib.bf_win_info(name.encode(), ctypes.byref(ns),
+                           ctypes.byref(ne), ctypes.byref(dt)) != 0:
+            raise RuntimeError(f"window {name!r} not in the local table")
+        self._lib = lib
+        self.name = name
+        self.n_slots = ns.value
+        self.n_elems = int(ne.value)
+        self.dtype = np.dtype(np.float64 if dt.value == 1 else np.float32)
+
+    def deposit_async(self, slot: int, arr: np.ndarray, *,
+                      accumulate: bool = True, copy: bool = True,
+                      drain: bool = False) -> int:
+        del copy  # applied before return, signature parity only
+        a = np.ascontiguousarray(arr, dtype=self.dtype).ravel()
+        v = self._lib.bf_win_deposit(
+            self.name.encode(), slot, a.ctypes.data, self.n_elems,
+            1 if accumulate else 0)
+        if v < 0:
+            raise RuntimeError(
+                f"deposit into {self.name!r}[{slot}] failed")
+        if drain:
+            _mt.inc("bf_drain_deposits_total", 1.0, peer="local")
+            _bb.record("drain_deposit", window=self.name, slot=slot,
+                       peer="local")
+        return int(v)
+
+
 class DepositStream:
     """Per-PEER pipelined deposit engine: fire-and-forget deposits into any
     of a peer's windows through one background sender with a bounded
@@ -1980,7 +2072,25 @@ class DepositStream:
     the error and mark the peer DEAD (:attr:`health`).
     ``heartbeat_interval_s > 0`` additionally probes an *idle* stream
     with the lightweight HEARTBEAT wire op, so peer health does not go
-    stale between deposits."""
+    stale between deposits.
+
+    Same-host shm fast path (``shm=True``): when the peer address names
+    THIS machine, deposits are routed through the named-shm window table
+    (``AsyncWindow(attach=True)`` — or the process-local table when
+    owner and depositor share a process) instead of the TCP wire: one
+    mutex-guarded memory accumulate, no frame, no ack.  Detection is
+    per stream and transparent: the first attach failure (no native
+    runtime, non-shm windows on the owner, remote host) records a
+    ``shm_fallback`` blackbox event and routes everything over TCP; a
+    per-window geometry/dtype mismatch or a mid-run shm fault falls
+    back for that window only.  Routing is sticky per window name, so
+    a window's deposits never reorder across transports.  Fence
+    semantics are unchanged — an shm deposit is APPLIED when
+    :meth:`deposit_async` returns (the slot flip is atomic under the
+    window mutex: a torn write is absent, never half-applied), so
+    :meth:`flush` still fences exactly the deposits still on the wire.
+    Health/heartbeats keep riding TCP: liveness of the peer *process*
+    is a wire question even when payloads take the table."""
 
     def __init__(self, address: Tuple[str, int],
                  timeout_s: float = 30.0, *, codec: Optional[str] = None,
@@ -1990,7 +2100,9 @@ class DepositStream:
                  reconnect=None,
                  heartbeat_interval_s: float = 0.0,
                  suspect_after_s: float = 2.0,
-                 dead_after_s: float = 20.0):
+                 dead_after_s: float = 20.0,
+                 shm: bool = False,
+                 on_ewma: Optional[Callable[[float], None]] = None):
         self._addr = (address[0], int(address[1]))
         self._peer = f"{address[0]}:{address[1]}"
         self._timeout_s = float(timeout_s)
@@ -2067,7 +2179,23 @@ class DepositStream:
         # tracing is off).  Written by the ack thread only; readers take
         # a GIL-atomic tuple-ref snapshot.
         self._phase_ewma: Optional[Tuple[float, float, float]] = None
+        # striping hook: when set, EWMA updates go to the callback
+        # INSTEAD of the per-peer gauge — a StripedDepositStream rolls
+        # its stripes up into one bf_peer_ack_ewma_seconds{peer=} value
+        # (max-of-stripes) so the slow-peer detector sees one peer, not
+        # one gauge per stripe
+        self._on_ewma = on_ewma
         self._reconnects = 0
+        # ------------------------------------------------ shm fast path
+        # co-location is decided once per stream (cheap address test);
+        # capability (native runtime + shm-backed windows on the owner)
+        # is probed at the first deposit and latched — see _shm_window
+        self._shm_ok = bool(shm) and _is_local_host(self._addr[0])
+        if shm and not self._shm_ok:
+            _bb.record("shm_fallback", peer=self._peer, window="*",
+                       reason="peer host is not local")
+        self._shm_wins: Dict[bytes, Optional[object]] = {}
+        self._shm_deposits = 0
         self._sock = self._connect_once(self._timeout_s)
         self._sender = threading.Thread(
             target=self._send_loop, daemon=True,
@@ -2333,7 +2461,10 @@ class DepositStream:
         a = self._ack_ewma_alpha
         ewma = seconds if prev is None else (a * seconds + (1.0 - a) * prev)
         self._ack_ewma = ewma  # bfverify: shared-ok single float-ref store, atomic under the GIL; only the ack thread writes
-        _mt.set("bf_peer_ack_ewma_seconds", ewma, peer=self._peer)
+        if self._on_ewma is not None:
+            self._on_ewma(ewma)
+        else:
+            _mt.set("bf_peer_ack_ewma_seconds", ewma, peer=self._peer)
 
     def ack_ewma(self) -> Optional[float]:
         """EWMA (seconds) over this peer's deposit-ack latencies and
@@ -2415,6 +2546,94 @@ class DepositStream:
                 "may ever use — the controller backs OFF from there")
         self._codec = want
 
+    def set_max_batch_bytes(self, n: int) -> None:
+        """Retune the coalescing cap at a ROUND BOUNDARY (the autotune
+        knob: smaller frames = more frames in flight = deeper pipeline;
+        larger frames = fewer acks).  A single int store read by the
+        sender thread at its next coalesce — call from the producer
+        thread, ideally fenced, like :meth:`set_codec`."""
+        self._max_batch_bytes = max(1 << 16, int(n))
+
+    # ----------------------------------------------------- shm fast path
+    def _shm_window(self, name: bytes):
+        """Resolve the shm route for one window name, caching the
+        verdict: an attached/local window handle, or None (permanent TCP
+        for this name).  The FIRST attach failure latches shm off for
+        the whole stream — windows of one owner are homogeneous, and a
+        per-name probe against a non-shm owner would pay the attach
+        timeout once per leaf."""
+        if name in self._shm_wins:
+            return self._shm_wins[name]
+        win = None
+        try:
+            from bluefog_tpu.runtime.async_windows import AsyncWindow
+            try:
+                win = AsyncWindow(name.decode(), attach=True,
+                                  attach_timeout_s=1.0)
+            except ValueError:
+                # already open in THIS process: owner and depositor
+                # share a table — deposit through it directly
+                win = _LocalWindowRef(name.decode())
+        except Exception as e:  # noqa: BLE001 — any capability failure
+            # (no native runtime, owner's windows not shm-backed, stale
+            # geometry) means TCP, never an error
+            self._shm_ok = False
+            _bb.record("shm_fallback", peer=self._peer,
+                       window=name.decode("utf-8", "replace"),
+                       reason=f"{type(e).__name__}: {e}"[:200])
+        self._shm_wins[name] = win
+        return win
+
+    def _try_shm_deposit(self, name: bytes, slot: int, arr: np.ndarray,
+                         *, accumulate: bool, drain: bool) -> bool:
+        """Apply one deposit through the same-host shm table.  True =
+        applied (exactly once, atomically under the window mutex);
+        False = route this deposit over TCP instead.  A chaos 'client'
+        fault or a real shm failure here models the TORN-WRITE case:
+        the fault fires BEFORE the atomic table accumulate, so a torn
+        shm write is never half-applied — it is absent, and recovery is
+        re-delivery over the TCP wire (still exactly once)."""
+        win = self._shm_window(name)
+        if win is None:
+            return False
+        if win.dtype != arr.dtype or win.n_elems != arr.size:
+            # geometry mismatch: the wire path's per-item dtype/size
+            # negotiation handles it; the table route cannot
+            self._shm_wins[name] = None
+            _bb.record("shm_fallback", peer=self._peer,
+                       window=name.decode("utf-8", "replace"),
+                       reason="dtype/size mismatch")
+            return False
+        act = _chaos.fire("client", peer=self._peer, seq=-1, shm=1)
+        if act is not None:
+            if act[0] in ("delay", "stall"):
+                time.sleep(act[1])
+            else:  # drop/truncate: the shm write tore before the flip
+                self._shm_wins[name] = None
+                _bb.record("shm_fallback", peer=self._peer,
+                           window=name.decode("utf-8", "replace"),
+                           reason=f"chaos:{act[0]}")
+                return False
+        try:
+            win.deposit_async(slot, arr, accumulate=accumulate,
+                              drain=drain)
+        except Exception as e:  # noqa: BLE001 — fall back, exactly once:
+            # the native deposit applies fully or returns an error
+            self._shm_wins[name] = None
+            _bb.record("shm_fallback", peer=self._peer,
+                       window=name.decode("utf-8", "replace"),
+                       reason=f"{type(e).__name__}: {e}"[:200])
+            return False
+        self._shm_deposits += 1
+        _mt.inc("bf_shm_deposits_total", 1.0, peer=self._peer)
+        return True
+
+    @property
+    def shm_deposits(self) -> int:
+        """Deposits this stream routed through the same-host shm table
+        (the programmatic twin of ``bf_shm_deposits_total{peer=}``)."""
+        return self._shm_deposits
+
     def deposit_async(self, name: bytes, slot: int, arr: np.ndarray, *,
                       accumulate: bool = True, copy: bool = True,
                       drain: bool = False) -> None:
@@ -2433,6 +2652,9 @@ class DepositStream:
                 f"pipelined deposits support f32/f64, got {a.dtype}")
         a = a.reshape(-1)
         self._raise_if_err()
+        if self._shm_ok and self._try_shm_deposit(
+                name, slot, a, accumulate=accumulate, drain=drain):
+            return  # applied: nothing in flight, nothing to fence
         # tracing: capture the CALLER's active span context here, on the
         # producer thread — round/parentage then ride the item into the
         # sender thread and onto the wire with zero API churn
@@ -2794,11 +3016,217 @@ class DepositStream:
         # _recover() side refuses the swap once _closed is set)
         with self._cv:
             sock = self._sock
+        # shutdown BEFORE close: closing an fd does not wake a thread
+        # blocked in recv() on it, so without this the acker sits in
+        # recv until the join times out (5 s per stream — N stripes pay
+        # it N times over)
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             sock.close()
         except OSError:
             pass
         self._acker.join(timeout=5)
+
+
+def stripe_of(name: bytes, n_stripes: int) -> int:
+    """The stable stripe routing function: crc32 of the window name
+    modulo the active stripe count.  Deterministic across processes and
+    runs (no Python hash randomization), so the sharded path's
+    per-coordinate ``name:r:ci`` windows spread over stripes the same
+    way on every rank."""
+    return zlib.crc32(name) % max(1, int(n_stripes))
+
+
+class StripedDepositStream:
+    """N parallel :class:`DepositStream` connections to ONE peer, striped
+    by window name — the line-rate DCN shape: one TCP stream serializes
+    every frame through one sender thread and one server-side applier,
+    while N stripes give the peer N senders, N connections, and N
+    concurrent appliers.  The sharded path's per-coordinate
+    ``name:r:ci`` windows are the natural stripe unit (:func:`stripe_of`
+    spreads coordinates deterministically); a dense run's per-leaf
+    window names spread the same way.
+
+    Duck-types the :class:`DepositStream` surface
+    (``deposit_async``/``flush``/``close``/``ack_ewma``/``phase_ewma``/
+    ``health``/``reconnects``/``set_codec``), so it drops into
+    ``PipelinedRemoteWindow(stream=...)`` unchanged.  Routing is sticky
+    per window name at a given stripe count, so one window's deposits
+    never reorder; :meth:`flush` fences EVERY stripe, preserving the
+    round-boundary audit discipline.
+
+    The stripe count and per-stripe coalescing cap are the autotuner's
+    knobs: :meth:`apply_plan` actuates a
+    :class:`~bluefog_tpu.control.transport.TransportPlan` at a ROUND
+    BOUNDARY (the BF-CTL001 lint holds call sites to round-boundary
+    vocabulary, like every other plan).  Growing opens fresh stripes
+    (``stripe_open`` blackbox event); shrinking fences the closing
+    stripes first, so no deposit strands (``stripe_close``).
+
+    Per-stripe ack-latency EWMAs roll up into the ONE existing
+    ``bf_peer_ack_ewma_seconds{peer=}`` gauge as the max over live
+    stripes — the slow-peer detector (PR 8) keeps working unchanged:
+    a peer is as slow as its slowest stripe.  NOT thread-safe for
+    concurrent producers (same contract as one stream)."""
+
+    def __init__(self, address: Tuple[str, int],
+                 timeout_s: float = 30.0, *, n_stripes: int = 2,
+                 max_stripes: int = 16, **stream_kwargs):
+        if "on_ewma" in stream_kwargs:
+            raise ValueError("on_ewma is owned by the striping rollup")
+        self._addr = (address[0], int(address[1]))
+        self._peer = f"{address[0]}:{address[1]}"
+        self._timeout_s = float(timeout_s)
+        self._kw = dict(stream_kwargs)
+        self._max_stripes = max(1, int(max_stripes))
+        self._plan_version = 0
+        # written by each stripe's ack thread, read anywhere: per-slot
+        # float stores + a max over a snapshot — GIL-atomic, worst case
+        # a reader sees a value one update stale
+        self._ewmas: List[Optional[float]] = []
+        self._ack_ewma: Optional[float] = None
+        self._stripes: List[DepositStream] = []
+        try:
+            for _ in range(max(1, min(int(n_stripes), self._max_stripes))):
+                self._open_stripe()
+        except BaseException:
+            self.close()
+            raise
+
+    # ----------------------------------------------------- stripe pool
+    def _open_stripe(self) -> None:
+        i = len(self._stripes)
+        self._ewmas.append(None)
+        self._stripes.append(DepositStream(
+            self._addr, self._timeout_s,
+            on_ewma=(lambda e, i=i: self._roll_up(i, e)), **self._kw))
+        _bb.record("stripe_open", peer=self._peer, stripe=i)
+        _mt.set("bf_stripe_streams", float(len(self._stripes)),
+                peer=self._peer)
+
+    def _roll_up(self, i: int, ewma: float) -> None:
+        # max-of-stripes: the peer's effective ack latency is its
+        # slowest stripe's — an optimistic mean would hide exactly the
+        # stripe a slow-peer detector needs to see
+        self._ewmas[i] = ewma
+        vals = [v for v in self._ewmas[:len(self._stripes)]
+                if v is not None]
+        if vals:
+            mx = max(vals)
+            self._ack_ewma = mx  # bfverify: shared-ok single float-ref store under the GIL; ack threads race benignly (last writer wins a max over the same snapshot)
+            _mt.set("bf_peer_ack_ewma_seconds", mx, peer=self._peer)
+
+    @property
+    def n_stripes(self) -> int:
+        """Live stripe connections (gauge twin:
+        ``bf_stripe_streams{peer=}``)."""
+        return len(self._stripes)
+
+    def apply_plan(self, plan) -> None:
+        """Actuate a :class:`~bluefog_tpu.control.transport.
+        TransportPlan` at a ROUND BOUNDARY: resize the stripe pool and
+        retune every stripe's coalescing cap.  Shrinking fences the
+        closing stripes before closing them, so actuation never strands
+        a deposit — the exact-mass audit holds through every retune."""
+        want = max(1, min(int(plan.stripes), self._max_stripes))
+        while len(self._stripes) < want:
+            self._open_stripe()
+        if want < len(self._stripes):
+            for s in self._stripes[want:]:
+                s.flush()
+            for i in range(len(self._stripes) - 1, want - 1, -1):
+                self._stripes[i].close()
+                self._ewmas[i] = None
+                _bb.record("stripe_close", peer=self._peer, stripe=i)
+            del self._stripes[want:]
+            _mt.set("bf_stripe_streams", float(len(self._stripes)),
+                    peer=self._peer)
+        for s in self._stripes:
+            s.set_max_batch_bytes(plan.coalesce_bytes)
+        self._plan_version = int(plan.version)
+
+    @property
+    def plan_version(self) -> int:
+        """Version of the TransportPlan last actuated (0 = launch)."""
+        return self._plan_version
+
+    # ------------------------------------------- DepositStream surface
+    def deposit_async(self, name: bytes, slot: int, arr: np.ndarray, *,
+                      accumulate: bool = True, copy: bool = True,
+                      drain: bool = False) -> None:
+        self._stripes[stripe_of(name, len(self._stripes))].deposit_async(
+            name, slot, arr, accumulate=accumulate, copy=copy,
+            drain=drain)
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Fence across ALL stripes: every prior deposit on every stripe
+        is applied when this returns — the audit sees one quiesced peer,
+        however many connections carried it."""
+        for s in self._stripes:
+            s.flush(timeout_s)
+
+    def set_codec(self, codec: Optional[str]) -> None:
+        for s in self._stripes:
+            s.set_codec(codec)
+
+    def set_max_batch_bytes(self, n: int) -> None:
+        for s in self._stripes:
+            s.set_max_batch_bytes(n)
+
+    def ack_ewma(self) -> Optional[float]:
+        """Max-of-stripes ack-latency EWMA (see class docstring)."""
+        return self._ack_ewma
+
+    def phase_ewma(self) -> Optional[Dict[str, float]]:
+        """Elementwise MAX over stripes' {net, queue, apply} EWMAs —
+        conservative: the phase split of the peer's worst case."""
+        out: Optional[Dict[str, float]] = None
+        for s in self._stripes:
+            p = s.phase_ewma()
+            if p is None:
+                continue
+            if out is None:
+                out = dict(p)
+            else:
+                for k, v in p.items():
+                    out[k] = max(out[k], v)
+        return out
+
+    @property
+    def health(self):
+        """Peer health of stripe 0 (all stripes share the peer; one
+        health machine is the peer's — extra stripes carry payload,
+        not liveness)."""
+        return self._stripes[0].health if self._stripes else None
+
+    @property
+    def reconnects(self) -> int:
+        """Sum of completed reconnect cycles across stripes."""
+        return sum(s.reconnects for s in self._stripes)
+
+    @property
+    def shm_deposits(self) -> int:
+        return sum(s.shm_deposits for s in self._stripes)
+
+    @property
+    def ack_latencies(self):
+        """Stripe 0's recent ack latencies (bench/observability parity;
+        per-stripe deques stay accessible via the stripes themselves)."""
+        return self._stripes[0].ack_latencies
+
+    def close(self) -> None:
+        """Close every stripe.  Does NOT flush — fence first when
+        exactness matters (same contract as one stream)."""
+        for i in range(len(self._stripes) - 1, -1, -1):
+            try:
+                self._stripes[i].close()
+            finally:
+                _bb.record("stripe_close", peer=self._peer, stripe=i)
+        self._stripes = []
+        _mt.set("bf_stripe_streams", 0.0, peer=self._peer)
 
 
 class PipelinedRemoteWindow:
@@ -2824,12 +3252,15 @@ class PipelinedRemoteWindow:
                  heartbeat_interval_s: Optional[float] = None,
                  suspect_after_s: Optional[float] = None,
                  dead_after_s: Optional[float] = None,
+                 shm: Optional[bool] = None,
                  stream: Optional[DepositStream] = None,
                  sync_retry=None):
         """``sync_retry`` configures the SYNC connection's bounded
         retry for idempotent reads (see :class:`RemoteWindow`); it is
         independent of ``stream=`` because every handle owns its sync
-        connection even when the deposit stream is shared."""
+        connection even when the deposit stream is shared.  ``shm=True``
+        opts the owned stream into the same-host shared-memory fast
+        path (see :class:`DepositStream`)."""
         self.name = name
         self._name_b = name.encode()
         if stream is not None and any(
@@ -2837,14 +3268,15 @@ class PipelinedRemoteWindow:
                                         max_queue_items, max_batch_bytes,
                                         reconnect,
                                         heartbeat_interval_s,
-                                        suspect_after_s, dead_after_s)):
+                                        suspect_after_s, dead_after_s,
+                                        shm)):
             # a shared stream carries ITS configuration; accepting these
             # kwargs here would silently ignore them (e.g. codec='f32'
             # riding an uncompressed stream)
             raise ValueError(
                 "stream= is mutually exclusive with codec/topk_ratio/"
                 "max_in_flight/max_queue_items/max_batch_bytes/reconnect/"
-                "heartbeat_interval_s/suspect_after_s/dead_after_s — "
+                "heartbeat_interval_s/suspect_after_s/dead_after_s/shm — "
                 "configure the shared DepositStream itself")
         self._sync = RemoteWindow(address, name, timeout_s,
                                   retry=sync_retry)
@@ -2867,7 +3299,8 @@ class PipelinedRemoteWindow:
                 suspect_after_s=(2.0 if suspect_after_s is None
                                  else suspect_after_s),
                 dead_after_s=(20.0 if dead_after_s is None
-                              else dead_after_s))
+                              else dead_after_s),
+                shm=bool(shm))
         except BaseException:
             # a rejected handshake (version/feature) must not leak the
             # already-open sync connection and its server handler thread

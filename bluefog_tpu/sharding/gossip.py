@@ -120,7 +120,12 @@ def run_sharded_gossip(
     out_nbrs = [list(topology.out_neighbors(r)) for r in range(n)]
     slot_of = [{src: k for k, src in enumerate(in_nbrs[r])} for r in range(n)]
 
-    # one window per (rank, coordinate): the shard-local landing zone
+    # one window per (rank, coordinate): the shard-local landing zone.
+    # The ``name:r:ci`` naming is also the DCN STRIPE UNIT — over the
+    # striped transport, :func:`~bluefog_tpu.runtime.window_server.
+    # stripe_of` spreads a rank's per-coordinate windows deterministically
+    # across a StripedDepositStream's parallel connections, so one owner's
+    # coordinates ride N senders/appliers instead of serializing on one
     wins: List[List[AsyncWindow]] = []
     try:
         for r in range(n):

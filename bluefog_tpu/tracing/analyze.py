@@ -203,11 +203,16 @@ def round_report(graph: dict) -> dict:
                       if not s.get("open"))
             if sub:
                 phases[ph] = tot / max(1, len(durs))
+        # round spans emitted by an overlap-enabled runner carry the
+        # measured per-round hidden/total fold split
+        ovs = [float(s["overlap"]) for s in lst
+               if not s.get("open") and s.get("overlap") is not None]
         per_rank[int(rank)] = {
             "rounds": len(durs),
             "round_mean_s": _mean(durs),
             "round_max_s": max(durs) if durs else 0.0,
             "phase_mean_s": phases,
+            **({"overlap_mean": _mean(ovs)} if ovs else {}),
         }
     straggler = sorted(per_rank,
                        key=lambda r: -per_rank[r]["round_mean_s"])
@@ -468,7 +473,9 @@ def _format_report(rep: dict, directory: str) -> str:
         lines.append(
             f"rank {rank}: {st['rounds']} round(s), mean "
             f"{st['round_mean_s'] * 1e3:.1f}ms"
-            + (f" ({ph})" if ph else ""))
+            + (f" ({ph})" if ph else "")
+            + (f", fold overlap {_pct(st['overlap_mean'])}"
+               if "overlap_mean" in st else ""))
     if rr["straggler_ranking"]:
         lines.append("straggler ranking (slowest first): "
                      + ", ".join(map(str, rr["straggler_ranking"])))

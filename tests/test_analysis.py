@@ -582,6 +582,57 @@ class TestWindowLint:
         assert not [d for d in report.warnings
                     if d.code.startswith("BF-WIN")], report.format()
 
+    def test_seeded_violation_mid_step_staged_apply(self):
+        # BF-WIN004: folding the overlap buffer's staged round-(k-1)
+        # mass from a hot-loop helper with no boundary vocabulary —
+        # stale mixing applied mid-step
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+
+        src = (
+            "def step(db, x, p):\n"
+            "    staged, busy = db.apply_staged()\n"
+            "    for k, buf, fresh in staged:\n"
+            "        x += buf[:-1]\n"
+            "        p += buf[-1]\n"
+        )
+        diags = check_pipelined_flush(src, filename="seeded.py")
+        assert any(d.code == "BF-WIN004" and d.severity == "error"
+                   for d in diags), [d.format() for d in diags]
+
+    def test_boundary_named_staged_apply_is_clean(self):
+        # the sanctioned shape: the apply lives in a function whose name
+        # carries the round-boundary vocabulary (the runner's
+        # fold_staged_at_round_boundary closure); module level is NOT ok
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+
+        src = (
+            "def fold_staged_at_round_boundary(db, x, p):\n"
+            "    staged, busy = db.apply_staged()\n"
+            "    for k, buf, fresh in staged:\n"
+            "        x += buf[:-1]\n"
+            "        p += buf[-1]\n"
+            "    return p\n"
+        )
+        assert not check_pipelined_flush(src, filename="clean.py")
+        diags = check_pipelined_flush("db.apply_staged()\n",
+                                      filename="mod.py")
+        assert [d.code for d in diags] == ["BF-WIN004"]
+
+    def test_overlap_apply_sites_are_boundary_only_in_repo(self):
+        # repo-clean: both runners' overlap folds must keep their
+        # boundary-vocabulary names — a rename or a new mid-loop call
+        # site of apply_staged trips this before it ships
+        import inspect
+
+        from bluefog_tpu.analysis.window_lint import check_pipelined_flush
+        from bluefog_tpu.runtime import async_windows
+
+        src = inspect.getsource(async_windows)
+        assert "apply_staged" in src  # the overlap path exists
+        diags = check_pipelined_flush(src, filename="async_windows.py")
+        assert not [d for d in diags if d.code == "BF-WIN004"], \
+            [d.format() for d in diags]
+
 
 # ---------------------------------------------------------------------------
 # BF-RES: reconnect/retry loops must carry a budget or deadline

@@ -874,5 +874,5 @@ class TestCommittedBenchGates:
                 gated.append(fname)
                 assert bench_gate_failures(doc) == []
         for expected in ("BENCH_control.json", "BENCH_fleet.json",
-                         "BENCH_sim.json"):
+                         "BENCH_sim.json", "BENCH_transport.json"):
             assert expected in gated, (expected, gated)

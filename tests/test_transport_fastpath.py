@@ -1,0 +1,539 @@
+"""Hot-path raw speed: shm fast path, striped DCN, autotune, overlap.
+
+The three raw-speed attacks of the transport hot path, each tested
+against the invariant it is NOT allowed to spend — the exact push-sum
+mass audit:
+
+1. **same-host shm fast path** — ``DepositStream(shm=True)`` routes
+   deposits through the named-shm window table instead of loopback TCP,
+   falling back transparently (``shm_fallback`` blackbox event) on any
+   capability failure, and recovering torn shm writes by re-delivery
+   over the wire — exactly once either way;
+2. **striped DCN** — ``StripedDepositStream`` spreads window names over
+   N parallel connections (``stripe_of``), fences ALL stripes on flush,
+   actuates ``TransportPlan`` grow/shrink without stranding a deposit,
+   and rolls per-stripe ack EWMAs up into the one
+   ``bf_peer_ack_ewma_seconds{peer=}`` gauge as max-of-stripes (the PR-8
+   slow-peer detector reads it unchanged);
+3. **compute/gossip overlap** — :class:`DoubleBuffer` stages landed
+   deposits under compute and folds them at the round boundary in slot
+   order, bit-identical to the serial fold over the same deposits.
+
+Plus the pure autotune decision function's hysteresis/no-flap/cooldown
+properties and the MP acceptance scenario (kill-one-rank under the shm
+route, exact audit — ``_mp_fastpath_worker.py``).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.runtime import native
+from tests._util import REPO as _REPO, clean_env, uniq as _uniq
+
+_NATIVE = native.load() is not None
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolated():
+    from bluefog_tpu import chaos
+
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _serve(names, n_elems=8, *, shm=False):
+    """Owner-side window table + server in THIS process (the depositing
+    stream still runs its full client path against it)."""
+    from bluefog_tpu.runtime.async_windows import (AsyncWindow,
+                                                   shm_unlink_window)
+    from bluefog_tpu.runtime.window_server import WindowServer
+
+    wins = {}
+    for nm in names:
+        if shm:
+            shm_unlink_window(nm)
+        wins[nm] = AsyncWindow(nm, n_slots=1, n_elems=n_elems,
+                               dtype=np.float64, shm=shm)
+    srv = WindowServer()
+    _, port = srv.start("127.0.0.1")
+    return wins, srv, port
+
+
+# ---------------------------------------------------------------------------
+# 1. same-host shm fast path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not _NATIVE, reason="shm windows need native runtime")
+class TestShmFastPath:
+    def test_shm_deposits_exactly_once_and_metered(self):
+        from bluefog_tpu.metrics import registry as mreg
+        from bluefog_tpu.runtime.window_server import DepositStream
+
+        name = _uniq("fp_shm")
+        wins, srv, port = _serve([name], shm=True)
+        reg = mreg.metrics_start()
+        st = DepositStream(("127.0.0.1", port), shm=True)
+        try:
+            total = np.zeros(8)
+            for i in range(15):
+                v = np.full(8, float(i + 1))
+                st.deposit_async(name.encode(), 0, v, accumulate=True)
+                total += v
+            st.flush(timeout_s=30)
+            got, fresh = wins[name].read(0, consume=False)
+            # EXACT value and EXACT apply count through the table route
+            assert np.array_equal(got, total)
+            assert fresh == 15
+            # every deposit really rode shm, none silently fell to TCP
+            assert st.shm_deposits == 15
+            snap = reg.snapshot()
+            assert any(k.startswith("bf_shm_deposits_total") and v == 15.0
+                       for k, v in snap.items()), snap
+        finally:
+            mreg.metrics_stop()
+            st.close()
+            srv.stop()
+            for w in wins.values():
+                w.free()
+
+    def test_fallback_when_owner_windows_not_shm(self):
+        # the detection-failure path: owner's windows are process-local
+        # (not shm-backed) — the stream latches shm off after one probe,
+        # records the blackbox breadcrumb, and the deposits land over
+        # TCP with identical semantics
+        from bluefog_tpu.blackbox import recorder as bb
+        from bluefog_tpu.runtime.window_server import DepositStream
+
+        name = _uniq("fp_fall")
+        wins, srv, port = _serve([name], shm=False)
+        rec = bb.configure(rank=0)
+        st = DepositStream(("127.0.0.1", port), shm=True)
+        try:
+            total = np.zeros(8)
+            for i in range(8):
+                v = np.full(8, float(i + 1))
+                st.deposit_async(name.encode(), 0, v, accumulate=True)
+                total += v
+            st.flush(timeout_s=30)
+            got, fresh = wins[name].read(0, consume=False)
+            assert np.array_equal(got, total)
+            assert fresh == 8
+            assert st.shm_deposits == 0
+            kinds = [e["kind"] for e in rec.events()]
+            assert "shm_fallback" in kinds, kinds
+        finally:
+            bb.reset()
+            st.close()
+            srv.stop()
+            for w in wins.values():
+                w.free()
+
+    def test_remote_host_is_never_probed(self):
+        # the detection rule itself: loopback/local names say yes, a
+        # TEST-NET-3 address (guaranteed not this machine) says no —
+        # so a cross-host stream never even probes for shm windows
+        from bluefog_tpu.runtime.window_server import _is_local_host
+
+        assert not _is_local_host("203.0.113.7")
+        assert _is_local_host("127.0.0.1")
+        assert _is_local_host("localhost")
+
+    def test_torn_shm_write_redelivers_over_tcp_exactly_once(self):
+        # the torn-write model: a chaos 'client' fault fires BEFORE the
+        # atomic table accumulate, so the shm write is absent (never
+        # half-applied); recovery is re-delivery of THAT deposit over
+        # the TCP wire — total applied exactly once
+        from bluefog_tpu import chaos
+        from bluefog_tpu.blackbox import recorder as bb
+        from bluefog_tpu.runtime.window_server import DepositStream
+
+        name = _uniq("fp_torn")
+        wins, srv, port = _serve([name], shm=True)
+        rec = bb.configure(rank=0)
+        chaos.configure("client:truncate:times=1")
+        st = DepositStream(("127.0.0.1", port), shm=True)
+        try:
+            total = np.zeros(8)
+            for i in range(10):
+                v = np.full(8, float(i + 1))
+                st.deposit_async(name.encode(), 0, v, accumulate=True)
+                total += v
+            st.flush(timeout_s=30)
+            got, fresh = wins[name].read(0, consume=False)
+            # the torn deposit arrived over TCP, everything else over
+            # shm — and the window saw each deposit exactly once
+            assert np.array_equal(got, total)
+            assert fresh == 10
+            assert st.shm_deposits < 10
+            kinds = [e["kind"] for e in rec.events()]
+            assert "shm_fallback" in kinds, kinds
+        finally:
+            bb.reset()
+            st.close()
+            srv.stop()
+            for w in wins.values():
+                w.free()
+
+
+# ---------------------------------------------------------------------------
+# 2. striped DCN stream
+# ---------------------------------------------------------------------------
+
+
+class TestStripedStream:
+    def test_striped_routing_exactly_once_across_windows(self):
+        from bluefog_tpu.runtime.window_server import (StripedDepositStream,
+                                                       stripe_of)
+
+        names = [_uniq(f"fp_str{i}") for i in range(6)]
+        wins, srv, port = _serve(names)
+        st = StripedDepositStream(("127.0.0.1", port), n_stripes=3)
+        try:
+            assert st.n_stripes == 3
+            # the name set must actually exercise >1 stripe for this to
+            # test routing (deterministic, so assert it)
+            stripes_hit = {stripe_of(nm.encode(), 3) for nm in names}
+            assert len(stripes_hit) > 1, stripes_hit
+            totals = {nm: np.zeros(8) for nm in names}
+            for i in range(8):
+                for nm in names:
+                    v = np.full(8, float(i + 1))
+                    st.deposit_async(nm.encode(), 0, v, accumulate=True)
+                    totals[nm] += v
+            st.flush(timeout_s=30)  # fences EVERY stripe
+            for nm in names:
+                got, fresh = wins[nm].read(0, consume=False)
+                assert np.array_equal(got, totals[nm]), nm
+                assert fresh == 8, nm
+        finally:
+            st.close()
+            srv.stop()
+            for w in wins.values():
+                w.free()
+
+    def test_apply_plan_grow_shrink_never_strands_a_deposit(self):
+        from bluefog_tpu.blackbox import recorder as bb
+        from bluefog_tpu.control import TransportPlan
+        from bluefog_tpu.metrics import registry as mreg
+        from bluefog_tpu.runtime.window_server import StripedDepositStream
+
+        names = [_uniq(f"fp_plan{i}") for i in range(4)]
+        wins, srv, port = _serve(names)
+        reg = mreg.metrics_start()
+        rec = bb.configure(rank=0)
+        st = StripedDepositStream(("127.0.0.1", port), n_stripes=1)
+        try:
+            totals = {nm: np.zeros(8) for nm in names}
+
+            def deposit_round(i):
+                for nm in names:
+                    v = np.full(8, float(i + 1))
+                    st.deposit_async(nm.encode(), 0, v, accumulate=True)
+                    totals[nm] += v
+
+            deposit_round(0)
+            st.apply_plan(TransportPlan(version=1, round=1, stripes=4,
+                                        coalesce_bytes=1 << 20))
+            assert st.n_stripes == 4
+            assert st.plan_version == 1
+            deposit_round(1)
+            # shrink FENCES the closing stripes before closing them —
+            # round 1's deposits on stripes 1-3 must not strand
+            st.apply_plan(TransportPlan(version=2, round=2, stripes=1,
+                                        coalesce_bytes=4 << 20))
+            assert st.n_stripes == 1
+            deposit_round(2)
+            st.flush(timeout_s=30)
+            for nm in names:
+                got, fresh = wins[nm].read(0, consume=False)
+                assert np.array_equal(got, totals[nm]), nm
+                assert fresh == 3, nm
+            peer = f"127.0.0.1:{port}"
+            snap = reg.snapshot()
+            assert snap.get(f'bf_stripe_streams{{peer="{peer}"}}') == 1.0
+            kinds = [e["kind"] for e in rec.events()]
+            assert "stripe_open" in kinds and "stripe_close" in kinds
+        finally:
+            st.close()
+            snap = mreg.current().snapshot()
+            # gauge zeroed on close: a dead stream advertises no stripes
+            assert snap.get(
+                f'bf_stripe_streams{{peer="127.0.0.1:{port}"}}') == 0.0
+            bb.reset()
+            mreg.metrics_stop()
+            srv.stop()
+            for w in wins.values():
+                w.free()
+
+    def test_ack_ewma_rollup_is_max_of_stripes(self):
+        from bluefog_tpu.metrics import registry as mreg
+        from bluefog_tpu.runtime.window_server import StripedDepositStream
+
+        name = _uniq("fp_ewma")
+        wins, srv, port = _serve([name])
+        reg = mreg.metrics_start()
+        st = StripedDepositStream(("127.0.0.1", port), n_stripes=2)
+        try:
+            for i in range(6):
+                st.deposit_async(name.encode(), 0, np.ones(8),
+                                 accumulate=True)
+                st.flush(timeout_s=30)
+            # rollup: the stream-level EWMA is the max over stripes that
+            # have evidence, and it feeds the ONE per-peer gauge the
+            # slow-peer detector polls
+            per_stripe = [s.ack_ewma() for s in st._stripes
+                          if s.ack_ewma() is not None]
+            assert per_stripe, "no stripe collected ack evidence"
+            assert st.ack_ewma() == max(per_stripe)
+            snap = reg.snapshot()
+            key = f'bf_peer_ack_ewma_seconds{{peer="127.0.0.1:{port}"}}'
+            assert snap.get(key) == st.ack_ewma(), snap
+        finally:
+            st.close()
+            mreg.metrics_stop()
+            srv.stop()
+            for w in wins.values():
+                w.free()
+
+
+# ---------------------------------------------------------------------------
+# 3. transport autotune (pure decision function)
+# ---------------------------------------------------------------------------
+
+
+class TestTransportAutotune:
+    def _p0(self, **kw):
+        from bluefog_tpu.control import TransportPlan
+
+        return TransportPlan(**kw)
+
+    def test_widen_on_slow_net_dominated_acks(self):
+        from bluefog_tpu.control import decide_transport_plan
+
+        p0 = self._p0()
+        p1 = decide_transport_plan(
+            p0, 10, ack_ewma_s=0.08,
+            phase_s={"net": 0.06, "queue": 0.01, "apply": 0.01})
+        assert (p1.stripes, p1.version) == (2, 1)
+        assert p1.coalesce_bytes == p0.coalesce_bytes // 2
+
+    def test_slow_host_is_not_widened_into(self):
+        # apply/queue-dominated latency: more stripes would just queue
+        # more at the same busy owner — plan must not change
+        from bluefog_tpu.control import decide_transport_plan
+
+        p0 = self._p0()
+        p1 = decide_transport_plan(
+            p0, 10, ack_ewma_s=0.08,
+            phase_s={"net": 0.01, "queue": 0.03, "apply": 0.04})
+        assert p1 is p0
+
+    def test_hysteresis_band_never_flaps(self):
+        # evidence oscillating BETWEEN the exit and enter thresholds:
+        # the plan must stay byte-stable through the whole sweep
+        from bluefog_tpu.control import (TransportConfig,
+                                         decide_transport_plan)
+
+        cfg = TransportConfig()
+        plan = self._p0(version=3, round=0, stripes=2)
+        for r, ack in enumerate([0.021, 0.049, 0.030, 0.045, 0.025],
+                                start=cfg.cooldown_rounds):
+            nxt = decide_transport_plan(plan, r, ack_ewma_s=ack, cfg=cfg)
+            assert nxt is plan, (r, ack)
+
+    def test_cooldown_freezes_a_fresh_plan(self):
+        from bluefog_tpu.control import decide_transport_plan
+
+        p1 = decide_transport_plan(
+            self._p0(), 10, ack_ewma_s=0.08)
+        assert p1.version == 1 and p1.round == 10
+        # violently slow evidence inside the cooldown: frozen
+        p2 = decide_transport_plan(p1, 10 + 15, ack_ewma_s=0.5)
+        assert p2 is p1
+        p3 = decide_transport_plan(p1, 10 + 16, ack_ewma_s=0.5)
+        assert p3.version == 2 and p3.stripes == 4
+
+    def test_narrow_on_recovery_and_floor_saturation(self):
+        from bluefog_tpu.control import decide_transport_plan
+
+        wide = self._p0(version=5, round=0, stripes=4,
+                        coalesce_bytes=1 << 20)
+        p1 = decide_transport_plan(wide, 100, ack_ewma_s=0.001)
+        assert (p1.stripes, p1.version) == (2, 6)
+        floor = self._p0(version=7, round=0, stripes=1,
+                         coalesce_bytes=16 << 20)
+        p2 = decide_transport_plan(floor, 100, ack_ewma_s=0.001)
+        assert p2 is floor  # saturated at the floor: no version churn
+
+    def test_no_evidence_never_tunes(self):
+        from bluefog_tpu.control import decide_transport_plan
+
+        p0 = self._p0()
+        assert decide_transport_plan(p0, 50, ack_ewma_s=None) is p0
+
+    def test_plan_canonical_bytes_roundtrip(self):
+        from bluefog_tpu.control import TransportPlan
+
+        p = TransportPlan(version=9, round=144, stripes=8,
+                          coalesce_bytes=1 << 19)
+        q = TransportPlan.from_bytes(p.to_bytes())
+        assert p == q and p.to_bytes() == q.to_bytes()
+
+
+# ---------------------------------------------------------------------------
+# 4. compute/gossip overlap (DoubleBuffer)
+# ---------------------------------------------------------------------------
+
+
+class TestOverlapBuffer:
+    def _window(self, name, slots=3, n=9):
+        from bluefog_tpu.runtime.async_windows import AsyncWindow
+
+        return AsyncWindow(name, n_slots=slots, n_elems=n,
+                           dtype=np.float64)
+
+    def test_fold_is_bit_identical_to_serial_at_fixed_seed(self):
+        # the byte-identity contract: the staged fold applies the SAME
+        # floating-point op sequence as the serial consume over the same
+        # landed deposits — per-slot accumulation in deposit order
+        # (done by the window table in both paths), fold in slot order
+        from bluefog_tpu.runtime.async_windows import DoubleBuffer
+
+        rng = np.random.default_rng(7)
+        deposits = [(k, rng.standard_normal(9)) for k in (0, 1, 1, 2, 0)]
+
+        # serial: land everything, read slots in order, fold
+        win_s = self._window(_uniq("fp_ser"))
+        try:
+            for k, v in deposits:
+                win_s.deposit(k, v, accumulate=True)
+            x_s = np.zeros(8)
+            p_s = 1.0
+            for k in range(3):
+                buf, fresh = win_s.read(k, consume=True)
+                if fresh > 0:
+                    x_s = x_s + buf[:-1]
+                    p_s = p_s + buf[-1]
+        finally:
+            win_s.free()
+
+        # overlapped: same deposits, harvester staged them under
+        # "compute", boundary fold in slot order
+        win_o = self._window(_uniq("fp_ovl"))
+        db = DoubleBuffer(win_o, [0, 1, 2], 9, poll_s=0.0001)
+        try:
+            db.begin()
+            for k, v in deposits:
+                win_o.deposit(k, v, accumulate=True)
+            deadline = time.time() + 5.0
+            while db.staged_mass() == 0.0 and time.time() < deadline:
+                db.begin()
+                time.sleep(0.002)
+            staged, _busy = db.apply_staged()
+            x_o = np.zeros(8)
+            p_o = 1.0
+            for k, buf, fresh in staged:
+                if fresh > 0:
+                    x_o = x_o + buf[:-1]
+                    p_o = p_o + buf[-1]
+        finally:
+            db.close()
+            win_o.free()
+
+        # bit-identical, not merely close
+        assert np.array_equal(x_s, x_o)
+        assert p_s == p_o
+
+    def test_close_returns_leftovers_and_is_idempotent(self):
+        from bluefog_tpu.runtime.async_windows import DoubleBuffer
+
+        win = self._window(_uniq("fp_close"))
+        db = DoubleBuffer(win, [0, 1, 2], 9, poll_s=0.0001)
+        try:
+            db.begin()
+            win.deposit(1, np.full(9, 2.0), accumulate=True)
+            deadline = time.time() + 5.0
+            while db.staged_mass() == 0.0 and time.time() < deadline:
+                time.sleep(0.002)
+            left = db.close()
+            assert [k for k, _, _ in left] == [1]
+            assert float(left[0][1][-1]) == 2.0
+            assert db.close() == []  # idempotent, nothing double-drained
+        finally:
+            win.free()
+
+    def test_overlap_run_exact_mass_and_gauge_thread_mode(self):
+        # the runner-level invariant: overlap moves WHEN mixing applies,
+        # never mass — a full thread-mode dsgd run with the harvester on
+        # conserves sum(p) == n exactly, and reports the overlap gauge
+        from bluefog_tpu import topology as T
+        from bluefog_tpu.metrics import registry as mreg
+        from bluefog_tpu.runtime.async_windows import run_async_dsgd
+
+        reg = mreg.metrics_start()
+        try:
+            rep = run_async_dsgd(
+                T.RingGraph(4), np.ones(6),
+                lambda r, s, z: (float(z @ z), 2 * z),
+                duration_s=10.0, stop_after_steps=25,
+                name=_uniq("fp_run"), overlap=True)
+            assert abs(rep.total_mass - 4.0) < 1e-9, rep.total_mass
+            # stop_after_steps halts the RUN when the first rank hits
+            # the cap; every rank must still have made progress
+            assert max(rep.steps_per_rank) >= 25, rep.steps_per_rank
+            assert min(rep.steps_per_rank) > 0, rep.steps_per_rank
+            snap = reg.snapshot()
+            ovs = {k: v for k, v in snap.items()
+                   if k.startswith("bf_overlap_fraction")}
+            assert ovs, snap  # per-rank gauge was published
+            assert all(0.0 <= v <= 1.0 for v in ovs.values()), ovs
+        finally:
+            mreg.metrics_stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. multi-process acceptance: kill-one-rank under the shm route
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.skipif(not _NATIVE, reason="shm windows need native runtime")
+@pytest.mark.duration_budget(60)  # MP acceptance scenario; subprocess startup dominates
+def test_mp_kill_one_rank_shm_route_exact_audit():
+    """One of three rank PROCESSES is SIGKILLed mid-dsgd while deposits
+    ride the same-host shm fast path and a server-side chaos drop churns
+    the TCP leg: survivors heal and rank 0's post-heal mass audit is
+    EXACT, with ``bf_shm_deposits_total`` proving the audit really ran
+    through shared memory (see ``_mp_fastpath_worker.py``)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as bdir:
+        worker = os.path.join(_REPO, "tests", "_mp_fastpath_worker.py")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(r), "3", bdir, "3.5"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=clean_env(), cwd=_REPO)
+            for r in range(3)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("fastpath MP workers timed out:\n"
+                        + "\n".join(o or "" for o in outs))
+    assert procs[2].returncode == -9, (procs[2].returncode, outs[2])
+    for r in (0, 1):
+        assert procs[r].returncode == 0, f"worker {r} failed:\n{outs[r]}"
+        assert f"FP_MP_OK {r}" in outs[r], outs[r]
